@@ -1,0 +1,36 @@
+"""ccka_tpu — TPU-native cost- and carbon-aware cluster autoscaling framework.
+
+A brand-new JAX/XLA/pjit framework with the capabilities of
+`vedantsawal/Cost-and-Carbon-Aware-Kubernetes-Autoscaler` (reference at
+/root/reference): a closed feedback loop reading service-health metrics
+(Prometheus), cost ($/hr, OpenCost) and grid carbon intensity, deciding the
+cheapest/cleanest cluster configuration that meets SLOs, and actuating it as
+Karpenter NodePool patches, HPA replica targets, and KEDA triggers.
+
+Where the reference hand-codes two bash rule profiles
+(`demo_20_offpeak_configure.sh`, `demo_21_peak_configure.sh`), this framework
+makes the decision step a pluggable :class:`~ccka_tpu.policy.base.PolicyBackend`:
+the rule engine is retained as the CPU reference, and TPU backends treat
+autoscaling as batched differentiable control over a replayable cluster
+simulator (`vmap` over thousands of clusters, `lax.scan` over the control
+horizon, `pjit`/`shard_map` over the device mesh).
+
+Subpackages
+-----------
+- ``config``     typed config system (replaces the reference's .env scheme,
+                 `00_common.sh:5-24`)
+- ``signals``    SignalSource interface: synthetic / replay / live Prometheus,
+                 OpenCost, carbon-intensity backends (`06_opencost.sh`, `.env:14-16`)
+- ``sim``        batched JAX cluster simulator (Karpenter/scheduler dynamics)
+- ``policy``     PolicyBackend interface, rule reference, feasibility constraints
+- ``models``     flax policy networks (MLP, actor-critic, MPC controller)
+- ``train``      diff-MPC and PPO training loops, orbax checkpointing
+- ``ops``        pallas TPU kernels for hot simulator ops
+- ``parallel``   mesh construction, sharding specs, multi-host collectives
+- ``actuation``  NodePool/HPA/KEDA patch emitters + dry-run and kubectl sinks
+- ``harness``    preroll checks, paired configure/observe lifecycle, telemetry
+"""
+
+__version__ = "0.1.0"
+
+from ccka_tpu.config import FrameworkConfig, default_config  # noqa: F401

@@ -1,0 +1,447 @@
+"""Typed, validated configuration system.
+
+Replaces the reference's layered env-var scheme — `.env` file sourced by
+`00_common.sh:5`, defaults-if-unset (`00_common.sh:8-10`), hard `require_var`
+validation (`00_common.sh:18-20`), per-script tunables
+(`demo_30_burst_configure.sh:7-8`), and the demo env with live AWS lookup
+(`demo_00_env.sh:13-15`) — with frozen dataclasses, a single validation pass,
+`CCKA_*` environment overrides, and dict/JSON round-tripping.
+
+Design notes (TPU-first): everything that reaches the device is resolved here
+into *static* shapes and floats — pool/zone counts, horizon lengths, pod/node
+capacities — so that downstream `jit`/`scan`/`vmap` traces never see dynamic
+shapes. The config is hashable (tuples, not lists) and can be passed as a
+static argument to jitted functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any, Mapping, Tuple
+
+ENV_PREFIX = "CCKA_"
+
+
+class ConfigError(ValueError):
+    """Raised on invalid configuration — analog of `require_var` hard-fail
+    (`00_common.sh:18-20`)."""
+
+
+# ---------------------------------------------------------------------------
+# Leaf specs
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class NodeTypeSpec:
+    """An instance-type capacity/price model.
+
+    Defaults model the reference cluster's `m6i.large` (`.env:6`,
+    `01_cluster.sh:24-35`): 2 vCPU / 8 GiB, us-east-2 on-demand ≈ $0.096/hr.
+    ``watts_idle``/``watts_full`` give a linear power model for carbon
+    accounting (the reference never measured power; see BASELINE.md).
+    """
+
+    name: str = "m6i.large"
+    vcpu: float = 2.0
+    mem_gib: float = 8.0
+    od_price_hr: float = 0.096
+    spot_price_hr_mean: float = 0.035
+    watts_idle: float = 40.0
+    watts_full: float = 110.0
+    # vCPU reserved for system daemons (kubelet/CNI); the schedulable residue
+    # is what the bin-packing model sees.
+    system_reserved_vcpu: float = 0.2
+    system_reserved_mem_gib: float = 0.6
+
+    def validate(self) -> None:
+        if self.vcpu <= 0 or self.mem_gib <= 0:
+            raise ConfigError(f"node type {self.name}: non-positive capacity")
+        if self.system_reserved_vcpu >= self.vcpu:
+            raise ConfigError(f"node type {self.name}: reserved >= capacity")
+        if self.od_price_hr <= 0 or self.spot_price_hr_mean <= 0:
+            raise ConfigError(f"node type {self.name}: non-positive price")
+
+
+@dataclass(frozen=True)
+class PoolSpec:
+    """A Karpenter NodePool analog.
+
+    The reference defines two pools, `spot-preferred` and `on-demand-slo`
+    (`demo_00_env.sh:18-19`), labeled `autoscale.strategy=cost|slo` and
+    `carbon.simulated=low|medium` (`demo_10_setup_configure.sh:59-62`).
+    ``capacity_types`` is the allowed `karpenter.sh/capacity-type` set as
+    patched by the profiles (`demo_20_offpeak_configure.sh:74-78`).
+    """
+
+    name: str
+    strategy: str  # "cost" | "slo"
+    capacity_types: Tuple[str, ...] = ("spot", "on-demand")
+    max_nodes: int = 64
+
+    def validate(self) -> None:
+        if self.strategy not in ("cost", "slo"):
+            raise ConfigError(f"pool {self.name}: bad strategy {self.strategy!r}")
+        for ct in self.capacity_types:
+            if ct not in ("spot", "on-demand"):
+                raise ConfigError(f"pool {self.name}: bad capacity type {ct!r}")
+        if not self.capacity_types:
+            raise ConfigError(f"pool {self.name}: empty capacity_types")
+        if self.max_nodes <= 0:
+            raise ConfigError(f"pool {self.name}: max_nodes must be positive")
+
+
+@dataclass(frozen=True)
+class ClusterConfig:
+    """Cluster topology: region/zones/pools/instance type.
+
+    Mirrors `.env:1-8` (cluster identity, min/max/desired sizes) and
+    `demo_00_env.sh:18-23` (pool names, zone preferences).
+    """
+
+    name: str = "demo1"
+    region: str = "us-east-2"
+    zones: Tuple[str, ...] = ("us-east-2a", "us-east-2b", "us-east-2c")
+    offpeak_zones: Tuple[str, ...] = ("us-east-2a",)
+    peak_zones: Tuple[str, ...] = ("us-east-2c",)
+    pools: Tuple[PoolSpec, ...] = (
+        PoolSpec(name="spot-preferred", strategy="cost"),
+        PoolSpec(name="on-demand-slo", strategy="slo",
+                 capacity_types=("on-demand",)),
+    )
+    node_type: NodeTypeSpec = field(default_factory=NodeTypeSpec)
+    # Managed nodegroup floor that Karpenter never touches (`.env:7-8`:
+    # min 2 / desired 3 / max 6 m6i.large).
+    base_nodes: int = 3
+
+    @property
+    def n_zones(self) -> int:
+        return len(self.zones)
+
+    @property
+    def n_pools(self) -> int:
+        return len(self.pools)
+
+    def pool_index(self, name: str) -> int:
+        for i, p in enumerate(self.pools):
+            if p.name == name:
+                return i
+        raise ConfigError(f"unknown pool {name!r}")
+
+    def validate(self) -> None:
+        if not self.zones:
+            raise ConfigError("cluster: no zones")
+        for z in self.offpeak_zones + self.peak_zones:
+            if z not in self.zones:
+                raise ConfigError(f"cluster: preference zone {z!r} not in zones")
+        names = [p.name for p in self.pools]
+        if len(set(names)) != len(names):
+            raise ConfigError("cluster: duplicate pool names")
+        for p in self.pools:
+            p.validate()
+        self.node_type.validate()
+        if self.base_nodes < 0:
+            raise ConfigError("cluster: negative base_nodes")
+
+
+@dataclass(frozen=True)
+class WorkloadConfig:
+    """Burst workload model.
+
+    The reference load generator creates COUNT=12 Deployments × REPLICAS=5 =
+    60 pods, odd deployments pinned to spot, even to on-demand, each pod
+    requesting 200m CPU / 128Mi (`demo_30_burst_configure.sh:7-8,59-70,135-137`)
+    — sized to overflow the 3×m6i.large base capacity and force scale-out.
+    """
+
+    deployments: int = 12
+    replicas: int = 5
+    pod_cpu_request: float = 0.2
+    pod_mem_request_gib: float = 0.125
+    # Fraction of pods labeled critical=true — these may never tolerate spot
+    # (Kyverno ClusterPolicy `critical-no-spot-without-pdb`, `04_kyverno.sh:47-75`).
+    critical_fraction: float = 0.0
+    # PDB minAvailable=50% on the burst group (`demo_10_setup_configure.sh:46-57`).
+    pdb_min_available: float = 0.5
+
+    @property
+    def total_pods(self) -> int:
+        return self.deployments * self.replicas
+
+    def validate(self) -> None:
+        if self.deployments <= 0 or self.replicas <= 0:
+            raise ConfigError("workload: non-positive size")
+        if self.pod_cpu_request <= 0 or self.pod_mem_request_gib <= 0:
+            raise ConfigError("workload: non-positive pod request")
+        if not 0.0 <= self.critical_fraction <= 1.0:
+            raise ConfigError("workload: critical_fraction out of [0,1]")
+        if not 0.0 <= self.pdb_min_available <= 1.0:
+            raise ConfigError("workload: pdb_min_available out of [0,1]")
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    """Cluster-dynamics parameters for the JAX simulator.
+
+    ``dt_s`` matches the reference's control-relevant cadence: the ADOT
+    metrics pipeline scrapes every 30s (`06_opencost.sh:323`), and the
+    neutral consolidation timer is 30s (`demo_19_reset_policies.sh:22-29`).
+    ``provision_delay_s`` models Karpenter's pending→NodeRegistered latency;
+    ``spot_interruption_rate_hr`` makes spot reclaims a first-class stochastic
+    process — the very thing the reference disabled
+    (`settings.interruptionQueue=""`, `05_karpenter.sh:136`).
+    """
+
+    dt_s: float = 30.0
+    horizon_steps: int = 2880  # one simulated day at 30s ticks
+    provision_delay_s: float = 90.0
+    spot_interruption_rate_hr: float = 0.05  # per spot node per hour
+    # Utilization below which WhenEmptyOrUnderutilized may consolidate a node.
+    underutil_threshold: float = 0.5
+    # Latency proxy: seconds of pending-pod backlog translated into SLO burn.
+    slo_pending_weight: float = 1.0
+    max_pending_pods: int = 512
+
+    @property
+    def provision_delay_steps(self) -> int:
+        return max(1, int(round(self.provision_delay_s / self.dt_s)))
+
+    def validate(self) -> None:
+        if self.dt_s <= 0:
+            raise ConfigError("sim: dt_s must be positive")
+        if self.horizon_steps <= 0:
+            raise ConfigError("sim: horizon_steps must be positive")
+        if self.spot_interruption_rate_hr < 0:
+            raise ConfigError("sim: negative interruption rate")
+        if not 0.0 < self.underutil_threshold <= 1.0:
+            raise ConfigError("sim: underutil_threshold out of (0,1]")
+
+
+@dataclass(frozen=True)
+class SignalsConfig:
+    """Signal-source configuration.
+
+    ``carbon_default_g_kwh`` reproduces the reference's documented fallback:
+    "leave blank to use dummy ~400 g/kWh" (`.env:14-16`). ``carbon_zone`` is
+    the ElectricityMaps-style zone id (`.env:15`, `US-CAL-CISO`).
+    ``scrape_interval_s`` mirrors the ADOT pipeline (`06_opencost.sh:323`).
+    """
+
+    backend: str = "synthetic"  # "synthetic" | "replay" | "live"
+    carbon_api_key: str = ""
+    carbon_zone: str = "US-CAL-CISO"
+    carbon_default_g_kwh: float = 400.0
+    scrape_interval_s: float = 30.0
+    prometheus_url: str = "http://localhost:8005/workspaces/local"
+    opencost_url: str = "http://localhost:9090"
+    carbon_url: str = "https://api.electricitymap.org/v3"
+    request_timeout_s: float = 10.0
+
+    def validate(self) -> None:
+        if self.backend not in ("synthetic", "replay", "live"):
+            raise ConfigError(f"signals: unknown backend {self.backend!r}")
+        if self.carbon_default_g_kwh <= 0:
+            raise ConfigError("signals: non-positive default carbon intensity")
+        if self.scrape_interval_s <= 0:
+            raise ConfigError("signals: non-positive scrape interval")
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training hyperparameters for the learned PolicyBackends."""
+
+    batch_clusters: int = 256
+    unroll_steps: int = 64
+    learning_rate: float = 3e-4
+    seed: int = 0
+    # Objective weights: J = cost + carbon_weight * gCO2 + slo_weight * burn.
+    carbon_weight: float = 5e-5  # $ per gCO2 (≈ $50/tCO2e social cost)
+    slo_weight: float = 0.05     # $ per pending-pod-step
+    # PPO-specific.
+    ppo_clip: float = 0.2
+    ppo_epochs: int = 4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+    # MPC-specific.
+    mpc_horizon: int = 32
+    mpc_iters: int = 20
+
+    def validate(self) -> None:
+        if self.batch_clusters <= 0 or self.unroll_steps <= 0:
+            raise ConfigError("train: non-positive batch/unroll")
+        if self.learning_rate <= 0:
+            raise ConfigError("train: non-positive learning rate")
+        if not 0.0 < self.gamma <= 1.0:
+            raise ConfigError("train: gamma out of (0,1]")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout for `pjit`/`shard_map`.
+
+    The cluster batch is data-parallel over the ``data`` axis (ICI within a
+    slice); ``model`` exists for sharding large policy nets if they ever grow
+    beyond one chip. Axis sizes of -1 mean "use all available devices".
+    """
+
+    data_axis: str = "data"
+    model_axis: str = "model"
+    data_parallel: int = -1
+    model_parallel: int = 1
+
+    def validate(self) -> None:
+        if self.model_parallel <= 0:
+            raise ConfigError("mesh: model_parallel must be positive")
+        if self.data_parallel != -1 and self.data_parallel <= 0:
+            raise ConfigError("mesh: data_parallel must be -1 (all devices) or positive")
+
+
+# ---------------------------------------------------------------------------
+# Root config
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FrameworkConfig:
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    workload: WorkloadConfig = field(default_factory=WorkloadConfig)
+    sim: SimConfig = field(default_factory=SimConfig)
+    signals: SignalsConfig = field(default_factory=SignalsConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+    def validate(self) -> "FrameworkConfig":
+        self.cluster.validate()
+        self.workload.validate()
+        self.sim.validate()
+        self.signals.validate()
+        self.train.validate()
+        self.mesh.validate()
+        return self
+
+    # -- serialization ------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return _asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "FrameworkConfig":
+        return _from_dict(cls, d).validate()
+
+    @classmethod
+    def from_json(cls, s: str) -> "FrameworkConfig":
+        return cls.from_dict(json.loads(s))
+
+    def with_overrides(self, **dotted: Any) -> "FrameworkConfig":
+        """Apply dotted-path overrides, e.g. ``sim__dt_s=15`` or
+        ``{"sim.dt_s": 15}`` via ``with_overrides(**{"sim.dt_s": 15})``."""
+        d = self.to_dict()
+        for key, value in dotted.items():
+            path = key.replace("__", ".").split(".")
+            node = d
+            for part in path[:-1]:
+                if not isinstance(node, dict) or part not in node:
+                    raise ConfigError(f"override: unknown section {part!r} in {key!r}")
+                node = node[part]
+            if not isinstance(node, dict) or path[-1] not in node:
+                raise ConfigError(f"override: unknown field {path[-1]!r} in {key!r}")
+            node[path[-1]] = value
+        return FrameworkConfig.from_dict(d)
+
+
+def default_config() -> FrameworkConfig:
+    """The demo-equivalent default config, validated."""
+    return FrameworkConfig().validate()
+
+
+def config_from_env(base: FrameworkConfig | None = None,
+                    environ: Mapping[str, str] | None = None) -> FrameworkConfig:
+    """Apply ``CCKA_SECTION_FIELD=value`` environment overrides.
+
+    This is the analog of the reference's `.env` + `source` scheme
+    (`00_common.sh:5-10`): e.g. ``CCKA_SIM_DT_S=15``,
+    ``CCKA_SIGNALS_CARBON_ZONE=DE``. Values are JSON-decoded when possible
+    (numbers, booleans, arrays), else taken as strings.
+    """
+    base = base or default_config()
+    environ = os.environ if environ is None else environ
+    overrides: dict[str, Any] = {}
+    sections = {f.name: f.type for f in fields(FrameworkConfig)}
+    for key, raw in environ.items():
+        if not key.startswith(ENV_PREFIX):
+            continue
+        rest = key[len(ENV_PREFIX):].lower()
+        section = rest.split("_", 1)[0]
+        if section not in sections or "_" not in rest:
+            continue
+        field_name = rest.split("_", 1)[1]
+        try:
+            value: Any = json.loads(raw)
+        except (json.JSONDecodeError, ValueError):
+            value = raw
+        if isinstance(value, list):
+            value = tuple(value)
+        overrides[f"{section}.{field_name}"] = value
+    if not overrides:
+        return base
+    return base.with_overrides(**overrides)
+
+
+# ---------------------------------------------------------------------------
+# Generic dataclass <-> dict plumbing
+# ---------------------------------------------------------------------------
+
+
+def _asdict(obj: Any) -> Any:
+    if is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _asdict(getattr(obj, f.name)) for f in fields(obj)}
+    if isinstance(obj, tuple):
+        return [_asdict(x) for x in obj]
+    return obj
+
+
+_NESTED_TYPES = {
+    "node_type": NodeTypeSpec,
+    "pools": PoolSpec,
+    "cluster": ClusterConfig,
+    "workload": WorkloadConfig,
+    "sim": SimConfig,
+    "signals": SignalsConfig,
+    "train": TrainConfig,
+    "mesh": MeshConfig,
+}
+
+
+def _from_dict(cls: type, d: Mapping[str, Any]) -> Any:
+    kwargs: dict[str, Any] = {}
+    valid = {f.name for f in fields(cls)}
+    for key, value in d.items():
+        if key not in valid:
+            raise ConfigError(f"{cls.__name__}: unknown field {key!r}")
+        nested = _NESTED_TYPES.get(key)
+        if nested is not None and isinstance(value, Mapping):
+            kwargs[key] = _from_dict(nested, value)
+        elif nested is not None and isinstance(value, (list, tuple)):
+            kwargs[key] = tuple(
+                _from_dict(nested, v) if isinstance(v, Mapping) else v
+                for v in value
+            )
+        elif isinstance(value, list):
+            kwargs[key] = tuple(value)
+        else:
+            kwargs[key] = value
+    return cls(**kwargs)
+
+
+def require(condition: bool, message: str) -> None:
+    """Hard-fail assertion helper, analog of `require_var` (`00_common.sh:18-20`)."""
+    if not condition:
+        raise ConfigError(message)

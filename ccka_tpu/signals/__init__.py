@@ -1,0 +1,32 @@
+"""Signal ingestion layer.
+
+The reference's signal plane is a metrics pipeline — kube-state-metrics →
+ADOT collector (30s scrape) → SigV4 → Amazon Managed Prometheus
+(`06_opencost.sh:318-341`) — queried back by OpenCost and Grafana through a
+SigV4 proxy (`06_opencost.sh:426`, `demo_40_watch_observe.sh:106-110`), plus a
+carbon-intensity stub that falls back to a dummy ~400 g/kWh when no API key is
+set (`.env:14-16`).
+
+Here every signal is a :class:`~ccka_tpu.signals.base.SignalSource` with three
+interchangeable backends:
+
+- ``synthetic``  — sinusoidal diurnal price/carbon + bursty demand (the
+  reference's dummy-carbon fallback, generalized);
+- ``replay``     — replays stored traces (the AMP time-series store analog);
+- ``live``       — real HTTP clients for Prometheus-compatible APIs, OpenCost
+  and ElectricityMaps-style carbon APIs.
+
+All backends emit the same device-ready :class:`ExogenousTrace` tensor bundle,
+so the simulator, the rule policy and the learned policies are agnostic to
+where signals come from.
+"""
+
+from ccka_tpu.signals.base import ExogenousTrace, SignalSource, TraceMeta  # noqa: F401
+from ccka_tpu.signals.synthetic import SyntheticSignalSource  # noqa: F401
+from ccka_tpu.signals.replay import ReplaySignalSource, save_trace, load_trace  # noqa: F401
+from ccka_tpu.signals.live import (  # noqa: F401
+    PrometheusClient,
+    OpenCostClient,
+    CarbonIntensityClient,
+    LiveSignalSource,
+)

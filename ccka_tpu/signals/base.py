@@ -1,0 +1,113 @@
+"""SignalSource interface and the ExogenousTrace tensor bundle.
+
+The reference reads three signal families — service health via PromQL
+(`demo_40_watch_observe.sh:106-110`), cost via OpenCost (`06_opencost.sh:436`),
+and carbon intensity via a stubbed API (`.env:14-16`) — each on a 30s cadence
+(`06_opencost.sh:323`). This module defines the common tensor format those
+signals are lowered into before touching the device: a time-major bundle of
+`float32` arrays with static shapes, ready for `lax.scan` over the horizon and
+`vmap` over a cluster batch.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ExogenousTrace(NamedTuple):
+    """Time-major exogenous inputs to the cluster simulator.
+
+    Shapes use T = steps, Z = zones. A batch dimension, when present, is
+    prepended by ``vmap``; this type stays rank-stable either way.
+
+    Attributes:
+      spot_price_hr:  [T, Z] $/node-hr for spot capacity per zone (OpenCost's
+        node pricing signal, `06_opencost.sh:404-429`).
+      od_price_hr:    [T, Z] $/node-hr for on-demand capacity per zone.
+      carbon_g_kwh:   [T, Z] grid carbon intensity per zone
+        (ElectricityMaps-style; dummy fallback ~400 g/kWh, `.env:14-16`).
+      demand_pods:    [T, C] desired pods per workload class. C=2 matches the
+        reference's burst generator which alternates spot-targeted and
+        on-demand-targeted deployments (`demo_30_burst_configure.sh:59-70`).
+      is_peak:        [T] {0,1} peak-hours indicator — the signal the human
+        operator acts on when choosing demo_20 vs demo_21 (`README.md:52-57`).
+    """
+
+    spot_price_hr: jnp.ndarray
+    od_price_hr: jnp.ndarray
+    carbon_g_kwh: jnp.ndarray
+    demand_pods: jnp.ndarray
+    is_peak: jnp.ndarray
+
+    @property
+    def steps(self) -> int:
+        return self.spot_price_hr.shape[-2]
+
+    @property
+    def n_zones(self) -> int:
+        return self.spot_price_hr.shape[-1]
+
+    def slice_steps(self, start: int, length: int) -> "ExogenousTrace":
+        return ExogenousTrace(
+            spot_price_hr=self.spot_price_hr[..., start:start + length, :],
+            od_price_hr=self.od_price_hr[..., start:start + length, :],
+            carbon_g_kwh=self.carbon_g_kwh[..., start:start + length, :],
+            demand_pods=self.demand_pods[..., start:start + length, :],
+            is_peak=self.is_peak[..., start:start + length],
+        )
+
+    def validate_shapes(self) -> None:
+        t, z = self.spot_price_hr.shape[-2:]
+        checks = {
+            "od_price_hr": self.od_price_hr.shape[-2:] == (t, z),
+            "carbon_g_kwh": self.carbon_g_kwh.shape[-2:] == (t, z),
+            "demand_pods": self.demand_pods.shape[-2] == t,
+            "is_peak": self.is_peak.shape[-1] == t,
+        }
+        bad = [k for k, ok in checks.items() if not ok]
+        if bad:
+            shapes = {k: tuple(getattr(self, k).shape) for k in self._fields}
+            raise ValueError(f"inconsistent trace shapes for {bad}: {shapes}")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceMeta:
+    """Provenance for a trace — what the AMP workspace alias + region were to
+    the reference (`demo_00_env.sh:11-15`)."""
+
+    source: str  # "synthetic" | "replay" | "live"
+    start_unix_s: float
+    dt_s: float
+    zones: tuple[str, ...]
+    description: str = ""
+
+
+class SignalSource(abc.ABC):
+    """Common interface over synthetic/replay/live signal backends.
+
+    ``trace`` produces a whole horizon at once (training, simulation); ``tick``
+    produces the latest single-step observation (the live control loop's 30s
+    scrape, `06_opencost.sh:323`). Both return device-ready arrays.
+    """
+
+    @abc.abstractmethod
+    def trace(self, steps: int, *, seed: int = 0) -> ExogenousTrace:
+        """Materialize ``steps`` ticks of exogenous signals."""
+
+    @abc.abstractmethod
+    def meta(self) -> TraceMeta:
+        """Provenance of what :meth:`trace` returns."""
+
+    def tick(self, t_index: int, *, seed: int = 0) -> ExogenousTrace:
+        """A single-step trace at tick ``t_index`` (default: slice of trace)."""
+        full = self.trace(t_index + 1, seed=seed)
+        return full.slice_steps(t_index, 1)
+
+
+def as_f32(x) -> jnp.ndarray:
+    return jnp.asarray(np.asarray(x), dtype=jnp.float32)

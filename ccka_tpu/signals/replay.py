@@ -1,0 +1,103 @@
+"""Replay signal backend: stored traces as the time-series store.
+
+The reference durably stores metrics in Amazon Managed Prometheus
+(`06_opencost.sh:153-163`) and queries them back over its API
+(`demo_40_watch_observe.sh:106-110`). The replay backend is that store's
+role in this framework: traces captured from live scraping (or generated
+synthetically) are saved as compressed ``.npz`` files and replayed
+deterministically for policy training and evaluation on held-out data
+(BASELINE.json config #3: "replayed OpenCost/ElectricityMaps traces").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Mapping
+
+import numpy as np
+
+from ccka_tpu.signals.base import ExogenousTrace, SignalSource, TraceMeta, as_f32
+
+_FIELDS = ("spot_price_hr", "od_price_hr", "carbon_g_kwh", "demand_pods", "is_peak")
+
+
+def save_trace(path: str, trace: ExogenousTrace, meta: TraceMeta) -> None:
+    """Persist a trace + provenance to ``path`` (.npz)."""
+    arrays = {k: np.asarray(getattr(trace, k)) for k in _FIELDS}
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps({
+            "source": meta.source,
+            "start_unix_s": meta.start_unix_s,
+            "dt_s": meta.dt_s,
+            "zones": list(meta.zones),
+            "description": meta.description,
+        }).encode("utf-8"), dtype=np.uint8)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez_compressed(path, **arrays)
+
+
+def load_trace(path: str) -> tuple[ExogenousTrace, TraceMeta]:
+    with np.load(path) as data:
+        trace = ExogenousTrace(**{k: as_f32(data[k]) for k in _FIELDS})
+        raw = bytes(data["__meta__"].tobytes()) if "__meta__" in data else b"{}"
+    md = json.loads(raw.decode("utf-8") or "{}")
+    meta = TraceMeta(
+        source=md.get("source", "replay"),
+        start_unix_s=float(md.get("start_unix_s", 0.0)),
+        dt_s=float(md.get("dt_s", 30.0)),
+        zones=tuple(md.get("zones", ())),
+        description=md.get("description", ""),
+    )
+    trace.validate_shapes()
+    return trace, meta
+
+
+class ReplaySignalSource(SignalSource):
+    """Replays a stored trace; deterministic, seed-independent.
+
+    ``trace(steps)`` tiles the stored trace if a longer horizon is requested
+    (periodic extension — diurnal signals tile naturally) and slices if
+    shorter. ``offset_steps`` selects held-out evaluation windows.
+    """
+
+    def __init__(self, trace: ExogenousTrace, meta: TraceMeta,
+                 *, offset_steps: int = 0):
+        trace.validate_shapes()
+        self._trace = trace
+        self._meta = meta
+        self.offset_steps = offset_steps
+
+    @classmethod
+    def from_file(cls, path: str, *, offset_steps: int = 0) -> "ReplaySignalSource":
+        trace, meta = load_trace(path)
+        return cls(trace, meta, offset_steps=offset_steps)
+
+    def meta(self) -> TraceMeta:
+        return self._meta
+
+    def trace(self, steps: int, *, seed: int = 0) -> ExogenousTrace:
+        del seed  # replay is deterministic
+        stored = self._trace.steps
+        need = self.offset_steps + steps
+        if need > stored:
+            reps = -(-need // stored)  # ceil
+            full = ExogenousTrace(*[
+                np.concatenate([np.asarray(a)] * reps, axis=-2)
+                if a.ndim >= 2 else np.concatenate([np.asarray(a)] * reps, axis=-1)
+                for a in self._trace
+            ])
+            full = ExogenousTrace(*[as_f32(a) for a in full])
+        else:
+            full = self._trace
+        return full.slice_steps(self.offset_steps, steps)
+
+
+def trace_from_arrays(arrays: Mapping[str, np.ndarray], dt_s: float,
+                      zones: tuple[str, ...]) -> tuple[ExogenousTrace, TraceMeta]:
+    """Build a replayable trace from raw arrays (e.g. parsed Prometheus
+    query_range results)."""
+    trace = ExogenousTrace(**{k: as_f32(arrays[k]) for k in _FIELDS})
+    trace.validate_shapes()
+    meta = TraceMeta(source="replay", start_unix_s=0.0, dt_s=dt_s, zones=zones)
+    return trace, meta

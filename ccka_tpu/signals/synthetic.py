@@ -1,0 +1,153 @@
+"""Synthetic signal backend: diurnal price/carbon + bursty demand.
+
+Generalizes the reference's dummy-carbon fallback ("leave blank to use dummy
+~400 g/kWh", `.env:14-16`) into a full synthetic exogenous world matching
+BASELINE.json config #2 ("synthetic sinusoidal carbon + spot-price signal").
+
+All generation is pure numpy on host (signals are I/O, not compute — the
+reference likewise keeps ingestion out of the hot loop, `06_opencost.sh:323`),
+then shipped to device once as a single batch of arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ccka_tpu.config import ClusterConfig, SignalsConfig, SimConfig, WorkloadConfig
+from ccka_tpu.signals.base import ExogenousTrace, SignalSource, TraceMeta, as_f32
+
+_DAY_S = 86400.0
+
+
+class SyntheticSignalSource(SignalSource):
+    """Sinusoidal diurnal spot price and carbon intensity, bursty pod demand.
+
+    - Spot price: mean from the node type, ±35% diurnal swing (cheapest at
+      night), small AR(1) noise, per-zone phase offsets — so zones genuinely
+      differ and zone-selection actions (`demo_20_offpeak_configure.sh:71`)
+      matter.
+    - On-demand price: constant per the node type (on-demand pricing is
+      stable), identical across zones.
+    - Carbon: mean ``carbon_default_g_kwh`` with a solar-dip daytime profile
+      (cleanest mid-day, dirtiest evening ramp — the CAISO duck curve for the
+      default `US-CAL-CISO` zone, `.env:15`).
+    - Demand: base load plus peak-hours burst reaching the reference's 60-pod
+      burst scale (`demo_30_burst_configure.sh:7-8`), split across the two
+      workload classes like the odd/even spot/on-demand deployments
+      (`demo_30_burst_configure.sh:59-70`).
+    - is_peak: 1 during 09:00-21:00 local, the regime in which the reference
+      operator would run `demo_21_peak_configure.sh`.
+    """
+
+    def __init__(self,
+                 cluster: ClusterConfig,
+                 workload: WorkloadConfig,
+                 sim: SimConfig,
+                 signals: SignalsConfig,
+                 *,
+                 start_unix_s: float = 0.0):
+        self.cluster = cluster
+        self.workload = workload
+        self.sim = sim
+        self.signals = signals
+        self.start_unix_s = start_unix_s
+        # Longest trace generated so far, per seed. Generation is
+        # prefix-stable (per-family RNG streams drawn step-sequentially), so
+        # serving shorter requests as slices is exact, and tick-at-t costs
+        # amortized O(1) instead of regenerating O(t) every scrape.
+        self._cache: dict[int, ExogenousTrace] = {}
+
+    def meta(self) -> TraceMeta:
+        return TraceMeta(
+            source="synthetic",
+            start_unix_s=self.start_unix_s,
+            dt_s=self.sim.dt_s,
+            zones=self.cluster.zones,
+            description="sinusoidal diurnal spot price + duck-curve carbon + bursty demand",
+        )
+
+    def trace(self, steps: int, *, seed: int = 0) -> ExogenousTrace:
+        cached = self._cache.get(seed)
+        if cached is not None and cached.steps >= steps:
+            return cached.slice_steps(0, steps)
+        # Geometric growth so a tick-by-tick caller regenerates rarely.
+        gen_steps = max(steps, 2 * cached.steps if cached is not None else 0, 128)
+        trace = self._generate(gen_steps, seed)
+        self._cache[seed] = trace
+        return trace.slice_steps(0, steps)
+
+    def _generate(self, steps: int, seed: int) -> ExogenousTrace:
+        # Independent streams per signal family; each draws step-sequentially,
+        # so prefixes are stable across different requested lengths.
+        rng_spot = np.random.default_rng([seed, 0])
+        rng_carbon = np.random.default_rng([seed, 1])
+        rng_demand = np.random.default_rng([seed, 2])
+        z = self.cluster.n_zones
+        dt = self.sim.dt_s
+        t = self.start_unix_s + np.arange(steps) * dt  # [T]
+        tod = (t % _DAY_S) / _DAY_S  # time-of-day in [0,1)
+        tod_z = tod[:, None]  # [T, 1] broadcast against zones
+
+        nt = self.cluster.node_type
+
+        # Per-zone phase offsets (deterministic per zone index).
+        phase = (np.arange(z) / max(z, 1)) * 0.15  # [Z] fraction of a day
+
+        # Spot price: diurnal swing + AR(1) noise, clipped to [20%, 95%] of OD.
+        diurnal = 1.0 + 0.35 * np.sin(2 * np.pi * (tod_z - 0.25 + phase))  # [T,Z]
+        noise = _ar1(rng_spot, (steps, z), rho=0.97, sigma=0.04)
+        spot = nt.spot_price_hr_mean * diurnal * (1.0 + noise)
+        spot = np.clip(spot, 0.2 * nt.od_price_hr, 0.95 * nt.od_price_hr)
+
+        od = np.full((steps, z), nt.od_price_hr)
+
+        # Carbon duck curve: base − solar dip (centered 13:00) + evening ramp
+        # (centered 19:30), small noise; clipped positive.
+        base = self.signals.carbon_default_g_kwh
+        solar = 0.45 * base * _bump(tod_z, center=13.5 / 24, width=3.5 / 24)
+        evening = 0.25 * base * _bump(tod_z + phase, center=19.5 / 24, width=2.0 / 24)
+        carbon = base - solar + evening
+        carbon = carbon * (1.0 + 0.1 * (np.arange(z) / max(z, 1)))[None, :]
+        carbon = carbon * (1.0 + _ar1(rng_carbon, (steps, z), rho=0.95, sigma=0.03))
+        carbon = np.clip(carbon, 20.0, None)
+
+        # Peak indicator 09:00-21:00.
+        is_peak = ((tod >= 9 / 24) & (tod < 21 / 24)).astype(np.float32)
+
+        # Demand: base 40% of burst scale off-peak, ramping to the full
+        # 60-pod burst at peak, with bursty noise; split between the two
+        # classes like the reference's odd/even deployments.
+        total = float(self.workload.total_pods)
+        level = total * (0.4 + 0.6 * _bump(tod, center=14.0 / 24, width=5.0 / 24))
+        level = level * (1.0 + 0.15 * _ar1(rng_demand, (steps,), rho=0.9, sigma=0.5))
+        level = np.clip(level, 0.0, 2.0 * total)
+        demand = np.stack([np.ceil(level / 2.0), np.floor(level / 2.0)], axis=-1)
+
+        trace = ExogenousTrace(
+            spot_price_hr=as_f32(spot),
+            od_price_hr=as_f32(od),
+            carbon_g_kwh=as_f32(carbon),
+            demand_pods=as_f32(demand),
+            is_peak=as_f32(is_peak),
+        )
+        trace.validate_shapes()
+        return trace
+
+
+def _ar1(rng: np.random.Generator, shape, rho: float, sigma: float) -> np.ndarray:
+    """Stationary AR(1) noise along axis 0."""
+    steps = shape[0]
+    rest = shape[1:]
+    out = np.zeros(shape, dtype=np.float64)
+    x = rng.normal(0.0, sigma, size=rest)
+    scale = np.sqrt(1.0 - rho * rho)
+    for i in range(steps):
+        x = rho * x + scale * rng.normal(0.0, sigma, size=rest)
+        out[i] = x
+    return out
+
+
+def _bump(x: np.ndarray, center: float, width: float) -> np.ndarray:
+    """Smooth periodic bump in [0,1] centered at ``center`` (day fraction)."""
+    d = np.minimum(np.abs(x - center), 1.0 - np.abs(x - center))
+    return np.exp(-0.5 * (d / (width / 2.0)) ** 2)

@@ -1,0 +1,33 @@
+"""Test environment: force an 8-device virtual CPU mesh before JAX imports.
+
+Multi-chip hardware is unavailable in CI; sharding tests run on
+`--xla_force_host_platform_device_count=8` CPU devices, mirroring how the
+driver dry-runs the multi-chip path (`__graft_entry__.dryrun_multichip`).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import pytest  # noqa: E402
+
+import ccka_tpu  # noqa: E402
+from ccka_tpu.config import default_config  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cfg():
+    return default_config()
+
+
+@pytest.fixture(scope="session")
+def small_cfg():
+    """A shrunken config for fast simulator tests."""
+    return default_config().with_overrides(**{
+        "sim.horizon_steps": 64,
+        "train.batch_clusters": 4,
+        "train.unroll_steps": 8,
+    })

@@ -1,0 +1,82 @@
+"""Config system tests — validation, env overrides, round-tripping.
+
+Covers the behaviors the reference implements as `.env` + `00_common.sh`
+(defaults-if-unset `:8-10`, `require_var` hard-fail `:18-20`) and per-script
+tunables (`demo_30_burst_configure.sh:7-8`).
+"""
+
+import pytest
+
+from ccka_tpu.config import (
+    ClusterConfig,
+    ConfigError,
+    FrameworkConfig,
+    PoolSpec,
+    config_from_env,
+    default_config,
+)
+
+
+def test_default_config_validates():
+    cfg = default_config()
+    assert cfg.cluster.name == "demo1"
+    assert cfg.cluster.n_pools == 2
+    assert cfg.cluster.n_zones == 3
+    assert cfg.workload.total_pods == 60  # 12 deployments x 5 replicas
+
+
+def test_pool_names_match_reference():
+    # demo_00_env.sh:18-19
+    cfg = default_config()
+    assert [p.name for p in cfg.cluster.pools] == ["spot-preferred", "on-demand-slo"]
+    assert cfg.cluster.pools[0].capacity_types == ("spot", "on-demand")
+    assert cfg.cluster.pools[1].capacity_types == ("on-demand",)
+
+
+def test_round_trip_json():
+    cfg = default_config()
+    again = FrameworkConfig.from_json(cfg.to_json())
+    assert again == cfg
+
+
+def test_with_overrides_dotted():
+    cfg = default_config().with_overrides(**{"sim.dt_s": 15.0, "train.seed": 7})
+    assert cfg.sim.dt_s == 15.0
+    assert cfg.train.seed == 7
+    # original untouched (frozen)
+    assert default_config().sim.dt_s == 30.0
+
+
+def test_with_overrides_unknown_field():
+    with pytest.raises(ConfigError):
+        default_config().with_overrides(**{"sim.not_a_field": 1})
+
+
+def test_env_overrides():
+    cfg = config_from_env(environ={
+        "CCKA_SIM_DT_S": "15",
+        "CCKA_SIGNALS_CARBON_ZONE": "DE",
+        "UNRELATED": "x",
+    })
+    assert cfg.sim.dt_s == 15
+    assert cfg.signals.carbon_zone == "DE"
+
+
+def test_validation_bad_strategy():
+    with pytest.raises(ConfigError):
+        ClusterConfig(pools=(PoolSpec(name="x", strategy="bogus"),)).validate()
+
+
+def test_validation_zone_membership():
+    with pytest.raises(ConfigError):
+        ClusterConfig(offpeak_zones=("nowhere-1x",)).validate()
+
+
+def test_validation_negative_dt():
+    with pytest.raises(ConfigError):
+        default_config().with_overrides(**{"sim.dt_s": -1.0})
+
+
+def test_config_hashable_for_jit_static_args():
+    cfg = default_config()
+    assert hash(cfg) == hash(default_config())

@@ -1,0 +1,230 @@
+"""Signals layer tests: synthetic generation, replay round-trip, live parsing.
+
+Live clients are tested against canned JSON in the exact wire shapes the
+reference queries: Prometheus `/api/v1/query` (`demo_40_watch_observe.sh:110`)
+and label values (`:108`); carbon falls back to the documented dummy
+~400 g/kWh (`.env:14-16`).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ccka_tpu.config import default_config
+from ccka_tpu.signals import (
+    CarbonIntensityClient,
+    ExogenousTrace,
+    LiveSignalSource,
+    OpenCostClient,
+    PrometheusClient,
+    ReplaySignalSource,
+    SyntheticSignalSource,
+    load_trace,
+    save_trace,
+)
+from ccka_tpu.signals.live import SignalUnavailable, make_signal_source
+
+
+@pytest.fixture(scope="module")
+def synth():
+    cfg = default_config()
+    return SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+
+
+def test_synthetic_shapes(synth):
+    tr = synth.trace(128, seed=0)
+    assert tr.spot_price_hr.shape == (128, 3)
+    assert tr.od_price_hr.shape == (128, 3)
+    assert tr.carbon_g_kwh.shape == (128, 3)
+    assert tr.demand_pods.shape == (128, 2)
+    assert tr.is_peak.shape == (128,)
+
+
+def test_synthetic_deterministic_per_seed(synth):
+    a = synth.trace(64, seed=3)
+    b = synth.trace(64, seed=3)
+    c = synth.trace(64, seed=4)
+    assert np.allclose(a.spot_price_hr, b.spot_price_hr)
+    assert not np.allclose(a.spot_price_hr, c.spot_price_hr)
+
+
+def test_synthetic_spot_below_od(synth):
+    tr = synth.trace(2880, seed=0)  # full day
+    assert np.all(np.asarray(tr.spot_price_hr) <= np.asarray(tr.od_price_hr) + 1e-6)
+    assert np.all(np.asarray(tr.spot_price_hr) > 0)
+
+
+def test_synthetic_carbon_positive_and_diurnal(synth):
+    tr = synth.trace(2880, seed=0)
+    carbon = np.asarray(tr.carbon_g_kwh)
+    assert np.all(carbon > 0)
+    # mid-day solar dip: day mean below evening mean
+    steps_per_hr = int(3600 / 30)
+    noon = carbon[12 * steps_per_hr:15 * steps_per_hr].mean()
+    evening = carbon[19 * steps_per_hr:21 * steps_per_hr].mean()
+    assert noon < evening
+
+
+def test_synthetic_peak_flag(synth):
+    tr = synth.trace(2880, seed=0)
+    steps_per_hr = int(3600 / 30)
+    is_peak = np.asarray(tr.is_peak)
+    assert is_peak[10 * steps_per_hr] == 1.0  # 10:00
+    assert is_peak[3 * steps_per_hr] == 0.0   # 03:00
+
+
+def test_replay_round_trip(tmp_path, synth):
+    tr = synth.trace(96, seed=1)
+    path = str(tmp_path / "trace.npz")
+    save_trace(path, tr, synth.meta())
+    loaded, meta = load_trace(path)
+    assert np.allclose(np.asarray(loaded.demand_pods), np.asarray(tr.demand_pods))
+    assert meta.zones == synth.meta().zones
+    assert meta.dt_s == 30.0
+
+
+def test_replay_tiling_and_offset(tmp_path, synth):
+    tr = synth.trace(32, seed=1)
+    path = str(tmp_path / "t.npz")
+    save_trace(path, tr, synth.meta())
+    src = ReplaySignalSource.from_file(path, offset_steps=8)
+    longer = src.trace(100)
+    assert longer.steps == 100
+    # periodic extension: step 0 of the replay == step 8 of the original
+    assert np.allclose(np.asarray(longer.spot_price_hr)[0],
+                       np.asarray(tr.spot_price_hr)[8])
+
+
+def _canned_fetch(responses):
+    calls = []
+
+    def fetch(url, headers):
+        calls.append(url)
+        for frag, body in responses.items():
+            if frag in url:
+                return json.dumps(body).encode()
+        raise OSError(f"no canned response for {url}")
+
+    fetch.calls = calls
+    return fetch
+
+
+def test_prometheus_instant_query_parsing():
+    fetch = _canned_fetch({
+        "/api/v1/query?": {
+            "status": "success",
+            "data": {"resultType": "vector", "result": [
+                {"metric": {"__name__": "up", "job": "ksm"},
+                 "value": [1700000000, "1"]},
+            ]},
+        },
+    })
+    client = PrometheusClient("http://amp.local/workspaces/w", fetch=fetch)
+    out = client.query("up")
+    assert out == [({"__name__": "up", "job": "ksm"}, 1.0)]
+
+
+def test_prometheus_error_raises():
+    fetch = _canned_fetch({"/api/v1/query?": {"status": "error", "error": "bad"}})
+    client = PrometheusClient("http://amp.local", fetch=fetch)
+    with pytest.raises(SignalUnavailable):
+        client.query("up")
+
+
+def test_prometheus_label_values():
+    fetch = _canned_fetch({
+        "/api/v1/label/__name__/values": {"status": "success",
+                                          "data": ["up", "kube_pod_status_phase"]},
+    })
+    client = PrometheusClient("http://amp.local", fetch=fetch)
+    assert "kube_pod_status_phase" in client.label_values("__name__")
+
+
+def test_opencost_allocation_parsing():
+    fetch = _canned_fetch({
+        "/allocation": {"code": 200, "data": [
+            {"nov-22": {"name": "nov-22", "totalCost": 1.25},
+             "kube-system": {"name": "kube-system", "totalCost": 0.75}},
+        ]},
+    })
+    client = OpenCostClient("http://opencost.local:9090", fetch=fetch)
+    costs = client.allocation()
+    assert costs["nov-22"] == pytest.approx(1.25)
+
+
+def test_carbon_dummy_fallback_no_key():
+    client = CarbonIntensityClient("https://api.example", api_key="",
+                                   zone="US-CAL-CISO", default_g_kwh=400.0)
+    assert client.latest() == 400.0  # .env:16 documented fallback
+
+
+def test_carbon_live_parse_and_fallback_on_error():
+    fetch = _canned_fetch({"carbon-intensity/latest": {"carbonIntensity": 123.4}})
+    client = CarbonIntensityClient("https://api.example", api_key="k",
+                                   zone="DE", default_g_kwh=400.0, fetch=fetch)
+    assert client.latest() == pytest.approx(123.4)
+
+    def broken(url, headers):
+        raise OSError("net down")
+
+    client2 = CarbonIntensityClient("https://api.example", api_key="k",
+                                    zone="DE", default_g_kwh=400.0, fetch=broken)
+    assert client2.latest() == 400.0
+
+
+def test_live_source_tick_merges_live_data():
+    cfg = default_config()
+    fetch = _canned_fetch({
+        "/api/v1/query?": {"status": "success", "data": {"result": [
+            {"metric": {}, "value": [0, "40"]}]}},
+        "/allocation": {"data": []},
+        "/assets": {"data": {}},
+    })
+    src = LiveSignalSource(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+                           fetch=fetch)
+    tick = src.tick(0)
+    assert tick.steps == 1
+    # pending(40) + running(40) = 80 pods spread over 2 classes
+    assert np.asarray(tick.demand_pods).sum() == pytest.approx(80.0)
+
+
+def test_factory_dispatch():
+    cfg = default_config()
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    assert isinstance(src, SyntheticSignalSource)
+    assert isinstance(src.trace(4), ExogenousTrace)
+
+
+def test_synthetic_prefix_stable_and_cached(synth):
+    """trace(k) must equal trace(n)[:k] — tick-by-tick consumers rely on it."""
+    long = synth.trace(200, seed=11)
+    short = synth.trace(50, seed=11)
+    assert np.allclose(np.asarray(short.carbon_g_kwh),
+                       np.asarray(long.carbon_g_kwh)[:50])
+    assert np.allclose(np.asarray(short.demand_pods),
+                       np.asarray(long.demand_pods)[:50])
+
+
+def test_trace_shape_validation_raises():
+    import jax.numpy as jnp
+    with pytest.raises(ValueError, match="inconsistent trace shapes"):
+        ExogenousTrace(
+            spot_price_hr=jnp.zeros((4, 3)), od_price_hr=jnp.zeros((4, 3)),
+            carbon_g_kwh=jnp.zeros((4, 3)), demand_pods=jnp.zeros((5, 2)),
+            is_peak=jnp.zeros((4,)),
+        ).validate_shapes()
+
+
+def test_live_trace_backfills_pending_plus_running():
+    cfg = default_config()
+    pts = [[i * 30.0, "10"] for i in range(8)]
+    fetch = _canned_fetch({
+        "/api/v1/query_range": {"status": "success", "data": {"result": [
+            {"metric": {}, "values": pts}]}},
+    })
+    src = LiveSignalSource(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+                           fetch=fetch, start_unix_s=86400.0 * 100)
+    tr = src.trace(8)
+    # pending(10) + running(10) = 20 pods per step across 2 classes
+    assert np.asarray(tr.demand_pods).sum(-1) == pytest.approx(np.full(8, 20.0))
