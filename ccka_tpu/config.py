@@ -204,6 +204,17 @@ class SimConfig:
     # Latency proxy: seconds of pending-pod backlog translated into SLO burn.
     slo_pending_weight: float = 1.0
     max_pending_pods: int = 512
+    # Request throughput proxy per running pod (for gCO2/req and $/req):
+    # sized so the 60-pod burst serves ~36k req/min, the same order as the
+    # reference's 25k req/min productization target (report PDF p.4 §9).
+    rps_per_pod: float = 10.0
+    # Fraction of demand that must be served for an interval to count as an
+    # SLO-met interval (the "$/SLO-hour" denominator).
+    slo_served_fraction: float = 0.99
+    # Bin-packing fragmentation: WhenEmpty consolidation can only reclaim
+    # truly-empty nodes; fragmentation keeps ~this fraction of repack-optimal
+    # capacity stranded on partially-filled nodes.
+    fragmentation: float = 0.3
 
     @property
     def provision_delay_steps(self) -> int:
@@ -218,6 +229,12 @@ class SimConfig:
             raise ConfigError("sim: negative interruption rate")
         if not 0.0 < self.underutil_threshold <= 1.0:
             raise ConfigError("sim: underutil_threshold out of (0,1]")
+        if self.rps_per_pod <= 0:
+            raise ConfigError("sim: rps_per_pod must be positive")
+        if not 0.0 < self.slo_served_fraction <= 1.0:
+            raise ConfigError("sim: slo_served_fraction out of (0,1]")
+        if self.fragmentation < 0:
+            raise ConfigError("sim: negative fragmentation")
 
 
 @dataclass(frozen=True)

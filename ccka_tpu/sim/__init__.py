@@ -1,0 +1,46 @@
+"""Batched, differentiable cluster simulator (JAX).
+
+The reference's "hot loop" is not in its scripts at all — it is Karpenter's
+reconcile loop reacting to Pending pods and consolidating per NodePool
+disruption policy, plus the kube-scheduler placing pods and the 30s metrics
+scrape (`SURVEY.md` §3.3). This package models that loop as a pure function
+
+    step(params, state, action, exogenous, key) -> (state', metrics)
+
+over flat feature tensors, so that:
+
+- `vmap` batches thousands of independent clusters (BASELINE.json config #3/#5),
+- `lax.scan` runs the control horizon on-device with no host round-trips,
+- `jax.grad` differentiates episode cost/carbon/SLO w.r.t. actions (diff-MPC),
+- `pjit`/`shard_map` shard the cluster batch over a TPU mesh.
+
+Modeled dynamics (all branch-free, static shapes):
+- pod scheduling against capacity-type capacity (nodeSelector semantics of
+  `demo_30_burst_configure.sh:104-106`),
+- Karpenter-style provisioning with a delay pipeline, weighted by zone/
+  capacity-type requirements (`demo_20_offpeak_configure.sh:69-79`) and spot
+  pricing (Karpenter's cheapest-fit),
+- consolidation per `{WhenEmpty | WhenEmptyOrUnderutilized, consolidateAfter}`
+  (`demo_20_offpeak_configure.sh:59-60`, `demo_21_peak_configure.sh:56-57`)
+  with PDB eviction budget (`demo_10_setup_configure.sh:46-57`),
+- spot interruptions as a first-class stochastic process — the thing the
+  reference explicitly disabled (`05_karpenter.sh:136`),
+- cost and carbon accounting per node-step, and an SLO/latency proxy.
+"""
+
+from ccka_tpu.sim.types import (  # noqa: F401
+    Action,
+    ClusterState,
+    SimParams,
+    StepMetrics,
+    CT_SPOT,
+    CT_OD,
+)
+from ccka_tpu.sim.dynamics import step  # noqa: F401
+from ccka_tpu.sim.rollout import (  # noqa: F401
+    batched_rollout,
+    initial_state,
+    rollout,
+    rollout_actions,
+)
+from ccka_tpu.sim.metrics import EpisodeSummary, summarize  # noqa: F401

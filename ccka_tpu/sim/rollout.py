@@ -1,0 +1,128 @@
+"""Rollouts: `lax.scan` over the horizon, `vmap` over the cluster batch.
+
+This is the device-resident replacement for the reference's operational loop
+(`demo_18 → demo_20|21 → demo_30 → demo_40`, `README.md:52-57`): instead of
+one live cluster stepped by hand, thousands of simulated clusters advance a
+full control horizon per XLA dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ccka_tpu.config import FrameworkConfig
+from ccka_tpu.sim.dynamics import ExoStep, step
+from ccka_tpu.sim.types import Action, ClusterState, N_CT, SimParams, StepMetrics
+from ccka_tpu.signals.base import ExogenousTrace
+
+# action_fn(state, exo_step, t_index) -> Action
+ActionFn = Callable[[ClusterState, ExoStep, jnp.ndarray], Action]
+
+
+def initial_state(cfg: FrameworkConfig) -> ClusterState:
+    """Fresh cluster: only the managed base nodegroup, nothing pending."""
+    p, z = cfg.cluster.n_pools, cfg.cluster.n_zones
+    c = 2
+    k = cfg.sim.provision_delay_steps
+    zero = jnp.float32(0.0)
+    return ClusterState(
+        nodes=jnp.zeros((p, z, N_CT), jnp.float32),
+        pipeline=jnp.zeros((k, p, z, N_CT), jnp.float32),
+        running=jnp.zeros((c,), jnp.float32),
+        consol_timer_s=jnp.zeros((p,), jnp.float32),
+        time_s=zero,
+        acc_cost_usd=zero,
+        acc_carbon_g=zero,
+        acc_requests=zero,
+        acc_slo_ok_s=zero,
+        acc_evictions=zero,
+    )
+
+
+def exo_steps(trace: ExogenousTrace) -> ExoStep:
+    """Repack a time-major trace as scan-consumable xs (leading axis = T)."""
+    return ExoStep(
+        spot_price_hr=trace.spot_price_hr,
+        od_price_hr=trace.od_price_hr,
+        carbon_g_kwh=trace.carbon_g_kwh,
+        demand_pods=trace.demand_pods,
+        is_peak=trace.is_peak,
+    )
+
+
+def rollout(params: SimParams,
+            state0: ClusterState,
+            action_fn: ActionFn,
+            trace: ExogenousTrace,
+            key: jax.Array,
+            *,
+            stochastic: bool = False) -> tuple[ClusterState, StepMetrics]:
+    """Scan the closed loop decide→act→step over the trace horizon.
+
+    ``action_fn`` is the PolicyBackend's jittable decide(); it sees the
+    current state and tick signals — exactly the observation surface the
+    reference's operator has when choosing demo_20 vs demo_21.
+    """
+    xs = exo_steps(trace)
+    t0 = jnp.arange(xs.is_peak.shape[0], dtype=jnp.int32)
+
+    def body(carry, inp):
+        state, k = carry
+        exo, t = inp
+        k, sub = jax.random.split(k)
+        action = action_fn(state, exo, t)
+        state, metrics = step(params, state, action, exo, sub,
+                              stochastic=stochastic)
+        return (state, k), metrics
+
+    (final, _), metrics = jax.lax.scan(body, (state0, key), (xs, t0))
+    return final, metrics
+
+
+def rollout_actions(params: SimParams,
+                    state0: ClusterState,
+                    actions: Action,
+                    trace: ExogenousTrace,
+                    key: jax.Array,
+                    *,
+                    stochastic: bool = False) -> tuple[ClusterState, StepMetrics]:
+    """Rollout under a precomputed action sequence (leading axis = T).
+
+    This is the diff-MPC path: gradients flow from episode objectives back
+    through `scan` into every action of the plan.
+    """
+    xs = exo_steps(trace)
+
+    def body(carry, inp):
+        state, k = carry
+        exo, action = inp
+        k, sub = jax.random.split(k)
+        state, metrics = step(params, state, action, exo, sub,
+                              stochastic=stochastic)
+        return (state, k), metrics
+
+    (final, _), metrics = jax.lax.scan(body, (state0, key), (xs, actions))
+    return final, metrics
+
+
+def batched_rollout(params: SimParams,
+                    states0: ClusterState,
+                    action_fn: ActionFn,
+                    traces: ExogenousTrace,
+                    keys: jax.Array,
+                    *,
+                    stochastic: bool = False) -> tuple[ClusterState, StepMetrics]:
+    """`vmap` of :func:`rollout` over a leading cluster-batch axis.
+
+    ``states0``/``traces``/``keys`` carry a leading batch dim B; params and
+    the policy are shared. This is BASELINE.json config #3/#5: hundreds to
+    10k clusters advanced in lockstep on one chip or a mesh.
+    """
+    fn = jax.vmap(
+        lambda s, tr, k: rollout(params, s, action_fn, tr, k,
+                                 stochastic=stochastic),
+        in_axes=(0, 0, 0))
+    return fn(states0, traces, keys)

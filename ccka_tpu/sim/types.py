@@ -1,0 +1,152 @@
+"""Simulator pytrees: parameters, state, actions, per-step metrics.
+
+Dimension glossary (all static at trace time):
+  P = number of NodePools (2: `spot-preferred`, `on-demand-slo`,
+      `demo_00_env.sh:18-19`)
+  Z = number of zones (3 in us-east-2, `demo_20_offpeak_configure.sh:41`)
+  T_CT = capacity types (2: spot=0, on-demand=1, `karpenter.sh/capacity-type`)
+  C = workload classes (2: spot-targeted, od-targeted — the odd/even
+      deployments of `demo_30_burst_configure.sh:59-70`)
+  K = provisioning-delay pipeline depth (provision_delay_s / dt_s)
+
+Node counts are float32 throughout: the simulator is a continuous relaxation
+so `jax.grad` flows through provisioning/consolidation magnitudes (SURVEY.md
+§7 "hard parts (1)"); stochastic mode adds sampled integer-like jumps for
+spot interruptions without breaking the relaxation.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ccka_tpu.config import FrameworkConfig
+
+CT_SPOT = 0
+CT_OD = 1
+N_CT = 2
+
+
+class SimParams(NamedTuple):
+    """Static-per-run physical parameters, derived from FrameworkConfig.
+
+    Kept as a pytree of scalars/arrays (not a static arg) so one compiled
+    step serves many configs of identical shape.
+    """
+
+    dt_s: jnp.ndarray                 # [] seconds per control tick
+    pods_per_node: jnp.ndarray        # [] schedulable pods per node
+    base_od_nodes: jnp.ndarray        # [] managed-nodegroup floor (.env:7-8)
+    max_nodes: jnp.ndarray            # [P] per-pool node cap
+    static_ct_allow: jnp.ndarray      # [P, T_CT] pool's intrinsic capacity types
+    class_ct: jnp.ndarray             # [C, T_CT] one-hot: class c needs ct
+    provision_pipeline_k: int         # static python int: pipeline depth
+    interrupt_p_step: jnp.ndarray     # [] P(spot node interrupted per step)
+    pdb_min_available: jnp.ndarray    # [] PDB floor (demo_10:52-57)
+    fragmentation: jnp.ndarray        # [] stranded-capacity factor for WhenEmpty
+    underutil_threshold: jnp.ndarray  # [] utilization gate for Underutilized
+    watts_idle: jnp.ndarray           # [] per node
+    watts_full: jnp.ndarray           # [] per node
+    rps_per_pod: jnp.ndarray          # [] request throughput proxy
+    slo_served_fraction: jnp.ndarray  # [] served/desired to count SLO-met
+    consolidate_tau_s: jnp.ndarray    # [] softness of the consolidate-after gate
+
+    @classmethod
+    def from_config(cls, cfg: FrameworkConfig) -> "SimParams":
+        cl, wl, sm = cfg.cluster, cfg.workload, cfg.sim
+        nt = cl.node_type
+        ppn = float(np.floor(min(
+            (nt.vcpu - nt.system_reserved_vcpu) / wl.pod_cpu_request,
+            (nt.mem_gib - nt.system_reserved_mem_gib) / wl.pod_mem_request_gib,
+        )))
+        static_allow = np.zeros((cl.n_pools, N_CT), np.float32)
+        for i, pool in enumerate(cl.pools):
+            static_allow[i, CT_SPOT] = float("spot" in pool.capacity_types)
+            static_allow[i, CT_OD] = float("on-demand" in pool.capacity_types)
+        # class 0 → spot nodeSelector, class 1 → on-demand nodeSelector
+        class_ct = np.eye(N_CT, dtype=np.float32)
+        return cls(
+            dt_s=jnp.float32(sm.dt_s),
+            pods_per_node=jnp.float32(ppn),
+            base_od_nodes=jnp.float32(cl.base_nodes),
+            max_nodes=jnp.asarray([p.max_nodes for p in cl.pools], jnp.float32),
+            static_ct_allow=jnp.asarray(static_allow),
+            class_ct=jnp.asarray(class_ct),
+            provision_pipeline_k=sm.provision_delay_steps,
+            interrupt_p_step=jnp.float32(
+                sm.spot_interruption_rate_hr * sm.dt_s / 3600.0),
+            pdb_min_available=jnp.float32(wl.pdb_min_available),
+            fragmentation=jnp.float32(sm.fragmentation),
+            underutil_threshold=jnp.float32(sm.underutil_threshold),
+            watts_idle=jnp.float32(nt.watts_idle),
+            watts_full=jnp.float32(nt.watts_full),
+            rps_per_pod=jnp.float32(sm.rps_per_pod),
+            slo_served_fraction=jnp.float32(sm.slo_served_fraction),
+            consolidate_tau_s=jnp.float32(0.25 * sm.dt_s),
+        )
+
+
+class ClusterState(NamedTuple):
+    """The evolving cluster, one batch element = one simulated cluster."""
+
+    nodes: jnp.ndarray          # [P, Z, T_CT] active Karpenter-owned nodes
+    pipeline: jnp.ndarray       # [K, P, Z, T_CT] provisioning in flight
+    running: jnp.ndarray        # [C] running pods per class
+    consol_timer_s: jnp.ndarray  # [P] seconds of continuous reclaimable slack
+    time_s: jnp.ndarray         # [] simulated wall-clock
+    # Episode accumulators (folded here so scan carries everything).
+    acc_cost_usd: jnp.ndarray   # []
+    acc_carbon_g: jnp.ndarray   # []
+    acc_requests: jnp.ndarray   # [] served requests (proxy)
+    acc_slo_ok_s: jnp.ndarray   # [] seconds meeting the served-fraction SLO
+    acc_evictions: jnp.ndarray  # [] pods evicted by consolidation (PDB audit)
+
+
+class Action(NamedTuple):
+    """Continuous canonical action — the §3.2 action surface, relaxed.
+
+    The rule profiles map onto this exactly:
+      off-peak (`demo_20_offpeak_configure.sh:59-60,69-79`):
+        spot pool: consolidation_aggr=1 (WhenEmptyOrUnderutilized),
+        od pool:   consolidation_aggr=0, consolidate_after_s=60,
+        zone_weight one-hot on OFFPEAK_ZONES, ct_allow per write_req_patch.
+      peak (`demo_21_peak_configure.sh:56-57,65-75`):
+        both pools aggr=0, after=120s, zones=PEAK_ZONES.
+    ``hpa_scale`` closes the reference's HPA gap (§2.3: prometheus-adapter
+    installed but no HPA object): per-class multiplier on desired replicas.
+    """
+
+    zone_weight: jnp.ndarray          # [P, Z] in [0,1]
+    ct_allow: jnp.ndarray             # [P, T_CT] in [0,1]
+    consolidation_aggr: jnp.ndarray   # [P] in [0,1]: 0=WhenEmpty, 1=+Underutilized
+    consolidate_after_s: jnp.ndarray  # [P] seconds
+    hpa_scale: jnp.ndarray            # [C] multiplier on demanded pods
+
+    @classmethod
+    def neutral(cls, n_pools: int, n_zones: int, n_classes: int = 2) -> "Action":
+        """The `demo_19_reset_policies.sh:22-29` reset: all zones, intrinsic
+        capacity types, WhenEmpty/30s."""
+        return cls(
+            zone_weight=jnp.ones((n_pools, n_zones), jnp.float32),
+            ct_allow=jnp.ones((n_pools, N_CT), jnp.float32),
+            consolidation_aggr=jnp.zeros((n_pools,), jnp.float32),
+            consolidate_after_s=jnp.full((n_pools,), 30.0, jnp.float32),
+            hpa_scale=jnp.ones((n_classes,), jnp.float32),
+        )
+
+
+class StepMetrics(NamedTuple):
+    """Per-tick observables — what the KSM→ADOT→AMP pipeline would scrape."""
+
+    cost_usd: jnp.ndarray        # [] this tick
+    carbon_g: jnp.ndarray        # [] this tick
+    served_pods: jnp.ndarray     # [C]
+    pending_pods: jnp.ndarray    # [C]
+    desired_pods: jnp.ndarray    # [C] HPA-scaled scheduling target
+    demand_pods: jnp.ndarray     # [C] raw exogenous demand (SLO/req basis)
+    nodes_by_ct: jnp.ndarray     # [T_CT] active node totals
+    slo_ok: jnp.ndarray          # [] {0,1} served-fraction SLO met this tick
+    interrupted_nodes: jnp.ndarray  # [] spot nodes reclaimed this tick
+    evicted_pods: jnp.ndarray    # [] consolidation evictions this tick
