@@ -1,0 +1,8 @@
+"""`python -m ccka_tpu` → the ccka CLI."""
+
+import sys
+
+from ccka_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

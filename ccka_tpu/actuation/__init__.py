@@ -1,0 +1,28 @@
+"""Actuation layer: rendering decisions into cluster mutations.
+
+The reference actuates by patching Karpenter NodePools with kubectl —
+merge patches for disruption settings and JSON patches for requirements
+(`demo_20_offpeak_configure.sh:59-60,96`, `demo_21_peak_configure.sh:56-57`),
+with read-back verification and a schema-path fallback (`:84-127`). This
+package reproduces that surface exactly and closes the reference's actuation
+gaps (§2.3: HPA never created, KEDA never installed):
+
+- ``patches``  — Action → NodePool merge/JSON patches (golden-tested against
+  the reference's emitted JSON), HPA replica targets, KEDA ScaledObject spec;
+- ``sink``     — where patches go: DryRunSink (tests/CI), KubectlSink
+  (live clusters, injectable runner), both implementing apply-and-verify
+  with the reference's path fallback.
+"""
+
+from ccka_tpu.actuation.patches import (  # noqa: F401
+    NodePoolPatchSet,
+    render_nodepool_patches,
+    render_hpa_manifests,
+    render_keda_scaledobject,
+)
+from ccka_tpu.actuation.sink import (  # noqa: F401
+    ActuationSink,
+    DryRunSink,
+    KubectlSink,
+    PatchCommand,
+)

@@ -1,0 +1,175 @@
+"""Render canonical Actions into Kubernetes mutation payloads.
+
+The emitted NodePool patch JSON is byte-compatible with what the reference's
+bash writes (the oracle format per SURVEY.md §4):
+
+- disruption merge patches: `demo_20_offpeak_configure.sh:59-60`
+  (`{"spec":{"disruption":{"consolidationPolicy":"WhenEmptyOrUnderutilized"}}}`
+  and `{"spec":{"disruption":{"consolidationPolicy":"WhenEmpty",
+  "consolidateAfter":"60s"}}}`), `demo_21_peak_configure.sh:56-57` (120s);
+- requirements JSON patches: `write_req_patch`
+  (`demo_20_offpeak_configure.sh:64-81` with op:replace,
+  `demo_21_peak_configure.sh:60-77` with op:add) — a single op at
+  `{path_prefix}/requirements` whose value is
+  `[{"key":"topology.kubernetes.io/zone","operator":"In","values":[...]},
+    {"key":"karpenter.sh/capacity-type","operator":"In","values":[...]}]`.
+
+HPA and KEDA renderers realize the capabilities the reference names but
+never creates (§2.3: prometheus-adapter installed yet no HPA object,
+`03_monitoring.sh:17-19`; KEDA SQS env stub, `.env:10-12`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from ccka_tpu.config import ClusterConfig, WorkloadConfig
+from ccka_tpu.sim.types import CT_OD, CT_SPOT, Action
+
+PRIMARY_PATH = "/spec/template/spec"    # demo_20:86
+FALLBACK_PATH = "/spec/template"        # demo_20:87
+
+_CT_NAMES = ("spot", "on-demand")       # index order = (CT_SPOT, CT_OD)
+
+
+@dataclass(frozen=True)
+class NodePoolPatchSet:
+    """One pool's mutation: a disruption merge patch + requirements JSON
+    patch (primary and fallback path variants, demo_20:84-127)."""
+
+    pool: str
+    disruption_merge: dict
+    requirements_json: list        # at PRIMARY_PATH
+    requirements_json_fallback: list  # at FALLBACK_PATH
+
+
+def _threshold(x, cut: float = 0.5) -> np.ndarray:
+    return np.asarray(x) > cut
+
+
+def render_nodepool_patches(action: Action, cluster: ClusterConfig,
+                            *, op: str = "replace") -> list[NodePoolPatchSet]:
+    """Discretize a (feasible) Action into per-pool Karpenter patches.
+
+    ``op`` mirrors the reference's profile difference: off-peak uses
+    op:replace (`demo_20:69`), peak op:add (`demo_21:65`).
+    """
+    if op not in ("replace", "add"):
+        raise ValueError(f"bad patch op {op!r}")
+    zone_mask = _threshold(action.zone_weight)            # [P, Z]
+    ct_mask = _threshold(action.ct_allow)                 # [P, T_CT]
+    aggr = _threshold(action.consolidation_aggr)          # [P]
+    after = np.asarray(action.consolidate_after_s)        # [P]
+
+    out = []
+    for i, pool in enumerate(cluster.pools):
+        if aggr[i]:
+            # demo_20:59 — WhenEmptyOrUnderutilized, no consolidateAfter.
+            merge = {"spec": {"disruption": {
+                "consolidationPolicy": "WhenEmptyOrUnderutilized"}}}
+        else:
+            merge = {"spec": {"disruption": {
+                "consolidationPolicy": "WhenEmpty",
+                "consolidateAfter": f"{int(round(float(after[i])))}s"}}}
+
+        zones = [z for j, z in enumerate(cluster.zones) if zone_mask[i, j]]
+        if not zones:  # unsatisfiable requirement — guarded upstream too
+            zones = list(cluster.zones)
+        # Reference writes spot before on-demand (demo_20:75). The rendered
+        # set is always intersected with the pool's intrinsic capacity types:
+        # the SLO pool can never be patched to offer spot, no matter what an
+        # (unprojected) action requests — the Kyverno critical-workload
+        # guarantee enforced at the last exit (`04_kyverno.sh:47-75`).
+        cts = [name for k, name in enumerate(_CT_NAMES)
+               if ct_mask[i, k] and name in pool.capacity_types]
+        if not cts:
+            cts = [name for name in _CT_NAMES if name in pool.capacity_types]
+        requirements = [
+            {"key": "topology.kubernetes.io/zone", "operator": "In",
+             "values": zones},
+            {"key": "karpenter.sh/capacity-type", "operator": "In",
+             "values": cts},
+        ]
+        out.append(NodePoolPatchSet(
+            pool=pool.name,
+            disruption_merge=merge,
+            requirements_json=[{
+                "op": op, "path": f"{PRIMARY_PATH}/requirements",
+                "value": requirements}],
+            requirements_json_fallback=[{
+                "op": op, "path": f"{FALLBACK_PATH}/requirements",
+                "value": requirements}],
+        ))
+    return out
+
+
+def render_hpa_manifests(action: Action, cluster: ClusterConfig,
+                         workload: WorkloadConfig,
+                         namespace: str = "nov-22") -> list[dict]:
+    """HorizontalPodAutoscaler objects per workload class.
+
+    Closes §2.3: the reference installs prometheus-adapter
+    (`03_monitoring.sh:17-19`) precisely to feed HPA custom metrics, yet
+    creates no HPA. One HPA per burst deployment group, with the policy's
+    hpa_scale folded into the replica ceiling. Namespace default matches
+    the demo (`demo_00_env.sh:9-10`).
+    """
+    scale = np.clip(np.asarray(action.hpa_scale), 0.1, 4.0)
+    per_class = workload.total_pods // 2
+    manifests = []
+    for c, cls_name in enumerate(("burst-spot", "burst-od")):
+        target = max(1, int(round(per_class * float(scale[c]))))
+        manifests.append({
+            "apiVersion": "autoscaling/v2",
+            "kind": "HorizontalPodAutoscaler",
+            "metadata": {"name": f"hpa-{cls_name}", "namespace": namespace},
+            "spec": {
+                "scaleTargetRef": {"apiVersion": "apps/v1",
+                                   "kind": "Deployment",
+                                   "name": cls_name},
+                "minReplicas": max(1, target // 4),
+                "maxReplicas": target,
+                "metrics": [{
+                    "type": "Resource",
+                    "resource": {"name": "cpu",
+                                 "target": {"type": "Utilization",
+                                            "averageUtilization": 70}},
+                }],
+            },
+        })
+    return manifests
+
+
+def render_keda_scaledobject(action: Action, queue_name: str,
+                             namespace: str = "nov-22",
+                             region: str = "us-east-2") -> dict:
+    """KEDA ScaledObject for SQS-driven scaling.
+
+    Realizes the reference's `.env:10-12` stub (`CREATE_SQS`,
+    `SQS_QUEUE_NAME` with no ScaledObject or KEDA install anywhere).
+    Queue-length target tightens as the policy scales up (hpa_scale mean).
+    """
+    scale = float(np.mean(np.clip(np.asarray(action.hpa_scale), 0.1, 4.0)))
+    queue_len = max(1, int(round(10.0 / scale)))
+    return {
+        "apiVersion": "keda.sh/v1alpha1",
+        "kind": "ScaledObject",
+        "metadata": {"name": f"scaled-{queue_name}", "namespace": namespace},
+        "spec": {
+            "scaleTargetRef": {"name": "burst-queue-worker"},
+            "minReplicaCount": 0,
+            "maxReplicaCount": 100,
+            "triggers": [{
+                "type": "aws-sqs-queue",
+                "metadata": {
+                    "queueURL": f"https://sqs.{region}.amazonaws.com/"
+                                f"ACCOUNT/{queue_name}",
+                    "queueLength": str(queue_len),
+                    "awsRegion": region,
+                },
+            }],
+        },
+    }

@@ -1,0 +1,229 @@
+"""CLI — the reference's demo scripts as thin PolicyBackend callers.
+
+`BASELINE.json`: "demo_20_offpeak_configure.sh and demo_21_peak_configure.sh
+become thin callers of PolicyBackend.decide()". Subcommand ↔ script map:
+
+  offpeak   ← demo_20_offpeak_configure.sh
+  peak      ← demo_21_peak_configure.sh
+  reset     ← demo_19_reset_policies.sh
+  observe   ← demo_20/21_*_observe.sh (read-only state dump)
+  preroll   ← demo_18_preroll_check.sh (environment assertions)
+  simulate  — run the batched simulator and print episode KPIs (new: the
+              test substrate the reference lacked, SURVEY.md §4)
+  show-config — resolved FrameworkConfig (replaces `demo_00_env.sh` output)
+
+All mutating commands default to --dry-run (printing kubectl-equivalent
+commands); --live routes through KubectlSink.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ccka_tpu.config import ConfigError, FrameworkConfig, config_from_env
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ccka",
+        description="TPU-native cost- and carbon-aware cluster autoscaler")
+    p.add_argument("--config", help="path to a FrameworkConfig JSON file")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VAL",
+                   help="dotted config override, e.g. --set sim.dt_s=15")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    for name, helptext in (
+            ("offpeak", "apply the cost-biased Off-Peak profile (demo_20)"),
+            ("peak", "apply the SLO-biased Peak profile (demo_21)"),
+            ("reset", "normalize NodePools to neutral (demo_19)")):
+        sp = sub.add_parser(name, help=helptext)
+        sp.add_argument("--live", action="store_true",
+                        help="apply via kubectl instead of dry-run")
+        sp.add_argument("--json", action="store_true",
+                        help="emit patches as JSON instead of commands")
+
+    so = sub.add_parser("observe", help="print the profile a policy would "
+                                        "apply right now (read-only)")
+    # Learned backends gain observe support once their checkpoint-loading
+    # path lands; advertising them before then would misattribute decisions.
+    so.add_argument("--backend", default="rule", choices=("rule",))
+
+    sp = sub.add_parser("preroll", help="environment assertions (demo_18)")
+    sp.add_argument("--live", action="store_true")
+
+    ss = sub.add_parser("simulate", help="batched simulator + KPI report")
+    ss.add_argument("--backend", default="rule", choices=("rule", "neutral"))
+    ss.add_argument("--days", type=float, default=1.0)
+    ss.add_argument("--clusters", type=int, default=1)
+    ss.add_argument("--seed", type=int, default=0)
+    ss.add_argument("--stochastic", action="store_true")
+
+    sub.add_parser("show-config", help="print the resolved config")
+    return p
+
+
+def _load_config(args) -> FrameworkConfig:
+    if args.config:
+        with open(args.config) as f:
+            cfg = FrameworkConfig.from_json(f.read())
+    else:
+        cfg = config_from_env()
+    overrides = {}
+    for kv in args.set:
+        if "=" not in kv:
+            raise SystemExit(f"--set expects KEY=VAL, got {kv!r}")
+        key, val = kv.split("=", 1)
+        try:
+            overrides[key] = json.loads(val)
+        except json.JSONDecodeError:
+            overrides[key] = val
+    return cfg.with_overrides(**overrides) if overrides else cfg
+
+
+def _cmd_profile(cfg: FrameworkConfig, profile: str, live: bool,
+                 as_json: bool) -> int:
+    from ccka_tpu.actuation import DryRunSink, KubectlSink, render_nodepool_patches
+    from ccka_tpu.policy import offpeak_action, peak_action
+    from ccka_tpu.policy.rule import neutral_action
+
+    action, op = {
+        "offpeak": (offpeak_action(cfg.cluster), "replace"),  # demo_20:69
+        "peak": (peak_action(cfg.cluster), "add"),            # demo_21:65
+        "reset": (neutral_action(cfg.cluster), "replace"),
+    }[profile]
+    patches = render_nodepool_patches(action, cfg.cluster, op=op)
+
+    if as_json:
+        print(json.dumps([{
+            "pool": ps.pool,
+            "disruption_merge": ps.disruption_merge,
+            "requirements_json": ps.requirements_json,
+        } for ps in patches], indent=2))
+
+    sink = KubectlSink() if live else DryRunSink(echo=not as_json)
+    results = sink.apply_all(patches)
+    ok = all(r.ok for r in results)
+    for r in results:
+        status = "ok" if r.ok else "FAILED"
+        fb = " (fallback path)" if r.used_fallback else ""
+        print(f"[{status}] nodepool/{r.pool}{fb}", file=sys.stderr)
+        if not r.ok and r.detail:
+            print(r.detail, file=sys.stderr)
+    print(f"[{'ok' if ok else 'err'}] {profile} profile "
+          f"{'applied' if live else 'rendered (dry-run)'}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def _cmd_observe(cfg: FrameworkConfig, backend: str) -> int:
+    import jax.numpy as jnp
+
+    from ccka_tpu.policy import RulePolicy
+    from ccka_tpu.sim import initial_state
+    from ccka_tpu.signals.live import make_signal_source
+
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    tick = src.tick(0)
+    from ccka_tpu.sim.rollout import exo_steps
+    exo = jax_tree_first(exo_steps(tick))
+    policy = RulePolicy(cfg.cluster)
+    action = policy.decide(initial_state(cfg), exo, jnp.int32(0))
+    is_peak = float(exo.is_peak) > 0.5
+    print(json.dumps({
+        "backend": backend,
+        "profile": policy.profile_name(is_peak),
+        "is_peak": is_peak,
+        "consolidate_after_s": [float(x) for x in action.consolidate_after_s],
+        "consolidation_aggr": [float(x) for x in action.consolidation_aggr],
+        "zone_weight": [[float(x) for x in row] for row in action.zone_weight],
+    }, indent=2))
+    return 0
+
+
+def jax_tree_first(tree):
+    """Strip the leading length-1 time axis from a 1-step trace."""
+    import jax
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
+                  clusters: int, seed: int, stochastic: bool) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ccka_tpu.policy import RulePolicy
+    from ccka_tpu.sim import (SimParams, batched_rollout, initial_state,
+                              rollout, summarize)
+    from ccka_tpu.sim.types import Action
+    from ccka_tpu.signals.live import make_signal_source
+
+    params = SimParams.from_config(cfg)
+    steps = int(days * 86400.0 / cfg.sim.dt_s)
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+
+    if backend == "rule":
+        action_fn = RulePolicy(cfg.cluster).action_fn()
+    else:
+        neutral = Action.neutral(cfg.cluster.n_pools, cfg.cluster.n_zones)
+        action_fn = lambda s, e, t: neutral  # noqa: E731
+
+    if clusters == 1:
+        trace = src.trace(steps, seed=seed)
+        final, metrics = jax.jit(
+            lambda s, k: rollout(params, s, action_fn, trace, k,
+                                 stochastic=stochastic)
+        )(initial_state(cfg), jax.random.key(seed))
+    else:
+        traces = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[src.trace(steps, seed=seed + i) for i in range(clusters)])
+        states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (clusters,) + x.shape),
+            initial_state(cfg))
+        keys = jax.random.split(jax.random.key(seed), clusters)
+        final, metrics = batched_rollout(params, states, action_fn, traces,
+                                         keys, stochastic=stochastic)
+    s = summarize(params, metrics)
+    import numpy as np
+    report = {k: np.asarray(v).mean().item() for k, v in s._asdict().items()}
+    report["backend"] = backend
+    report["clusters"] = clusters
+    report["days"] = days
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+def _cmd_preroll(cfg: FrameworkConfig, live: bool) -> int:
+    from ccka_tpu.harness.preroll import run_preroll
+    return run_preroll(cfg, live=live)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    try:
+        cfg = _load_config(args)
+    except ConfigError as e:
+        print(f"ccka: config error: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"ccka: cannot read config: {e}", file=sys.stderr)
+        return 2
+
+    if args.command in ("offpeak", "peak", "reset"):
+        return _cmd_profile(cfg, args.command, args.live, args.json)
+    if args.command == "observe":
+        return _cmd_observe(cfg, args.backend)
+    if args.command == "simulate":
+        return _cmd_simulate(cfg, args.backend, args.days, args.clusters,
+                             args.seed, args.stochastic)
+    if args.command == "preroll":
+        return _cmd_preroll(cfg, args.live)
+    if args.command == "show-config":
+        print(cfg.to_json())
+        return 0
+    raise SystemExit(f"unknown command {args.command}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -248,6 +248,7 @@ class SignalsConfig:
     """
 
     backend: str = "synthetic"  # "synthetic" | "replay" | "live"
+    replay_path: str = ""       # .npz trace for the replay backend
     carbon_api_key: str = ""
     carbon_zone: str = "US-CAL-CISO"
     carbon_default_g_kwh: float = 400.0
@@ -260,6 +261,8 @@ class SignalsConfig:
     def validate(self) -> None:
         if self.backend not in ("synthetic", "replay", "live"):
             raise ConfigError(f"signals: unknown backend {self.backend!r}")
+        if self.backend == "replay" and not self.replay_path:
+            raise ConfigError("signals: replay backend requires replay_path")
         if self.carbon_default_g_kwh <= 0:
             raise ConfigError("signals: non-positive default carbon intensity")
         if self.scrape_interval_s <= 0:

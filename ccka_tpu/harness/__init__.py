@@ -1,0 +1,10 @@
+"""Demo/ops harness: preroll checks, paired configure/observe, lifecycle.
+
+The reference's operational discipline (SURVEY.md §4) — preroll assertion
+gates (`demo_18_preroll_check.sh`), paired `*_configure.sh`/`*_observe.sh`
+stages, reset (`demo_19`) and cleanup (`demo_50`) — re-expressed as Python
+components usable both as a pytest fixture layer and from the CLI.
+"""
+
+from ccka_tpu.harness.preroll import PrerollCheck, run_preroll  # noqa: F401
+from ccka_tpu.harness.lifecycle import Stage, ConfigureObserve  # noqa: F401

@@ -1,0 +1,145 @@
+"""Preroll assertion gate — `demo_18_preroll_check.sh` as a component.
+
+The reference asserts, each with exit-1 + remediation hint (`:23-81`):
+namespace exists, zero leftover burst workloads, NodePools in the neutral
+profile, dashboard ports free, and the Karpenter node role mapped in
+aws-auth. The framework analog checks the pieces *this* stack depends on,
+in two tiers:
+
+- always: config validity, JAX backend present, simulator compiles a step,
+  signal source produces a sane tick;
+- --live additionally: kubectl reachable, both NodePools exist, NodePools
+  currently neutral (consolidationPolicy WhenEmpty, `demo_18:42-55`).
+
+Each check returns (ok, detail) and the runner prints a pass/fail table —
+the same contract as the bash gate, machine-checkable from pytest.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+from ccka_tpu.config import ConfigError, FrameworkConfig
+
+
+@dataclass
+class PrerollCheck:
+    name: str
+    ok: bool
+    detail: str = ""
+    hint: str = ""
+
+
+def check_config(cfg: FrameworkConfig) -> PrerollCheck:
+    try:
+        cfg.validate()
+        return PrerollCheck("config-valid", True)
+    except ConfigError as e:
+        return PrerollCheck("config-valid", False, str(e),
+                            hint="fix the flagged field or CCKA_* override")
+
+
+def check_jax_backend() -> PrerollCheck:
+    try:
+        import jax
+        devices = jax.devices()
+        kinds = {d.platform for d in devices}
+        return PrerollCheck("jax-backend", True,
+                            f"{len(devices)} device(s): {sorted(kinds)}")
+    except Exception as e:  # noqa: BLE001 — any backend failure blocks
+        return PrerollCheck("jax-backend", False, str(e),
+                            hint="check JAX_PLATFORMS / TPU runtime")
+
+
+def check_simulator_compiles(cfg: FrameworkConfig) -> PrerollCheck:
+    try:
+        import jax
+
+        from ccka_tpu.policy.rule import neutral_action
+        from ccka_tpu.sim import SimParams, initial_state, rollout
+        from ccka_tpu.signals import SyntheticSignalSource
+
+        params = SimParams.from_config(cfg)
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        tr = src.trace(4)
+        act = neutral_action(cfg.cluster)
+        final, _ = jax.jit(
+            lambda s, k: rollout(params, s, lambda st, e, t: act, tr, k)
+        )(initial_state(cfg), jax.random.key(0))
+        jax.block_until_ready(final)
+        return PrerollCheck("simulator-compiles", True)
+    except Exception as e:  # noqa: BLE001
+        return PrerollCheck("simulator-compiles", False, repr(e)[:300],
+                            hint="simulator/XLA regression — run pytest tests/test_sim.py")
+
+
+def check_signals(cfg: FrameworkConfig) -> PrerollCheck:
+    try:
+        import numpy as np
+
+        from ccka_tpu.signals.live import make_signal_source
+        src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim,
+                                 cfg.signals)
+        tick = src.tick(0)
+        arr = np.asarray(tick.carbon_g_kwh)
+        if not np.all(np.isfinite(arr)) or np.any(arr <= 0):
+            return PrerollCheck("signals-sane", False,
+                                f"carbon tick {arr.tolist()}",
+                                hint="check signal backend configuration")
+        return PrerollCheck("signals-sane", True,
+                            f"backend={cfg.signals.backend}")
+    except Exception as e:  # noqa: BLE001
+        return PrerollCheck("signals-sane", False, repr(e)[:300],
+                            hint="check signals.* config / endpoints")
+
+
+def check_nodepools_live(cfg: FrameworkConfig, runner) -> list[PrerollCheck]:
+    """Live-cluster checks (demo_18:42-55): pools exist and are neutral."""
+    out = []
+    for pool in cfg.cluster.pools:
+        rc, got = runner(["kubectl", "get", "nodepool", pool.name, "-o",
+                          "jsonpath={.spec.disruption.consolidationPolicy}"])
+        if rc != 0:
+            out.append(PrerollCheck(f"nodepool-{pool.name}", False, got,
+                                    hint="create the NodePool (ccka bootstrap)"))
+        elif got.strip() not in ("WhenEmpty", ""):
+            out.append(PrerollCheck(
+                f"nodepool-{pool.name}", False,
+                f"consolidationPolicy={got.strip()!r} not neutral",
+                hint="run `ccka reset` first (demo_19 analog)"))
+        else:
+            out.append(PrerollCheck(f"nodepool-{pool.name}", True))
+    return out
+
+
+def run_preroll(cfg: FrameworkConfig, *, live: bool = False,
+                runner=None, echo: bool = True) -> int:
+    """Run all checks; returns 0 iff all pass (exit-code contract of
+    demo_18_preroll_check.sh)."""
+    checks: list[PrerollCheck] = [
+        check_config(cfg),
+        check_jax_backend(),
+        check_simulator_compiles(cfg),
+        check_signals(cfg),
+    ]
+    if live:
+        from ccka_tpu.actuation.sink import _subprocess_runner
+        checks.extend(check_nodepools_live(cfg, runner or _subprocess_runner))
+
+    ok = True
+    for c in checks:
+        ok &= c.ok
+        if echo:
+            mark = "PASS" if c.ok else "FAIL"
+            line = f"[{mark}] {c.name}"
+            if c.detail:
+                line += f" — {c.detail}"
+            if not c.ok and c.hint:
+                line += f"  (hint: {c.hint})"
+            print(line)
+    if echo:
+        print(f"[{'ok' if ok else 'err'}] preroll "
+              f"{'passed' if ok else 'FAILED'}")
+    return 0 if ok else 1

@@ -1,0 +1,23 @@
+"""Policy layer: the pluggable decision step.
+
+The reference's "policy engine" is two bash scripts the operator runs by hand
+— `demo_20_offpeak_configure.sh` (cost-biased) and `demo_21_peak_configure.sh`
+(SLO-biased) — each hard-coding disruption settings, zone sets and
+capacity-type sets (`SURVEY.md` §3.2). Here the decision step is a
+:class:`~ccka_tpu.policy.base.PolicyBackend` with a jittable
+``decide(state, exo, t) -> Action`` surface:
+
+- :class:`~ccka_tpu.policy.rule.RulePolicy` — the CPU reference, reproducing
+  Peak/Off-Peak semantics exactly (golden-tested against the reference's
+  emitted patch JSON);
+- learned TPU backends (``ccka_tpu.train``) — diff-MPC and PPO over the
+  batched simulator.
+
+``constraints`` encodes the Kyverno admission guardrails (`04_kyverno.sh`)
+as action feasibility projection, so *any* backend's output renders to valid,
+policy-compliant Karpenter patches.
+"""
+
+from ccka_tpu.policy.base import Observation, PolicyBackend  # noqa: F401
+from ccka_tpu.policy.rule import RulePolicy, offpeak_action, peak_action  # noqa: F401
+from ccka_tpu.policy.constraints import project_feasible  # noqa: F401

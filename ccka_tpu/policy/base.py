@@ -1,0 +1,78 @@
+"""PolicyBackend interface and the observation feature surface.
+
+`BASELINE.json` north star: "Replace the hand-coded Peak/Off-Peak decision
+logic with a pluggable PolicyBackend interface… demo_20/21 become thin
+callers of PolicyBackend.decide()". The interface is deliberately jittable:
+``decide`` is a pure function of (state, exogenous tick, time index) so the
+same backend drives (a) the live 30s control loop, (b) million-step batched
+simulation under `lax.scan`/`vmap`, and (c) gradient-based training.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ccka_tpu.sim.dynamics import ExoStep
+from ccka_tpu.sim.types import Action, ClusterState, SimParams
+
+
+class Observation(NamedTuple):
+    """Flat policy features, built from state + tick signals.
+
+    This is the tensorized form of what the reference's operator looks at
+    before choosing a profile: dashboards of cost, pending pods, node counts
+    and the clock (`demo_40_watch_observe.sh`, `README.md:52-57`).
+    """
+
+    nodes_pzc: jnp.ndarray      # [P, Z, T_CT] fleet
+    pipeline_ct: jnp.ndarray    # [T_CT] capacity in flight (nodes)
+    running: jnp.ndarray        # [C]
+    demand: jnp.ndarray         # [C] raw demand this tick
+    spot_price_hr: jnp.ndarray  # [Z]
+    od_price_hr: jnp.ndarray    # [Z]
+    carbon_g_kwh: jnp.ndarray   # [Z]
+    is_peak: jnp.ndarray        # []
+    tod_frac: jnp.ndarray       # [] time of day in [0,1)
+
+    def flatten(self) -> jnp.ndarray:
+        """Single feature vector (for MLP policies)."""
+        parts = [jnp.ravel(x) for x in self]
+        return jnp.concatenate([p.astype(jnp.float32) for p in parts])
+
+
+def observe(params: SimParams, state: ClusterState, exo: ExoStep) -> Observation:
+    return Observation(
+        nodes_pzc=state.nodes,
+        pipeline_ct=state.pipeline.sum(axis=(0, 1, 2)),
+        running=state.running,
+        demand=exo.demand_pods,
+        spot_price_hr=exo.spot_price_hr,
+        od_price_hr=exo.od_price_hr,
+        carbon_g_kwh=exo.carbon_g_kwh,
+        is_peak=exo.is_peak,
+        tod_frac=(state.time_s % 86400.0) / 86400.0,
+    )
+
+
+class PolicyBackend(abc.ABC):
+    """A pluggable decision backend.
+
+    Implementations must keep :meth:`decide` traceable (no Python branching
+    on array values) so it can live inside `jit`/`scan`/`vmap`/`grad`.
+    """
+
+    @abc.abstractmethod
+    def decide(self, state: ClusterState, exo: ExoStep,
+               t: jnp.ndarray) -> Action:
+        """Map the current cluster + signals to an action."""
+
+    def action_fn(self):
+        """Adapter for :func:`ccka_tpu.sim.rollout.rollout`."""
+        return lambda state, exo, t: self.decide(state, exo, t)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__

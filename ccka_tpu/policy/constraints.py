@@ -1,0 +1,75 @@
+"""Action feasibility projection — the Kyverno guardrails as math.
+
+The reference enforces safety at admission time with Kyverno ClusterPolicies
+(`04_kyverno.sh`): `require-requests-limits` (all pods must carry
+requests/limits, `:24-42`) and `critical-no-spot-without-pdb` (pods labeled
+critical may never tolerate `karpenter.sh/capacity-type=spot`, `:47-75`).
+Learned policies emit unconstrained continuous actions; this module projects
+them into the feasible set *before* they reach the simulator or the actuation
+layer, so every emitted Karpenter patch is admission-valid by construction
+(SURVEY.md §7 hard part (4)).
+
+Projections (all differentiable clamps/renormalizations):
+  1. box-clamp every field to its domain;
+  2. intersect capacity-type allowance with each pool's intrinsic set —
+     the on-demand-slo pool can never offer spot (PoolSpec.capacity_types);
+  3. SLO pools must always allow on-demand (the critical-workload guarantee:
+     capacity for non-spot-tolerating pods always exists);
+  4. a pool whose zone mask collapses to ~zero is reset to all-zones —
+     an empty requirement set would make the NodePool unsatisfiable
+     (the failure mode demo_30_burst_observe.sh:20-28 diagnoses);
+  5. hpa_scale bounded to [0.1, 4] so the HPA lever cannot hard-zero a
+     workload class.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ccka_tpu.config import ClusterConfig
+from ccka_tpu.sim.types import CT_OD, N_CT, Action
+
+_MIN_ZONE_MASS = 1e-3
+
+
+def static_ct_allow(cluster: ClusterConfig) -> jnp.ndarray:
+    allow = jnp.zeros((cluster.n_pools, N_CT), jnp.float32)
+    for i, pool in enumerate(cluster.pools):
+        for j, ct in enumerate(("spot", "on-demand")):
+            if ct in pool.capacity_types:
+                allow = allow.at[i, j].set(1.0)
+    return allow
+
+
+def slo_pool_mask(cluster: ClusterConfig) -> jnp.ndarray:
+    return jnp.asarray(
+        [1.0 if p.strategy == "slo" else 0.0 for p in cluster.pools],
+        jnp.float32)
+
+
+def project_feasible(action: Action, cluster: ClusterConfig) -> Action:
+    """Project an arbitrary action into the Kyverno-feasible set.
+
+    Traceable and differentiable (clamps + where), usable inside training
+    loops so the learned policy is optimized *through* the projection.
+    """
+    static = static_ct_allow(cluster)
+    slo_mask = slo_pool_mask(cluster)
+
+    zone_w = jnp.clip(action.zone_weight, 0.0, 1.0)
+    # Rule 4: never emit an unsatisfiable (all-zero) zone requirement.
+    mass = zone_w.sum(axis=-1, keepdims=True)
+    zone_w = jnp.where(mass < _MIN_ZONE_MASS, jnp.ones_like(zone_w), zone_w)
+
+    ct = jnp.clip(action.ct_allow, 0.0, 1.0) * static          # rule 2
+    # Rule 3: SLO pools always offer on-demand capacity.
+    ct = ct.at[:, CT_OD].set(
+        jnp.maximum(ct[:, CT_OD], slo_mask))
+
+    return Action(
+        zone_weight=zone_w,
+        ct_allow=ct,
+        consolidation_aggr=jnp.clip(action.consolidation_aggr, 0.0, 1.0),
+        consolidate_after_s=jnp.clip(action.consolidate_after_s, 0.0, 3600.0),
+        hpa_scale=jnp.clip(action.hpa_scale, 0.1, 4.0),        # rule 5
+    )

@@ -1,0 +1,112 @@
+"""The rule-based CPU reference policy: Peak / Off-Peak profiles.
+
+Reproduces the reference's two profiles exactly (the golden tests in
+`tests/test_actuation.py` assert the rendered patch JSON byte-matches the
+shapes written by the bash scripts):
+
+Off-Peak (`demo_20_offpeak_configure.sh`):
+  - spot pool disruption: `WhenEmptyOrUnderutilized` (aggressive, `:59`)
+  - od pool disruption:   `WhenEmpty` + `consolidateAfter: 60s` (`:60`)
+  - requirements (op:replace, `:69-79`): zones = OFFPEAK_ZONES;
+    spot pool capacity types ["spot","on-demand"], od pool ["on-demand"]
+
+Peak (`demo_21_peak_configure.sh`):
+  - both pools: `WhenEmpty` + `consolidateAfter: 120s` (`:56-57`)
+  - requirements (op:add, `:65-75`): zones = PEAK_ZONES; same capacity types
+
+The profile *choice* — which the reference delegates to the human operator
+(`README.md:52-57`) — is automated here from the peak-hours signal, closing
+the reference's "autoscaling controller" gap (§2.3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ccka_tpu.config import ClusterConfig
+from ccka_tpu.policy.base import PolicyBackend
+from ccka_tpu.sim.dynamics import ExoStep
+from ccka_tpu.sim.types import CT_OD, CT_SPOT, N_CT, Action, ClusterState
+
+
+def _zone_onehot(cluster: ClusterConfig, zones: tuple[str, ...]) -> jnp.ndarray:
+    w = [1.0 if z in zones else 0.0 for z in cluster.zones]
+    return jnp.asarray(w, jnp.float32)
+
+
+def _profile_ct_allow(cluster: ClusterConfig) -> jnp.ndarray:
+    """Both profiles pin the same capacity-type sets: spot pool allows
+    ["spot","on-demand"], od pool ["on-demand"]
+    (`demo_20_offpeak_configure.sh:74-78`, `demo_21_peak_configure.sh:70-74`)."""
+    allow = jnp.zeros((cluster.n_pools, N_CT), jnp.float32)
+    for i, pool in enumerate(cluster.pools):
+        if pool.strategy == "cost":
+            allow = allow.at[i, CT_SPOT].set(1.0)
+        allow = allow.at[i, CT_OD].set(1.0)
+    return allow
+
+
+def offpeak_action(cluster: ClusterConfig) -> Action:
+    """The demo_20 profile as a canonical Action."""
+    n_p = cluster.n_pools
+    zone_w = jnp.stack([_zone_onehot(cluster, cluster.offpeak_zones)] * n_p)
+    aggr = jnp.asarray(
+        [1.0 if p.strategy == "cost" else 0.0 for p in cluster.pools],
+        jnp.float32)
+    # Karpenter requires consolidateAfter with WhenEmpty; the spot pool's
+    # WhenEmptyOrUnderutilized patch omits it (demo_20:59) → Karpenter
+    # default 0s. The od pool gets 60s (demo_20:60).
+    after = jnp.asarray(
+        [0.0 if p.strategy == "cost" else 60.0 for p in cluster.pools],
+        jnp.float32)
+    return Action(
+        zone_weight=zone_w,
+        ct_allow=_profile_ct_allow(cluster),
+        consolidation_aggr=aggr,
+        consolidate_after_s=after,
+        hpa_scale=jnp.ones((2,), jnp.float32),
+    )
+
+
+def peak_action(cluster: ClusterConfig) -> Action:
+    """The demo_21 profile as a canonical Action."""
+    n_p = cluster.n_pools
+    zone_w = jnp.stack([_zone_onehot(cluster, cluster.peak_zones)] * n_p)
+    return Action(
+        zone_weight=zone_w,
+        ct_allow=_profile_ct_allow(cluster),
+        consolidation_aggr=jnp.zeros((n_p,), jnp.float32),
+        consolidate_after_s=jnp.full((n_p,), 120.0, jnp.float32),
+        hpa_scale=jnp.ones((2,), jnp.float32),
+    )
+
+
+def neutral_action(cluster: ClusterConfig) -> Action:
+    """The demo_19 reset profile: WhenEmpty/30s, all zones, intrinsic
+    capacity types (`demo_19_reset_policies.sh:22-29`)."""
+    return Action.neutral(cluster.n_pools, cluster.n_zones)
+
+
+class RulePolicy(PolicyBackend):
+    """Peak/Off-Peak switcher — the reference's decision logic, automated.
+
+    ``decide`` is traceable: both profile actions are precomputed constants
+    and selected per-tick with `lax.select`-style `where` on the peak-hours
+    signal, so the rule policy runs inside `scan`/`vmap` batches as the
+    baseline opponent for learned policies.
+    """
+
+    def __init__(self, cluster: ClusterConfig):
+        self.cluster = cluster
+        self._off = offpeak_action(cluster)
+        self._peak = peak_action(cluster)
+
+    def decide(self, state: ClusterState, exo: ExoStep,
+               t: jnp.ndarray) -> Action:
+        is_peak = exo.is_peak > 0.5
+        return jax.tree.map(
+            lambda a, b: jnp.where(is_peak, a, b), self._peak, self._off)
+
+    def profile_name(self, is_peak: bool) -> str:
+        return "peak" if is_peak else "offpeak"

@@ -237,7 +237,9 @@ class LiveSignalSource(SignalSource):
         nt = self.cluster.node_type
         base = self._synth.trace(t_index + 1, seed=seed).slice_steps(t_index, 0 + 1)
 
-        spot = np.asarray(base.spot_price_hr).copy()
+        # Spot prices pass through the synthetic prior — a live AWS
+        # spot-price-history feed is a future hook (the reference also has
+        # no spot-price signal; OpenCost covers realized node cost only).
         od = np.asarray(base.od_price_hr).copy()
         demand = np.asarray(base.demand_pods).copy()
 
@@ -262,30 +264,38 @@ class LiveSignalSource(SignalSource):
         carbon = np.full((1, z), carbon_val, dtype=np.float32)
 
         return ExogenousTrace(
-            spot_price_hr=as_f32(spot), od_price_hr=as_f32(od),
+            spot_price_hr=base.spot_price_hr, od_price_hr=as_f32(od),
             carbon_g_kwh=as_f32(carbon), demand_pods=as_f32(demand),
             is_peak=base.is_peak,
         )
 
     def trace(self, steps: int, *, seed: int = 0) -> ExogenousTrace:
-        # Backfill: synthetic prior, overwritten where live history exists.
-        # Demand means pending+running (the same quantity tick() scrapes);
-        # the range window ends at the source's wall-clock anchor.
-        base = self._synth.trace(steps, seed=seed)
-        demand = np.asarray(base.demand_pods).copy()
+        # Backfill a *historical* window ending at the wall-clock anchor:
+        # tick i covers [start + i·dt, start + (i+1)·dt) with
+        # start = anchor − steps·dt. The synthetic prior is re-anchored to
+        # that same past window so demand, prices, carbon and is_peak all
+        # refer to the same wall-clock instants; Prometheus samples are
+        # placed by their returned timestamps, not by array position.
         end = self.start_unix_s
         start = end - steps * self.sim.dt_s
+        synth_past = SyntheticSignalSource(
+            self.cluster, self._synth.workload, self.sim, self.signals,
+            start_unix_s=start)
+        base = synth_past.trace(steps, seed=seed)
+        demand = np.asarray(base.demand_pods).copy()
         try:
-            total = None
+            total: dict[int, float] = {}
             for q in (self.PENDING_QUERY, self.RUNNING_QUERY):
                 series = self.prom.query_range(q, start=start, end=end,
                                                step_s=self.sim.dt_s)
                 if series:
-                    _, _, vals = series[0]
-                    total = vals if total is None else total[:len(vals)] + vals[:len(total)]
-            if total is not None:
-                n = min(steps, len(total))
-                demand[:n, :] = total[:n, None] / demand.shape[-1]
+                    _, times, vals = series[0]
+                    for t, v in zip(times, vals):
+                        i = int(round((float(t) - start) / self.sim.dt_s))
+                        if 0 <= i < steps:
+                            total[i] = total.get(i, 0.0) + float(v)
+            for i, v in total.items():
+                demand[i, :] = v / demand.shape[-1]
         except SignalUnavailable:
             pass
         return ExogenousTrace(
@@ -299,14 +309,24 @@ def make_signal_source(cluster: ClusterConfig, workload: WorkloadConfig,
                        sim: SimConfig, signals: SignalsConfig,
                        *, fetch: Fetch | None = None,
                        replay_path: str | None = None) -> SignalSource:
-    """Factory keyed on ``signals.backend``."""
+    """Factory keyed on ``signals.backend``.
+
+    ``replay_path`` defaults to ``signals.replay_path``, so the replay
+    backend is reachable purely through config/CCKA_* env overrides.
+    """
+    from ccka_tpu.config import ConfigError
     if signals.backend == "synthetic":
         return SyntheticSignalSource(cluster, workload, sim, signals)
     if signals.backend == "replay":
         from ccka_tpu.signals.replay import ReplaySignalSource
-        if not replay_path:
-            raise ValueError("replay backend requires replay_path")
-        return ReplaySignalSource.from_file(replay_path)
+        path = replay_path or signals.replay_path
+        if not path:
+            raise ConfigError("signals: replay backend requires replay_path")
+        try:
+            return ReplaySignalSource.from_file(path)
+        except (OSError, KeyError, ValueError) as e:
+            raise ConfigError(f"signals: cannot load replay trace "
+                              f"{path!r}: {e}") from e
     if signals.backend == "live":
         return LiveSignalSource(cluster, workload, sim, signals, fetch=fetch)
-    raise ValueError(f"unknown signals backend {signals.backend!r}")
+    raise ConfigError(f"unknown signals backend {signals.backend!r}")

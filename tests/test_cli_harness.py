@@ -1,0 +1,119 @@
+"""CLI + harness tests: subcommand contracts, preroll gate, lifecycle pairs."""
+
+import json
+
+import pytest
+
+from ccka_tpu.actuation import DryRunSink, render_nodepool_patches
+from ccka_tpu.cli import main
+from ccka_tpu.config import default_config
+from ccka_tpu.harness import ConfigureObserve, Stage, run_preroll
+from ccka_tpu.policy import offpeak_action, peak_action
+
+
+def test_cli_show_config(capsys):
+    assert main(["show-config"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["cluster"]["name"] == "demo1"
+
+
+def test_cli_offpeak_dry_run(capsys):
+    assert main(["offpeak"]) == 0
+    captured = capsys.readouterr()
+    assert "kubectl patch nodepool spot-preferred" in captured.out
+    assert "WhenEmptyOrUnderutilized" in captured.out
+    assert "offpeak profile rendered (dry-run)" in captured.err
+
+
+def test_cli_peak_json_output(capsys):
+    assert main(["peak", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    pools = {d["pool"] for d in doc}
+    assert pools == {"spot-preferred", "on-demand-slo"}
+    assert doc[0]["requirements_json"][0]["op"] == "add"  # demo_21:65
+
+
+def test_cli_reset_neutral(capsys):
+    assert main(["reset"]) == 0
+    out = capsys.readouterr().out
+    assert '"consolidateAfter": "30s"' in out or "30s" in out  # demo_19:22-29
+
+
+def test_cli_set_override(capsys):
+    assert main(["--set", "cluster.name=prod", "show-config"]) == 0
+    assert json.loads(capsys.readouterr().out)["cluster"]["name"] == "prod"
+
+
+def test_cli_observe(capsys):
+    assert main(["observe"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["profile"] in ("peak", "offpeak")
+    assert len(doc["consolidate_after_s"]) == 2
+
+
+def test_cli_simulate_small(capsys):
+    assert main(["--set", "sim.horizon_steps=16", "simulate", "--days",
+                 "0.01", "--backend", "rule"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["cost_usd"] > 0
+    assert 0.0 <= doc["slo_attainment"] <= 1.0
+
+
+def test_preroll_passes_offline(capsys):
+    cfg = default_config()
+    assert run_preroll(cfg, live=False) == 0
+    out = capsys.readouterr().out
+    assert "[PASS] config-valid" in out
+    assert "[PASS] simulator-compiles" in out
+
+
+def test_preroll_live_checks_with_fake_kubectl():
+    cfg = default_config()
+
+    def neutral_runner(argv):
+        return 0, "WhenEmpty"
+
+    assert run_preroll(cfg, live=True, runner=neutral_runner, echo=False) == 0
+
+    def hot_runner(argv):
+        return 0, "WhenEmptyOrUnderutilized"
+
+    # demo_18:42-55 — non-neutral pools must fail the gate
+    assert run_preroll(cfg, live=True, runner=hot_runner, echo=False) == 1
+
+    def missing_runner(argv):
+        return 1, "Error from server (NotFound)"
+
+    assert run_preroll(cfg, live=True, runner=missing_runner, echo=False) == 1
+
+
+def test_configure_observe_pair():
+    cfg = default_config()
+    co = ConfigureObserve(DryRunSink())
+    stage = Stage(
+        name="offpeak",
+        patchsets=render_nodepool_patches(offpeak_action(cfg.cluster),
+                                          cfg.cluster),
+        expect={
+            # demo_20_offpeak_observe.sh expectations
+            "spot-preferred": ("WhenEmptyOrUnderutilized",
+                               ["spot", "on-demand"]),
+            "on-demand-slo": ("WhenEmpty", ["on-demand"]),
+        })
+    assert co.run(stage)
+
+
+def test_configure_observe_detects_mismatch():
+    cfg = default_config()
+    co = ConfigureObserve(DryRunSink())
+    stage = Stage(
+        name="bad-oracle",
+        patchsets=render_nodepool_patches(peak_action(cfg.cluster),
+                                          cfg.cluster, op="add"),
+        expect={"spot-preferred": ("WhenEmptyOrUnderutilized", ["spot"])})
+    assert not co.run(stage)
+
+
+def test_cli_bad_set_clean_error(capsys):
+    assert main(["--set", "sim.nope=1", "show-config"]) == 2
+    assert "config error" in capsys.readouterr().err

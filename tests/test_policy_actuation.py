@@ -1,0 +1,302 @@
+"""Golden tests: rule policy → rendered patches must byte-match the
+reference's emitted JSON (the oracle format, SURVEY.md §4 "Implication"),
+plus constraint projection and sink apply/verify/fallback semantics.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.actuation import (
+    DryRunSink,
+    KubectlSink,
+    render_hpa_manifests,
+    render_keda_scaledobject,
+    render_nodepool_patches,
+)
+from ccka_tpu.actuation.patches import FALLBACK_PATH, PRIMARY_PATH
+from ccka_tpu.config import default_config
+from ccka_tpu.policy import (
+    RulePolicy,
+    offpeak_action,
+    peak_action,
+    project_feasible,
+)
+from ccka_tpu.sim import SimParams, initial_state, rollout, summarize
+from ccka_tpu.signals import SyntheticSignalSource
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config()
+
+
+# ---------------------------------------------------------------------------
+# Golden patch JSON — oracle strings transcribed from the reference scripts.
+# ---------------------------------------------------------------------------
+
+
+def test_offpeak_disruption_merge_golden(cfg):
+    ps = render_nodepool_patches(offpeak_action(cfg.cluster), cfg.cluster,
+                                 op="replace")
+    by_pool = {p.pool: p for p in ps}
+    # demo_20_offpeak_configure.sh:59
+    assert by_pool["spot-preferred"].disruption_merge == json.loads(
+        '{"spec":{"disruption":{"consolidationPolicy":"WhenEmptyOrUnderutilized"}}}')
+    # demo_20_offpeak_configure.sh:60
+    assert by_pool["on-demand-slo"].disruption_merge == json.loads(
+        '{"spec":{"disruption":{"consolidationPolicy":"WhenEmpty","consolidateAfter":"60s"}}}')
+
+
+def test_offpeak_requirements_json_golden(cfg):
+    ps = render_nodepool_patches(offpeak_action(cfg.cluster), cfg.cluster,
+                                 op="replace")
+    by_pool = {p.pool: p for p in ps}
+    # demo_20_offpeak_configure.sh:69-79 with OFFPEAK_ZONES=us-east-2a
+    # (demo_00_env.sh:22)
+    assert by_pool["spot-preferred"].requirements_json == json.loads(
+        '[{"op":"replace","path":"/spec/template/spec/requirements","value":['
+        '{"key":"topology.kubernetes.io/zone","operator":"In","values":["us-east-2a"]},'
+        '{"key":"karpenter.sh/capacity-type","operator":"In","values":["spot","on-demand"]}]}]')
+    assert by_pool["on-demand-slo"].requirements_json == json.loads(
+        '[{"op":"replace","path":"/spec/template/spec/requirements","value":['
+        '{"key":"topology.kubernetes.io/zone","operator":"In","values":["us-east-2a"]},'
+        '{"key":"karpenter.sh/capacity-type","operator":"In","values":["on-demand"]}]}]')
+
+
+def test_peak_patches_golden(cfg):
+    ps = render_nodepool_patches(peak_action(cfg.cluster), cfg.cluster,
+                                 op="add")
+    by_pool = {p.pool: p for p in ps}
+    # demo_21_peak_configure.sh:56-57 — both pools WhenEmpty/120s
+    for pool in ("spot-preferred", "on-demand-slo"):
+        assert by_pool[pool].disruption_merge == json.loads(
+            '{"spec":{"disruption":{"consolidationPolicy":"WhenEmpty","consolidateAfter":"120s"}}}')
+    # demo_21:65-75 — op:add, PEAK_ZONES=us-east-2c (demo_00_env.sh:23)
+    req = by_pool["spot-preferred"].requirements_json
+    assert req[0]["op"] == "add"
+    assert req[0]["path"] == "/spec/template/spec/requirements"
+    assert req[0]["value"][0]["values"] == ["us-east-2c"]
+    assert req[0]["value"][1]["values"] == ["spot", "on-demand"]
+    assert by_pool["on-demand-slo"].requirements_json[0]["value"][1][
+        "values"] == ["on-demand"]
+
+
+def test_fallback_patch_path(cfg):
+    ps = render_nodepool_patches(offpeak_action(cfg.cluster), cfg.cluster)
+    assert ps[0].requirements_json_fallback[0]["path"] == \
+        "/spec/template/requirements"  # demo_20:87,110
+
+
+# ---------------------------------------------------------------------------
+# Rule policy behavior
+# ---------------------------------------------------------------------------
+
+
+def test_rule_policy_switches_on_peak_signal(cfg):
+    policy = RulePolicy(cfg.cluster)
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    tr = src.trace(2880, seed=0)
+    params = SimParams.from_config(cfg)
+    final, metrics = rollout(params, initial_state(cfg), policy.action_fn(),
+                             tr, jax.random.key(0))
+    s = summarize(params, metrics)
+    assert float(s.cost_usd) > 0
+    assert np.all(np.isfinite(np.asarray(jax.tree.leaves(s)[0])))
+
+
+def test_rule_policy_is_traceable_and_matches_profiles(cfg):
+    policy = RulePolicy(cfg.cluster)
+    from ccka_tpu.sim.dynamics import ExoStep
+    z = cfg.cluster.n_zones
+
+    def exo(is_peak):
+        return ExoStep(
+            spot_price_hr=jnp.zeros((z,)), od_price_hr=jnp.zeros((z,)),
+            carbon_g_kwh=jnp.zeros((z,)), demand_pods=jnp.zeros((2,)),
+            is_peak=jnp.float32(is_peak))
+
+    decide = jax.jit(policy.decide)
+    st = initial_state(cfg)
+    a_off = decide(st, exo(0.0), jnp.int32(0))
+    a_peak = decide(st, exo(1.0), jnp.int32(0))
+    assert np.allclose(np.asarray(a_off.consolidate_after_s),
+                       np.asarray(offpeak_action(cfg.cluster).consolidate_after_s))
+    assert np.allclose(np.asarray(a_peak.zone_weight),
+                       np.asarray(peak_action(cfg.cluster).zone_weight))
+
+
+# ---------------------------------------------------------------------------
+# Constraint projection (Kyverno guardrails, 04_kyverno.sh)
+# ---------------------------------------------------------------------------
+
+
+def test_project_feasible_od_pool_never_spot(cfg):
+    a = offpeak_action(cfg.cluster)._replace(
+        ct_allow=jnp.ones((2, 2), jnp.float32))  # try to allow spot everywhere
+    p = project_feasible(a, cfg.cluster)
+    od_idx = cfg.cluster.pool_index("on-demand-slo")
+    assert float(p.ct_allow[od_idx, 0]) == 0.0  # spot stripped
+    assert float(p.ct_allow[od_idx, 1]) == 1.0
+
+
+def test_project_feasible_slo_pool_keeps_od(cfg):
+    a = offpeak_action(cfg.cluster)._replace(
+        ct_allow=jnp.zeros((2, 2), jnp.float32))  # try to disallow everything
+    p = project_feasible(a, cfg.cluster)
+    od_idx = cfg.cluster.pool_index("on-demand-slo")
+    assert float(p.ct_allow[od_idx, 1]) == 1.0  # critical capacity guaranteed
+
+
+def test_project_feasible_zone_collapse_resets(cfg):
+    a = offpeak_action(cfg.cluster)._replace(
+        zone_weight=jnp.zeros((2, 3), jnp.float32))
+    p = project_feasible(a, cfg.cluster)
+    assert np.all(np.asarray(p.zone_weight) == 1.0)
+
+
+def test_project_feasible_hpa_bounded(cfg):
+    a = offpeak_action(cfg.cluster)._replace(
+        hpa_scale=jnp.asarray([0.0, 100.0], jnp.float32))
+    p = project_feasible(a, cfg.cluster)
+    assert float(p.hpa_scale[0]) == pytest.approx(0.1)
+    assert float(p.hpa_scale[1]) == pytest.approx(4.0)
+
+
+def test_projection_is_differentiable(cfg):
+    def loss(x):
+        a = offpeak_action(cfg.cluster)._replace(zone_weight=x)
+        return project_feasible(a, cfg.cluster).zone_weight.sum()
+
+    g = jax.grad(loss)(jnp.full((2, 3), 0.7, jnp.float32))
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+# ---------------------------------------------------------------------------
+# Sinks
+# ---------------------------------------------------------------------------
+
+
+def test_dry_run_sink_applies_and_verifies(cfg):
+    sink = DryRunSink()
+    results = sink.apply_all(
+        render_nodepool_patches(offpeak_action(cfg.cluster), cfg.cluster))
+    assert all(r.ok for r in results)
+    assert not any(r.used_fallback for r in results)
+    # 2 pools × (merge + json) = 4 commands
+    assert len(sink.commands) == 4
+    assert sink.rendered()[0].startswith("kubectl patch nodepool spot-preferred")
+
+
+def test_dry_run_sink_fallback_branch(cfg):
+    sink = DryRunSink(schema_path=FALLBACK_PATH)
+    results = sink.apply_all(
+        render_nodepool_patches(peak_action(cfg.cluster), cfg.cluster, op="add"))
+    assert all(r.ok for r in results)
+    assert all(r.used_fallback for r in results)
+    # merge + primary json (fails) + fallback json per pool
+    assert len(sink.commands) == 6
+
+
+def test_kubectl_sink_with_fake_runner(cfg):
+    calls = []
+
+    def runner(argv):
+        calls.append(list(argv))
+        if argv[:2] == ["kubectl", "get"]:
+            return 0, "topology.kubernetes.io/zone=In:us-east-2a \n"
+        return 0, "nodepool.karpenter.sh/spot-preferred patched"
+
+    sink = KubectlSink(runner=runner)
+    results = sink.apply_all(
+        render_nodepool_patches(offpeak_action(cfg.cluster), cfg.cluster))
+    assert all(r.ok and not r.used_fallback for r in results)
+    patch_calls = [c for c in calls if c[:2] == ["kubectl", "patch"]]
+    assert "--type=merge" in patch_calls[0]
+    assert "--type=json" in patch_calls[1]
+
+
+def test_kubectl_sink_fallback_on_empty_readback(cfg):
+    state = {"applied_fallback": False}
+
+    def runner(argv):
+        if argv[:2] == ["kubectl", "get"]:
+            # Primary jsonpath reads empty; fallback reads populated.
+            if ".spec.template.spec." in argv[-1]:
+                return 0, ""
+            return 0, "karpenter.sh/capacity-type=In:on-demand \n"
+        if "--type=json" in argv and "/spec/template/requirements" in argv[-1]:
+            state["applied_fallback"] = True
+        return 0, "ok"
+
+    sink = KubectlSink(runner=runner)
+    res = sink.apply_nodepool(
+        render_nodepool_patches(offpeak_action(cfg.cluster), cfg.cluster)[0])
+    assert res.ok and res.used_fallback
+    assert state["applied_fallback"]
+
+
+# ---------------------------------------------------------------------------
+# HPA / KEDA gap-closers (§2.3)
+# ---------------------------------------------------------------------------
+
+
+def test_hpa_manifests(cfg):
+    acts = offpeak_action(cfg.cluster)._replace(
+        hpa_scale=jnp.asarray([2.0, 0.5], jnp.float32))
+    hpas = render_hpa_manifests(acts, cfg.cluster, cfg.workload)
+    assert len(hpas) == 2
+    assert hpas[0]["kind"] == "HorizontalPodAutoscaler"
+    assert hpas[0]["spec"]["maxReplicas"] == 60  # 30 per class × 2.0
+    assert hpas[1]["spec"]["maxReplicas"] == 15  # 30 per class × 0.5
+    assert hpas[0]["metadata"]["namespace"] == "nov-22"  # demo_00_env.sh:9
+
+
+def test_keda_scaledobject(cfg):
+    so = render_keda_scaledobject(offpeak_action(cfg.cluster), "burst-queue")
+    assert so["kind"] == "ScaledObject"
+    assert so["spec"]["triggers"][0]["type"] == "aws-sqs-queue"
+    assert so["spec"]["triggers"][0]["metadata"]["awsRegion"] == "us-east-2"
+
+
+def test_reset_profile_never_grants_spot_to_slo_pool(cfg):
+    # Live-cluster safety: even an unprojected all-ones action (the neutral
+    # reset) must not patch the SLO pool to offer spot capacity
+    # (04_kyverno.sh:47-75 critical-workload guarantee, enforced at render).
+    from ccka_tpu.sim.types import Action
+    ps = render_nodepool_patches(
+        Action.neutral(cfg.cluster.n_pools, cfg.cluster.n_zones), cfg.cluster)
+    by_pool = {p.pool: p for p in ps}
+    cts = by_pool["on-demand-slo"].requirements_json[0]["value"][1]["values"]
+    assert cts == ["on-demand"]
+
+
+def test_lifecycle_verify_reads_back_from_sink(cfg):
+    # A sink on the legacy schema path silently rejects primary-path-only
+    # patches; verify() must catch that from the sink's observed state.
+    from ccka_tpu.actuation.patches import FALLBACK_PATH as FB
+    from ccka_tpu.harness import ConfigureObserve, Stage
+
+    class DroppingSink(DryRunSink):
+        """Accepts merges but silently drops requirements patches."""
+
+        def _patch(self, cmd):
+            if cmd.patch_type == "json":
+                self.commands.append(cmd)
+                return  # dropped on the floor
+            super()._patch(cmd)
+
+        def _readback_ok(self, pool, path_prefix):
+            return True  # lies about apply success
+
+    co = ConfigureObserve(DroppingSink())
+    stage = Stage(
+        name="offpeak",
+        patchsets=render_nodepool_patches(offpeak_action(cfg.cluster),
+                                          cfg.cluster),
+        expect={"spot-preferred": ("WhenEmptyOrUnderutilized",
+                                   ["spot", "on-demand"])})
+    assert not co.run(stage)  # skeptical read-back catches the drop

@@ -218,13 +218,54 @@ def test_trace_shape_validation_raises():
 
 def test_live_trace_backfills_pending_plus_running():
     cfg = default_config()
-    pts = [[i * 30.0, "10"] for i in range(8)]
+    anchor = 86400.0 * 100
+    start = anchor - 8 * 30.0
+    pts = [[start + i * 30.0, "10"] for i in range(8)]
     fetch = _canned_fetch({
         "/api/v1/query_range": {"status": "success", "data": {"result": [
             {"metric": {}, "values": pts}]}},
     })
     src = LiveSignalSource(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
-                           fetch=fetch, start_unix_s=86400.0 * 100)
+                           fetch=fetch, start_unix_s=anchor)
     tr = src.trace(8)
     # pending(10) + running(10) = 20 pods per step across 2 classes
     assert np.asarray(tr.demand_pods).sum(-1) == pytest.approx(np.full(8, 20.0))
+
+
+def test_replay_backend_reachable_via_config(tmp_path):
+    cfg0 = default_config()
+    synth = SyntheticSignalSource(cfg0.cluster, cfg0.workload, cfg0.sim,
+                                  cfg0.signals)
+    path = str(tmp_path / "rt.npz")
+    save_trace(path, synth.trace(16, seed=0), synth.meta())
+    cfg = cfg0.with_overrides(**{"signals.backend": "replay",
+                                 "signals.replay_path": path})
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    assert isinstance(src, ReplaySignalSource)
+    assert src.trace(8).steps == 8
+
+
+def test_replay_backend_missing_path_is_config_error():
+    from ccka_tpu.config import ConfigError
+    with pytest.raises(ConfigError, match="replay_path"):
+        default_config().with_overrides(**{"signals.backend": "replay"})
+
+
+def test_live_trace_backfill_aligned_by_timestamp():
+    # Samples are placed by returned timestamps: a range result covering only
+    # the last 4 ticks must land at indices 4..7, not 0..3.
+    cfg = default_config()
+    anchor = 86400.0 * 10
+    steps = 8
+    start = anchor - steps * 30.0
+    pts = [[start + i * 30.0, "7"] for i in range(4, 8)]
+    fetch = _canned_fetch({
+        "/api/v1/query_range": {"status": "success", "data": {"result": [
+            {"metric": {}, "values": pts}]}},
+    })
+    src = LiveSignalSource(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+                           fetch=fetch, start_unix_s=anchor)
+    tr = src.trace(steps)
+    demand = np.asarray(tr.demand_pods).sum(-1)
+    assert demand[4:] == pytest.approx(np.full(4, 14.0))  # 7 pending + 7 running
+    assert not np.allclose(demand[:4], 14.0)
