@@ -21,8 +21,7 @@ never creates (§2.3: prometheus-adapter installed yet no HPA object,
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -32,7 +31,11 @@ from ccka_tpu.sim.types import CT_OD, CT_SPOT, Action
 PRIMARY_PATH = "/spec/template/spec"    # demo_20:86
 FALLBACK_PATH = "/spec/template"        # demo_20:87
 
-_CT_NAMES = ("spot", "on-demand")       # index order = (CT_SPOT, CT_OD)
+# Rendered names by capacity-type index; tied to the sim constants so a
+# reorder there cannot silently desynchronize the wire format.
+_CT_NAMES = ("spot", "on-demand")
+assert _CT_NAMES.index("spot") == CT_SPOT
+assert _CT_NAMES.index("on-demand") == CT_OD
 
 
 @dataclass(frozen=True)
@@ -144,14 +147,20 @@ def render_hpa_manifests(action: Action, cluster: ClusterConfig,
 
 
 def render_keda_scaledobject(action: Action, queue_name: str,
+                             account_id: str,
                              namespace: str = "nov-22",
                              region: str = "us-east-2") -> dict:
     """KEDA ScaledObject for SQS-driven scaling.
 
     Realizes the reference's `.env:10-12` stub (`CREATE_SQS`,
     `SQS_QUEUE_NAME` with no ScaledObject or KEDA install anywhere).
+    ``account_id`` is the AWS account owning the queue (required — a
+    placeholder URL would render the scaler permanently inactive).
     Queue-length target tightens as the policy scales up (hpa_scale mean).
     """
+    if not account_id:
+        raise ValueError("render_keda_scaledobject requires the AWS "
+                         "account id owning the SQS queue")
     scale = float(np.mean(np.clip(np.asarray(action.hpa_scale), 0.1, 4.0)))
     queue_len = max(1, int(round(10.0 / scale)))
     return {
@@ -166,7 +175,7 @@ def render_keda_scaledobject(action: Action, queue_name: str,
                 "type": "aws-sqs-queue",
                 "metadata": {
                     "queueURL": f"https://sqs.{region}.amazonaws.com/"
-                                f"ACCOUNT/{queue_name}",
+                                f"{account_id}/{queue_name}",
                     "queueLength": str(queue_len),
                     "awsRegion": region,
                 },

@@ -17,7 +17,7 @@ from __future__ import annotations
 import json
 import shlex
 import subprocess
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Sequence
 
 from ccka_tpu.actuation.patches import (
@@ -59,8 +59,15 @@ class ActuationSink:
     """Base: apply a pool's patch set with read-back + fallback."""
 
     def apply_nodepool(self, ps: NodePoolPatchSet) -> ApplyResult:
-        self._patch(PatchCommand("nodepool", ps.pool, "merge",
-                                 ps.disruption_merge))
+        # The disruption merge patch is load-bearing: the reference runs it
+        # under `set -e` (demo_20:59-60), so a rejection aborts the profile.
+        if not self._patch(PatchCommand("nodepool", ps.pool, "merge",
+                                        ps.disruption_merge)):
+            return ApplyResult(ps.pool, ok=False, used_fallback=False,
+                               detail="disruption merge patch rejected: "
+                                      + self._dump(ps.pool)[:500])
+        # Requirements patch failures are tolerated here; the read-back +
+        # fallback below decides (demo_20:96-98).
         self._patch(PatchCommand("nodepool", ps.pool, "json",
                                  ps.requirements_json))
         if self._readback_ok(ps.pool, PRIMARY_PATH):
@@ -85,7 +92,8 @@ class ActuationSink:
 
     # -- backend hooks ------------------------------------------------------
 
-    def _patch(self, cmd: PatchCommand) -> None:
+    def _patch(self, cmd: PatchCommand) -> bool:
+        """Apply one mutation; returns False if the backend rejected it."""
         raise NotImplementedError
 
     def _readback_ok(self, pool: str, path_prefix: str) -> bool:
@@ -108,7 +116,7 @@ class DryRunSink(ActuationSink):
         self.schema_path = schema_path
         self.echo = echo
 
-    def _patch(self, cmd: PatchCommand) -> None:
+    def _patch(self, cmd: PatchCommand) -> bool:
         self.commands.append(cmd)
         if self.echo:
             print(cmd.render())
@@ -124,6 +132,7 @@ class DryRunSink(ActuationSink):
                 if oper["path"] == self.schema_path + "/requirements":
                     entry["requirements_at"] = oper["path"]
                     entry["requirements"] = oper["value"]
+        return True
 
     def _readback_ok(self, pool: str, path_prefix: str) -> bool:
         entry = self.store.get(pool, {})
@@ -159,12 +168,9 @@ class KubectlSink(ActuationSink):
     def __init__(self, runner: Runner | None = None):
         self.runner = runner or _subprocess_runner
 
-    def _patch(self, cmd: PatchCommand) -> None:
-        rc, out = self.runner(cmd.kubectl_argv())
-        if rc != 0:
-            # demo_20:96-98: primary-path failures are warnings; read-back
-            # decides whether the fallback fires.
-            pass
+    def _patch(self, cmd: PatchCommand) -> bool:
+        rc, _ = self.runner(cmd.kubectl_argv())
+        return rc == 0
 
     def _readback_ok(self, pool: str, path_prefix: str) -> bool:
         # demo_20:102: jsonpath over requirements key/operator/values.
@@ -206,7 +212,12 @@ def _subprocess_runner(argv: Sequence[str]) -> tuple[int, str]:
     try:
         proc = subprocess.run(list(argv), capture_output=True, text=True,
                               timeout=60, check=False)
-        return proc.returncode, proc.stdout
+        # kubectl writes error detail to stderr; fold it in so failures
+        # surface their reason to the operator (dump-state discipline).
+        out = proc.stdout
+        if proc.returncode != 0 and proc.stderr:
+            out = (out + "\n" + proc.stderr).strip()
+        return proc.returncode, out
     except (OSError, subprocess.TimeoutExpired) as e:
         return 127, str(e)
 

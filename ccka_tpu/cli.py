@@ -210,18 +210,23 @@ def main(argv: list[str] | None = None) -> int:
         print(f"ccka: cannot read config: {e}", file=sys.stderr)
         return 2
 
-    if args.command in ("offpeak", "peak", "reset"):
-        return _cmd_profile(cfg, args.command, args.live, args.json)
-    if args.command == "observe":
-        return _cmd_observe(cfg, args.backend)
-    if args.command == "simulate":
-        return _cmd_simulate(cfg, args.backend, args.days, args.clusters,
-                             args.seed, args.stochastic)
-    if args.command == "preroll":
-        return _cmd_preroll(cfg, args.live)
-    if args.command == "show-config":
-        print(cfg.to_json())
-        return 0
+    try:
+        if args.command in ("offpeak", "peak", "reset"):
+            return _cmd_profile(cfg, args.command, args.live, args.json)
+        if args.command == "observe":
+            return _cmd_observe(cfg, args.backend)
+        if args.command == "simulate":
+            return _cmd_simulate(cfg, args.backend, args.days, args.clusters,
+                                 args.seed, args.stochastic)
+        if args.command == "preroll":
+            return _cmd_preroll(cfg, args.live)
+        if args.command == "show-config":
+            print(cfg.to_json())
+            return 0
+    except ConfigError as e:
+        # e.g. a replay trace that validates as a path but fails to load
+        print(f"ccka: config error: {e}", file=sys.stderr)
+        return 2
     raise SystemExit(f"unknown command {args.command}")
 
 

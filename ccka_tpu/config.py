@@ -283,6 +283,9 @@ class TrainConfig:
     # PPO-specific.
     ppo_clip: float = 0.2
     ppo_epochs: int = 4
+    # Early-stop epochs once approx-KL exceeds this (masked inside the
+    # jitted epoch scan; prevents destructive late-training updates).
+    ppo_target_kl: float = 0.05
     gamma: float = 0.99
     gae_lambda: float = 0.95
     entropy_coef: float = 0.01

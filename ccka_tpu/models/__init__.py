@@ -1,0 +1,18 @@
+"""Policy networks (flax) for the learned backends.
+
+The reference's decision logic is two hand-coded bash profiles; BASELINE.json
+replaces it with "a small neural/MPC controller trained via PPO or direct
+gradient against a replayable cluster simulator". These are those
+controllers: a deterministic policy MLP (diff-MPC warm starts / behavior
+cloning), a Gaussian actor-critic (PPO), and the latent↔Action codec that
+maps unconstrained network outputs through squashing + the Kyverno
+feasibility projection into valid Karpenter actions.
+"""
+
+from ccka_tpu.models.nets import (  # noqa: F401
+    ActorCritic,
+    PolicyMLP,
+    latent_dim,
+    latent_to_action,
+    action_to_latent,
+)

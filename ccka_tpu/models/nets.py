@@ -1,0 +1,126 @@
+"""Networks and the latent action codec.
+
+Design for the MXU: the observation is tiny (~29 features), so the policy is
+a small MLP whose cost is dominated by dispatch, not FLOPs — the win comes
+from `vmap`ing it over thousands of clusters so the per-cluster matmul
+batches into one MXU-shaped [B, F] x [F, H] product (bfloat16 torso, float32
+heads for numerically-sensitive distribution parameters).
+
+The latent action codec keeps the network unconstrained (R^A) and maps into
+the feasible Action set with smooth squashings + the Kyverno projection
+(`ccka_tpu.policy.constraints`), so gradients and PPO exploration both live
+in an unbounded space while everything emitted is admission-valid.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccka_tpu.config import ClusterConfig
+from ccka_tpu.policy.constraints import project_feasible
+from ccka_tpu.sim.types import Action, N_CT
+
+_AFTER_MAX_S = 600.0   # consolidateAfter squash ceiling (10 min)
+_HPA_LO, _HPA_HI = 0.1, 4.0
+_EPS = 1e-6
+
+
+def latent_dim(cluster: ClusterConfig, n_classes: int = 2) -> int:
+    p, z = cluster.n_pools, cluster.n_zones
+    return p * z + p * N_CT + p + p + n_classes
+
+
+def latent_to_action(u: jnp.ndarray, cluster: ClusterConfig,
+                     n_classes: int = 2) -> Action:
+    """Unconstrained latent → feasible Action (smooth, invertible a.e.)."""
+    p, z = cluster.n_pools, cluster.n_zones
+    sizes = [p * z, p * N_CT, p, p, n_classes]
+    # Static split points — shapes must stay concrete under jit.
+    parts = jnp.split(u, list(np.cumsum(sizes)[:-1]), axis=-1)
+    zone_w = jax.nn.sigmoid(parts[0]).reshape(u.shape[:-1] + (p, z))
+    ct = jax.nn.sigmoid(parts[1]).reshape(u.shape[:-1] + (p, N_CT))
+    aggr = jax.nn.sigmoid(parts[2])
+    after = _AFTER_MAX_S * jax.nn.sigmoid(parts[3])
+    hpa = _HPA_LO + (_HPA_HI - _HPA_LO) * jax.nn.sigmoid(parts[4])
+    return project_feasible(
+        Action(zone_weight=zone_w, ct_allow=ct, consolidation_aggr=aggr,
+               consolidate_after_s=after, hpa_scale=hpa),
+        cluster)
+
+
+def action_to_latent(action: Action, cluster: ClusterConfig) -> jnp.ndarray:
+    """Inverse codec (clipped logit) — used to warm-start plans/policies at
+    a rule profile instead of random actions."""
+    def logit(x, lo=0.0, hi=1.0):
+        y = jnp.clip((x - lo) / (hi - lo), 1e-4, 1.0 - 1e-4)
+        return jnp.log(y) - jnp.log1p(-y)
+
+    parts = [
+        logit(action.zone_weight).reshape(action.zone_weight.shape[:-2] + (-1,)),
+        logit(action.ct_allow).reshape(action.ct_allow.shape[:-2] + (-1,)),
+        logit(action.consolidation_aggr),
+        logit(action.consolidate_after_s, 0.0, _AFTER_MAX_S),
+        logit(action.hpa_scale, _HPA_LO, _HPA_HI),
+    ]
+    return jnp.concatenate(parts, axis=-1)
+
+
+def _normalize_obs(obs: jnp.ndarray) -> jnp.ndarray:
+    """Cheap fixed normalization — keeps the net scale-free without running
+    statistics (feature magnitudes are known: nodes O(10), pods O(60),
+    $/hr O(0.1), gCO2/kWh O(500))."""
+    return jnp.sign(obs) * jnp.log1p(jnp.abs(obs))
+
+
+class PolicyMLP(nn.Module):
+    """Deterministic policy: observation → latent action.
+
+    bfloat16 torso (MXU-native), float32 output head.
+    """
+
+    out_dim: int
+    hidden: Sequence[int] = (128, 128)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> jnp.ndarray:
+        x = _normalize_obs(obs).astype(jnp.bfloat16)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=jnp.bfloat16)(x)
+            x = nn.gelu(x)
+        u = nn.Dense(self.out_dim, dtype=jnp.float32,
+                     kernel_init=nn.initializers.zeros)(x.astype(jnp.float32))
+        return u
+
+
+class ActorCritic(nn.Module):
+    """Gaussian actor + value critic with a shared torso (PPO).
+
+    The actor emits (mean, log_std) over the latent action space; log_std is
+    a learned state-independent vector (standard for continuous PPO). The
+    zero-init mean head makes the initial policy the codec midpoint — all
+    zones open, both capacity types allowed, mild consolidation — i.e. close
+    to the reference's neutral profile (`demo_19_reset_policies.sh`).
+    """
+
+    act_dim: int
+    hidden: Sequence[int] = (128, 128)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray):
+        x = _normalize_obs(obs).astype(jnp.bfloat16)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=jnp.bfloat16)(x)
+            x = nn.gelu(x)
+        x = x.astype(jnp.float32)
+        mean = nn.Dense(self.act_dim, dtype=jnp.float32,
+                        kernel_init=nn.initializers.zeros,
+                        name="actor_mean")(x)
+        log_std = self.param("log_std", nn.initializers.constant(-0.5),
+                             (self.act_dim,))
+        value = nn.Dense(1, dtype=jnp.float32, name="critic")(x)
+        return mean, log_std, jnp.squeeze(value, axis=-1)

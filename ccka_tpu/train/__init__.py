@@ -1,0 +1,22 @@
+"""Training: the learned PolicyBackends (diff-MPC and PPO) + checkpointing.
+
+BASELINE.json configs #2 and #3 realized:
+- ``mpc``  — direct gradient through the simulator: a receding-horizon plan
+  optimized with `jax.grad` through `lax.scan` (single cluster → batched);
+- ``ppo``  — actor-critic PPO over a `vmap` batch of stochastic simulated
+  clusters on synthetic or replayed traces;
+- ``objective`` — the shared scalarization ($ + carbon + SLO) so rule, MPC
+  and PPO are scored on identical ground;
+- ``checkpoint`` — orbax persistence of policy/train state (the durable
+  state store the reference delegates to the cluster + AMP, SURVEY.md §5).
+"""
+
+from ccka_tpu.train.objective import episode_objective, step_reward  # noqa: F401
+from ccka_tpu.train.mpc import MPCBackend, optimize_plan  # noqa: F401
+from ccka_tpu.train.ppo import PPOBackend, ppo_train  # noqa: F401
+from ccka_tpu.train.checkpoint import save_state, load_state  # noqa: F401
+from ccka_tpu.train.evaluate import (  # noqa: F401
+    compare_backends,
+    evaluate_backend,
+    heldout_traces,
+)

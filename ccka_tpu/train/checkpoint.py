@@ -1,0 +1,54 @@
+"""Checkpoint/resume via orbax — the durable state the reference never had.
+
+SURVEY.md §5: the reference's only persistence is idempotent re-runnable
+scripts plus state left in the cluster and AMP; policy parameters (the two
+bash profiles) are "checkpointed" in git. Learned policies need real
+persistence: orbax PyTree checkpoints of policy params / full train state,
+with step-numbered directories and latest-resume.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def save_state(path: str, state: Any, *, step: int | None = None) -> str:
+    """Save a pytree (policy params or full train state). Returns the
+    concrete checkpoint directory."""
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, f"step_{step:08d}")
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, jax.device_get(state), force=True)
+    return path
+
+
+def load_state(path: str, *, step: int | None = None,
+               target: Any = None) -> Any:
+    """Load a checkpoint; ``step=None`` with a step-structured directory
+    resumes the latest step."""
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, f"step_{step:08d}")
+    elif os.path.isdir(path):
+        steps = sorted(d for d in os.listdir(path) if d.startswith("step_"))
+        if steps:
+            path = os.path.join(path, steps[-1])
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(path, item=target)
+    return restored
+
+
+def latest_step(path: str) -> int | None:
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return None
+    steps = sorted(d for d in os.listdir(path) if d.startswith("step_"))
+    if not steps:
+        return None
+    return int(steps[-1].split("_")[1])

@@ -1,0 +1,76 @@
+"""Head-to-head backend evaluation on held-out traces.
+
+BASELINE.json's success criterion: the JAX policy "beats the rule baseline
+on $/SLO-hour and gCO2/req on held-out traces". This module runs any set of
+PolicyBackends over identical held-out stochastic worlds (same traces, same
+interruption randomness) and reports per-backend EpisodeSummary KPIs plus
+the scalar objective — the scoreboard for rule vs MPC vs PPO.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccka_tpu.config import FrameworkConfig
+from ccka_tpu.policy.base import PolicyBackend
+from ccka_tpu.sim import SimParams, initial_state, rollout, summarize
+from ccka_tpu.sim.types import StepMetrics
+from ccka_tpu.signals.base import ExogenousTrace, SignalSource
+from ccka_tpu.train.objective import episode_objective
+
+
+def heldout_traces(source: SignalSource, *, steps: int, n: int,
+                   seed0: int = 10_000) -> list[ExogenousTrace]:
+    """Evaluation traces from seeds disjoint from training seeds (training
+    uses seed+1000+i; evaluation starts at 10k)."""
+    return [source.trace(steps, seed=seed0 + i) for i in range(n)]
+
+
+def evaluate_backend(cfg: FrameworkConfig, backend: PolicyBackend,
+                     traces: list[ExogenousTrace], *,
+                     stochastic: bool = True,
+                     eval_seed: int = 0) -> dict:
+    """Mean KPIs for one backend over the held-out set. The world PRNG key
+    depends only on (eval_seed, trace index) — identical across backends —
+    so comparisons are paired."""
+    params = SimParams.from_config(cfg)
+    action_fn = backend.action_fn()
+    run = jax.jit(lambda s, tr, k: rollout(params, s, action_fn, tr, k,
+                                           stochastic=stochastic))
+    summaries, objectives = [], []
+    for i, tr in enumerate(traces):
+        final, metrics = run(initial_state(cfg),
+                             tr, jax.random.key(eval_seed * 131071 + i))
+        summaries.append(summarize(params, metrics))
+        objectives.append(episode_objective(metrics, cfg.train))
+    out = {k: float(np.mean([np.asarray(getattr(s, k)) for s in summaries]))
+           for k in summaries[0]._fields}
+    out["objective_usd"] = float(np.mean([np.asarray(o) for o in objectives]))
+    out["backend"] = backend.name
+    return out
+
+
+def compare_backends(cfg: FrameworkConfig,
+                     backends: Mapping[str, PolicyBackend],
+                     traces: list[ExogenousTrace],
+                     *, stochastic: bool = True) -> dict[str, dict]:
+    """Scoreboard: {name: KPI dict}, plus win/loss vs the 'rule' entry on
+    the two headline metrics when present."""
+    results = {name: evaluate_backend(cfg, b, traces, stochastic=stochastic)
+               for name, b in backends.items()}
+    rule = results.get("rule")
+    if rule:
+        for name, r in results.items():
+            if name == "rule":
+                continue
+            r["vs_rule_usd_per_slo_hour"] = (
+                r["usd_per_slo_hour"] / max(rule["usd_per_slo_hour"], 1e-9))
+            r["vs_rule_g_co2_per_kreq"] = (
+                r["g_co2_per_kreq"] / max(rule["g_co2_per_kreq"], 1e-9))
+            r["vs_rule_objective"] = (
+                r["objective_usd"] / max(rule["objective_usd"], 1e-9))
+    return results

@@ -1,0 +1,149 @@
+"""Differentiable MPC: direct gradient through the cluster simulator.
+
+BASELINE.json config #2: "1-cluster JAX diff-MPC on synthetic sinusoidal
+carbon + spot-price signal". The plan is a latent action sequence [H, A];
+the objective backpropagates through the full `lax.scan` of deterministic
+dynamics (`ccka_tpu.sim.dynamics.step` with expectation-mode interruptions),
+and Adam ascends it entirely on-device — the optimization loop itself is a
+`lax.fori_loop` inside one jit, so planning costs one XLA dispatch.
+
+Closed-loop use is receding horizon: re-plan every ``replan_every`` ticks
+from the current (possibly stochastic) state, execute the prefix.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ccka_tpu.config import ClusterConfig, FrameworkConfig, TrainConfig
+from ccka_tpu.models import action_to_latent, latent_to_action
+from ccka_tpu.policy.base import PolicyBackend
+from ccka_tpu.policy.rule import neutral_action
+from ccka_tpu.sim.dynamics import ExoStep
+from ccka_tpu.sim.rollout import exo_steps, rollout_actions
+from ccka_tpu.sim.types import Action, ClusterState, SimParams
+from ccka_tpu.signals.base import ExogenousTrace
+from ccka_tpu.train.objective import episode_objective
+
+
+class PlanResult(NamedTuple):
+    plan_latent: jnp.ndarray   # [H, A] optimized latent plan
+    losses: jnp.ndarray        # [iters] objective trajectory
+
+
+@partial(jax.jit, static_argnames=("cluster", "tcfg", "iters"))
+def optimize_plan(params: SimParams,
+                  cluster: ClusterConfig,
+                  tcfg: TrainConfig,
+                  state0: ClusterState,
+                  trace: ExogenousTrace,
+                  init_latent: jnp.ndarray,
+                  *,
+                  iters: int = 50) -> PlanResult:
+    """Optimize a latent plan against one trace window. Fully on-device."""
+
+    def objective(latent):
+        actions = jax.vmap(lambda u: latent_to_action(u, cluster))(latent)
+        _, metrics = rollout_actions(params, state0, actions, trace,
+                                     jax.random.key(0), stochastic=False)
+        return episode_objective(metrics, tcfg)
+
+    opt = optax.adam(tcfg.learning_rate * 10.0)  # plans tolerate larger steps
+
+    def body(i, carry):
+        latent, opt_state, losses = carry
+        loss, grads = jax.value_and_grad(objective)(latent)
+        updates, opt_state = opt.update(grads, opt_state, latent)
+        latent = optax.apply_updates(latent, updates)
+        return latent, opt_state, losses.at[i].set(loss)
+
+    losses0 = jnp.zeros((iters,), jnp.float32)
+    latent, _, losses = jax.lax.fori_loop(
+        0, iters, body, (init_latent, opt.init(init_latent), losses0))
+    return PlanResult(plan_latent=latent, losses=losses)
+
+
+class MPCBackend(PolicyBackend):
+    """Receding-horizon diff-MPC controller.
+
+    ``decide`` executes the current plan position; :meth:`replan` refreshes
+    the plan from the latest state + forecast window. The evaluation loop
+    (`evaluate`) interleaves stochastic world steps with periodic replanning
+    — the learned counterpart of the operator's demo_20/21 cadence.
+    """
+
+    def __init__(self, cfg: FrameworkConfig, *, horizon: int | None = None,
+                 iters: int | None = None, replan_every: int = 8):
+        self.cfg = cfg
+        self.cluster = cfg.cluster
+        self.params = SimParams.from_config(cfg)
+        self.tcfg = cfg.train
+        self.horizon = horizon or cfg.train.mpc_horizon
+        self.iters = iters or cfg.train.mpc_iters
+        self.replan_every = replan_every
+        # Warm start at the neutral profile rather than random actions.
+        base = action_to_latent(neutral_action(self.cluster), self.cluster)
+        self._plan = jnp.broadcast_to(base, (self.horizon,) + base.shape)
+        self._plan_age = 0
+
+    # -- planning -----------------------------------------------------------
+
+    def replan(self, state: ClusterState, window: ExogenousTrace) -> PlanResult:
+        window = window.slice_steps(0, self.horizon)
+        result = optimize_plan(self.params, self.cluster, self.tcfg, state,
+                               window, self._plan, iters=self.iters)
+        self._plan = result.plan_latent
+        self._plan_age = 0
+        return result
+
+    # -- PolicyBackend ------------------------------------------------------
+
+    def decide(self, state: ClusterState, exo: ExoStep,
+               t: jnp.ndarray) -> Action:
+        idx = jnp.minimum(jnp.asarray(t) % self.replan_every,
+                          self.horizon - 1)
+        latent = jnp.take(self._plan, idx, axis=0)
+        return latent_to_action(latent, self.cluster)
+
+    # -- closed-loop evaluation --------------------------------------------
+
+    def evaluate(self, state0: ClusterState, trace: ExogenousTrace,
+                 key: jax.Array, *, stochastic: bool = True):
+        """Closed-loop receding-horizon run over ``trace``; returns
+        (final_state, stacked StepMetrics) like `rollout`."""
+        from ccka_tpu.sim.dynamics import step as sim_step
+
+        steps = trace.steps
+        jit_step = jax.jit(partial(sim_step, stochastic=stochastic))
+        state = state0
+        all_metrics = []
+        xs = exo_steps(trace)
+        for t in range(steps):
+            if t % self.replan_every == 0:
+                window = trace.slice_steps(
+                    t, min(self.horizon, steps - t))
+                if window.steps < self.horizon:
+                    # pad by tiling the tail so the plan shape stays static
+                    reps = -(-self.horizon // max(window.steps, 1))
+                    window = ExogenousTrace(*[
+                        jnp.concatenate([x] * reps, axis=-2)[..., :self.horizon, :]
+                        if x.ndim >= 2 else
+                        jnp.concatenate([x] * reps, axis=-1)[..., :self.horizon]
+                        for x in window])
+                self.replan(state, window)
+            exo = jax.tree.map(lambda x: x[t], xs)
+            action = latent_to_action(
+                self._plan[min(t % self.replan_every, self.horizon - 1)],
+                self.cluster)
+            key, sub = jax.random.split(key)
+            state, m = jit_step(self.params, state, action, exo, sub)
+            all_metrics.append(m)
+        # Same layout as `rollout`'s scan: time leading — scalars [T],
+        # vectors [T, C].
+        stacked = jax.tree.map(lambda *ms: jnp.stack(ms, axis=0), *all_metrics)
+        return state, stacked

@@ -1,0 +1,38 @@
+"""The control objective: one scalarization for every backend.
+
+SURVEY.md §7 hard part (2): the reference never measured $/SLO-hour or
+gCO2/req, so the new framework must *define* the objective consistently
+across the rule baseline and learned policies. The scalarization prices the
+three signal families in dollars:
+
+    J = cost_usd
+      + carbon_weight · carbon_g          (default ≈ $50/tCO2e social cost)
+      + slo_weight · pending_pod·ticks    (SLO burn proxy: unserved demand)
+
+Lower is better. Rewards for PPO are the per-tick negative increments of J.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ccka_tpu.config import TrainConfig
+from ccka_tpu.sim.types import StepMetrics
+
+
+def step_cost(metrics: StepMetrics, tcfg: TrainConfig) -> jnp.ndarray:
+    """Per-tick scalar cost (leading axes preserved)."""
+    pending = jnp.maximum(
+        metrics.demand_pods - metrics.served_pods, 0.0).sum(axis=-1)
+    return (metrics.cost_usd
+            + tcfg.carbon_weight * metrics.carbon_g
+            + tcfg.slo_weight * pending)
+
+
+def step_reward(metrics: StepMetrics, tcfg: TrainConfig) -> jnp.ndarray:
+    return -step_cost(metrics, tcfg)
+
+
+def episode_objective(metrics: StepMetrics, tcfg: TrainConfig) -> jnp.ndarray:
+    """Sum of per-tick costs over the time axis (axis -1 after stacking)."""
+    return step_cost(metrics, tcfg).sum(axis=-1)

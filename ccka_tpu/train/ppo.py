@@ -1,0 +1,264 @@
+"""PPO actor-critic over a vmapped batch of simulated clusters.
+
+BASELINE.json config #3: "PPO actor-critic, 256 simulated clusters vmap'd on
+replayed OpenCost/ElectricityMaps traces". TPU mapping:
+
+- the environment IS the device: world stepping, reward, GAE, and the
+  clipped-surrogate update are one jitted function per iteration — no
+  host↔device transfer except the scalar diagnostics;
+- the cluster batch rides `vmap` (and the `data` mesh axis under pjit —
+  see `ccka_tpu.parallel`); the policy matmul batches [B, F]x[F, H] onto
+  the MXU in bfloat16;
+- episodes are continuing (a cluster never "resets" mid-trace, matching the
+  always-on control loop the reference operates), so GAE bootstraps from the
+  critic at the window edge.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ccka_tpu.config import FrameworkConfig
+from ccka_tpu.models import ActorCritic, latent_dim, latent_to_action
+from ccka_tpu.policy.base import PolicyBackend, observe
+from ccka_tpu.sim.dynamics import ExoStep, step as sim_step
+from ccka_tpu.sim.rollout import exo_steps, initial_state
+from ccka_tpu.sim.types import Action, ClusterState, SimParams
+from ccka_tpu.signals.base import ExogenousTrace
+from ccka_tpu.train.objective import step_reward
+
+# Reward scale: step costs are O($0.01–0.1); normalize into O(1) for stable
+# advantage/value optimization.
+_REWARD_SCALE = 100.0
+
+
+class PPOTrainState(NamedTuple):
+    params: dict
+    opt_state: optax.OptState
+    env_states: ClusterState          # [B, ...] persistent worlds
+    key: jax.Array
+    iteration: jnp.ndarray            # []
+
+
+class PPODiagnostics(NamedTuple):
+    mean_reward: jnp.ndarray
+    policy_loss: jnp.ndarray
+    value_loss: jnp.ndarray
+    entropy: jnp.ndarray
+    approx_kl: jnp.ndarray
+
+
+def _gaussian_logp(u, mean, log_std):
+    var = jnp.exp(2.0 * log_std)
+    return (-0.5 * ((u - mean) ** 2 / var + 2.0 * log_std
+                    + jnp.log(2.0 * jnp.pi))).sum(axis=-1)
+
+
+class PPOTrainer:
+    """Builds and drives the jitted PPO iteration."""
+
+    def __init__(self, cfg: FrameworkConfig):
+        self.cfg = cfg
+        self.cluster = cfg.cluster
+        self.tcfg = cfg.train
+        self.params_sim = SimParams.from_config(cfg)
+        self.act_dim = latent_dim(cfg.cluster)
+        self.net = ActorCritic(act_dim=self.act_dim)
+        self.opt = optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.adam(self.tcfg.learning_rate),
+        )
+        self._iteration_fn = jax.jit(self._iteration)
+
+    # -- initialization -----------------------------------------------------
+
+    def init_state(self, seed: int | None = None) -> PPOTrainState:
+        seed = self.tcfg.seed if seed is None else seed
+        key = jax.random.key(seed)
+        key, k_init = jax.random.split(key)
+        b = self.tcfg.batch_clusters
+        dummy_obs = self._obs(self._broadcast_state(b),
+                              self._dummy_exo(b))
+        params = self.net.init(k_init, dummy_obs[0])
+        return PPOTrainState(
+            params=params,
+            opt_state=self.opt.init(params),
+            env_states=self._broadcast_state(b),
+            key=key,
+            iteration=jnp.int32(0),
+        )
+
+    def _broadcast_state(self, b: int) -> ClusterState:
+        s = initial_state(self.cfg)
+        return jax.tree.map(lambda x: jnp.broadcast_to(x, (b,) + x.shape), s)
+
+    def _dummy_exo(self, b: int) -> ExoStep:
+        z, c = self.cluster.n_zones, 2
+        return ExoStep(
+            spot_price_hr=jnp.zeros((b, z)), od_price_hr=jnp.zeros((b, z)),
+            carbon_g_kwh=jnp.zeros((b, z)), demand_pods=jnp.zeros((b, c)),
+            is_peak=jnp.zeros((b,)))
+
+    def _obs(self, states: ClusterState, exo: ExoStep) -> jnp.ndarray:
+        return jax.vmap(
+            lambda s, e: observe(self.params_sim, s, e).flatten()
+        )(states, exo)
+
+    # -- one PPO iteration (collect + GAE + update), fully jitted -----------
+
+    def _iteration(self, ts: PPOTrainState, window: ExogenousTrace):
+        """window: [B, T, ...] exogenous slice for this iteration."""
+        tcfg = self.tcfg
+        xs = exo_steps(window)
+        # time-major for scan: [T, B, ...]
+        xs_t = jax.tree.map(lambda x: jnp.moveaxis(x, 1, 0), xs)
+
+        def collect_step(carry, exo_t):
+            states, key = carry
+            key, k_act, k_step = jax.random.split(key, 3)
+            obs = self._obs(states, exo_t)                       # [B, F]
+            mean, log_std, value = self.net.apply(ts.params, obs)
+            u = mean + jnp.exp(log_std) * jax.random.normal(
+                k_act, mean.shape)
+            logp = _gaussian_logp(u, mean, log_std)
+            actions = jax.vmap(
+                lambda ui: latent_to_action(ui, self.cluster))(u)
+            step_keys = jax.random.split(k_step, obs.shape[0])
+            states, metrics = jax.vmap(
+                partial(sim_step, self.params_sim, stochastic=True)
+            )(states, actions, exo_t, step_keys)
+            reward = step_reward(metrics, tcfg) * _REWARD_SCALE   # [B]
+            return (states, key), (obs, u, logp, value, reward)
+
+        (env_states, key), (obs_t, u_t, logp_t, value_t, reward_t) = \
+            jax.lax.scan(collect_step, (ts.env_states, ts.key), xs_t)
+
+        # Bootstrap value at the window edge (continuing episodes).
+        last_exo = jax.tree.map(lambda x: x[-1], xs_t)
+        _, _, last_value = self.net.apply(
+            ts.params, self._obs(env_states, last_exo))
+
+        # GAE over the time axis.
+        def gae_step(carry, inp):
+            gae, next_value = carry
+            reward, value = inp
+            delta = reward + tcfg.gamma * next_value - value
+            gae = delta + tcfg.gamma * tcfg.gae_lambda * gae
+            return (gae, value), gae
+
+        (_, _), adv_rev = jax.lax.scan(
+            gae_step, (jnp.zeros_like(last_value), last_value),
+            (reward_t[::-1], value_t[::-1]))
+        advantages = adv_rev[::-1]                                # [T, B]
+        returns = advantages + value_t
+        advantages = ((advantages - advantages.mean())
+                      / (advantages.std() + 1e-8))
+
+        flat = lambda x: x.reshape((-1,) + x.shape[2:])           # noqa: E731
+        obs_f, u_f = flat(obs_t), flat(u_t)
+        logp_f, adv_f, ret_f = flat(logp_t), flat(advantages), flat(returns)
+
+        def loss_fn(params):
+            mean, log_std, value = self.net.apply(params, obs_f)
+            logp = _gaussian_logp(u_f, mean, log_std)
+            ratio = jnp.exp(logp - logp_f)
+            clipped = jnp.clip(ratio, 1.0 - tcfg.ppo_clip, 1.0 + tcfg.ppo_clip)
+            policy_loss = -jnp.minimum(ratio * adv_f, clipped * adv_f).mean()
+            value_loss = jnp.square(value - ret_f).mean()
+            entropy = (log_std + 0.5 * jnp.log(2.0 * jnp.pi * jnp.e)).sum()
+            total = (policy_loss + tcfg.value_coef * value_loss
+                     - tcfg.entropy_coef * entropy)
+            kl = (logp_f - logp).mean()
+            return total, (policy_loss, value_loss, entropy, kl)
+
+        def epoch(carry, _):
+            params, opt_state, stopped = carry
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            _, _, _, kl = aux
+            # Target-KL early stop, branch-free: once KL exceeds target the
+            # remaining epochs apply zero updates (stops destructive
+            # late-epoch policy drift).
+            stop_now = jnp.logical_or(stopped, kl > tcfg.ppo_target_kl)
+            updates, new_opt_state = self.opt.update(grads, opt_state, params)
+            updates = jax.tree.map(
+                lambda u: jnp.where(stop_now, jnp.zeros_like(u), u), updates)
+            params = optax.apply_updates(params, updates)
+            opt_state = jax.tree.map(
+                lambda new, old: jnp.where(stop_now, old, new), new_opt_state,
+                opt_state)
+            return (params, opt_state, stop_now), aux
+
+        (params, opt_state, _), aux = jax.lax.scan(
+            epoch, (ts.params, ts.opt_state, jnp.bool_(False)), None,
+            length=tcfg.ppo_epochs)
+        p_loss, v_loss, entropy, kl = jax.tree.map(lambda x: x[-1], aux)
+
+        new_ts = PPOTrainState(
+            params=params, opt_state=opt_state, env_states=env_states,
+            key=key, iteration=ts.iteration + 1)
+        diag = PPODiagnostics(
+            mean_reward=reward_t.mean() / _REWARD_SCALE,
+            policy_loss=p_loss, value_loss=v_loss,
+            entropy=entropy, approx_kl=kl)
+        return new_ts, diag
+
+    # -- host-side driver ---------------------------------------------------
+
+    def make_windows(self, source, iterations: int,
+                     *, seed: int = 0) -> ExogenousTrace:
+        """[B, total_T, ...] per-cluster traces (different seeds per
+        cluster, BASELINE #3's replayed-trace batch)."""
+        b = self.tcfg.batch_clusters
+        total = iterations * self.tcfg.unroll_steps
+        traces = [source.trace(total, seed=seed + i) for i in range(b)]
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *traces)
+
+    def train(self, source, iterations: int, *, seed: int | None = None,
+              log_every: int = 0) -> tuple[PPOTrainState, list[dict]]:
+        ts = self.init_state(seed)
+        all_traces = self.make_windows(source, iterations,
+                                       seed=(seed or self.tcfg.seed) + 1000)
+        t_len = self.tcfg.unroll_steps
+        history = []
+        for it in range(iterations):
+            window = all_traces.slice_steps(it * t_len, t_len)
+            ts, diag = self._iteration_fn(ts, window)
+            if log_every and (it % log_every == 0 or it == iterations - 1):
+                rec = {k: float(v) for k, v in diag._asdict().items()}
+                rec["iteration"] = it
+                history.append(rec)
+        return ts, history
+
+
+class PPOBackend(PolicyBackend):
+    """Deterministic (mean-action) policy from trained PPO params."""
+
+    def __init__(self, cfg: FrameworkConfig, params):
+        self.cfg = cfg
+        self.cluster = cfg.cluster
+        self.params_sim = SimParams.from_config(cfg)
+        self.net = ActorCritic(act_dim=latent_dim(cfg.cluster))
+        self.params = params
+
+    def decide(self, state: ClusterState, exo: ExoStep,
+               t: jnp.ndarray) -> Action:
+        obs = observe(self.params_sim, state, exo).flatten()
+        mean, _, _ = self.net.apply(self.params, obs)
+        return latent_to_action(mean, self.cluster)
+
+
+def ppo_train(cfg: FrameworkConfig, source, iterations: int,
+              *, seed: int | None = None,
+              log_every: int = 10) -> tuple[PPOBackend, list[dict]]:
+    """Convenience: train and wrap the deterministic backend."""
+    trainer = PPOTrainer(cfg)
+    ts, history = trainer.train(source, iterations, seed=seed,
+                                log_every=log_every)
+    return PPOBackend(cfg, ts.params), history

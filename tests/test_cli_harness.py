@@ -117,3 +117,11 @@ def test_configure_observe_detects_mismatch():
 def test_cli_bad_set_clean_error(capsys):
     assert main(["--set", "sim.nope=1", "show-config"]) == 2
     assert "config error" in capsys.readouterr().err
+
+
+def test_cli_replay_load_failure_clean_error(capsys):
+    rc = main(["--set", "signals.backend=replay",
+               "--set", "signals.replay_path=/tmp/definitely-missing.npz",
+               "simulate", "--days", "0.01"])
+    assert rc == 2
+    assert "config error" in capsys.readouterr().err

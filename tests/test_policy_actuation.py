@@ -256,10 +256,14 @@ def test_hpa_manifests(cfg):
 
 
 def test_keda_scaledobject(cfg):
-    so = render_keda_scaledobject(offpeak_action(cfg.cluster), "burst-queue")
+    so = render_keda_scaledobject(offpeak_action(cfg.cluster), "burst-queue",
+                              account_id="123456789012")
     assert so["kind"] == "ScaledObject"
     assert so["spec"]["triggers"][0]["type"] == "aws-sqs-queue"
     assert so["spec"]["triggers"][0]["metadata"]["awsRegion"] == "us-east-2"
+    assert "123456789012" in so["spec"]["triggers"][0]["metadata"]["queueURL"]
+    with pytest.raises(ValueError, match="account id"):
+        render_keda_scaledobject(offpeak_action(cfg.cluster), "q", account_id="")
 
 
 def test_reset_profile_never_grants_spot_to_slo_pool(cfg):
@@ -300,3 +304,20 @@ def test_lifecycle_verify_reads_back_from_sink(cfg):
         expect={"spot-preferred": ("WhenEmptyOrUnderutilized",
                                    ["spot", "on-demand"])})
     assert not co.run(stage)  # skeptical read-back catches the drop
+
+
+def test_kubectl_sink_fails_when_merge_patch_rejected(cfg):
+    # RBAC denial / admission rejection of the disruption merge must surface
+    # as ok=False with detail, not a silent '[ok] applied'.
+    def runner(argv):
+        if "--type=merge" in argv:
+            return 1, 'Error from server (Forbidden): nodepools "x" is forbidden'
+        if argv[:2] == ["kubectl", "get"]:
+            return 0, "karpenter.sh/capacity-type=In:on-demand \n"
+        return 0, "ok"
+
+    sink = KubectlSink(runner=runner)
+    res = sink.apply_nodepool(
+        render_nodepool_patches(offpeak_action(cfg.cluster), cfg.cluster)[0])
+    assert not res.ok
+    assert "merge patch rejected" in res.detail

@@ -1,0 +1,160 @@
+"""Learned-backend tests: codec invertibility, MPC improvement over its
+initialization, PPO iteration mechanics and learning signal, checkpoints.
+
+Kept small (tiny horizons/batches) so the suite stays fast on the 8-device
+CPU mesh; the full-scale configs run through bench.py / train scripts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import default_config
+from ccka_tpu.models import (
+    ActorCritic,
+    PolicyMLP,
+    action_to_latent,
+    latent_dim,
+    latent_to_action,
+)
+from ccka_tpu.policy.rule import neutral_action, offpeak_action
+from ccka_tpu.sim import SimParams, initial_state, rollout, summarize
+from ccka_tpu.sim.rollout import rollout_actions
+from ccka_tpu.signals import SyntheticSignalSource
+from ccka_tpu.train import MPCBackend, optimize_plan, save_state, load_state
+from ccka_tpu.train.objective import episode_objective
+from ccka_tpu.train.ppo import PPOBackend, PPOTrainer
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config().with_overrides(**{
+        "train.batch_clusters": 4,
+        "train.unroll_steps": 8,
+        "train.mpc_horizon": 16,
+        "train.mpc_iters": 15,
+    })
+
+
+@pytest.fixture(scope="module")
+def source(cfg):
+    return SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                 cfg.signals)
+
+
+def test_latent_dim(cfg):
+    # P*Z + P*2 + P + P + C = 6 + 4 + 2 + 2 + 2
+    assert latent_dim(cfg.cluster) == 16
+
+
+def test_codec_round_trip(cfg):
+    a = offpeak_action(cfg.cluster)
+    u = action_to_latent(a, cfg.cluster)
+    back = latent_to_action(u, cfg.cluster)
+    # Values at {0,1} saturate the logit; recovered within clip tolerance.
+    assert np.allclose(np.asarray(back.zone_weight),
+                       np.asarray(a.zone_weight), atol=1e-3)
+    assert np.allclose(np.asarray(back.consolidation_aggr),
+                       np.asarray(a.consolidation_aggr), atol=1e-3)
+    assert np.allclose(np.asarray(back.hpa_scale),
+                       np.asarray(a.hpa_scale), atol=1e-2)
+
+
+def test_latent_to_action_always_feasible(cfg):
+    # Any latent, however extreme, maps to a Kyverno-feasible action.
+    for seed in range(3):
+        u = jax.random.normal(jax.random.key(seed),
+                              (latent_dim(cfg.cluster),)) * 10.0
+        a = latent_to_action(u, cfg.cluster)
+        od_idx = cfg.cluster.pool_index("on-demand-slo")
+        assert float(a.ct_allow[od_idx, 0]) == 0.0   # never spot on SLO pool
+        assert float(a.ct_allow[od_idx, 1]) >= 0.99  # od guaranteed
+        assert float(a.hpa_scale.min()) >= 0.1
+
+
+def test_policy_mlp_shapes(cfg):
+    net = PolicyMLP(out_dim=latent_dim(cfg.cluster))
+    obs = jnp.ones((29,))
+    params = net.init(jax.random.key(0), obs)
+    u = net.apply(params, obs)
+    assert u.shape == (16,)
+    batched = jax.vmap(lambda o: net.apply(params, o))(jnp.ones((8, 29)))
+    assert batched.shape == (8, 16)
+
+
+def test_actor_critic_zero_init_starts_near_neutral(cfg):
+    net = ActorCritic(act_dim=latent_dim(cfg.cluster))
+    obs = jnp.ones((29,))
+    params = net.init(jax.random.key(0), obs)
+    mean, log_std, value = net.apply(params, obs)
+    assert mean.shape == (16,)
+    assert np.allclose(np.asarray(mean), 0.0)  # zero-init head
+    a = latent_to_action(mean, cfg.cluster)
+    assert np.allclose(np.asarray(a.zone_weight), 0.5, atol=1e-6)
+
+
+def test_mpc_plan_improves_objective(cfg, source):
+    params = SimParams.from_config(cfg)
+    tr = source.trace(16, seed=3)
+    base = action_to_latent(neutral_action(cfg.cluster), cfg.cluster)
+    init = jnp.broadcast_to(base, (16,) + base.shape)
+    result = optimize_plan(params, cfg.cluster, cfg.train,
+                           initial_state(cfg), tr, init, iters=15)
+    assert np.isfinite(np.asarray(result.losses)).all()
+    assert float(result.losses[-1]) < float(result.losses[0])
+
+
+def test_mpc_backend_closed_loop(cfg, source):
+    mpc = MPCBackend(cfg, horizon=8, iters=5, replan_every=4)
+    tr = source.trace(12, seed=0)
+    final, metrics = mpc.evaluate(initial_state(cfg), tr,
+                                  jax.random.key(0), stochastic=False)
+    assert metrics.cost_usd.shape == (12,)
+    s = summarize(SimParams.from_config(cfg), metrics)
+    assert float(s.cost_usd) > 0
+
+
+def test_ppo_iteration_runs_and_shapes(cfg, source):
+    trainer = PPOTrainer(cfg)
+    ts, history = trainer.train(source, iterations=2, log_every=1)
+    assert int(ts.iteration) == 2
+    assert len(history) == 2
+    for rec in history:
+        assert np.isfinite(rec["policy_loss"])
+        assert np.isfinite(rec["mean_reward"])
+
+
+def test_ppo_backend_decides_feasible_actions(cfg, source):
+    trainer = PPOTrainer(cfg)
+    ts = trainer.init_state()
+    backend = PPOBackend(cfg, ts.params)
+    params = SimParams.from_config(cfg)
+    tr = source.trace(8, seed=0)
+    final, metrics = rollout(params, initial_state(cfg),
+                             backend.action_fn(), tr, jax.random.key(0))
+    assert metrics.cost_usd.shape == (8,)
+    assert np.isfinite(np.asarray(metrics.cost_usd)).all()
+
+
+def test_ppo_reward_improves_on_tiny_problem(cfg, source):
+    # Learnability smoke: 12 iterations on a tiny batch should move mean
+    # reward up (or at least not collapse). Loose bound — this is a
+    # mechanics test, not a benchmark.
+    trainer = PPOTrainer(cfg)
+    ts, history = trainer.train(source, iterations=12, log_every=1)
+    first = np.mean([h["mean_reward"] for h in history[:3]])
+    last = np.mean([h["mean_reward"] for h in history[-3:]])
+    assert last > first - 0.05  # no collapse; usually improves
+
+
+def test_checkpoint_round_trip(tmp_path, cfg):
+    trainer = PPOTrainer(cfg)
+    ts = trainer.init_state()
+    path = save_state(str(tmp_path / "ckpt"), ts.params, step=3)
+    assert "step_00000003" in path
+    restored = load_state(str(tmp_path / "ckpt"))
+    orig = jax.tree.leaves(ts.params)
+    back = jax.tree.leaves(restored)
+    assert all(np.allclose(np.asarray(a), np.asarray(b))
+               for a, b in zip(orig, back))
