@@ -1,0 +1,26 @@
+"""Device-mesh parallelism: sharding the cluster batch over ICI.
+
+The reference's "distribution" is Kubernetes-level (SURVEY.md §2.4): Karpenter
+fans nodes out, remote-write fans metrics in; there is no NCCL/MPI anywhere.
+The TPU-native equivalent: the *policy workload* — thousands of simulated
+clusters and the PPO/MPC updates over them — shards across a
+`jax.sharding.Mesh`:
+
+- ``data`` axis: the cluster batch (pure data parallelism; per-cluster
+  dynamics are independent, so the only collectives are the gradient
+  all-reduces XLA inserts in the PPO update — riding ICI within a slice);
+- ``model`` axis: reserved for sharding policy params if they outgrow a chip.
+
+Multi-host scaling is the same code: `jax.distributed.initialize()` makes
+`jax.devices()` span hosts, the mesh covers the global device set, and XLA
+routes intra-slice collectives over ICI and cross-slice over DCN. The driver
+validates this path on a virtual 8-device CPU mesh
+(`__graft_entry__.dryrun_multichip`).
+"""
+
+from ccka_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    shard_batch,
+    replicate,
+    batch_sharding,
+)
