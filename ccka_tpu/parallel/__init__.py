@@ -19,8 +19,14 @@ validates this path on a virtual 8-device CPU mesh
 """
 
 from ccka_tpu.parallel.mesh import (  # noqa: F401
-    make_mesh,
-    shard_batch,
-    replicate,
     batch_sharding,
+    batch_spec,
+    make_mesh,
+    replicate,
+    shard_batch,
+    shard_params,
+)
+from ccka_tpu.parallel.sharded import (  # noqa: F401
+    shard_ppo_state,
+    sharded_batched_rollout,
 )

@@ -1,0 +1,61 @@
+"""Mesh-sharded entry points for the batched simulator and PPO training.
+
+These wrap the single-chip `vmap` paths (`ccka_tpu.sim.rollout`,
+`ccka_tpu.train.ppo`) with explicit device placement: the cluster batch is
+split over the mesh's ``data`` axis, parameters are replicated, and the jit
+boundary is told the output shardings so XLA keeps results distributed
+instead of gathering to device 0. The rollout needs no collectives at all
+(clusters are independent); the PPO iteration's only collective is the
+gradient all-reduce XLA inserts for the batch-mean loss.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from ccka_tpu.parallel.mesh import replicate, shard_batch
+from ccka_tpu.sim.rollout import batched_rollout
+from ccka_tpu.sim.types import ClusterState, SimParams, StepMetrics
+from ccka_tpu.signals.base import ExogenousTrace
+from jax.sharding import Mesh
+
+
+def sharded_batched_rollout(mesh: Mesh,
+                            params: SimParams,
+                            states0: ClusterState,
+                            action_fn,
+                            traces: ExogenousTrace,
+                            keys: jax.Array,
+                            *,
+                            stochastic: bool = False
+                            ) -> tuple[ClusterState, StepMetrics]:
+    """`batched_rollout` with the cluster batch split over ``data``.
+
+    Inputs may live anywhere; they are placed here (params replicated,
+    batch sharded). Compiled once per (shape, mesh) pair.
+    """
+    params = replicate(mesh, params)
+    states0 = shard_batch(mesh, states0)
+    traces = shard_batch(mesh, traces)
+    keys = shard_batch(mesh, keys)
+    fn = jax.jit(partial(batched_rollout, stochastic=stochastic,
+                         action_fn=action_fn))
+    return fn(params, states0, traces=traces, keys=keys)
+
+
+def shard_ppo_state(mesh: Mesh, ts):
+    """Place a PPOTrainState on the mesh: env batch sharded, rest replicated.
+
+    The returned state drives `PPOTrainer._iteration_fn` unchanged — jit
+    propagates the input shardings through the scan, and the epoch update's
+    batch-mean gradients become one all-reduce over ``data``.
+    """
+    return ts._replace(
+        params=replicate(mesh, ts.params),
+        opt_state=replicate(mesh, ts.opt_state),
+        env_states=shard_batch(mesh, ts.env_states),
+        key=replicate(mesh, ts.key),
+        iteration=replicate(mesh, ts.iteration),
+    )

@@ -1,21 +1,56 @@
 """Test environment: force an 8-device virtual CPU mesh before JAX imports.
 
-Multi-chip hardware is unavailable in CI; sharding tests run on
-`--xla_force_host_platform_device_count=8` CPU devices, mirroring how the
-driver dry-runs the multi-chip path (`__graft_entry__.dryrun_multichip`).
+Multi-chip hardware is unavailable in CI, so the default lane runs on
+`--xla_force_host_platform_device_count=8` CPU devices — the sharding tests
+in `tests/test_parallel.py` genuinely split batches across those 8 devices,
+mirroring how the driver dry-runs the multi-chip path
+(`__graft_entry__.dryrun_multichip`).
+
+Set ``CCKA_TEST_TPU=1`` to instead run on the real accelerator: the CPU
+override is skipped, so the axon sitecustomize's ``jax_platforms=axon,cpu``
+selection stands and the tunneled TPU chip is used (note the env var
+``JAX_PLATFORMS`` alone cannot redirect this — see
+.claude/skills/verify/SKILL.md). That lane also un-skips `-m tpu` smoke
+tests.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flag = "--xla_force_host_platform_device_count=8"
-if _flag not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+if os.environ.get("CCKA_TEST_TPU", "") != "1":
+    # The session may arrive with JAX_PLATFORMS pointing at an accelerator;
+    # the CPU lane must override it, not setdefault around it. The env var
+    # alone is not enough: pytest's plugin chain imports jax before this
+    # conftest runs, baking the platform default — so also update the live
+    # config (safe: no backend is initialized during plugin import).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flag = "--xla_force_host_platform_device_count=8"
+    if _flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 import ccka_tpu  # noqa: E402
 from ccka_tpu.config import default_config  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: smoke tests for the real accelerator "
+        "(run with CCKA_TEST_TPU=1)")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Keep `-m tpu` smoke tests out of the CPU lane (CCKA_TEST_TPU=1 runs them)."""
+    if os.environ.get("CCKA_TEST_TPU", "") == "1":
+        return
+    skip = pytest.mark.skip(reason="TPU lane: run with CCKA_TEST_TPU=1")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture(scope="session")

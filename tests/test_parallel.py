@@ -1,0 +1,149 @@
+"""Mesh-parallel tests on the virtual 8-device CPU mesh (see conftest.py).
+
+Covers the SURVEY §2.4 "Intra-policy parallelism" row: the cluster batch
+must actually split across devices, and sharded results must match the
+single-device `vmap` path bit-for-bit (pure data parallelism — no
+cross-cluster math changes under sharding).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ccka_tpu.parallel  # noqa: F401  (import-health: VERDICT round-1 breakage)
+from ccka_tpu.config import ConfigError, MeshConfig
+from ccka_tpu.parallel import (
+    batch_sharding,
+    make_mesh,
+    replicate,
+    shard_batch,
+    shard_params,
+    shard_ppo_state,
+    sharded_batched_rollout,
+)
+from ccka_tpu.policy import RulePolicy
+from ccka_tpu.sim import SimParams, batched_rollout, initial_state
+from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+
+def _batch(cfg, b, steps, seed=0):
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    traces = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[src.trace(steps, seed=seed + i) for i in range(b)])
+    states = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (b,) + x.shape), initial_state(cfg))
+    keys = jax.random.split(jax.random.key(seed), b)
+    return states, traces, keys
+
+
+def test_eight_devices_present():
+    # conftest forces --xla_force_host_platform_device_count=8; if this
+    # fails, every sharding assertion below is vacuous.
+    assert jax.device_count() >= 8
+
+
+def test_make_mesh_default_uses_all_devices():
+    mesh = make_mesh()
+    assert mesh.shape["data"] == jax.device_count()
+    assert mesh.shape["model"] == 1
+
+
+def test_make_mesh_rejects_indivisible():
+    with pytest.raises(ConfigError):
+        make_mesh(MeshConfig(model_parallel=3), devices=jax.devices()[:8])
+
+
+def test_shard_batch_actually_shards():
+    mesh = make_mesh(devices=jax.devices()[:8])
+    x = jnp.arange(16 * 3, dtype=jnp.float32).reshape(16, 3)
+    sx = shard_batch(mesh, x)
+    assert sx.sharding == batch_sharding(mesh, 2)
+    # Each of the 8 devices holds a distinct 2-row shard.
+    assert len(sx.addressable_shards) == 8
+    rows = sorted(s.data.shape[0] for s in sx.addressable_shards)
+    assert rows == [2] * 8
+    np.testing.assert_array_equal(np.asarray(sx), np.asarray(x))
+
+
+def test_shard_batch_rejects_indivisible_batch():
+    mesh = make_mesh(devices=jax.devices()[:8])
+    with pytest.raises(ConfigError):
+        shard_batch(mesh, jnp.zeros((10, 3)))
+
+
+def test_replicate_places_on_all_devices():
+    mesh = make_mesh(devices=jax.devices()[:8])
+    x = replicate(mesh, jnp.arange(4.0))
+    assert x.sharding.is_fully_replicated
+    assert len(x.devices()) == 8
+
+
+def test_shard_params_model_axis():
+    mesh = make_mesh(MeshConfig(model_parallel=4, data_parallel=2),
+                     devices=jax.devices()[:8])
+    params = {
+        "kernel": jnp.zeros((16, 32)),   # 32 % 4 == 0 -> column-sharded
+        "head": jnp.zeros((16, 5)),      # 5 % 4 != 0 -> replicated
+        "bias": jnp.zeros((32,)),        # 1-D -> replicated
+    }
+    sp = shard_params(mesh, params)
+    kernel_shards = {s.data.shape for s in sp["kernel"].addressable_shards}
+    assert kernel_shards == {(16, 8)}
+    assert sp["head"].sharding.is_fully_replicated
+    assert sp["bias"].sharding.is_fully_replicated
+
+
+def test_sharded_rollout_matches_vmap(small_cfg):
+    """Numerical parity: 8-way sharded rollout == single-device vmap."""
+    cfg = small_cfg
+    params = SimParams.from_config(cfg)
+    b, steps = 8, 16
+    states, traces, keys = _batch(cfg, b, steps)
+    action_fn = RulePolicy(cfg.cluster).action_fn()
+
+    final_ref, metrics_ref = jax.jit(
+        lambda s, t, k: batched_rollout(params, s, action_fn, t, k,
+                                        stochastic=True))(states, traces, keys)
+
+    mesh = make_mesh(devices=jax.devices()[:8])
+    final_sh, metrics_sh = sharded_batched_rollout(
+        mesh, params, states, action_fn, traces, keys, stochastic=True)
+
+    # Output stays distributed (no implicit gather to device 0).
+    assert len(final_sh.acc_cost_usd.addressable_shards) == 8
+    for ref, sh in zip(jax.tree.leaves((final_ref, metrics_ref)),
+                       jax.tree.leaves((final_sh, metrics_sh))):
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(sh),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_sharded_ppo_iteration_runs_and_matches(small_cfg):
+    """One full PPO training step under 8-way sharding: executes, and the
+    updated params match the unsharded iteration (same rng, same data)."""
+    from ccka_tpu.train.ppo import PPOTrainer
+
+    cfg = small_cfg.with_overrides(**{
+        "train.batch_clusters": 8, "train.unroll_steps": 4})
+    trainer = PPOTrainer(cfg)
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    ts0 = trainer.init_state()
+    window = trainer.make_windows(src, 1, seed=7)
+
+    ts_ref, diag_ref = trainer._iteration_fn(ts0, window)
+
+    mesh = make_mesh(devices=jax.devices()[:8])
+    ts_sh = shard_ppo_state(mesh, trainer.init_state())
+    window_sh = shard_batch(mesh, window)
+    ts_out, diag_sh = trainer._iteration_fn(ts_sh, window_sh)
+
+    # Env batch stays sharded through the iteration.
+    assert len(ts_out.env_states.acc_cost_usd.addressable_shards) == 8
+    np.testing.assert_allclose(float(diag_ref.mean_reward),
+                               float(diag_sh.mean_reward), rtol=1e-4)
+    for ref, sh in zip(jax.tree.leaves(ts_ref.params),
+                       jax.tree.leaves(ts_out.params)):
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(sh),
+                                   rtol=2e-4, atol=2e-5)
