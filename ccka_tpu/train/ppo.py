@@ -217,8 +217,7 @@ class PPOTrainer:
         cluster, BASELINE #3's replayed-trace batch)."""
         b = self.tcfg.batch_clusters
         total = iterations * self.tcfg.unroll_steps
-        traces = [source.trace(total, seed=seed + i) for i in range(b)]
-        return jax.tree.map(lambda *xs: jnp.stack(xs), *traces)
+        return source.batch_trace(total, range(seed, seed + b))
 
     def train(self, source, iterations: int, *, seed: int | None = None,
               log_every: int = 0) -> tuple[PPOTrainState, list[dict]]:

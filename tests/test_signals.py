@@ -49,6 +49,18 @@ def test_synthetic_deterministic_per_seed(synth):
     assert not np.allclose(a.spot_price_hr, c.spot_price_hr)
 
 
+def test_batch_trace_bitwise_matches_stacked_traces(synth):
+    """Load-bearing identity: training (make_windows) uses batch_trace,
+    single-cluster paths use trace(); they must see the same world."""
+    seeds = range(11, 15)
+    batch = synth.batch_trace(96, seeds)
+    for name in batch._fields:
+        stacked = np.stack(
+            [np.asarray(getattr(synth.trace(96, seed=s), name))
+             for s in seeds])
+        assert np.array_equal(stacked, np.asarray(getattr(batch, name))), name
+
+
 def test_synthetic_spot_below_od(synth):
     tr = synth.trace(2880, seed=0)  # full day
     assert np.all(np.asarray(tr.spot_price_hr) <= np.asarray(tr.od_price_hr) + 1e-6)
