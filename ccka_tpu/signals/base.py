@@ -118,4 +118,7 @@ class SignalSource(abc.ABC):
 
 
 def as_f32(x) -> jnp.ndarray:
+    """float32 device array; jax inputs stay on device (no numpy round-trip)."""
+    if isinstance(x, jnp.ndarray):
+        return x.astype(jnp.float32)
     return jnp.asarray(np.asarray(x), dtype=jnp.float32)

@@ -56,6 +56,8 @@ class SyntheticSignalSource(SignalSource):
         # serving shorter requests as slices is exact, and tick-at-t costs
         # amortized O(1) instead of regenerating O(t) every scrape.
         self._cache: dict[int, ExogenousTrace] = {}
+        # Compiled device-generation programs per (steps, batch) shape.
+        self._device_fns: dict = {}
 
     def meta(self) -> TraceMeta:
         return TraceMeta(
@@ -105,13 +107,49 @@ class SyntheticSignalSource(SignalSource):
                  rho=0.9, sigma=0.5),
         )
 
-    def _assemble(self, steps: int, noise: tuple[np.ndarray, ...]
-                  ) -> ExogenousTrace:
+    def batch_trace_device(self, steps: int, key, batch: int
+                           ) -> ExogenousTrace:
+        """[B, T, ...] trace batch synthesized entirely on device.
+
+        TPU-native path for training-scale generation: noise comes from
+        `jax.random`, the AR(1) recurrences run as `associative_scan` (log-
+        depth instead of a T-step loop), and assembly is the same formulas
+        in jnp — zero host compute, zero host→device transfer. Statistically
+        identical family to :meth:`batch_trace` (same diurnal structure,
+        same AR(1) ρ/σ) but a different RNG stream, so use one or the other
+        within an experiment; keyed reproducibly by ``key``.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        fn = self._device_fns.get((steps, batch))
+        if fn is None:
+            z = self.cluster.n_zones
+
+            def generate(k):
+                ks, kc, kd = jax.random.split(k, 3)
+                noise = (
+                    _ar1_device(ks, (batch, steps, z), rho=0.97, sigma=0.04),
+                    _ar1_device(kc, (batch, steps, z), rho=0.95, sigma=0.03),
+                    _ar1_device(kd, (batch, steps), rho=0.9, sigma=0.5),
+                )
+                return self._assemble(steps, noise, xp=jnp)
+
+            # One jitted program per shape: traced eagerly this would
+            # dispatch every associative_scan stage as its own XLA program
+            # (minutes of compile through the TPU tunnel); jitted it is one
+            # fused program, ~1s to compile, ~ms to run.
+            fn = jax.jit(generate)
+            self._device_fns[(steps, batch)] = fn
+        return fn(key)
+
+    def _assemble(self, steps: int, noise: tuple, xp=np) -> ExogenousTrace:
         """Deterministic diurnal structure + noise → trace.
 
         ``noise`` arrays may carry a leading batch axis [B, T, ...]; the
         deterministic parts broadcast against it, and the returned trace
-        then has batch-leading leaves ([B, T, Z] etc.).
+        then has batch-leading leaves ([B, T, Z] etc.). ``xp`` selects the
+        array backend: numpy (host path) or jax.numpy (device path).
         """
         spot_noise, carbon_noise, demand_noise = noise
         batched = spot_noise.ndim == 3
@@ -120,45 +158,48 @@ class SyntheticSignalSource(SignalSource):
         t = self.start_unix_s + np.arange(steps) * dt  # [T]
         # f32 from here on — everything downstream is f32, and at fleet
         # scale (B=8192) f64 intermediates double the assembly cost.
-        tod = ((t % _DAY_S) / _DAY_S).astype(np.float32)  # time-of-day [0,1)
+        tod = xp.asarray(((t % _DAY_S) / _DAY_S), dtype=xp.float32)  # [0,1)
         tod_z = tod[:, None]  # [T, 1] broadcast against zones
 
         nt = self.cluster.node_type
 
         # Per-zone phase offsets (deterministic per zone index).
-        phase = ((np.arange(z) / max(z, 1)) * 0.15).astype(np.float32)  # [Z]
+        phase = xp.asarray((np.arange(z) / max(z, 1)) * 0.15,
+                           dtype=xp.float32)  # [Z] fraction of a day
 
         # Spot price: diurnal swing + AR(1) noise, clipped to [20%, 95%] of OD.
-        diurnal = 1.0 + 0.35 * np.sin(2 * np.pi * (tod_z - 0.25 + phase))  # [T,Z]
+        diurnal = 1.0 + 0.35 * xp.sin(2 * np.pi * (tod_z - 0.25 + phase))  # [T,Z]
         spot = nt.spot_price_hr_mean * diurnal * (1.0 + spot_noise)
-        spot = np.clip(spot, 0.2 * nt.od_price_hr, 0.95 * nt.od_price_hr)
+        spot = xp.clip(spot, 0.2 * nt.od_price_hr, 0.95 * nt.od_price_hr)
 
-        od = np.broadcast_to(np.float32(nt.od_price_hr), spot.shape)
+        od = xp.broadcast_to(xp.float32(nt.od_price_hr), spot.shape)
 
         # Carbon duck curve: base − solar dip (centered 13:00) + evening ramp
         # (centered 19:30), small noise; clipped positive.
         base = self.signals.carbon_default_g_kwh
-        solar = 0.45 * base * _bump(tod_z, center=13.5 / 24, width=3.5 / 24)
-        evening = 0.25 * base * _bump(tod_z + phase, center=19.5 / 24, width=2.0 / 24)
+        solar = 0.45 * base * _bump(tod_z, center=13.5 / 24, width=3.5 / 24, xp=xp)
+        evening = 0.25 * base * _bump(tod_z + phase, center=19.5 / 24,
+                                      width=2.0 / 24, xp=xp)
         carbon = base - solar + evening
-        carbon = carbon * (1.0 + 0.1 * (np.arange(z) / max(z, 1))
-                           )[None, :].astype(np.float32)
+        carbon = carbon * xp.asarray(
+            1.0 + 0.1 * (np.arange(z) / max(z, 1)), dtype=xp.float32)[None, :]
         carbon = carbon * (1.0 + carbon_noise)
-        carbon = np.clip(carbon, 20.0, None)
+        carbon = xp.clip(carbon, 20.0, None)
 
         # Peak indicator 09:00-21:00.
-        is_peak = ((tod >= 9 / 24) & (tod < 21 / 24)).astype(np.float32)
+        is_peak = ((tod >= 9 / 24) & (tod < 21 / 24)).astype(xp.float32)
         if batched:
-            is_peak = np.broadcast_to(is_peak, demand_noise.shape)
+            is_peak = xp.broadcast_to(is_peak, demand_noise.shape)
 
         # Demand: base 40% of burst scale off-peak, ramping to the full
         # 60-pod burst at peak, with bursty noise; split between the two
         # classes like the reference's odd/even deployments.
         total = float(self.workload.total_pods)
-        level = total * (0.4 + 0.6 * _bump(tod, center=14.0 / 24, width=5.0 / 24))
+        level = total * (0.4 + 0.6 * _bump(tod, center=14.0 / 24,
+                                           width=5.0 / 24, xp=xp))
         level = level * (1.0 + 0.15 * demand_noise)
-        level = np.clip(level, 0.0, 2.0 * total)
-        demand = np.stack([np.ceil(level / 2.0), np.floor(level / 2.0)], axis=-1)
+        level = xp.clip(level, 0.0, 2.0 * total)
+        demand = xp.stack([xp.ceil(level / 2.0), xp.floor(level / 2.0)], axis=-1)
 
         trace = ExogenousTrace(
             spot_price_hr=as_f32(spot),
@@ -196,7 +237,38 @@ def _ar1(rng: np.random.Generator, shape, rho: float, sigma: float) -> np.ndarra
     return out
 
 
-def _bump(x: np.ndarray, center: float, width: float) -> np.ndarray:
+def _bump(x, center: float, width: float, xp=np):
     """Smooth periodic bump in [0,1] centered at ``center`` (day fraction)."""
-    d = np.minimum(np.abs(x - center), 1.0 - np.abs(x - center))
-    return np.exp(-0.5 * (d / (width / 2.0)) ** 2)
+    d = xp.minimum(xp.abs(x - center), 1.0 - xp.abs(x - center))
+    return xp.exp(-0.5 * (d / (width / 2.0)) ** 2)
+
+
+def _ar1_device(key, shape, rho: float, sigma: float):
+    """Stationary AR(1) along the time axis (axis -2 of [..., T, Z] or
+    axis -1 of [..., T]), on device via log-depth `associative_scan`.
+
+    Same recurrence as :func:`_ar1`: ``x_0 ~ N(0,σ)`` then
+    ``x_t = ρ·x_{t-1} + √(1-ρ²)·N(0,σ)`` — expressed as the linear map
+    composition ``(a,b)∘(a',b') = (aa', a'b + b')`` scanned associatively,
+    so the TPU runs O(log T) passes of elementwise work instead of a
+    T-iteration loop.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    axis = len(shape) - 2 if len(shape) >= 3 else len(shape) - 1
+    k0, k1 = jax.random.split(key)
+    scale = np.float32(np.sqrt(1.0 - rho * rho))
+    x0_shape = shape[:axis] + (1,) + shape[axis + 1:]
+    x0 = sigma * jax.random.normal(k0, x0_shape, jnp.float32)
+    eps = scale * sigma * jax.random.normal(k1, shape, jnp.float32)
+    a = jnp.full(shape, np.float32(rho))
+
+    def combine(l, r):
+        return (l[0] * r[0], l[1] * r[0] + r[1])
+
+    _, b = jax.lax.associative_scan(combine, (a, eps), axis=axis)
+    # b_t composes all noise up to t; apow_t = ρ^(t+1) carries the initial
+    # state forward: x_t = ρ^(t+1)·x_0 + Σ_i ρ^(t-i)·e_i.
+    apow = jnp.cumprod(a, axis=axis)
+    return apow * x0 + b

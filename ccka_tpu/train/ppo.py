@@ -136,8 +136,11 @@ class PPOTrainer:
             reward = step_reward(metrics, tcfg) * _REWARD_SCALE   # [B]
             return (states, key), (obs, u, logp, value, reward)
 
+        # unroll: per-step tensors are small, so loop overhead dominates —
+        # same rationale as the rollout scan (`sim/rollout.py` _UNROLL).
         (env_states, key), (obs_t, u_t, logp_t, value_t, reward_t) = \
-            jax.lax.scan(collect_step, (ts.env_states, ts.key), xs_t)
+            jax.lax.scan(collect_step, (ts.env_states, ts.key), xs_t,
+                         unroll=4)
 
         # Bootstrap value at the window edge (continuing episodes).
         last_exo = jax.tree.map(lambda x: x[-1], xs_t)
@@ -214,9 +217,16 @@ class PPOTrainer:
     def make_windows(self, source, iterations: int,
                      *, seed: int = 0) -> ExogenousTrace:
         """[B, total_T, ...] per-cluster traces (different seeds per
-        cluster, BASELINE #3's replayed-trace batch)."""
+        cluster, BASELINE #3's replayed-trace batch).
+
+        With ``train.device_traces`` (default) and a synthetic source, the
+        batch is synthesized on device — keeps end-to-end training wall
+        time device-bound instead of host-trace-gen-bound.
+        """
         b = self.tcfg.batch_clusters
         total = iterations * self.tcfg.unroll_steps
+        if self.tcfg.device_traces and hasattr(source, "batch_trace_device"):
+            return source.batch_trace_device(total, jax.random.key(seed), b)
         return source.batch_trace(total, range(seed, seed + b))
 
     def train(self, source, iterations: int, *, seed: int | None = None,

@@ -61,6 +61,40 @@ def test_batch_trace_bitwise_matches_stacked_traces(synth):
         assert np.array_equal(stacked, np.asarray(getattr(batch, name))), name
 
 
+def test_device_trace_statistically_matches_host_path(synth):
+    """batch_trace_device is the same signal family as batch_trace: same
+    diurnal structure (exact, it's deterministic) and AR(1) noise moments."""
+    import jax
+
+    host = synth.batch_trace(2880, range(32))
+    dev = synth.batch_trace_device(2880, jax.random.key(0), 32)
+    for name in host._fields:
+        h, d = np.asarray(getattr(host, name)), np.asarray(getattr(dev, name))
+        assert h.shape == d.shape, name
+        # Batch-mean traces are noise-free-ish -> tight agreement on the
+        # deterministic structure; per-element values differ (other stream).
+        np.testing.assert_allclose(h.mean(axis=0), d.mean(axis=0),
+                                   rtol=0.12, atol=2.0)
+    # Noise scale agrees: per-element std over the batch.
+    h_std = np.asarray(host.spot_price_hr).std(axis=0).mean()
+    d_std = np.asarray(dev.spot_price_hr).std(axis=0).mean()
+    np.testing.assert_allclose(h_std, d_std, rtol=0.2)
+
+
+def test_ar1_device_moments():
+    """Stationary mean/var/autocorr of the device AR(1) match the model."""
+    import jax
+
+    from ccka_tpu.signals.synthetic import _ar1_device
+
+    rho, sigma = 0.9, 0.5
+    x = np.asarray(_ar1_device(jax.random.key(3), (64, 512), rho, sigma))
+    assert abs(x.mean()) < 0.02
+    np.testing.assert_allclose(x.var(), sigma**2, rtol=0.05)
+    lag1 = (x[:, 1:] * x[:, :-1]).mean() / x.var()
+    np.testing.assert_allclose(lag1, rho, rtol=0.05)
+
+
 def test_synthetic_spot_below_od(synth):
     tr = synth.trace(2880, seed=0)  # full day
     assert np.all(np.asarray(tr.spot_price_hr) <= np.asarray(tr.od_price_hr) + 1e-6)
