@@ -54,7 +54,8 @@ def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int) -> dict:
 
     results = {}
     for b in batch_sizes:
-        traces = src.batch_trace(horizon_steps, range(b))
+        # Device-side synthesis: setup stays off the host even at B=8192.
+        traces = src.batch_trace_device(horizon_steps, jax.random.key(7), b)
         states = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (b,) + x.shape), initial_state(cfg))
         keys = jax.random.split(jax.random.key(0), b)
@@ -93,13 +94,14 @@ def bench_ppo(cfg, iterations: int) -> dict:
     t_trace = time.perf_counter() - t0
 
     t_len = cfg.train.unroll_steps
-    ts, _ = trainer._iteration_fn(ts, windows.slice_steps(0, t_len))  # compile
+    ts, _ = trainer._iteration_fn(
+        ts, windows.slice_steps(0, t_len + 1))  # compile
     jax.block_until_ready(ts.params)
 
     t0 = time.perf_counter()
     for it in range(1, iterations + 1):
         ts, diag = trainer._iteration_fn(
-            ts, windows.slice_steps(it * t_len, t_len))
+            ts, windows.slice_steps(it * t_len, t_len + 1))
     jax.block_until_ready(ts.params)
     dt = time.perf_counter() - t0
 

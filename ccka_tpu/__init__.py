@@ -25,7 +25,6 @@ Subpackages
 - ``policy``     PolicyBackend interface, rule reference, feasibility constraints
 - ``models``     flax policy networks (MLP, actor-critic, MPC controller)
 - ``train``      diff-MPC and PPO training loops, orbax checkpointing
-- ``ops``        pallas TPU kernels for hot simulator ops
 - ``parallel``   mesh construction, sharding specs, multi-host collectives
 - ``actuation``  NodePool/HPA/KEDA patch emitters + dry-run and kubectl sinks
 - ``harness``    preroll checks, paired configure/observe lifecycle, telemetry

@@ -22,10 +22,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ccka_tpu.config import ClusterConfig
-from ccka_tpu.policy.constraints import project_feasible
+from ccka_tpu.policy.constraints import CONSOLIDATE_AFTER_MAX_S, project_feasible
 from ccka_tpu.sim.types import Action, N_CT
 
-_AFTER_MAX_S = 600.0   # consolidateAfter squash ceiling (10 min)
+# Codec squash ceiling == projection clip ceiling (single constant), so the
+# latent policy can reach the entire feasible consolidateAfter range.
+_AFTER_MAX_S = CONSOLIDATE_AFTER_MAX_S
 _HPA_LO, _HPA_HI = 0.1, 4.0
 _EPS = 1e-6
 

@@ -31,6 +31,13 @@ from ccka_tpu.sim.types import CT_OD, N_CT, Action
 
 _MIN_ZONE_MASS = 1e-3
 
+# Single source of truth for the consolidateAfter action ceiling: the latent
+# codec squashes into [0, MAX] and the projection clips to the same MAX, so
+# the policy can express the entire nominally-feasible range (round-1 had
+# 600s vs 3600s — a quarter of the projected range unreachable). 10 minutes
+# spans the reference's whole operating set (30/60/120s) with slack.
+CONSOLIDATE_AFTER_MAX_S = 600.0
+
 
 def static_ct_allow(cluster: ClusterConfig) -> jnp.ndarray:
     allow = jnp.zeros((cluster.n_pools, N_CT), jnp.float32)
@@ -70,6 +77,7 @@ def project_feasible(action: Action, cluster: ClusterConfig) -> Action:
         zone_weight=zone_w,
         ct_allow=ct,
         consolidation_aggr=jnp.clip(action.consolidation_aggr, 0.0, 1.0),
-        consolidate_after_s=jnp.clip(action.consolidate_after_s, 0.0, 3600.0),
+        consolidate_after_s=jnp.clip(action.consolidate_after_s, 0.0,
+                                     CONSOLIDATE_AFTER_MAX_S),
         hpa_scale=jnp.clip(action.hpa_scale, 0.1, 4.0),        # rule 5
     )

@@ -1,7 +1,7 @@
 """The rule-based CPU reference policy: Peak / Off-Peak profiles.
 
 Reproduces the reference's two profiles exactly (the golden tests in
-`tests/test_actuation.py` assert the rendered patch JSON byte-matches the
+`tests/test_policy_actuation.py` assert the rendered patch JSON byte-matches the
 shapes written by the bash scripts):
 
 Off-Peak (`demo_20_offpeak_configure.sh`):

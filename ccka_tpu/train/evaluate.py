@@ -38,9 +38,16 @@ def evaluate_backend(cfg: FrameworkConfig, backend: PolicyBackend,
     depends only on (eval_seed, trace index) — identical across backends —
     so comparisons are paired."""
     params = SimParams.from_config(cfg)
-    action_fn = backend.action_fn()
-    run = jax.jit(lambda s, tr, k: rollout(params, s, action_fn, tr, k,
-                                           stochastic=stochastic))
+    # MPC-style backends carry mutable host-side plan state that a jitted
+    # action_fn would freeze; they provide a jitted receding-horizon
+    # evaluate() instead (train/mpc.py receding_horizon_rollout).
+    if getattr(backend, "requires_receding_horizon", False):
+        run = lambda s, tr, k: backend.evaluate(  # noqa: E731
+            s, tr, k, stochastic=stochastic)
+    else:
+        action_fn = backend.action_fn()
+        run = jax.jit(lambda s, tr, k: rollout(params, s, action_fn, tr, k,
+                                               stochastic=stochastic))
     summaries, objectives = [], []
     for i, tr in enumerate(traces):
         final, metrics = run(initial_state(cfg),

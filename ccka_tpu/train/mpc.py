@@ -68,13 +68,81 @@ def optimize_plan(params: SimParams,
     return PlanResult(plan_latent=latent, losses=losses)
 
 
+@partial(jax.jit, static_argnames=("cluster", "tcfg", "horizon",
+                                   "replan_every", "iters", "stochastic"))
+def receding_horizon_rollout(params: SimParams,
+                             cluster: ClusterConfig,
+                             tcfg: TrainConfig,
+                             state0: ClusterState,
+                             trace: ExogenousTrace,
+                             init_latent: jnp.ndarray,
+                             key: jax.Array,
+                             *,
+                             horizon: int,
+                             replan_every: int,
+                             iters: int,
+                             stochastic: bool = True):
+    """Closed-loop receding-horizon MPC over a whole trace, in ONE jit.
+
+    Outer `lax.scan` over plan segments; each segment re-optimizes the plan
+    (the `optimize_plan` fori_loop, warm-started from the carried plan)
+    against an H-step forecast window gathered from the trace, then executes
+    the first ``replan_every`` actions through stochastic dynamics. Replaces
+    the round-1 per-tick host loop (unusable at day-long horizons): the
+    whole evaluation is device-resident, so day-long traces cost one
+    dispatch.
+
+    ``trace.steps`` must be a multiple of ``replan_every``. Forecast windows
+    that overrun the trace are clamped to the final tick (persistence
+    forecast at the edge).
+    """
+    t_steps = trace.steps
+    if t_steps % replan_every:
+        raise ValueError(f"trace length {t_steps} not a multiple of "
+                         f"replan_every={replan_every}")
+    n_seg = t_steps // replan_every
+
+    starts = jnp.arange(n_seg) * replan_every
+    idx = jnp.minimum(starts[:, None] + jnp.arange(horizon)[None, :],
+                      t_steps - 1)                       # [n_seg, H]
+    # Trace leaves are time-leading ([T, Z]/[T, C]/[T]); gather axis 0.
+    windows = jax.tree.map(lambda x: x[idx], exo_steps(trace))  # [n_seg,H,..]
+    segs = jax.tree.map(
+        lambda x: x.reshape((n_seg, replan_every) + x.shape[1:]),
+        exo_steps(trace))                                 # [n_seg, R, ...]
+
+    def body(carry, inp):
+        state, k, plan = carry
+        window, seg = inp
+        pr = optimize_plan(params, cluster, tcfg, state,
+                           ExogenousTrace(*window), plan, iters=iters)
+        plan = pr.plan_latent
+        actions = jax.vmap(lambda u: latent_to_action(u, cluster))(
+            plan[:replan_every])
+        k, sub = jax.random.split(k)
+        state, metrics = rollout_actions(
+            params, state, actions, ExogenousTrace(*seg), sub,
+            stochastic=stochastic)
+        # Warm-start the next segment with the plan rolled forward by the
+        # executed prefix, so carried actions stay time-aligned with the
+        # next forecast window.
+        return (state, k, jnp.roll(plan, -replan_every, axis=0)), metrics
+
+    (final, _, _), metrics = jax.lax.scan(
+        body, (state0, key, init_latent), (windows, segs))
+    # [n_seg, R, ...] -> [T, ...], matching `rollout`'s layout.
+    metrics = jax.tree.map(
+        lambda m: m.reshape((t_steps,) + m.shape[2:]), metrics)
+    return final, metrics
+
+
 class MPCBackend(PolicyBackend):
     """Receding-horizon diff-MPC controller.
 
-    ``decide`` executes the current plan position; :meth:`replan` refreshes
-    the plan from the latest state + forecast window. The evaluation loop
-    (`evaluate`) interleaves stochastic world steps with periodic replanning
-    — the learned counterpart of the operator's demo_20/21 cadence.
+    ``decide`` executes the current plan position (host-side live loop);
+    :meth:`replan` refreshes the plan from the latest state + forecast
+    window; :meth:`evaluate` runs the fully-jitted closed loop
+    (:func:`receding_horizon_rollout`).
     """
 
     def __init__(self, cfg: FrameworkConfig, *, horizon: int | None = None,
@@ -110,40 +178,47 @@ class MPCBackend(PolicyBackend):
         latent = jnp.take(self._plan, idx, axis=0)
         return latent_to_action(latent, self.cluster)
 
+    def action_fn(self):
+        """Unsafe under jit: `decide` reads the mutable host-side plan, so a
+        jitted rollout would bake the warm-start plan in as a constant and
+        never replan — silently wrong evaluation numbers. Use
+        :meth:`evaluate` (the jitted receding-horizon loop) instead."""
+        raise RuntimeError(
+            "MPCBackend.action_fn() would freeze the current plan inside "
+            "jit; use MPCBackend.evaluate() / receding_horizon_rollout() "
+            "for closed-loop runs, or decide() in the live host loop.")
+
+    # evaluate_backend dispatches to `evaluate` instead of action_fn().
+    requires_receding_horizon = True
+
     # -- closed-loop evaluation --------------------------------------------
 
     def evaluate(self, state0: ClusterState, trace: ExogenousTrace,
                  key: jax.Array, *, stochastic: bool = True):
         """Closed-loop receding-horizon run over ``trace``; returns
-        (final_state, stacked StepMetrics) like `rollout`."""
-        from ccka_tpu.sim.dynamics import step as sim_step
+        (final_state, stacked StepMetrics) like `rollout`. One XLA dispatch
+        end to end (see :func:`receding_horizon_rollout`).
 
-        steps = trace.steps
-        jit_step = jax.jit(partial(sim_step, stochastic=stochastic))
-        state = state0
-        all_metrics = []
-        xs = exo_steps(trace)
-        for t in range(steps):
-            if t % self.replan_every == 0:
-                window = trace.slice_steps(
-                    t, min(self.horizon, steps - t))
-                if window.steps < self.horizon:
-                    # pad by tiling the tail so the plan shape stays static
-                    reps = -(-self.horizon // max(window.steps, 1))
-                    window = ExogenousTrace(*[
-                        jnp.concatenate([x] * reps, axis=-2)[..., :self.horizon, :]
-                        if x.ndim >= 2 else
-                        jnp.concatenate([x] * reps, axis=-1)[..., :self.horizon]
-                        for x in window])
-                self.replan(state, window)
-            exo = jax.tree.map(lambda x: x[t], xs)
-            action = latent_to_action(
-                self._plan[min(t % self.replan_every, self.horizon - 1)],
-                self.cluster)
-            key, sub = jax.random.split(key)
-            state, m = jit_step(self.params, state, action, exo, sub)
-            all_metrics.append(m)
-        # Same layout as `rollout`'s scan: time leading — scalars [T],
-        # vectors [T, C].
-        stacked = jax.tree.map(lambda *ms: jnp.stack(ms, axis=0), *all_metrics)
-        return state, stacked
+        Traces whose length is not a multiple of ``replan_every`` are padded
+        with their final tick (persistence) and the metrics sliced back, so
+        KPI sums cover exactly ``trace.steps`` ticks — comparable tick-for-
+        tick with other backends on the same trace. The returned state
+        reflects the padded run (metrics, not the state, feed scoreboards).
+        """
+        t = trace.steps
+        r = self.replan_every
+        pad = (-t) % r
+        if pad:
+            last = trace.slice_steps(t - 1, 1)
+            trace = ExogenousTrace(*[
+                jnp.concatenate([x, jnp.repeat(l, pad, axis=0)], axis=0)
+                for x, l in zip(trace, last)])
+        base = action_to_latent(neutral_action(self.cluster), self.cluster)
+        init = jnp.broadcast_to(base, (self.horizon,) + base.shape)
+        final, metrics = receding_horizon_rollout(
+            self.params, self.cluster, self.tcfg, state0, trace, init, key,
+            horizon=self.horizon, replan_every=r,
+            iters=self.iters, stochastic=stochastic)
+        if pad:
+            metrics = jax.tree.map(lambda m: m[:t], metrics)
+        return final, metrics

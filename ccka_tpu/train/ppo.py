@@ -113,11 +113,15 @@ class PPOTrainer:
     # -- one PPO iteration (collect + GAE + update), fully jitted -----------
 
     def _iteration(self, ts: PPOTrainState, window: ExogenousTrace):
-        """window: [B, T, ...] exogenous slice for this iteration."""
+        """window: [B, T+1, ...] exogenous slice for this iteration — T
+        collect steps plus one lookahead tick for the GAE bootstrap
+        observation (windows overlap by one step between iterations)."""
         tcfg = self.tcfg
         xs = exo_steps(window)
-        # time-major for scan: [T, B, ...]
-        xs_t = jax.tree.map(lambda x: jnp.moveaxis(x, 1, 0), xs)
+        # time-major for scan: [T+1, B, ...]
+        xs_all = jax.tree.map(lambda x: jnp.moveaxis(x, 1, 0), xs)
+        xs_t = jax.tree.map(lambda x: x[:-1], xs_all)
+        boot_exo = jax.tree.map(lambda x: x[-1], xs_all)
 
         def collect_step(carry, exo_t):
             states, key = carry
@@ -142,10 +146,11 @@ class PPOTrainer:
             jax.lax.scan(collect_step, (ts.env_states, ts.key), xs_t,
                          unroll=4)
 
-        # Bootstrap value at the window edge (continuing episodes).
-        last_exo = jax.tree.map(lambda x: x[-1], xs_t)
+        # Bootstrap value at the window edge (continuing episodes): the
+        # post-step env states paired with the NEXT tick's exogenous
+        # signals — the observation the policy would actually see at T.
         _, _, last_value = self.net.apply(
-            ts.params, self._obs(env_states, last_exo))
+            ts.params, self._obs(env_states, boot_exo))
 
         # GAE over the time axis.
         def gae_step(carry, inp):
@@ -224,7 +229,9 @@ class PPOTrainer:
         time device-bound instead of host-trace-gen-bound.
         """
         b = self.tcfg.batch_clusters
-        total = iterations * self.tcfg.unroll_steps
+        # +1: each iteration consumes unroll_steps collect ticks plus one
+        # lookahead tick for the GAE bootstrap (windows overlap by one).
+        total = iterations * self.tcfg.unroll_steps + 1
         if self.tcfg.device_traces and hasattr(source, "batch_trace_device"):
             return source.batch_trace_device(total, jax.random.key(seed), b)
         return source.batch_trace(total, range(seed, seed + b))
@@ -232,12 +239,12 @@ class PPOTrainer:
     def train(self, source, iterations: int, *, seed: int | None = None,
               log_every: int = 0) -> tuple[PPOTrainState, list[dict]]:
         ts = self.init_state(seed)
-        all_traces = self.make_windows(source, iterations,
-                                       seed=(seed or self.tcfg.seed) + 1000)
+        seed = self.tcfg.seed if seed is None else seed
+        all_traces = self.make_windows(source, iterations, seed=seed + 1000)
         t_len = self.tcfg.unroll_steps
         history = []
         for it in range(iterations):
-            window = all_traces.slice_steps(it * t_len, t_len)
+            window = all_traces.slice_steps(it * t_len, t_len + 1)
             ts, diag = self._iteration_fn(ts, window)
             if log_every and (it % log_every == 0 or it == iterations - 1):
                 rec = {k: float(v) for k, v in diag._asdict().items()}
