@@ -11,7 +11,10 @@ gaps (§2.3: HPA never created, KEDA never installed):
   the reference's emitted JSON), HPA replica targets, KEDA ScaledObject spec;
 - ``sink``     — where patches go: DryRunSink (tests/CI), KubectlSink
   (live clusters, injectable runner), both implementing apply-and-verify
-  with the reference's path fallback.
+  with the reference's path fallback, plus generic manifest apply/delete
+  (`kubectl apply -f` equivalents) for HPA/KEDA/bootstrap objects;
+- ``bootstrap`` — NodePool + EC2NodeClass creation and demo_50-ordered
+  teardown (the reference's missing `demo_01`).
 """
 
 from ccka_tpu.actuation.patches import (  # noqa: F401
@@ -24,5 +27,12 @@ from ccka_tpu.actuation.sink import (  # noqa: F401
     ActuationSink,
     DryRunSink,
     KubectlSink,
+    ManifestCommand,
     PatchCommand,
+)
+from ccka_tpu.actuation.bootstrap import (  # noqa: F401
+    bootstrap,
+    cleanup,
+    render_ec2nodeclass_manifest,
+    render_nodepool_manifest,
 )

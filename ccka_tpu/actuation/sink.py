@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import shlex
 import subprocess
+import time
 from dataclasses import dataclass
 from typing import Callable, Sequence
 
@@ -45,6 +46,40 @@ class PatchCommand:
 
     def render(self) -> str:
         return shlex.join(self.kubectl_argv())
+
+
+@dataclass(frozen=True)
+class ManifestCommand:
+    """A whole-object mutation: `kubectl apply -f` / `kubectl delete`.
+
+    ``selector`` (label selector) replaces ``name`` for bulk deletes —
+    e.g. NodeClaims, whose names are Karpenter-generated and only reachable
+    via their `karpenter.sh/nodepool` label."""
+
+    action: str           # "apply" | "delete" | "scrub-finalizers"
+    kind: str
+    name: str = ""
+    namespace: str = ""
+    doc: object = None    # full manifest for "apply"
+    selector: str = ""    # label selector (delete only), e.g. "k=v"
+
+    def kubectl_argv(self) -> list[str]:
+        ns = ["-n", self.namespace] if self.namespace else []
+        if self.action == "apply":
+            return ["kubectl", "apply", *ns, "-f", "-"]
+        if self.action == "scrub-finalizers":
+            return ["kubectl", "patch", self.kind, self.name, *ns,
+                    "--type=merge", "-p",
+                    json.dumps({"metadata": {"finalizers": []}})]
+        target = (["-l", self.selector] if self.selector else [self.name])
+        return ["kubectl", "delete", self.kind, *target, *ns,
+                "--ignore-not-found", "--wait=false"]
+
+    def render(self) -> str:
+        line = shlex.join(self.kubectl_argv())
+        if self.action == "apply":
+            line += " <<'EOF'\n" + json.dumps(self.doc, indent=2) + "\nEOF"
+        return line
 
 
 @dataclass
@@ -90,10 +125,72 @@ class ActuationSink:
         The observe-script analog (`demo_20_offpeak_observe.sh:8-27`)."""
         raise NotImplementedError
 
+    # -- generic manifests (kubectl apply/delete equivalents) ---------------
+    #
+    # Closes the reference's §2.3 half-gap: HPA/KEDA objects were *rendered*
+    # in round 1 but had no apply path (prometheus-adapter installed yet no
+    # HPA object, `03_monitoring.sh:17-19`; KEDA stub `.env:10-12`).
+
+    def apply_manifest(self, doc: dict) -> ApplyResult:
+        """`kubectl apply -f` + skeptical read-back via :meth:`get_object`."""
+        kind = doc.get("kind", "")
+        meta = doc.get("metadata", {})
+        name = meta.get("name", "")
+        ns = meta.get("namespace", "")
+        ident = f"{kind}/{name}"
+        if not kind or not name:
+            return ApplyResult(ident, ok=False, used_fallback=False,
+                               detail="manifest missing kind or name")
+        if not self._apply(ManifestCommand("apply", kind, name, ns, doc)):
+            return ApplyResult(ident, ok=False, used_fallback=False,
+                               detail="apply rejected")
+        if not self.get_object(kind, name, namespace=ns):
+            return ApplyResult(ident, ok=False, used_fallback=False,
+                               detail="read-back empty after apply")
+        return ApplyResult(ident, ok=True, used_fallback=False)
+
+    def apply_manifests(self, docs: Sequence[dict]) -> list[ApplyResult]:
+        return [self.apply_manifest(d) for d in docs]
+
+    def delete_object(self, kind: str, name: str = "", *,
+                      namespace: str = "", selector: str = "",
+                      scrub_finalizers: bool = False,
+                      grace_s: float = 5.0,
+                      sleep_fn: Callable[[float], None] | None = None
+                      ) -> bool:
+        """`kubectl delete --ignore-not-found` by name or label selector.
+
+        With ``scrub_finalizers``, the demo_50 finalizer-scrub rescue
+        (`demo_50_cleanup_configure.sh:32-35`) fires only for an object
+        observed STUCK: still present ``grace_s`` seconds after the async
+        delete — never immediately, which would strip finalizers (e.g.
+        `karpenter.sh/termination`) off healthily-terminating objects.
+        Selector deletes skip the scrub (no single object to patch)."""
+        ok = self._apply(ManifestCommand("delete", kind, name, namespace,
+                                         selector=selector))
+        if scrub_finalizers and name and self.get_object(
+                kind, name, namespace=namespace):
+            (sleep_fn or time.sleep)(grace_s)
+            if self.get_object(kind, name, namespace=namespace):
+                self._apply(ManifestCommand("scrub-finalizers", kind, name,
+                                            namespace))
+                ok = self._apply(ManifestCommand("delete", kind, name,
+                                                 namespace))
+        return ok
+
+    def get_object(self, kind: str, name: str, *,
+                   namespace: str = "") -> dict:
+        """Full-object read-back; {} when absent."""
+        raise NotImplementedError
+
     # -- backend hooks ------------------------------------------------------
 
     def _patch(self, cmd: PatchCommand) -> bool:
         """Apply one mutation; returns False if the backend rejected it."""
+        raise NotImplementedError
+
+    def _apply(self, cmd: ManifestCommand) -> bool:
+        """Execute one manifest-level command."""
         raise NotImplementedError
 
     def _readback_ok(self, pool: str, path_prefix: str) -> bool:
@@ -111,8 +208,9 @@ class DryRunSink(ActuationSink):
     """
 
     def __init__(self, *, schema_path: str = PRIMARY_PATH, echo: bool = False):
-        self.commands: list[PatchCommand] = []
-        self.store: dict[str, dict] = {}
+        self.commands: list = []          # PatchCommand | ManifestCommand
+        self.store: dict[str, dict] = {}  # NodePool patch-level store
+        self.objects: dict[tuple, dict] = {}  # (kind, ns, name) -> manifest
         self.schema_path = schema_path
         self.echo = echo
 
@@ -133,6 +231,37 @@ class DryRunSink(ActuationSink):
                     entry["requirements_at"] = oper["path"]
                     entry["requirements"] = oper["value"]
         return True
+
+    def _apply(self, cmd: ManifestCommand) -> bool:
+        self.commands.append(cmd)
+        if self.echo:
+            print(cmd.render())
+        key = (cmd.kind.lower(), cmd.namespace, cmd.name)
+        if cmd.action == "apply":
+            self.objects[key] = cmd.doc
+            if cmd.kind.lower() == "nodepool":
+                # Seed the patch-level store so subsequent NodePool patch/
+                # observe flows see the bootstrapped object (the round-trip
+                # bootstrap -> preroll -> reset the reference never had).
+                spec = cmd.doc.get("spec", {})
+                entry = self.store.setdefault(cmd.name, {})
+                entry["spec"] = {"disruption": dict(spec.get("disruption", {}))}
+                reqs = (spec.get("template", {}).get("spec", {})
+                        .get("requirements", []))
+                if reqs:
+                    entry["requirements"] = reqs
+                    entry["requirements_at"] = (
+                        self.schema_path + "/requirements")
+        elif cmd.action == "delete":
+            self.objects.pop(key, None)
+            if cmd.kind.lower() == "nodepool":
+                self.store.pop(cmd.name, None)
+        # scrub-finalizers is a no-op on the simulated store.
+        return True
+
+    def get_object(self, kind: str, name: str, *,
+                   namespace: str = "") -> dict:
+        return self.objects.get((kind.lower(), namespace, name), {})
 
     def _readback_ok(self, pool: str, path_prefix: str) -> bool:
         entry = self.store.get(pool, {})
@@ -171,6 +300,36 @@ class KubectlSink(ActuationSink):
     def _patch(self, cmd: PatchCommand) -> bool:
         rc, _ = self.runner(cmd.kubectl_argv())
         return rc == 0
+
+    def _apply(self, cmd: ManifestCommand) -> bool:
+        if cmd.action == "apply":
+            # The runner interface is argv-only (no stdin), so the manifest
+            # travels via a temp file — kubectl accepts JSON at -f.
+            import os
+            import tempfile
+            fd, path = tempfile.mkstemp(suffix=".json")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(cmd.doc, f)
+                ns = ["-n", cmd.namespace] if cmd.namespace else []
+                rc, _ = self.runner(["kubectl", "apply", *ns, "-f", path])
+            finally:
+                os.unlink(path)
+            return rc == 0
+        rc, _ = self.runner(cmd.kubectl_argv())
+        return rc == 0
+
+    def get_object(self, kind: str, name: str, *,
+                   namespace: str = "") -> dict:
+        ns = ["-n", namespace] if namespace else []
+        rc, out = self.runner(["kubectl", "get", kind, name, *ns,
+                               "-o", "json"])
+        if rc != 0:
+            return {}
+        try:
+            return json.loads(out)
+        except json.JSONDecodeError:
+            return {}
 
     def _readback_ok(self, pool: str, path_prefix: str) -> bool:
         # demo_20:102: jsonpath over requirements key/operator/values.

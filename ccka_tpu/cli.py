@@ -46,12 +46,46 @@ def _build_parser() -> argparse.ArgumentParser:
 
     so = sub.add_parser("observe", help="print the profile a policy would "
                                         "apply right now (read-only)")
-    # Learned backends gain observe support once their checkpoint-loading
-    # path lands; advertising them before then would misattribute decisions.
-    so.add_argument("--backend", default="rule", choices=("rule",))
+    so.add_argument("--backend", default="rule",
+                    choices=("rule", "mpc", "ppo"))
+    so.add_argument("--checkpoint", default="",
+                    help="orbax checkpoint dir (required for ppo)")
+
+    sr = sub.add_parser(
+        "run", help="the live closed-loop controller: scrape->decide->"
+                    "render->apply->verify every interval (the §2.3 "
+                    "controller the reference left to a human operator)")
+    sr.add_argument("--backend", default="rule",
+                    choices=("rule", "mpc", "ppo"))
+    sr.add_argument("--checkpoint", default="")
+    sr.add_argument("--ticks", type=int, default=0,
+                    help="stop after N ticks (0 = run forever)")
+    sr.add_argument("--interval", type=float, default=None,
+                    help="seconds between ticks (default: signals scrape "
+                         "interval, 30s)")
+    sr.add_argument("--live", action="store_true",
+                    help="apply via kubectl instead of the dry-run sink")
+    sr.add_argument("--hpa", action="store_true",
+                    help="also realize the policy's HPA lever as "
+                         "HorizontalPodAutoscaler objects each tick")
+    sr.add_argument("--seed", type=int, default=0)
 
     sp = sub.add_parser("preroll", help="environment assertions (demo_18)")
     sp.add_argument("--live", action="store_true")
+
+    sb = sub.add_parser(
+        "bootstrap", help="create the EC2NodeClass + NodePools — the "
+                          "reference's missing demo_01 (SURVEY §2.1)")
+    sb.add_argument("--live", action="store_true")
+    sb.add_argument("--json", action="store_true",
+                    help="print the manifests instead of applying")
+
+    sc = sub.add_parser(
+        "cleanup", help="teardown in demo_50 order: namespace, NodePools "
+                        "first, NodeClaims w/ finalizer scrub")
+    sc.add_argument("--live", action="store_true")
+    sc.add_argument("--wipe-nodeclass", action="store_true",
+                    help="also delete the EC2NodeClass (WIPE_NODECLASS)")
 
     ss = sub.add_parser("simulate", help="batched simulator + KPI report")
     ss.add_argument("--backend", default="rule", choices=("rule", "neutral"))
@@ -116,10 +150,30 @@ def _cmd_profile(cfg: FrameworkConfig, profile: str, live: bool,
     return 0 if ok else 1
 
 
-def _cmd_observe(cfg: FrameworkConfig, backend: str) -> int:
+def make_backend(cfg: FrameworkConfig, name: str, checkpoint: str = ""):
+    """Backend factory shared by observe/simulate/run/evaluate."""
+    from ccka_tpu.policy import RulePolicy
+
+    if name == "rule":
+        return RulePolicy(cfg.cluster)
+    if name == "mpc":
+        from ccka_tpu.train.mpc import MPCBackend
+        return MPCBackend(cfg)
+    if name == "ppo":
+        if not checkpoint:
+            raise SystemExit("ccka: --backend ppo requires --checkpoint DIR")
+        from ccka_tpu.train.checkpoint import load_state
+        from ccka_tpu.train.ppo import PPOBackend, PPOTrainer
+        target = PPOTrainer(cfg).init_state().params
+        params = load_state(checkpoint, target=target)
+        return PPOBackend(cfg, params)
+    raise SystemExit(f"ccka: unknown backend {name!r}")
+
+
+def _cmd_observe(cfg: FrameworkConfig, backend_name: str,
+                 checkpoint: str = "") -> int:
     import jax.numpy as jnp
 
-    from ccka_tpu.policy import RulePolicy
     from ccka_tpu.sim import initial_state
     from ccka_tpu.signals.live import make_signal_source
 
@@ -127,18 +181,39 @@ def _cmd_observe(cfg: FrameworkConfig, backend: str) -> int:
     tick = src.tick(0)
     from ccka_tpu.sim.rollout import exo_steps
     exo = jax_tree_first(exo_steps(tick))
-    policy = RulePolicy(cfg.cluster)
-    action = policy.decide(initial_state(cfg), exo, jnp.int32(0))
+    policy = make_backend(cfg, backend_name, checkpoint)
+    state0 = initial_state(cfg)
+    if hasattr(policy, "replan"):  # receding-horizon backends plan first
+        policy.replan(state0, src.trace(policy.horizon))
+    action = policy.decide(state0, exo, jnp.int32(0))
     is_peak = float(exo.is_peak) > 0.5
-    print(json.dumps({
-        "backend": backend,
-        "profile": policy.profile_name(is_peak),
+    out = {
+        "backend": backend_name,
         "is_peak": is_peak,
         "consolidate_after_s": [float(x) for x in action.consolidate_after_s],
         "consolidation_aggr": [float(x) for x in action.consolidation_aggr],
         "zone_weight": [[float(x) for x in row] for row in action.zone_weight],
-    }, indent=2))
+    }
+    if hasattr(policy, "profile_name"):
+        out["profile"] = policy.profile_name(is_peak)
+    print(json.dumps(out, indent=2))
     return 0
+
+
+def _cmd_run(cfg: FrameworkConfig, backend_name: str, checkpoint: str,
+             ticks: int, interval: float | None, live: bool,
+             seed: int, hpa: bool = False) -> int:
+    from ccka_tpu.harness.controller import controller_from_config
+
+    backend = make_backend(cfg, backend_name, checkpoint)
+    ctrl = controller_from_config(cfg, backend, live=live,
+                                  interval_s=interval, seed=seed,
+                                  apply_hpa=hpa)
+    reports = ctrl.run(ticks if ticks > 0 else None)
+    ok = all(r.applied and r.verified for r in reports) if reports else True
+    print(f"[{'ok' if ok else 'err'}] controller ran "
+          f"{len(reports)} tick(s)", file=sys.stderr)
+    return 0 if ok else 1
 
 
 def jax_tree_first(tree):
@@ -199,6 +274,43 @@ def _cmd_preroll(cfg: FrameworkConfig, live: bool) -> int:
     return run_preroll(cfg, live=live)
 
 
+def _cmd_bootstrap(cfg: FrameworkConfig, live: bool, as_json: bool) -> int:
+    from ccka_tpu.actuation import (DryRunSink, KubectlSink, bootstrap,
+                                    render_ec2nodeclass_manifest,
+                                    render_nodepool_manifest)
+
+    if as_json:
+        docs = [render_ec2nodeclass_manifest(cfg.cluster)]
+        docs += [render_nodepool_manifest(cfg.cluster, p)
+                 for p in cfg.cluster.pools]
+        print(json.dumps(docs, indent=2))
+        return 0
+    sink = KubectlSink() if live else DryRunSink(echo=True)
+    results = bootstrap(cfg, sink)
+    ok = all(r.ok for r in results)
+    for r in results:
+        print(f"[{'ok' if r.ok else 'FAILED'}] {r.pool}"
+              + (f" — {r.detail}" if r.detail else ""), file=sys.stderr)
+    print(f"[{'ok' if ok else 'err'}] bootstrap "
+          f"{'applied' if live else 'rendered (dry-run)'}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def _cmd_cleanup(cfg: FrameworkConfig, live: bool,
+                 wipe_nodeclass: bool) -> int:
+    from ccka_tpu.actuation import DryRunSink, KubectlSink, cleanup
+
+    sink = KubectlSink() if live else DryRunSink(echo=True)
+    results = cleanup(cfg, sink, wipe_nodeclass=wipe_nodeclass)
+    ok = all(good for _, good in results)
+    for name, good in results:
+        print(f"[{'ok' if good else 'FAILED'}] delete {name}",
+              file=sys.stderr)
+    print(f"[{'ok' if ok else 'err'}] cleanup "
+          f"{'done' if live else 'rendered (dry-run)'}", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
     try:
@@ -214,12 +326,19 @@ def main(argv: list[str] | None = None) -> int:
         if args.command in ("offpeak", "peak", "reset"):
             return _cmd_profile(cfg, args.command, args.live, args.json)
         if args.command == "observe":
-            return _cmd_observe(cfg, args.backend)
+            return _cmd_observe(cfg, args.backend, args.checkpoint)
+        if args.command == "run":
+            return _cmd_run(cfg, args.backend, args.checkpoint, args.ticks,
+                            args.interval, args.live, args.seed, args.hpa)
         if args.command == "simulate":
             return _cmd_simulate(cfg, args.backend, args.days, args.clusters,
                                  args.seed, args.stochastic)
         if args.command == "preroll":
             return _cmd_preroll(cfg, args.live)
+        if args.command == "bootstrap":
+            return _cmd_bootstrap(cfg, args.live, args.json)
+        if args.command == "cleanup":
+            return _cmd_cleanup(cfg, args.live, args.wipe_nodeclass)
         if args.command == "show-config":
             print(cfg.to_json())
             return 0

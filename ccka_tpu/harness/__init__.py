@@ -8,3 +8,8 @@ components usable both as a pytest fixture layer and from the CLI.
 
 from ccka_tpu.harness.preroll import PrerollCheck, run_preroll  # noqa: F401
 from ccka_tpu.harness.lifecycle import Stage, ConfigureObserve  # noqa: F401
+from ccka_tpu.harness.controller import (  # noqa: F401
+    Controller,
+    TickReport,
+    controller_from_config,
+)

@@ -1,0 +1,224 @@
+"""The live closed-loop controller — the component the reference never built.
+
+The reference's proposal describes a "Cost & Carbon Aware Controller …
+computing the cheapest/cleanest configuration that meets SLOs" every few
+seconds (proposal PDF p.4), but in code the decision step is the *operator
+manually running* `demo_20_offpeak_configure.sh` or `demo_21_peak_configure.sh`
+(`README.md:52-57`). This module closes that §2.3 gap: a daemon composing
+the pieces the framework already has, on the reference's 30s metrics cadence
+(`06_opencost.sh:323`):
+
+    scrape (SignalSource.tick) → decide (PolicyBackend) → render
+    (NodePool patches) → apply (ActuationSink) → verify (observed_state
+    read-back) → account (simulator state estimate) → KPI log line
+
+State estimation: the controller carries a :class:`ClusterState` estimate
+advanced through the simulator dynamics with the applied action each tick
+(model-based dead reckoning). Policies therefore see the same observation
+surface in live operation as in training; scraped signals (prices, carbon,
+demand, is_peak) are the measured inputs, exactly the quantities the
+KSM→ADOT→AMP pipeline carried in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccka_tpu.actuation.patches import render_nodepool_patches
+from ccka_tpu.actuation.sink import ActuationSink
+from ccka_tpu.config import FrameworkConfig
+from ccka_tpu.policy.base import PolicyBackend
+from ccka_tpu.sim.dynamics import step as sim_step
+from ccka_tpu.sim.rollout import exo_steps, initial_state
+from ccka_tpu.sim.types import Action, ClusterState, SimParams
+from ccka_tpu.signals.base import SignalSource
+
+
+@dataclasses.dataclass
+class TickReport:
+    """One control tick's structured record (the KPI log line payload)."""
+
+    t: int
+    is_peak: bool
+    profile: str               # backend-reported mode, e.g. "peak"/"offpeak"
+    applied: bool              # all pool patches accepted
+    verified: bool             # read-back matches the rendered intent
+    fallbacks: int             # pools that needed the legacy schema path
+    cost_usd_hr: float         # estimated fleet $/hr after this tick
+    carbon_g_hr: float         # estimated gCO2/hr
+    nodes_spot: float
+    nodes_od: float
+    pending_pods: float
+    slo_ok: bool
+    detail: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+
+def _verify_pool(observed: dict, ps) -> bool:
+    """Rendered intent vs sink read-back (never vs what we meant to send)."""
+    want_policy = ps.disruption_merge["spec"]["disruption"][
+        "consolidationPolicy"]
+    if observed.get("consolidationPolicy") != want_policy:
+        return False
+    want = {r["key"]: r["values"] for r in ps.requirements_json[0]["value"]}
+    if observed.get("capacity_types") != want.get(
+            "karpenter.sh/capacity-type"):
+        return False
+    if observed.get("zones") != want.get("topology.kubernetes.io/zone"):
+        return False
+    return True
+
+
+class Controller:
+    """Scrape→decide→act loop over pluggable backend/source/sink.
+
+    ``interval_s`` defaults to the signals scrape cadence (30s, matching
+    `06_opencost.sh:323`); tests inject ``sleep_fn``/``log_fn`` and run with
+    interval 0.
+    """
+
+    def __init__(self,
+                 cfg: FrameworkConfig,
+                 backend: PolicyBackend,
+                 source: SignalSource,
+                 sink: ActuationSink,
+                 *,
+                 interval_s: float | None = None,
+                 seed: int = 0,
+                 apply_hpa: bool = False,
+                 log_fn: Callable[[str], None] | None = None,
+                 sleep_fn: Callable[[float], None] = time.sleep):
+        self.cfg = cfg
+        self.backend = backend
+        self.source = source
+        self.sink = sink
+        self.interval_s = (cfg.signals.scrape_interval_s
+                           if interval_s is None else interval_s)
+        self.apply_hpa = apply_hpa
+        self.seed = seed
+        self.log_fn = log_fn if log_fn is not None else (
+            lambda line: print(line, flush=True))
+        self.sleep_fn = sleep_fn
+        self.params = SimParams.from_config(cfg)
+        self.state: ClusterState = initial_state(cfg)
+        self.key = jax.random.key(seed)
+        self._step = jax.jit(
+            lambda s, a, e, k: sim_step(self.params, s, a, e, k,
+                                        stochastic=False))
+        # MPC-style backends replan against a forecast window.
+        self._replan_every = getattr(backend, "replan_every", 0)
+        self._horizon = getattr(backend, "horizon", 0)
+
+    # -- one tick ----------------------------------------------------------
+
+    def tick(self, t: int) -> TickReport:
+        # 1. scrape the latest signals (the 30s AMP pipeline analog).
+        tick_trace = self.source.tick(t, seed=self.seed)
+        exo = jax.tree.map(lambda x: x[0], exo_steps(tick_trace))
+        is_peak = bool(float(exo.is_peak) > 0.5)
+
+        # 2. decide. Receding-horizon backends periodically re-optimize
+        #    against the source's forward-looking window (exact future for
+        #    synthetic/replay, persistence forecast for live).
+        if self._replan_every and t % self._replan_every == 0:
+            window = self.source.forecast(t, self._horizon, seed=self.seed)
+            self.backend.replan(self.state, window)
+        action = self.backend.decide(self.state, exo, jnp.int32(t))
+
+        # 3. render: op mirrors the reference's profile split — peak uses
+        #    op:add (demo_21:65), off-peak op:replace (demo_20:69).
+        patches = render_nodepool_patches(
+            action, self.cfg.cluster, op="add" if is_peak else "replace")
+
+        # 4. apply through the sink (kubectl-shaped, with fallback). With
+        #    apply_hpa, the tick also realizes the HPA lever as actual
+        #    HorizontalPodAutoscaler objects — the §2.3 capability the
+        #    reference installed prometheus-adapter for but never created.
+        results = self.sink.apply_all(patches)
+        if self.apply_hpa:
+            from ccka_tpu.actuation.patches import render_hpa_manifests
+            results += self.sink.apply_manifests(
+                render_hpa_manifests(action, self.cfg.cluster,
+                                     self.cfg.workload))
+        applied = all(r.ok for r in results)
+        fallbacks = sum(1 for r in results if r.used_fallback)
+
+        # 5. verify: skeptical read-back against the rendered intent.
+        verified = applied and all(
+            _verify_pool(self.sink.observed_state(ps.pool), ps)
+            for ps in patches)
+
+        # 6. advance the model-based state estimate (expectation dynamics).
+        self.key, sub = jax.random.split(self.key)
+        self.state, metrics = self._step(self.state, action, exo, sub)
+
+        dt_hr = float(self.params.dt_s) / 3600.0
+        profile = ""
+        if hasattr(self.backend, "profile_name"):
+            profile = self.backend.profile_name(is_peak)
+        report = TickReport(
+            t=t,
+            is_peak=is_peak,
+            profile=profile or self.backend.name,
+            applied=applied,
+            verified=verified,
+            fallbacks=fallbacks,
+            cost_usd_hr=float(metrics.cost_usd) / dt_hr,
+            carbon_g_hr=float(metrics.carbon_g) / dt_hr,
+            nodes_spot=float(metrics.nodes_by_ct[0]),
+            nodes_od=float(metrics.nodes_by_ct[1]),
+            pending_pods=float(np.asarray(metrics.pending_pods).sum()),
+            slo_ok=bool(float(metrics.slo_ok) > 0.5),
+            detail="; ".join(r.detail for r in results if r.detail)[:500],
+        )
+        self.log_fn(report.to_json())
+        return report
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self, ticks: int | None = None,
+            start_tick: int = 0) -> list[TickReport]:
+        """Drive the loop for ``ticks`` iterations (None = forever).
+
+        Sleeps ``interval_s`` between ticks — the operator cadence the
+        reference left to a human. Returns the collected reports (for a
+        bounded run; an unbounded run only logs).
+        """
+        reports: list[TickReport] = []
+        t = start_tick
+        while ticks is None or t < start_tick + ticks:
+            report = self.tick(t)
+            if ticks is not None:  # unbounded daemons only log (no
+                reports.append(report)  # unbounded in-memory accumulation)
+            t += 1
+            more = ticks is None or t < start_tick + ticks
+            if more and self.interval_s > 0:
+                self.sleep_fn(self.interval_s)
+        return reports
+
+
+def controller_from_config(cfg: FrameworkConfig, backend: PolicyBackend,
+                           *, live: bool = False,
+                           runner=None, **kwargs) -> Controller:
+    """Wire a controller with the configured signal source and a sink:
+    DryRunSink by default, KubectlSink with ``live=True`` (runner
+    injectable for tests)."""
+    from ccka_tpu.actuation.sink import DryRunSink, KubectlSink
+    from ccka_tpu.signals.live import make_signal_source
+
+    source = make_signal_source(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    if live:
+        sink = KubectlSink(runner) if runner else KubectlSink()
+    else:
+        sink = DryRunSink()
+    return Controller(cfg, backend, source, sink, **kwargs)

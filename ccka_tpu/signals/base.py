@@ -108,6 +108,19 @@ class SignalSource(abc.ABC):
         full = self.trace(t_index + 1, seed=seed)
         return full.slice_steps(t_index, 1)
 
+    def forecast(self, t_index: int, steps: int, *,
+                 seed: int = 0) -> ExogenousTrace:
+        """``steps`` ticks of *forward-looking* signals from ``t_index`` —
+        what a receding-horizon planner optimizes against.
+
+        Default: the future slice of :meth:`trace` (exact for synthetic/
+        replay worlds, where the trace IS the future). Live sources must
+        override — their trace() is backfilled history, not a forecast
+        (LiveSignalSource uses persistence forecasting).
+        """
+        return self.trace(t_index + steps, seed=seed).slice_steps(
+            t_index, steps)
+
     def batch_trace(self, steps: int, seeds) -> ExogenousTrace:
         """[B, T, ...] traces for a batch of seeds (default: stack
         per-seed :meth:`trace` calls; synthetic overrides vectorized)."""

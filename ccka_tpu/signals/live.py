@@ -304,6 +304,34 @@ class LiveSignalSource(SignalSource):
             is_peak=base.is_peak,
         )
 
+    def forecast(self, t_index: int, steps: int, *,
+                 seed: int = 0) -> ExogenousTrace:
+        """Forward window for receding-horizon planning: the synthetic
+        diurnal prior shaped to NOW's measured levels (persistence-of-
+        anomaly). The base default would slice ``trace()``, which for a
+        live source is *backfilled history* frozen at the construction
+        anchor — a planner fed that would optimize yesterday's window
+        forever."""
+        prior = self._synth.forecast(t_index, steps, seed=seed)
+        now = self.tick(t_index, seed=seed)
+
+        def _lvl(x) -> float:
+            return float(np.asarray(x).mean())
+
+        d_ratio = _lvl(now.demand_pods) / max(
+            _lvl(prior.demand_pods[:1]), 1e-6)
+        c_ratio = _lvl(now.carbon_g_kwh) / max(
+            _lvl(prior.carbon_g_kwh[:1]), 1e-6)
+        od_now = _lvl(now.od_price_hr)
+        return ExogenousTrace(
+            spot_price_hr=prior.spot_price_hr,
+            od_price_hr=as_f32(np.full_like(
+                np.asarray(prior.od_price_hr), od_now)),
+            carbon_g_kwh=as_f32(np.asarray(prior.carbon_g_kwh) * c_ratio),
+            demand_pods=as_f32(np.asarray(prior.demand_pods) * d_ratio),
+            is_peak=prior.is_peak,
+        )
+
 
 def make_signal_source(cluster: ClusterConfig, workload: WorkloadConfig,
                        sim: SimConfig, signals: SignalsConfig,

@@ -69,14 +69,29 @@ class SyntheticSignalSource(SignalSource):
         )
 
     def trace(self, steps: int, *, seed: int = 0) -> ExogenousTrace:
+        self._ensure_cached(steps, seed)
+        return self._cache[seed].slice_steps(0, steps)
+
+    def _ensure_cached(self, steps: int, seed: int) -> None:
         cached = self._cache.get(seed)
         if cached is not None and cached.steps >= steps:
-            return cached.slice_steps(0, steps)
+            return
         # Geometric growth so a tick-by-tick caller regenerates rarely.
         gen_steps = max(steps, 2 * cached.steps if cached is not None else 0, 128)
-        trace = self._assemble(gen_steps, self._noise(gen_steps, seed))
-        self._cache[seed] = trace
-        return trace.slice_steps(0, steps)
+        self._cache[seed] = self._assemble(gen_steps,
+                                           self._noise(gen_steps, seed))
+
+    def tick(self, t_index: int, *, seed: int = 0) -> ExogenousTrace:
+        """O(1) amortized per tick: slice straight out of the prefix-stable
+        cache (the base default's trace(t+1) intermediate would copy O(t)
+        device memory every scrape — unbounded growth for a long-lived
+        controller daemon)."""
+        return self.forecast(t_index, 1, seed=seed)
+
+    def forecast(self, t_index: int, steps: int, *,
+                 seed: int = 0) -> ExogenousTrace:
+        self._ensure_cached(t_index + steps, seed)
+        return self._cache[seed].slice_steps(t_index, steps)
 
     def batch_trace(self, steps: int, seeds) -> ExogenousTrace:
         """[B, T, ...] traces for a batch of seeds in one vectorized pass.

@@ -1,0 +1,181 @@
+"""Closed-loop controller integration tests (VERDICT round-1 item 3).
+
+Drives >=10 ticks of the scrape->decide->render->apply->verify loop through
+a synthetic signal source positioned just before the 09:00 peak edge, so
+`is_peak` flips mid-run, and asserts the applied NodePool patches change
+with it — the automation of the operator's demo_20->demo_21 switch.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ccka_tpu.actuation.sink import DryRunSink, KubectlSink
+from ccka_tpu.config import default_config
+from ccka_tpu.harness.controller import Controller, controller_from_config
+from ccka_tpu.policy import RulePolicy
+from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+
+@pytest.fixture()
+def cfg_edge():
+    """Default config; sources started at 08:58 flip to peak at tick 4."""
+    return default_config()
+
+
+def _source_at_peak_edge(cfg):
+    # 08:58:00 -> ticks 0..3 off-peak, tick 4+ peak (30s ticks).
+    return SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                 cfg.signals, start_unix_s=8 * 3600 + 58 * 60)
+
+
+def test_controller_ten_ticks_flip_peak(cfg_edge):
+    cfg = cfg_edge
+    src = _source_at_peak_edge(cfg)
+    sink = DryRunSink()
+    lines = []
+    ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, sink,
+                      interval_s=0.0, log_fn=lines.append)
+    reports = ctrl.run(ticks=10)
+
+    assert len(reports) == 10
+    assert all(r.applied and r.verified for r in reports)
+    # The peak edge: first 4 off-peak, rest peak.
+    assert [r.is_peak for r in reports] == [False] * 4 + [True] * 6
+    assert reports[0].profile == "offpeak" and reports[-1].profile == "peak"
+
+    # The sink's stored pool state follows the flip: off-peak leaves the
+    # spot pool on aggressive consolidation + OFFPEAK_ZONES; peak pins
+    # PEAK_ZONES and conservative consolidation (demo_20 vs demo_21).
+    spot_pool = cfg.cluster.pools[0].name
+    observed = sink.observed_state(spot_pool)
+    assert observed["consolidationPolicy"] == "WhenEmpty"  # peak, conservative
+    assert observed["zones"] == list(cfg.cluster.peak_zones)
+
+    # Structured KPI log: one JSON line per tick, machine-parseable.
+    assert len(lines) == 10
+    rec = json.loads(lines[-1])
+    assert rec["t"] == 9 and rec["is_peak"] is True
+    assert rec["cost_usd_hr"] > 0
+
+    # Patch stream actually changed at the flip: compare rendered commands
+    # of an off-peak tick vs a peak tick.
+    cmds = [c.render() for c in sink.commands]
+    offpeak_reqs = [c for c in cmds[:8] if "us-east-2a" in c]
+    peak_reqs = [c for c in cmds[-8:] if "us-east-2c" in c]
+    assert offpeak_reqs and peak_reqs
+
+
+def test_controller_through_fake_kubectl_runner(cfg_edge):
+    """Same loop through KubectlSink with an injected fake kubectl that
+    maintains a NodePool store — exercises the real argv path."""
+    cfg = cfg_edge
+    store: dict[str, dict] = {p.name: {"requirements": []}
+                              for p in cfg.cluster.pools}
+    calls = []
+
+    def fake_kubectl(argv):
+        calls.append(list(argv))
+        assert argv[0] == "kubectl"
+        if argv[1] == "patch":
+            name, ptype, patch = argv[3], argv[4], json.loads(argv[6])
+            entry = store.setdefault(name, {})
+            if ptype == "--type=merge":
+                entry.setdefault("spec", {}).setdefault("disruption", {}
+                    ).update(patch["spec"]["disruption"])
+            else:
+                entry["requirements"] = patch[0]["value"]
+            return 0, "patched"
+        if argv[1] == "get":
+            name = argv[3]
+            entry = store.get(name)
+            if entry is None:
+                return 1, "not found"
+            if "jsonpath" in argv[-1]:
+                reqs = entry.get("requirements", [])
+                out = "\n".join(
+                    f"{r['key']}=In:{' '.join(r['values'])}" for r in reqs)
+                return 0, out
+            doc = {"spec": {"disruption": entry.get("spec", {}).get(
+                       "disruption", {}),
+                   "template": {"spec": {"requirements":
+                                         entry.get("requirements", [])}}}}
+            return 0, json.dumps(doc)
+        return 1, f"unhandled {argv}"
+
+    src = _source_at_peak_edge(cfg)
+    ctrl = Controller(cfg, RulePolicy(cfg.cluster), src,
+                      KubectlSink(fake_kubectl), interval_s=0.0,
+                      log_fn=lambda _line: None)
+    reports = ctrl.run(ticks=10)
+    assert all(r.applied and r.verified for r in reports)
+    # Every tick patched both pools (merge + json per pool) and read back.
+    patch_calls = [c for c in calls if c[1] == "patch"]
+    assert len(patch_calls) == 10 * 2 * 2
+    # Post-flip store holds the peak profile.
+    od_pool = cfg.cluster.pools[1].name
+    dis = store[od_pool]["spec"]["disruption"]
+    assert dis == {"consolidationPolicy": "WhenEmpty",
+                   "consolidateAfter": "120s"}
+
+
+def test_controller_sleeps_between_ticks(cfg_edge):
+    cfg = cfg_edge
+    naps = []
+    ctrl = Controller(cfg, RulePolicy(cfg.cluster),
+                      _source_at_peak_edge(cfg), DryRunSink(),
+                      interval_s=30.0, log_fn=lambda _l: None,
+                      sleep_fn=naps.append)
+    ctrl.run(ticks=3)
+    assert naps == [30.0, 30.0]  # no sleep after the final tick
+
+
+def test_controller_reports_unverified_on_mangling_sink(cfg_edge):
+    """A sink that silently drops the requirements patch must surface as
+    verified=False (the skeptical read-back discipline)."""
+    cfg = cfg_edge
+
+    class DroppingSink(DryRunSink):
+        def _patch(self, cmd):
+            if cmd.patch_type == "json":
+                self.commands.append(cmd)
+                return True  # accepted but silently dropped
+            return super()._patch(cmd)
+
+    ctrl = Controller(cfg, RulePolicy(cfg.cluster),
+                      _source_at_peak_edge(cfg), DroppingSink(),
+                      interval_s=0.0, log_fn=lambda _l: None)
+    reports = ctrl.run(ticks=2)
+    # The patch "applies" only via the fallback mechanism failing -> the
+    # apply itself reports not-ok (read-back at both paths empty).
+    assert not any(r.applied and r.verified for r in reports)
+
+
+def test_controller_from_config_wires_dry_run(cfg_edge):
+    ctrl = controller_from_config(cfg_edge, RulePolicy(cfg_edge.cluster),
+                                  interval_s=0.0,
+                                  log_fn=lambda _l: None)
+    assert isinstance(ctrl.sink, DryRunSink)
+    reports = ctrl.run(ticks=1)
+    assert reports[0].applied
+
+
+def test_controller_with_mpc_backend_replans(cfg_edge):
+    """The receding-horizon path: controller triggers replan() on schedule
+    and MPC decide() drives valid patches end to end."""
+    from ccka_tpu.train.mpc import MPCBackend
+
+    cfg = cfg_edge.with_overrides(**{"train.mpc_iters": 2})
+    backend = MPCBackend(cfg, horizon=8, iters=2, replan_every=4)
+    src = _source_at_peak_edge(cfg)
+    sink = DryRunSink()
+    ctrl = Controller(cfg, backend, src, sink, interval_s=0.0,
+                      log_fn=lambda _l: None)
+    reports = ctrl.run(ticks=8)
+    assert all(r.applied for r in reports)
+    # Patches rendered from MPC actions are structurally valid Karpenter
+    # JSON: both pools patched every tick.
+    pools = {c.name for c in sink.commands}
+    assert pools == {p.name for p in cfg.cluster.pools}
+    assert np.isfinite([r.cost_usd_hr for r in reports]).all()

@@ -235,6 +235,37 @@ def test_live_source_tick_merges_live_data():
     assert np.asarray(tick.demand_pods).sum() == pytest.approx(80.0)
 
 
+def test_live_source_forecast_is_forward_and_level_matched():
+    """The live forecast must track NOW's measured levels (persistence of
+    anomaly), not replay the backfilled history window (round-2 review
+    finding: a frozen window would mis-plan every MPC replan)."""
+    cfg = default_config()
+    fetch = _canned_fetch({
+        "/api/v1/query?": {"status": "success", "data": {"result": [
+            {"metric": {}, "value": [0, "40"]}]}},
+        "/allocation": {"data": []},
+        "/assets": {"data": {}},
+    })
+    src = LiveSignalSource(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+                           fetch=fetch, start_unix_s=0.0)
+    fc = src.forecast(0, 16)
+    assert fc.steps == 16
+    # Measured demand (80 pods) dominates the synthetic prior's first tick.
+    first = float(np.asarray(fc.demand_pods)[0].sum())
+    assert first == pytest.approx(80.0, rel=0.05)
+    # Forecast differs from the backfilled-history slice (the old bug).
+    hist = src.trace(16)
+    assert not np.allclose(np.asarray(fc.demand_pods),
+                           np.asarray(hist.demand_pods))
+
+
+def test_synthetic_forecast_matches_trace_slice(synth):
+    fc = synth.forecast(37, 16, seed=5)
+    full = synth.trace(53, seed=5)
+    assert np.array_equal(np.asarray(fc.spot_price_hr),
+                          np.asarray(full.spot_price_hr)[37:53])
+
+
 def test_factory_dispatch():
     cfg = default_config()
     src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
