@@ -87,8 +87,33 @@ def _build_parser() -> argparse.ArgumentParser:
     sc.add_argument("--wipe-nodeclass", action="store_true",
                     help="also delete the EC2NodeClass (WIPE_NODECLASS)")
 
+    st = sub.add_parser(
+        "train", help="train a learned backend; orbax checkpoints out")
+    st.add_argument("--backend", default="ppo", choices=("ppo", "mpc"))
+    st.add_argument("--iterations", type=int, default=40,
+                    help="PPO iterations / MPC warm-start Adam steps")
+    st.add_argument("--checkpoint-dir", required=True)
+    st.add_argument("--seed", type=int, default=None)
+    st.add_argument("--log-every", type=int, default=10)
+
+    se = sub.add_parser(
+        "evaluate", help="scoreboard: backends on held-out traces, with "
+                         "vs-rule ratios (the BASELINE.json criterion)")
+    se.add_argument("--backends", default="rule,mpc",
+                    help="comma list of rule,mpc,ppo")
+    se.add_argument("--checkpoint", default="",
+                    help="orbax dir for the ppo backend")
+    se.add_argument("--days", type=float, default=0.25)
+    se.add_argument("--traces", type=int, default=4)
+    se.add_argument("--seed", type=int, default=0)
+    se.add_argument("--deterministic", action="store_true",
+                    help="expectation dynamics instead of sampled worlds")
+
     ss = sub.add_parser("simulate", help="batched simulator + KPI report")
-    ss.add_argument("--backend", default="rule", choices=("rule", "neutral"))
+    ss.add_argument("--backend", default="rule",
+                    choices=("rule", "neutral", "ppo"))
+    ss.add_argument("--checkpoint", default="",
+                    help="orbax checkpoint dir (required for ppo)")
     ss.add_argument("--days", type=float, default=1.0)
     ss.add_argument("--clusters", type=int, default=1)
     ss.add_argument("--seed", type=int, default=0)
@@ -157,8 +182,18 @@ def make_backend(cfg: FrameworkConfig, name: str, checkpoint: str = ""):
     if name == "rule":
         return RulePolicy(cfg.cluster)
     if name == "mpc":
+        import numpy as np
+
         from ccka_tpu.train.mpc import MPCBackend
-        return MPCBackend(cfg)
+        backend = MPCBackend(cfg)
+        if checkpoint:  # trained warm-start plan (ccka train --backend mpc)
+            import jax.numpy as jnp
+
+            from ccka_tpu.train.checkpoint import load_state
+            restored = load_state(
+                checkpoint, target={"plan": np.asarray(backend._plan)})
+            backend._plan = jnp.asarray(restored["plan"])
+        return backend
     if name == "ppo":
         if not checkpoint:
             raise SystemExit("ccka: --backend ppo requires --checkpoint DIR")
@@ -223,11 +258,11 @@ def jax_tree_first(tree):
 
 
 def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
-                  clusters: int, seed: int, stochastic: bool) -> int:
+                  clusters: int, seed: int, stochastic: bool,
+                  checkpoint: str = "") -> int:
     import jax
     import jax.numpy as jnp
 
-    from ccka_tpu.policy import RulePolicy
     from ccka_tpu.sim import (SimParams, batched_rollout, initial_state,
                               rollout, summarize)
     from ccka_tpu.sim.types import Action
@@ -237,11 +272,11 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
     steps = int(days * 86400.0 / cfg.sim.dt_s)
     src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
 
-    if backend == "rule":
-        action_fn = RulePolicy(cfg.cluster).action_fn()
-    else:
+    if backend == "neutral":
         neutral = Action.neutral(cfg.cluster.n_pools, cfg.cluster.n_zones)
         action_fn = lambda s, e, t: neutral  # noqa: E731
+    else:
+        action_fn = make_backend(cfg, backend, checkpoint).action_fn()
 
     if clusters == 1:
         trace = src.trace(steps, seed=seed)
@@ -266,6 +301,67 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
     report["clusters"] = clusters
     report["days"] = days
     print(json.dumps(report, indent=2))
+    return 0
+
+
+def _cmd_train(cfg: FrameworkConfig, backend_name: str, iterations: int,
+               checkpoint_dir: str, seed: int | None,
+               log_every: int) -> int:
+    from ccka_tpu.signals.live import make_signal_source
+    from ccka_tpu.train.checkpoint import save_state
+
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    if backend_name == "ppo":
+        from ccka_tpu.train.ppo import PPOTrainer
+        trainer = PPOTrainer(cfg)
+        ts, history = trainer.train(src, iterations, seed=seed,
+                                    log_every=log_every or 1)
+        for rec in history:
+            print(json.dumps(rec))
+        path = save_state(checkpoint_dir, ts.params,
+                          step=int(ts.iteration))
+        print(f"[ok] ppo params -> {path}", file=sys.stderr)
+        return 0
+    # MPC has no trained parameters; its "training" artifact is a warm-
+    # start plan optimized against a representative window, which seeds
+    # replans (cuts online Adam iterations needed to converge).
+    import jax
+
+    from ccka_tpu.models import action_to_latent
+    from ccka_tpu.policy.rule import neutral_action
+    from ccka_tpu.sim import SimParams, initial_state
+    from ccka_tpu.train.mpc import optimize_plan
+    h = cfg.train.mpc_horizon
+    base = action_to_latent(neutral_action(cfg.cluster), cfg.cluster)
+    init = jax.numpy.broadcast_to(base, (h,) + base.shape)
+    result = optimize_plan(SimParams.from_config(cfg), cfg.cluster,
+                           cfg.train, initial_state(cfg),
+                           src.trace(h, seed=seed or cfg.train.seed),
+                           init, iters=iterations)
+    print(json.dumps({"final_objective": float(result.losses[-1]),
+                      "first_objective": float(result.losses[0])}))
+    # Dict-wrapped: orbax PyTree handlers reject bare-array items.
+    path = save_state(checkpoint_dir, {"plan": result.plan_latent},
+                      step=iterations)
+    print(f"[ok] mpc warm-start plan -> {path}", file=sys.stderr)
+    return 0
+
+
+def _cmd_evaluate(cfg: FrameworkConfig, backend_names: str, checkpoint: str,
+                  days: float, n_traces: int, seed: int,
+                  deterministic: bool) -> int:
+    from ccka_tpu.signals.live import make_signal_source
+    from ccka_tpu.train.evaluate import compare_backends, heldout_traces
+
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    steps = max(int(days * 86400.0 / cfg.sim.dt_s), 1)
+    traces = heldout_traces(src, steps=steps, n=n_traces,
+                            seed0=10_000 + seed)
+    backends = {name: make_backend(cfg, name, checkpoint)
+                for name in backend_names.split(",") if name}
+    board = compare_backends(cfg, backends, traces,
+                             stochastic=not deterministic)
+    print(json.dumps(board, indent=2, sort_keys=True))
     return 0
 
 
@@ -330,9 +426,16 @@ def main(argv: list[str] | None = None) -> int:
         if args.command == "run":
             return _cmd_run(cfg, args.backend, args.checkpoint, args.ticks,
                             args.interval, args.live, args.seed, args.hpa)
+        if args.command == "train":
+            return _cmd_train(cfg, args.backend, args.iterations,
+                              args.checkpoint_dir, args.seed, args.log_every)
+        if args.command == "evaluate":
+            return _cmd_evaluate(cfg, args.backends, args.checkpoint,
+                                 args.days, args.traces, args.seed,
+                                 args.deterministic)
         if args.command == "simulate":
             return _cmd_simulate(cfg, args.backend, args.days, args.clusters,
-                                 args.seed, args.stochastic)
+                                 args.seed, args.stochastic, args.checkpoint)
         if args.command == "preroll":
             return _cmd_preroll(cfg, args.live)
         if args.command == "bootstrap":

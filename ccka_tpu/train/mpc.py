@@ -213,8 +213,9 @@ class MPCBackend(PolicyBackend):
             trace = ExogenousTrace(*[
                 jnp.concatenate([x, jnp.repeat(l, pad, axis=0)], axis=0)
                 for x, l in zip(trace, last)])
-        base = action_to_latent(neutral_action(self.cluster), self.cluster)
-        init = jnp.broadcast_to(base, (self.horizon,) + base.shape)
+        # Start from the carried plan (neutral by default; a trained
+        # warm-start when loaded from a checkpoint).
+        init = jnp.asarray(self._plan)
         final, metrics = receding_horizon_rollout(
             self.params, self.cluster, self.tcfg, state0, trace, init, key,
             horizon=self.horizon, replan_every=r,

@@ -1,0 +1,80 @@
+"""End-to-end CLI path: train -> checkpoint -> evaluate/observe/simulate/run
+without writing Python (VERDICT round-1 item 7: learned backends were
+unreachable from the CLI)."""
+
+import json
+
+from ccka_tpu.cli import main
+
+_TINY = ["--set", "train.batch_clusters=4", "--set", "train.unroll_steps=8",
+         "--set", "train.mpc_horizon=8", "--set", "train.mpc_iters=3"]
+
+
+def test_train_ppo_then_evaluate_vs_rule(tmp_path, capsys):
+    ckpt = str(tmp_path / "ppo")
+    rc = main([*_TINY, "train", "--backend", "ppo", "--iterations", "2",
+               "--checkpoint-dir", ckpt, "--log-every", "1"])
+    out = capsys.readouterr()
+    assert rc == 0
+    history = [json.loads(line) for line in out.out.splitlines() if line]
+    assert history and "mean_reward" in history[0]
+
+    rc = main([*_TINY, "evaluate", "--backends", "rule,ppo",
+               "--checkpoint", ckpt, "--days", "0.05", "--traces", "2"])
+    out = capsys.readouterr()
+    assert rc == 0
+    board = json.loads(out.out)
+    assert set(board) == {"rule", "ppo"}
+    # The BASELINE.json criterion surface: vs-rule ratios present.
+    assert "vs_rule_usd_per_slo_hour" in board["ppo"]
+    assert "vs_rule_g_co2_per_kreq" in board["ppo"]
+    assert board["rule"]["usd_per_slo_hour"] > 0
+
+
+def test_train_mpc_warm_start_then_evaluate(tmp_path, capsys):
+    ckpt = str(tmp_path / "mpc")
+    rc = main([*_TINY, "train", "--backend", "mpc", "--iterations", "4",
+               "--checkpoint-dir", ckpt])
+    out = capsys.readouterr()
+    assert rc == 0
+    rec = json.loads(out.out.splitlines()[0])
+    assert rec["final_objective"] <= rec["first_objective"]
+
+    rc = main([*_TINY, "evaluate", "--backends", "mpc",
+               "--checkpoint", ckpt, "--days", "0.02", "--traces", "1"])
+    out = capsys.readouterr()
+    assert rc == 0
+    board = json.loads(out.out)
+    assert board["mpc"]["objective_usd"] > 0
+
+
+def test_simulate_with_ppo_checkpoint(tmp_path, capsys):
+    ckpt = str(tmp_path / "ppo")
+    main([*_TINY, "train", "--backend", "ppo", "--iterations", "1",
+          "--checkpoint-dir", ckpt, "--log-every", "0"])
+    capsys.readouterr()
+    rc = main([*_TINY, "simulate", "--backend", "ppo",
+               "--checkpoint", ckpt, "--days", "0.02"])
+    out = capsys.readouterr()
+    assert rc == 0
+    doc = json.loads(out.out)
+    assert doc["backend"] == "ppo" and doc["cost_usd"] > 0
+
+
+def test_run_with_ppo_checkpoint(tmp_path, capsys):
+    ckpt = str(tmp_path / "ppo")
+    main([*_TINY, "train", "--backend", "ppo", "--iterations", "1",
+          "--checkpoint-dir", ckpt, "--log-every", "0"])
+    capsys.readouterr()
+    rc = main([*_TINY, "run", "--backend", "ppo", "--checkpoint", ckpt,
+               "--ticks", "2", "--interval", "0"])
+    out = capsys.readouterr()
+    assert rc == 0
+    lines = [json.loads(x) for x in out.out.splitlines() if x.startswith("{")]
+    assert len(lines) == 2 and all(r["applied"] for r in lines)
+
+
+def test_ppo_backend_requires_checkpoint():
+    import pytest
+    with pytest.raises(SystemExit):
+        main(["observe", "--backend", "ppo"])
