@@ -20,6 +20,7 @@ gaps (§2.3: HPA never created, KEDA never installed):
 from ccka_tpu.actuation.patches import (  # noqa: F401
     NodePoolPatchSet,
     render_nodepool_patches,
+    render_region_nodepool_patches,
     render_hpa_manifests,
     render_keda_scaledobject,
 )

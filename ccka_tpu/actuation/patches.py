@@ -109,6 +109,51 @@ def render_nodepool_patches(action: Action, cluster: ClusterConfig,
     return out
 
 
+def render_region_nodepool_patches(
+        action: Action, cluster: ClusterConfig,
+        *, op: str = "replace") -> dict[str, list[NodePoolPatchSet]]:
+    """Multi-region actuation: one patchset list per region.
+
+    A Karpenter NodePool is a per-cluster object, and a cluster lives in one
+    region — so a multi-region fleet (BASELINE config #4) runs one Karpenter
+    per regional cluster, and the global action is split by intersecting its
+    selected zone set with each region's zones. A region whose intersection
+    is empty gets its full zone set (same guard as the single-region
+    renderer: an empty `In` requirement would make the pool unsatisfiable,
+    which is an outage, not a preference).
+
+    For the single-region topology this returns ``{region: patches}``
+    identical to :func:`render_nodepool_patches`.
+    """
+    base = render_nodepool_patches(action, cluster, op=op)
+    if not cluster.regions:
+        return {cluster.region: base}
+
+    def _scoped(patch_ops: list, region_zones: tuple) -> list:
+        ops = []
+        for p in patch_ops:
+            reqs = []
+            for req in p["value"]:
+                if req["key"] == "topology.kubernetes.io/zone":
+                    zones = [z for z in req["values"] if z in region_zones]
+                    reqs.append({**req, "values": zones or list(region_zones)})
+                else:
+                    reqs.append(req)
+            ops.append({**p, "value": reqs})
+        return ops
+
+    out: dict[str, list[NodePoolPatchSet]] = {}
+    for r in cluster.regions:
+        out[r.name] = [NodePoolPatchSet(
+            pool=ps.pool,
+            disruption_merge=ps.disruption_merge,
+            requirements_json=_scoped(ps.requirements_json, r.zones),
+            requirements_json_fallback=_scoped(
+                ps.requirements_json_fallback, r.zones),
+        ) for ps in base]
+    return out
+
+
 def render_hpa_manifests(action: Action, cluster: ClusterConfig,
                          workload: WorkloadConfig,
                          namespace: str = "nov-22") -> list[dict]:

@@ -30,6 +30,11 @@ def _build_parser() -> argparse.ArgumentParser:
         prog="ccka",
         description="TPU-native cost- and carbon-aware cluster autoscaler")
     p.add_argument("--config", help="path to a FrameworkConfig JSON file")
+    p.add_argument("--preset", default="default",
+                   choices=("default", "multiregion"),
+                   help="base config preset (multiregion = BASELINE "
+                        "config #4: 4 zones across 2 regions with "
+                        "diverging carbon)")
     p.add_argument("--set", action="append", default=[], metavar="KEY=VAL",
                    help="dotted config override, e.g. --set sim.dt_s=15")
     sub = p.add_subparsers(dest="command", required=True)
@@ -47,7 +52,7 @@ def _build_parser() -> argparse.ArgumentParser:
     so = sub.add_parser("observe", help="print the profile a policy would "
                                         "apply right now (read-only)")
     so.add_argument("--backend", default="rule",
-                    choices=("rule", "mpc", "ppo"))
+                    choices=("rule", "carbon", "mpc", "ppo"))
     so.add_argument("--checkpoint", default="",
                     help="orbax checkpoint dir (required for ppo)")
 
@@ -56,7 +61,7 @@ def _build_parser() -> argparse.ArgumentParser:
                     "render->apply->verify every interval (the §2.3 "
                     "controller the reference left to a human operator)")
     sr.add_argument("--backend", default="rule",
-                    choices=("rule", "mpc", "ppo"))
+                    choices=("rule", "carbon", "mpc", "ppo"))
     sr.add_argument("--checkpoint", default="")
     sr.add_argument("--ticks", type=int, default=0,
                     help="stop after N ticks (0 = run forever)")
@@ -100,7 +105,7 @@ def _build_parser() -> argparse.ArgumentParser:
         "evaluate", help="scoreboard: backends on held-out traces, with "
                          "vs-rule ratios (the BASELINE.json criterion)")
     se.add_argument("--backends", default="rule,mpc",
-                    help="comma list of rule,mpc,ppo")
+                    help="comma list of rule,carbon,mpc,ppo")
     se.add_argument("--checkpoint", default="",
                     help="orbax dir for the ppo backend")
     se.add_argument("--days", type=float, default=0.25)
@@ -111,7 +116,7 @@ def _build_parser() -> argparse.ArgumentParser:
 
     ss = sub.add_parser("simulate", help="batched simulator + KPI report")
     ss.add_argument("--backend", default="rule",
-                    choices=("rule", "neutral", "ppo"))
+                    choices=("rule", "carbon", "neutral", "ppo"))
     ss.add_argument("--checkpoint", default="",
                     help="orbax checkpoint dir (required for ppo)")
     ss.add_argument("--days", type=float, default=1.0)
@@ -125,10 +130,15 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def _load_config(args) -> FrameworkConfig:
     if args.config:
+        if args.preset != "default":
+            raise SystemExit("ccka: --config and --preset are mutually "
+                             "exclusive (the config file wins entirely; "
+                             "drop one)")
         with open(args.config) as f:
             cfg = FrameworkConfig.from_json(f.read())
     else:
-        cfg = config_from_env()
+        from ccka_tpu.config import PRESETS
+        cfg = config_from_env(base=PRESETS[args.preset]())
     overrides = {}
     for kv in args.set:
         if "=" not in kv:
@@ -177,10 +187,12 @@ def _cmd_profile(cfg: FrameworkConfig, profile: str, live: bool,
 
 def make_backend(cfg: FrameworkConfig, name: str, checkpoint: str = ""):
     """Backend factory shared by observe/simulate/run/evaluate."""
-    from ccka_tpu.policy import RulePolicy
+    from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy
 
     if name == "rule":
         return RulePolicy(cfg.cluster)
+    if name == "carbon":
+        return CarbonAwarePolicy(cfg.cluster)
     if name == "mpc":
         import numpy as np
 

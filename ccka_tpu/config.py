@@ -95,11 +95,48 @@ class PoolSpec:
 
 
 @dataclass(frozen=True)
+class RegionSpec:
+    """One cloud region of a multi-region fleet (BASELINE.json config #4).
+
+    The reference's multi-region story is paper-only ("multi-region ~$450/mo",
+    report PDF p.4 §8; GSLB routing + time-shifting, proposal PDF p.5). Here
+    each region contributes zones to the flat zone axis with its own grid
+    profile — carbon base level, solar-dip depth (the CAISO duck curve is
+    deep; MISO's is shallow), local-solar timezone offset, and price level —
+    so "carbon-aware node migration" is expressible as zone selection
+    spanning regions: the same `topology.kubernetes.io/zone In [...]` lever
+    the profiles already patch (`demo_20_offpeak_configure.sh:71`).
+    """
+
+    name: str
+    zones: Tuple[str, ...]
+    carbon_zone: str = ""            # ElectricityMaps zone id, e.g. "US-CAL-CISO"
+    carbon_base_g_kwh: float = 0.0   # 0 → signals.carbon_default_g_kwh
+    solar_frac: float = 0.45         # depth of the midday solar dip [0,1)
+    tz_offset_hr: float = 0.0        # local solar time vs the trace clock
+    od_price_scale: float = 1.0
+    spot_price_scale: float = 1.0
+
+    def validate(self) -> None:
+        if not self.zones:
+            raise ConfigError(f"region {self.name}: no zones")
+        if self.carbon_base_g_kwh < 0:
+            raise ConfigError(f"region {self.name}: negative carbon base")
+        if not 0.0 <= self.solar_frac < 1.0:
+            raise ConfigError(f"region {self.name}: solar_frac out of [0,1)")
+        if self.od_price_scale <= 0 or self.spot_price_scale <= 0:
+            raise ConfigError(f"region {self.name}: non-positive price scale")
+
+
+@dataclass(frozen=True)
 class ClusterConfig:
     """Cluster topology: region/zones/pools/instance type.
 
     Mirrors `.env:1-8` (cluster identity, min/max/desired sizes) and
-    `demo_00_env.sh:18-23` (pool names, zone preferences).
+    `demo_00_env.sh:18-23` (pool names, zone preferences). When ``regions``
+    is non-empty the fleet is multi-region: ``zones`` is derived as the
+    concatenation of each region's zones (in order), and the signal layer
+    gives each zone its region's carbon/price profile.
     """
 
     name: str = "demo1"
@@ -116,6 +153,13 @@ class ClusterConfig:
     # Managed nodegroup floor that Karpenter never touches (`.env:7-8`:
     # min 2 / desired 3 / max 6 m6i.large).
     base_nodes: int = 3
+    # Multi-region fleet (empty → classic single-region demo topology).
+    regions: Tuple[RegionSpec, ...] = ()
+
+    def __post_init__(self):
+        if self.regions:
+            derived = tuple(z for r in self.regions for z in r.zones)
+            object.__setattr__(self, "zones", derived)
 
     @property
     def n_zones(self) -> int:
@@ -124,6 +168,27 @@ class ClusterConfig:
     @property
     def n_pools(self) -> int:
         return len(self.pools)
+
+    @property
+    def n_regions(self) -> int:
+        return max(1, len(self.regions))
+
+    @property
+    def zone_region_index(self) -> Tuple[int, ...]:
+        """Region index per zone (all 0 for the single-region topology)."""
+        if not self.regions:
+            return (0,) * len(self.zones)
+        return tuple(i for i, r in enumerate(self.regions) for _ in r.zones)
+
+    def region_of_zone(self, zone: str) -> str:
+        if zone not in self.zones:
+            raise ConfigError(f"unknown zone {zone!r}")
+        if not self.regions:
+            return self.region
+        for r in self.regions:
+            if zone in r.zones:
+                return r.name
+        raise ConfigError(f"unknown zone {zone!r}")
 
     def pool_index(self, name: str) -> int:
         for i, p in enumerate(self.pools):
@@ -142,6 +207,14 @@ class ClusterConfig:
             raise ConfigError("cluster: duplicate pool names")
         for p in self.pools:
             p.validate()
+        if self.regions:
+            rnames = [r.name for r in self.regions]
+            if len(set(rnames)) != len(rnames):
+                raise ConfigError("cluster: duplicate region names")
+            for r in self.regions:
+                r.validate()
+            if len(set(self.zones)) != len(self.zones):
+                raise ConfigError("cluster: duplicate zones across regions")
         self.node_type.validate()
         if self.base_nodes < 0:
             raise ConfigError("cluster: negative base_nodes")
@@ -389,6 +462,45 @@ def default_config() -> FrameworkConfig:
     return FrameworkConfig().validate()
 
 
+def multi_region_config() -> FrameworkConfig:
+    """BASELINE.json config #4: 4 zones spanning two regions with diverging
+    grid-carbon profiles, for carbon-aware placement/migration.
+
+    East models a MISO-style grid — high base intensity, shallow solar dip;
+    West models CAISO — lower base, deep duck-curve midday dip, 3h-later
+    solar peak. The dummy-carbon magnitude anchors to the reference's
+    documented ~400 g/kWh fallback (`.env:14-16`).
+    """
+    cluster = ClusterConfig(
+        name="demo-multiregion",
+        region="us-east-2",
+        regions=(
+            RegionSpec(name="us-east-2",
+                       zones=("us-east-2a", "us-east-2b"),
+                       carbon_zone="US-MIDW-MISO",
+                       carbon_base_g_kwh=520.0,
+                       solar_frac=0.15,
+                       tz_offset_hr=0.0),
+            RegionSpec(name="us-west-2",
+                       zones=("us-west-2a", "us-west-2b"),
+                       carbon_zone="US-CAL-CISO",
+                       carbon_base_g_kwh=300.0,
+                       solar_frac=0.55,
+                       tz_offset_hr=-3.0,
+                       od_price_scale=1.04),
+        ),
+        offpeak_zones=("us-east-2a",),
+        peak_zones=("us-east-2b",),
+    )
+    return FrameworkConfig(cluster=cluster).validate()
+
+
+PRESETS = {
+    "default": default_config,
+    "multiregion": multi_region_config,
+}
+
+
 def config_from_env(base: FrameworkConfig | None = None,
                     environ: Mapping[str, str] | None = None) -> FrameworkConfig:
     """Apply ``CCKA_SECTION_FIELD=value`` environment overrides.
@@ -438,6 +550,7 @@ def _asdict(obj: Any) -> Any:
 _NESTED_TYPES = {
     "node_type": NodeTypeSpec,
     "pools": PoolSpec,
+    "regions": RegionSpec,
     "cluster": ClusterConfig,
     "workload": WorkloadConfig,
     "sim": SimConfig,

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ccka_tpu.actuation.patches import render_nodepool_patches
+from ccka_tpu.actuation.patches import render_region_nodepool_patches
 from ccka_tpu.actuation.sink import ActuationSink
 from ccka_tpu.config import FrameworkConfig
 from ccka_tpu.policy.base import PolicyBackend
@@ -90,7 +90,7 @@ class Controller:
                  cfg: FrameworkConfig,
                  backend: PolicyBackend,
                  source: SignalSource,
-                 sink: ActuationSink,
+                 sink: "ActuationSink | dict[str, ActuationSink]",
                  *,
                  interval_s: float | None = None,
                  seed: int = 0,
@@ -100,7 +100,24 @@ class Controller:
         self.cfg = cfg
         self.backend = backend
         self.source = source
-        self.sink = sink
+        # Multi-region fleets (BASELINE config #4) run one Karpenter per
+        # regional cluster, so actuation needs one sink per region. A bare
+        # sink serves the single-region topology; a dict must cover every
+        # configured region.
+        if isinstance(sink, dict):
+            missing = [r.name for r in cfg.cluster.regions
+                       if r.name not in sink] if cfg.cluster.regions else (
+                [cfg.cluster.region] if cfg.cluster.region not in sink else [])
+            if missing:
+                raise ValueError(f"no sink for region(s) {missing}")
+            self.region_sinks = dict(sink)
+        else:
+            names = ([r.name for r in cfg.cluster.regions]
+                     or [cfg.cluster.region])
+            self.region_sinks = {name: sink for name in names}
+        # Home-region sink: workload-scoped objects (HPA) live here.
+        self.sink = self.region_sinks.get(
+            cfg.cluster.region, next(iter(self.region_sinks.values())))
         self.interval_s = (cfg.signals.scrape_interval_s
                            if interval_s is None else interval_s)
         self.apply_hpa = apply_hpa
@@ -135,15 +152,20 @@ class Controller:
         action = self.backend.decide(self.state, exo, jnp.int32(t))
 
         # 3. render: op mirrors the reference's profile split — peak uses
-        #    op:add (demo_21:65), off-peak op:replace (demo_20:69).
-        patches = render_nodepool_patches(
+        #    op:add (demo_21:65), off-peak op:replace (demo_20:69). The
+        #    global zone selection is split per region (one Karpenter per
+        #    regional cluster); single-region topologies get one entry.
+        per_region = render_region_nodepool_patches(
             action, self.cfg.cluster, op="add" if is_peak else "replace")
 
-        # 4. apply through the sink (kubectl-shaped, with fallback). With
-        #    apply_hpa, the tick also realizes the HPA lever as actual
-        #    HorizontalPodAutoscaler objects — the §2.3 capability the
-        #    reference installed prometheus-adapter for but never created.
-        results = self.sink.apply_all(patches)
+        # 4. apply through each region's sink (kubectl-shaped, with
+        #    fallback). With apply_hpa, the tick also realizes the HPA lever
+        #    as actual HorizontalPodAutoscaler objects in the home region —
+        #    the §2.3 capability the reference installed prometheus-adapter
+        #    for but never created.
+        results = []
+        for region, patches in per_region.items():
+            results += self.region_sinks[region].apply_all(patches)
         if self.apply_hpa:
             from ccka_tpu.actuation.patches import render_hpa_manifests
             results += self.sink.apply_manifests(
@@ -152,9 +174,11 @@ class Controller:
         applied = all(r.ok for r in results)
         fallbacks = sum(1 for r in results if r.used_fallback)
 
-        # 5. verify: skeptical read-back against the rendered intent.
+        # 5. verify: skeptical read-back against the rendered intent,
+        #    region by region.
         verified = applied and all(
-            _verify_pool(self.sink.observed_state(ps.pool), ps)
+            _verify_pool(self.region_sinks[region].observed_state(ps.pool), ps)
+            for region, patches in per_region.items()
             for ps in patches)
 
         # 6. advance the model-based state estimate (expectation dynamics).
@@ -217,8 +241,17 @@ def controller_from_config(cfg: FrameworkConfig, backend: PolicyBackend,
 
     source = make_signal_source(cfg.cluster, cfg.workload, cfg.sim,
                                 cfg.signals)
-    if live:
-        sink = KubectlSink(runner) if runner else KubectlSink()
+
+    def make_sink():
+        if live:
+            return KubectlSink(runner) if runner else KubectlSink()
+        return DryRunSink()
+
+    if cfg.cluster.regions:
+        # One sink per regional cluster. Live multi-region operation needs
+        # per-region kubectl contexts wired into each runner; the default
+        # shares one runner (suitable for dry-run and single-context tests).
+        sink = {r.name: make_sink() for r in cfg.cluster.regions}
     else:
-        sink = DryRunSink()
+        sink = make_sink()
     return Controller(cfg, backend, source, sink, **kwargs)

@@ -10,6 +10,9 @@ capacity-type sets (`SURVEY.md` §3.2). Here the decision step is a
 - :class:`~ccka_tpu.policy.rule.RulePolicy` — the CPU reference, reproducing
   Peak/Off-Peak semantics exactly (golden-tested against the reference's
   emitted patch JSON);
+- :class:`~ccka_tpu.policy.carbon.CarbonAwarePolicy` — rule profiles with
+  carbon-derived zone selection (cross-region "follow the sun" migration,
+  BASELINE config #4);
 - learned TPU backends (``ccka_tpu.train``) — diff-MPC and PPO over the
   batched simulator.
 
@@ -20,4 +23,5 @@ policy-compliant Karpenter patches.
 
 from ccka_tpu.policy.base import Observation, PolicyBackend  # noqa: F401
 from ccka_tpu.policy.rule import RulePolicy, offpeak_action, peak_action  # noqa: F401
+from ccka_tpu.policy.carbon import CarbonAwarePolicy, carbon_zone_weight  # noqa: F401
 from ccka_tpu.policy.constraints import project_feasible  # noqa: F401

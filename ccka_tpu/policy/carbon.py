@@ -1,0 +1,104 @@
+"""Carbon-aware zone-selection policy: "follow the sun" as a rule backend.
+
+The reference stubs carbon awareness as static NodePool labels
+(`carbon.simulated=low|medium`, `demo_10_setup_configure.sh:61-62`) and an
+unused API key (`.env:14-16`); its multi-region/"migration" story is
+paper-only (proposal PDF p.5). This backend realizes both: it keeps the
+Peak/Off-Peak disruption and capacity-type semantics of the rule profiles
+(`demo_20_offpeak_configure.sh:59-60`, `demo_21_peak_configure.sh:56-57`)
+but derives the zone requirement from the *live carbon-intensity signal*
+instead of the static OFFPEAK_ZONES/PEAK_ZONES sets — preferring
+cleaner-than-fleet-average zones, across regions when the topology spans
+them (BASELINE.json config #4).
+
+Migration mechanics: the zone weight steers where Karpenter provisions new
+capacity (`topology.kubernetes.io/zone In [...]`,
+`demo_20_offpeak_configure.sh:71`); consolidation + spot churn then drain
+the dirty zones, so the fleet walks toward the clean region over a few
+provisioning cycles — node *migration* exactly as a real Karpenter fleet
+would do it (no live-migration primitive exists for nodes).
+
+``decide`` is traceable — the zone weight is a smooth function of the
+carbon tick — so the backend drives scan/vmap rollouts and serves as a
+baseline opponent for the learned backends.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ccka_tpu.config import ClusterConfig
+from ccka_tpu.policy.base import PolicyBackend
+from ccka_tpu.policy.rule import offpeak_action, peak_action
+from ccka_tpu.sim.dynamics import ExoStep
+from ccka_tpu.sim.types import Action, ClusterState
+
+
+def carbon_zone_weight(carbon_g_kwh: jnp.ndarray,
+                       *, sharpness: float = 10.0) -> jnp.ndarray:
+    """[Z] carbon signal → [Z] zone weight in (0,1).
+
+    A zone cleaner than the fleet mean gets weight > 0.5 (selected when the
+    action is discretized into a zone requirement,
+    `actuation/patches.py`), dirtier gets < 0.5; the margin is relative so
+    a 10% cleaner-than-average zone saturates toward 1. Smooth (sigmoid),
+    so diff-MPC gradients and the provisioning softmax in
+    `sim/dynamics.py` both see the carbon ordering.
+    """
+    mean = carbon_g_kwh.mean()
+    rel = (mean - carbon_g_kwh) / (mean + 1e-6)
+    return jax.nn.sigmoid(sharpness * rel)
+
+
+class CarbonAwarePolicy(PolicyBackend):
+    """Rule profiles with carbon-derived zone selection.
+
+    Disruption, capacity types and the HPA lever follow the Peak/Off-Peak
+    profile chosen by the peak-hours signal (same switching rule as
+    :class:`~ccka_tpu.policy.rule.RulePolicy`); the zone weight re-ranks
+    zones every tick by grid carbon intensity.
+
+    ``min_weight`` keeps a floor under every zone so the requirement can
+    never render empty and provisioning never fully starves a zone that is
+    about to become the cleanest (duck-curve crossovers happen twice a day).
+
+    ``stickiness`` is hysteresis: zones already holding fleet get a logit
+    bonus proportional to their share above uniform, so per-tick carbon
+    noise around a crossover cannot flip the zone requirement (and churn
+    real nodes) until the carbon margin genuinely exceeds
+    ``stickiness / sharpness`` (~10% relative by default). Stateless and
+    traceable — the "memory" is the fleet placement itself, which is
+    already in :class:`ClusterState`.
+    """
+
+    def __init__(self, cluster: ClusterConfig, *, sharpness: float = 10.0,
+                 min_weight: float = 0.05, stickiness: float = 1.0):
+        self.cluster = cluster
+        self.sharpness = sharpness
+        self.min_weight = min_weight
+        self.stickiness = stickiness
+        self._off = offpeak_action(cluster)
+        self._peak = peak_action(cluster)
+
+    def decide(self, state: ClusterState, exo: ExoStep,
+               t: jnp.ndarray) -> Action:
+        is_peak = exo.is_peak > 0.5
+        base = jax.tree.map(
+            lambda a, b: jnp.where(is_peak, a, b), self._peak, self._off)
+        mean = exo.carbon_g_kwh.mean()
+        rel = (mean - exo.carbon_g_kwh) / (mean + 1e-6)        # [Z]
+        nodes_z = state.nodes.sum(axis=(0, 2))                 # [Z]
+        n_zones = nodes_z.shape[-1]
+        share = nodes_z / (nodes_z.sum() + 1e-6)               # [Z]
+        # 0 when uniform; clipped so a fully-concentrated fleet cannot
+        # out-shout a genuinely large carbon divergence.
+        occupancy = jnp.clip(share * n_zones - 1.0, -1.0, 1.0)
+        w = jax.nn.sigmoid(self.sharpness * rel
+                           + self.stickiness * occupancy)
+        w = jnp.maximum(w, self.min_weight)                     # [Z]
+        zone_w = jnp.broadcast_to(w, base.zone_weight.shape)    # [P, Z]
+        return base._replace(zone_weight=zone_w)
+
+    def profile_name(self, is_peak: bool) -> str:
+        return ("peak" if is_peak else "offpeak") + "+carbon"

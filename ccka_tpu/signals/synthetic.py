@@ -51,6 +51,7 @@ class SyntheticSignalSource(SignalSource):
         self.sim = sim
         self.signals = signals
         self.start_unix_s = start_unix_s
+        self._zp = self._zone_params()
         # Longest trace generated so far, per seed. Generation is
         # prefix-stable (per-family RNG streams drawn step-sequentially), so
         # serving shorter requests as slices is exact, and tick-at-t costs
@@ -105,6 +106,49 @@ class SyntheticSignalSource(SignalSource):
         noises = [self._noise(steps, int(s)) for s in seeds]
         stacked = tuple(np.stack(parts) for parts in zip(*noises))
         return self._assemble(steps, stacked)
+
+    def _zone_params(self) -> dict[str, np.ndarray]:
+        """Per-zone signal parameters, each a float32 [Z] array.
+
+        Single-region: the classic demo profile — small per-zone phase
+        offsets, one carbon base with a mild per-zone scale spread.
+        Multi-region (`ClusterConfig.regions`, BASELINE config #4): each
+        zone inherits its region's grid profile — carbon base, solar-dip
+        depth, local-solar timezone offset, price scales — so regions'
+        carbon curves genuinely diverge and cross over the day, which is
+        what makes carbon-aware cross-region placement worth anything.
+        """
+        z = self.cluster.n_zones
+        frac = np.arange(z, dtype=np.float32) / max(z, 1)
+        default = np.float32(self.signals.carbon_default_g_kwh)
+        zp = {
+            "spot_phase": frac * 0.15,
+            "solar_phase": np.zeros(z, np.float32),
+            "evening_phase": frac * 0.15,
+            "carbon_base": np.full(z, default, np.float32),
+            "solar_frac": np.full(z, 0.45, np.float32),
+            "carbon_scale": 1.0 + 0.1 * frac,
+            "od_scale": np.ones(z, np.float32),
+            "spot_scale": np.ones(z, np.float32),
+        }
+        if not self.cluster.regions:
+            return zp
+        i = 0
+        for r in self.cluster.regions:
+            nz = max(len(r.zones), 1)
+            tzf = np.float32(r.tz_offset_hr / 24.0)
+            for j in range(len(r.zones)):
+                intra = np.float32(j / nz)
+                zp["spot_phase"][i] = tzf + 0.05 * intra
+                zp["solar_phase"][i] = tzf
+                zp["evening_phase"][i] = tzf + 0.05 * intra
+                zp["carbon_base"][i] = r.carbon_base_g_kwh or default
+                zp["solar_frac"][i] = r.solar_frac
+                zp["carbon_scale"][i] = 1.0 + 0.1 * intra
+                zp["od_scale"][i] = r.od_price_scale
+                zp["spot_scale"][i] = r.spot_price_scale
+                i += 1
+        return {k: v.astype(np.float32) for k, v in zp.items()}
 
     def _noise(self, steps: int, seed: int) -> tuple[np.ndarray, ...]:
         """Per-family AR(1) noise streams for one seed.
@@ -177,27 +221,32 @@ class SyntheticSignalSource(SignalSource):
         tod_z = tod[:, None]  # [T, 1] broadcast against zones
 
         nt = self.cluster.node_type
-
-        # Per-zone phase offsets (deterministic per zone index).
-        phase = xp.asarray((np.arange(z) / max(z, 1)) * 0.15,
-                           dtype=xp.float32)  # [Z] fraction of a day
+        # Per-zone grid/price profile [Z] arrays (region-aware; see
+        # `_zone_params`). Deterministic given the cluster topology.
+        zp = {k: xp.asarray(v) for k, v in self._zp.items()}
 
         # Spot price: diurnal swing + AR(1) noise, clipped to [20%, 95%] of OD.
-        diurnal = 1.0 + 0.35 * xp.sin(2 * np.pi * (tod_z - 0.25 + phase))  # [T,Z]
-        spot = nt.spot_price_hr_mean * diurnal * (1.0 + spot_noise)
-        spot = xp.clip(spot, 0.2 * nt.od_price_hr, 0.95 * nt.od_price_hr)
+        diurnal = 1.0 + 0.35 * xp.sin(
+            2 * np.pi * (tod_z - 0.25 + zp["spot_phase"]))  # [T,Z]
+        spot = (nt.spot_price_hr_mean * zp["spot_scale"] * diurnal
+                * (1.0 + spot_noise))
+        od_z = xp.float32(nt.od_price_hr) * zp["od_scale"]  # [Z]
+        spot = xp.clip(spot, 0.2 * od_z, 0.95 * od_z)
 
-        od = xp.broadcast_to(xp.float32(nt.od_price_hr), spot.shape)
+        od = xp.broadcast_to(od_z, spot.shape)
 
-        # Carbon duck curve: base − solar dip (centered 13:00) + evening ramp
-        # (centered 19:30), small noise; clipped positive.
-        base = self.signals.carbon_default_g_kwh
-        solar = 0.45 * base * _bump(tod_z, center=13.5 / 24, width=3.5 / 24, xp=xp)
-        evening = 0.25 * base * _bump(tod_z + phase, center=19.5 / 24,
-                                      width=2.0 / 24, xp=xp)
+        # Carbon duck curve per zone: base − solar dip (centered 13:00 local
+        # solar time) + evening ramp (centered 19:30), small noise; clipped
+        # positive. In multi-region mode base/dip-depth/phase come from the
+        # region's grid profile, so e.g. CAISO-west dips deep mid-day while
+        # MISO-east barely moves.
+        base = zp["carbon_base"]  # [Z]
+        solar = zp["solar_frac"] * base * _bump(
+            tod_z + zp["solar_phase"], center=13.5 / 24, width=3.5 / 24, xp=xp)
+        evening = 0.25 * base * _bump(tod_z + zp["evening_phase"],
+                                      center=19.5 / 24, width=2.0 / 24, xp=xp)
         carbon = base - solar + evening
-        carbon = carbon * xp.asarray(
-            1.0 + 0.1 * (np.arange(z) / max(z, 1)), dtype=xp.float32)[None, :]
+        carbon = carbon * zp["carbon_scale"]
         carbon = carbon * (1.0 + carbon_noise)
         carbon = xp.clip(carbon, 20.0, None)
 

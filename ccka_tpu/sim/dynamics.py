@@ -235,6 +235,7 @@ def step(params: SimParams,
         desired_pods=desired,
         demand_pods=exo.demand_pods,
         nodes_by_ct=nodes.sum(axis=(0, 1)),
+        nodes_by_zone=nodes.sum(axis=(0, 2)),
         slo_ok=slo_ok,
         interrupted_nodes=interrupted_total,
         evicted_pods=evicted,

@@ -147,6 +147,7 @@ class StepMetrics(NamedTuple):
     desired_pods: jnp.ndarray    # [C] HPA-scaled scheduling target
     demand_pods: jnp.ndarray     # [C] raw exogenous demand (SLO/req basis)
     nodes_by_ct: jnp.ndarray     # [T_CT] active node totals
+    nodes_by_zone: jnp.ndarray   # [Z] active node totals (region placement)
     slo_ok: jnp.ndarray          # [] {0,1} served-fraction SLO met this tick
     interrupted_nodes: jnp.ndarray  # [] spot nodes reclaimed this tick
     evicted_pods: jnp.ndarray    # [] consolidation evictions this tick
