@@ -14,7 +14,9 @@ gaps (§2.3: HPA never created, KEDA never installed):
   with the reference's path fallback, plus generic manifest apply/delete
   (`kubectl apply -f` equivalents) for HPA/KEDA/bootstrap objects;
 - ``bootstrap`` — NodePool + EC2NodeClass creation and demo_50-ordered
-  teardown (the reference's missing `demo_01`).
+  teardown (the reference's missing `demo_01`);
+- ``burst``    — the demo_30 load generator as manifests (odd/even
+  spot/on-demand Deployments, RBAC, PDB) with Pending-pod diagnostics.
 """
 
 from ccka_tpu.actuation.patches import (  # noqa: F401
@@ -36,4 +38,13 @@ from ccka_tpu.actuation.bootstrap import (  # noqa: F401
     cleanup,
     render_ec2nodeclass_manifest,
     render_nodepool_manifest,
+)
+from ccka_tpu.actuation.burst import (  # noqa: F401
+    apply_burst,
+    burst_status,
+    delete_burst,
+    pending_pod_diagnostics,
+    render_burst_deployments,
+    render_burst_pdb,
+    render_burst_rbac,
 )

@@ -183,6 +183,13 @@ class ActuationSink:
         """Full-object read-back; {} when absent."""
         raise NotImplementedError
 
+    def list_objects(self, kind: str, *, selector: str = "",
+                     namespace: str = "") -> list[dict]:
+        """`kubectl get <kind> -l <selector> -o json` — all matching
+        objects (the burst observer's listing verb,
+        `demo_30_burst_observe.sh:10-16`)."""
+        raise NotImplementedError
+
     # -- backend hooks ------------------------------------------------------
 
     def _patch(self, cmd: PatchCommand) -> bool:
@@ -253,7 +260,20 @@ class DryRunSink(ActuationSink):
                     entry["requirements_at"] = (
                         self.schema_path + "/requirements")
         elif cmd.action == "delete":
-            self.objects.pop(key, None)
+            if cmd.selector and "=" in cmd.selector:
+                # Label-selector delete (`kubectl delete -l k=v`), as the
+                # burst teardown and NodeClaim cleanup use.
+                sk, sv = cmd.selector.split("=", 1)
+                doomed = [
+                    k for k, doc in self.objects.items()
+                    if k[0] == cmd.kind.lower()
+                    and (not cmd.namespace or k[1] == cmd.namespace)
+                    and doc.get("metadata", {}).get("labels", {}).get(sk) == sv
+                ]
+                for k in doomed:
+                    self.objects.pop(k, None)
+            else:
+                self.objects.pop(key, None)
             if cmd.kind.lower() == "nodepool":
                 self.store.pop(cmd.name, None)
         # scrub-finalizers is a no-op on the simulated store.
@@ -262,6 +282,20 @@ class DryRunSink(ActuationSink):
     def get_object(self, kind: str, name: str, *,
                    namespace: str = "") -> dict:
         return self.objects.get((kind.lower(), namespace, name), {})
+
+    def list_objects(self, kind: str, *, selector: str = "",
+                     namespace: str = "") -> list[dict]:
+        sk, sv = (selector.split("=", 1) if "=" in selector else ("", ""))
+        out = []
+        for (k, ns, _name), doc in sorted(self.objects.items()):
+            if k != kind.lower():
+                continue
+            if namespace and ns != namespace:
+                continue
+            if sk and doc.get("metadata", {}).get("labels", {}).get(sk) != sv:
+                continue
+            out.append(doc)
+        return out
 
     def _readback_ok(self, pool: str, path_prefix: str) -> bool:
         entry = self.store.get(pool, {})
@@ -330,6 +364,20 @@ class KubectlSink(ActuationSink):
             return json.loads(out)
         except json.JSONDecodeError:
             return {}
+
+    def list_objects(self, kind: str, *, selector: str = "",
+                     namespace: str = "") -> list[dict]:
+        ns = ["-n", namespace] if namespace else []
+        sel = ["-l", selector] if selector else []
+        rc, out = self.runner(["kubectl", "get", kind, *sel, *ns,
+                               "-o", "json"])
+        if rc != 0:
+            return []
+        try:
+            doc = json.loads(out)
+        except json.JSONDecodeError:
+            return []
+        return list(doc.get("items", []))
 
     def _readback_ok(self, pool: str, path_prefix: str) -> bool:
         # demo_20:102: jsonpath over requirements key/operator/values.

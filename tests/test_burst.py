@@ -1,0 +1,168 @@
+"""Burst workload generator tests — the demo_30 analog (VERDICT item 6).
+
+Oracles come straight from the reference's generator and observer:
+odd→spot / even→on-demand alternation with the critical toleration on even
+deployments (`demo_30_burst_configure.sh:59-70`), the hardened pod spec
+with 200m/128Mi→500m/256Mi resources (`:110-140`), and the Pending-pod
+PodScheduled diagnostics table (`demo_30_burst_observe.sh:20-28`).
+"""
+
+import json
+
+import pytest
+
+from ccka_tpu.actuation import DryRunSink
+from ccka_tpu.actuation.burst import (
+    BURST_GROUP,
+    apply_burst,
+    burst_status,
+    delete_burst,
+    pending_pod_diagnostics,
+    render_burst_deployments,
+    render_burst_pdb,
+    render_burst_rbac,
+)
+from ccka_tpu.config import default_config
+
+
+@pytest.fixture()
+def workload():
+    return default_config().workload
+
+
+class TestRenderBurst:
+    def test_count_and_alternation(self, workload):
+        docs = render_burst_deployments(workload)
+        assert len(docs) == 12  # COUNT default, demo_30:7
+        for i, doc in enumerate(docs, start=1):
+            spec = doc["spec"]["template"]["spec"]
+            cap = spec["nodeSelector"]["karpenter.sh/capacity-type"]
+            # odd→spot with no tolerations; even→on-demand tolerating the
+            # critical taint (demo_30:59-70).
+            if i % 2 == 1:
+                assert cap == "spot"
+                assert spec["tolerations"] == []
+            else:
+                assert cap == "on-demand"
+                assert spec["tolerations"] == [
+                    {"key": "critical", "operator": "Equal",
+                     "value": "true", "effect": "NoSchedule"}]
+            assert doc["metadata"]["name"] == f"burst-web-{i}"
+            assert doc["spec"]["replicas"] == 5  # REPLICAS default
+
+    def test_pod_spec_hardening_and_resources(self, workload):
+        doc = render_burst_deployments(workload)[0]
+        pod = doc["spec"]["template"]["spec"]
+        c = pod["containers"][0]
+        # demo_30:135-140 resource shape.
+        assert c["resources"]["requests"] == {"cpu": "200m", "memory": "128Mi"}
+        assert c["resources"]["limits"] == {"cpu": "500m", "memory": "256Mi"}
+        # Kyverno require-requests-limits would admit this (04_kyverno:24-42).
+        assert c["readinessProbe"] and c["livenessProbe"]
+        assert pod["securityContext"]["runAsNonRoot"] is True
+        assert c["securityContext"]["capabilities"] == {"drop": ["ALL"]}
+
+    def test_spot_pods_never_tolerate_critical(self, workload):
+        """The Kyverno critical-no-spot guarantee (`04_kyverno.sh:47-75`):
+        nothing schedulable onto spot carries the critical toleration."""
+        for doc in render_burst_deployments(workload):
+            spec = doc["spec"]["template"]["spec"]
+            if spec["nodeSelector"]["karpenter.sh/capacity-type"] == "spot":
+                assert all(t.get("key") != "critical"
+                           for t in spec["tolerations"])
+
+    def test_scale_overrides(self, workload):
+        docs = render_burst_deployments(workload, count=3, replicas=7)
+        assert len(docs) == 3
+        assert all(d["spec"]["replicas"] == 7 for d in docs)
+
+    def test_pdb_and_rbac(self, workload):
+        pdb = render_burst_pdb(workload)
+        assert pdb["spec"]["minAvailable"] == "50%"  # demo_10:52
+        assert pdb["spec"]["selector"]["matchLabels"] == {
+            "group": BURST_GROUP}
+        kinds = [d["kind"] for d in render_burst_rbac()]
+        assert kinds == ["Namespace", "ServiceAccount", "Role",
+                         "RoleBinding"]
+
+
+class TestApplyObserveDelete:
+    def test_apply_roundtrip(self, workload):
+        sink = DryRunSink()
+        results = apply_burst(workload, sink)
+        # 4 RBAC docs + PDB + 12 deployments.
+        assert len(results) == 17
+        assert all(r.ok for r in results)
+        assert sink.get_object("Deployment", "burst-web-12",
+                               namespace="nov-22")
+
+    def test_status_summary(self, workload):
+        sink = DryRunSink()
+        apply_burst(workload, sink)
+        status = burst_status(sink)
+        assert status["count"] == 12
+        assert status["count_spot"] == 6
+        assert status["count_on_demand"] == 6
+        assert status["desired_pods"] == 60  # the reference's burst scale
+
+    def test_status_survives_sequence_gap(self, workload):
+        """Listing is by group label, not sequential name probing: a gap
+        (failed apply / operator delete) must not truncate the count."""
+        sink = DryRunSink()
+        apply_burst(workload, sink)
+        sink.delete_object("Deployment", "burst-web-3", namespace="nov-22")
+        status = burst_status(sink)
+        assert status["count"] == 11
+        assert status["desired_pods"] == 55
+
+    def test_delete_by_group_label(self, workload):
+        sink = DryRunSink()
+        apply_burst(workload, sink)
+        assert delete_burst(sink)
+        assert burst_status(sink)["count"] == 0
+        assert not sink.get_object("PodDisruptionBudget", "burst-pdb",
+                                   namespace="nov-22")
+        # RBAC survives for the next run.
+        assert sink.get_object("ServiceAccount", "scale-burst",
+                               namespace="nov-22")
+
+
+class TestPendingDiagnostics:
+    def test_extracts_podscheduled_reasons(self):
+        pods = [
+            {"metadata": {"name": "burst-web-1-abc"},
+             "spec": {"nodeSelector":
+                      {"karpenter.sh/capacity-type": "spot"}},
+             "status": {"phase": "Pending", "conditions": [
+                 {"type": "PodScheduled", "status": "False",
+                  "reason": "Unschedulable",
+                  "message": "0/3 nodes available: 3 node(s) didn't match "
+                             "Pod's node affinity/selector."}]}},
+            {"metadata": {"name": "burst-web-2-def"},
+             "spec": {"nodeSelector":
+                      {"karpenter.sh/capacity-type": "on-demand"}},
+             "status": {"phase": "Running", "conditions": [
+                 {"type": "PodScheduled", "status": "True"}]}},
+        ]
+        rows = pending_pod_diagnostics(pods)
+        assert len(rows) == 1
+        assert rows[0]["name"] == "burst-web-1-abc"
+        assert rows[0]["node_selector"] == "spot"
+        assert rows[0]["reason"] == "Unschedulable"
+        assert "didn't match" in rows[0]["message"]
+
+
+class TestBurstCLI:
+    def test_json_render(self, capsys):
+        from ccka_tpu.cli import main
+        assert main(["burst", "--json", "--count", "2"]) == 0
+        docs = json.loads(capsys.readouterr().out)
+        kinds = [d["kind"] for d in docs]
+        assert kinds.count("Deployment") == 2
+        assert "PodDisruptionBudget" in kinds
+
+    def test_dry_run_apply(self, capsys):
+        from ccka_tpu.cli import main
+        assert main(["burst"]) == 0
+        err = capsys.readouterr().err
+        assert "17 object(s) rendered (dry-run)" in err
