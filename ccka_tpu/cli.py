@@ -8,6 +8,7 @@ become thin callers of PolicyBackend.decide()". Subcommand ↔ script map:
   reset     ← demo_19_reset_policies.sh
   observe   ← demo_20/21_*_observe.sh (read-only state dump)
   preroll   ← demo_18_preroll_check.sh (environment assertions)
+  burst     ← demo_30_burst_configure.sh (COUNT×REPLICAS load generator)
   simulate  — run the batched simulator and print episode KPIs (new: the
               test substrate the reference lacked, SURVEY.md §4)
   show-config — resolved FrameworkConfig (replaces `demo_00_env.sh` output)
@@ -74,6 +75,9 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="also realize the policy's HPA lever as "
                          "HorizontalPodAutoscaler objects each tick")
     sr.add_argument("--seed", type=int, default=0)
+    sr.add_argument("--telemetry", default="",
+                    help="append per-tick JSONL records (incl. per-phase "
+                         "timings) to this file")
 
     sp = sub.add_parser("preroll", help="environment assertions (demo_18)")
     sp.add_argument("--live", action="store_true")
@@ -91,6 +95,24 @@ def _build_parser() -> argparse.ArgumentParser:
     sc.add_argument("--live", action="store_true")
     sc.add_argument("--wipe-nodeclass", action="store_true",
                     help="also delete the EC2NodeClass (WIPE_NODECLASS)")
+
+    sw = sub.add_parser(
+        "burst", help="the demo_30 load generator: COUNT x REPLICAS "
+                      "deployments alternating spot/on-demand nodeSelectors")
+    sw.add_argument("--count", type=int, default=None,
+                    help="deployments (default: workload.deployments, 12)")
+    sw.add_argument("--replicas", type=int, default=None,
+                    help="replicas each (default: workload.replicas, 5)")
+    sw.add_argument("--namespace", default=None,
+                    help="target namespace (default: workload.namespace)")
+    sw.add_argument("--live", action="store_true")
+    sw.add_argument("--json", action="store_true",
+                    help="print the manifests instead of applying")
+    sw.add_argument("--status", action="store_true",
+                    help="readiness summary of applied deployments "
+                         "(demo_30_burst_observe)")
+    sw.add_argument("--delete", action="store_true",
+                    help="remove the burst deployments + PDB")
 
     st = sub.add_parser(
         "train", help="train a learned backend; orbax checkpoints out")
@@ -123,6 +145,18 @@ def _build_parser() -> argparse.ArgumentParser:
     ss.add_argument("--clusters", type=int, default=1)
     ss.add_argument("--seed", type=int, default=0)
     ss.add_argument("--stochastic", action="store_true")
+    ss.add_argument("--profile-dir", default="",
+                    help="capture a JAX profiler trace of the rollout into "
+                         "this directory (TensorBoard profile plugin)")
+
+    sg = sub.add_parser(
+        "capture", help="record exogenous signals from the configured "
+                        "source into a replayable .npz trace (the AMP "
+                        "store analog)")
+    sg.add_argument("--out", required=True, help="output .npz path")
+    sg.add_argument("--steps", type=int, default=2880,
+                    help="ticks to record (default: one day at 30s)")
+    sg.add_argument("--seed", type=int, default=0)
 
     sub.add_parser("show-config", help="print the resolved config")
     return p
@@ -249,14 +283,17 @@ def _cmd_observe(cfg: FrameworkConfig, backend_name: str,
 
 def _cmd_run(cfg: FrameworkConfig, backend_name: str, checkpoint: str,
              ticks: int, interval: float | None, live: bool,
-             seed: int, hpa: bool = False) -> int:
+             seed: int, hpa: bool = False, telemetry: str = "") -> int:
     from ccka_tpu.harness.controller import controller_from_config
 
     backend = make_backend(cfg, backend_name, checkpoint)
     ctrl = controller_from_config(cfg, backend, live=live,
                                   interval_s=interval, seed=seed,
-                                  apply_hpa=hpa)
-    reports = ctrl.run(ticks if ticks > 0 else None)
+                                  apply_hpa=hpa, telemetry_path=telemetry)
+    try:
+        reports = ctrl.run(ticks if ticks > 0 else None)
+    finally:
+        ctrl.close()
     ok = all(r.applied and r.verified for r in reports) if reports else True
     print(f"[{'ok' if ok else 'err'}] controller ran "
           f"{len(reports)} tick(s)", file=sys.stderr)
@@ -271,10 +308,11 @@ def jax_tree_first(tree):
 
 def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
                   clusters: int, seed: int, stochastic: bool,
-                  checkpoint: str = "") -> int:
+                  checkpoint: str = "", profile_dir: str = "") -> int:
     import jax
     import jax.numpy as jnp
 
+    from ccka_tpu.harness.telemetry import profile_trace
     from ccka_tpu.sim import (SimParams, batched_rollout, initial_state,
                               rollout, summarize)
     from ccka_tpu.sim.types import Action
@@ -290,22 +328,25 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
     else:
         action_fn = make_backend(cfg, backend, checkpoint).action_fn()
 
-    if clusters == 1:
-        trace = src.trace(steps, seed=seed)
-        final, metrics = jax.jit(
-            lambda s, k: rollout(params, s, action_fn, trace, k,
-                                 stochastic=stochastic)
-        )(initial_state(cfg), jax.random.key(seed))
-    else:
-        traces = jax.tree.map(
-            lambda *xs: jnp.stack(xs),
-            *[src.trace(steps, seed=seed + i) for i in range(clusters)])
-        states = jax.tree.map(
-            lambda x: jnp.broadcast_to(x, (clusters,) + x.shape),
-            initial_state(cfg))
-        keys = jax.random.split(jax.random.key(seed), clusters)
-        final, metrics = batched_rollout(params, states, action_fn, traces,
-                                         keys, stochastic=stochastic)
+    with profile_trace(profile_dir):
+        if clusters == 1:
+            trace = src.trace(steps, seed=seed)
+            final, metrics = jax.jit(
+                lambda s, k: rollout(params, s, action_fn, trace, k,
+                                     stochastic=stochastic)
+            )(initial_state(cfg), jax.random.key(seed))
+        else:
+            traces = jax.tree.map(
+                lambda *xs: jnp.stack(xs),
+                *[src.trace(steps, seed=seed + i) for i in range(clusters)])
+            states = jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (clusters,) + x.shape),
+                initial_state(cfg))
+            keys = jax.random.split(jax.random.key(seed), clusters)
+            final, metrics = batched_rollout(params, states, action_fn,
+                                             traces, keys,
+                                             stochastic=stochastic)
+        jax.block_until_ready(metrics)
     s = summarize(params, metrics)
     import numpy as np
     report = {k: np.asarray(v).mean().item() for k, v in s._asdict().items()}
@@ -313,6 +354,22 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
     report["clusters"] = clusters
     report["days"] = days
     print(json.dumps(report, indent=2))
+    return 0
+
+
+def _cmd_capture(cfg: FrameworkConfig, out: str, steps: int,
+                 seed: int) -> int:
+    """Record the configured source into a replayable .npz — the capture
+    path into the framework's AMP-store analog (`signals/replay.py`)."""
+    from ccka_tpu.signals.live import make_signal_source
+    from ccka_tpu.signals.replay import save_trace
+
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    trace = src.trace(steps, seed=seed)
+    save_trace(out, trace, src.meta())
+    print(json.dumps({"out": out, "steps": steps,
+                      "source": src.meta().source,
+                      "zones": list(src.meta().zones)}))
     return 0
 
 
@@ -404,6 +461,44 @@ def _cmd_bootstrap(cfg: FrameworkConfig, live: bool, as_json: bool) -> int:
     return 0 if ok else 1
 
 
+def _cmd_burst(cfg: FrameworkConfig, args) -> int:
+    from ccka_tpu.actuation import DryRunSink, KubectlSink
+    from ccka_tpu.actuation.burst import (apply_burst, burst_status,
+                                          delete_burst,
+                                          render_burst_deployments,
+                                          render_burst_pdb,
+                                          render_burst_rbac)
+
+    ns = args.namespace or cfg.workload.namespace
+    if args.json:
+        docs = render_burst_rbac(ns)
+        docs.append(render_burst_pdb(cfg.workload, ns))
+        docs += render_burst_deployments(cfg.workload, ns,
+                                         count=args.count,
+                                         replicas=args.replicas)
+        print(json.dumps(docs, indent=2))
+        return 0
+    sink = KubectlSink() if args.live else DryRunSink(echo=True)
+    if args.delete:
+        ok = delete_burst(sink, ns)
+        print(f"[{'ok' if ok else 'err'}] burst workload removed"
+              if ok else "[err] burst delete failed", file=sys.stderr)
+        return 0 if ok else 1
+    if args.status:
+        print(json.dumps(burst_status(sink, ns), indent=2))
+        return 0
+    results = apply_burst(cfg.workload, sink, ns,
+                          count=args.count, replicas=args.replicas)
+    ok = all(r.ok for r in results)
+    bad = [r for r in results if not r.ok]
+    for r in bad:
+        print(f"[FAILED] {r.pool} — {r.detail}", file=sys.stderr)
+    print(f"[{'ok' if ok else 'err'}] burst: {len(results)} object(s) "
+          f"{'applied' if args.live else 'rendered (dry-run)'}",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
 def _cmd_cleanup(cfg: FrameworkConfig, live: bool,
                  wipe_nodeclass: bool) -> int:
     from ccka_tpu.actuation import DryRunSink, KubectlSink, cleanup
@@ -437,7 +532,8 @@ def main(argv: list[str] | None = None) -> int:
             return _cmd_observe(cfg, args.backend, args.checkpoint)
         if args.command == "run":
             return _cmd_run(cfg, args.backend, args.checkpoint, args.ticks,
-                            args.interval, args.live, args.seed, args.hpa)
+                            args.interval, args.live, args.seed, args.hpa,
+                            args.telemetry)
         if args.command == "train":
             return _cmd_train(cfg, args.backend, args.iterations,
                               args.checkpoint_dir, args.seed, args.log_every)
@@ -447,11 +543,16 @@ def main(argv: list[str] | None = None) -> int:
                                  args.deterministic)
         if args.command == "simulate":
             return _cmd_simulate(cfg, args.backend, args.days, args.clusters,
-                                 args.seed, args.stochastic, args.checkpoint)
+                                 args.seed, args.stochastic, args.checkpoint,
+                                 args.profile_dir)
+        if args.command == "capture":
+            return _cmd_capture(cfg, args.out, args.steps, args.seed)
         if args.command == "preroll":
             return _cmd_preroll(cfg, args.live)
         if args.command == "bootstrap":
             return _cmd_bootstrap(cfg, args.live, args.json)
+        if args.command == "burst":
+            return _cmd_burst(cfg, args)
         if args.command == "cleanup":
             return _cmd_cleanup(cfg, args.live, args.wipe_nodeclass)
         if args.command == "show-config":

@@ -24,6 +24,11 @@ from typing import Any, Mapping, Tuple
 
 ENV_PREFIX = "CCKA_"
 
+# The simulator's queueing-curve latency proxy clips utilization at
+# rho=0.98, so p95 saturates at base*(1 + 3*0.98^2/0.02) — an SLO bound at
+# or above this can never be violated (`sim/dynamics.py` latency proxy).
+LATENCY_SATURATION_FACTOR = 1.0 + 3.0 * 0.98 * 0.98 / 0.02
+
 
 class ConfigError(ValueError):
     """Raised on invalid configuration — analog of `require_var` hard-fail
@@ -232,6 +237,9 @@ class WorkloadConfig:
 
     deployments: int = 12
     replicas: int = 5
+    # Workload namespace (`demo_00_env.sh:9-10`): where burst Deployments,
+    # the PDB, HPAs and app-level SLO metrics live.
+    namespace: str = "nov-22"
     pod_cpu_request: float = 0.2
     pod_mem_request_gib: float = 0.125
     # Fraction of pods labeled critical=true — these may never tolerate spot
@@ -247,6 +255,8 @@ class WorkloadConfig:
     def validate(self) -> None:
         if self.deployments <= 0 or self.replicas <= 0:
             raise ConfigError("workload: non-positive size")
+        if not self.namespace:
+            raise ConfigError("workload: empty namespace")
         if self.pod_cpu_request <= 0 or self.pod_mem_request_gib <= 0:
             raise ConfigError("workload: non-positive pod request")
         if not 0.0 <= self.critical_fraction <= 1.0:
@@ -288,6 +298,16 @@ class SimConfig:
     # truly-empty nodes; fragmentation keeps ~this fraction of repack-optimal
     # capacity stranded on partially-filled nodes.
     fragmentation: float = 0.3
+    # Latency proxy (the app-level p95 the reference advertised as an SLO
+    # input but never collected — README.md:21, SURVEY §2.3): service p95
+    # at idle, inflated by a queueing curve as fleet load approaches
+    # capacity.
+    latency_base_ms: float = 20.0
+    # p95 bound for the SLO gate; 0 disables latency gating (SLO is then
+    # served-fraction only, the pre-existing behavior). Must sit below the
+    # proxy's saturation ceiling (see LATENCY_SATURATION_FACTOR) or the
+    # gate could never trip.
+    latency_slo_ms: float = 0.0
 
     @property
     def provision_delay_steps(self) -> int:
@@ -308,6 +328,18 @@ class SimConfig:
             raise ConfigError("sim: slo_served_fraction out of (0,1]")
         if self.fragmentation < 0:
             raise ConfigError("sim: negative fragmentation")
+        if self.latency_base_ms <= 0:
+            raise ConfigError("sim: latency_base_ms must be positive")
+        if self.latency_slo_ms < 0:
+            raise ConfigError("sim: negative latency_slo_ms")
+        ceiling = self.latency_base_ms * LATENCY_SATURATION_FACTOR
+        if self.latency_slo_ms >= ceiling > 0:
+            raise ConfigError(
+                f"sim: latency_slo_ms={self.latency_slo_ms} is at or above "
+                f"the proxy's saturation ceiling ({ceiling:.0f} ms = "
+                f"latency_base_ms x {LATENCY_SATURATION_FACTOR:.1f}); the "
+                "gate could never trip — lower the bound or raise "
+                "latency_base_ms")
 
 
 @dataclass(frozen=True)

@@ -13,3 +13,9 @@ from ccka_tpu.harness.controller import (  # noqa: F401
     TickReport,
     controller_from_config,
 )
+from ccka_tpu.harness.telemetry import (  # noqa: F401
+    StageTimer,
+    TelemetryWriter,
+    profile_trace,
+    read_telemetry,
+)

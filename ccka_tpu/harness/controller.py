@@ -58,6 +58,16 @@ class TickReport:
     pending_pods: float
     slo_ok: bool
     detail: str = ""
+    # Model-estimated app p95 (queueing-curve proxy, `sim/dynamics.py`).
+    latency_p95_ms: float = 0.0
+    # Measured app-level SLO metrics when the signal source scrapes them
+    # (live Prometheus: p95/RPS/queue depth — the §2.3 inputs the
+    # reference advertised but never collected). Empty for sources
+    # without an app-metrics path.
+    slo_metrics: dict = dataclasses.field(default_factory=dict)
+    # Per-phase wall timings (ms) of the scrape→decide→render→apply→verify→
+    # estimate pipeline — the structured-timing requirement of SURVEY §5.
+    timings_ms: dict = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
@@ -95,6 +105,7 @@ class Controller:
                  interval_s: float | None = None,
                  seed: int = 0,
                  apply_hpa: bool = False,
+                 telemetry_path: str = "",
                  log_fn: Callable[[str], None] | None = None,
                  sleep_fn: Callable[[float], None] = time.sleep):
         self.cfg = cfg
@@ -128,6 +139,11 @@ class Controller:
         self.params = SimParams.from_config(cfg)
         self.state: ClusterState = initial_state(cfg)
         self.key = jax.random.key(seed)
+        # Durable JSONL telemetry (the remote-write analog); "" disables.
+        self.telemetry = None
+        if telemetry_path:
+            from ccka_tpu.harness.telemetry import TelemetryWriter
+            self.telemetry = TelemetryWriter(telemetry_path)
         self._step = jax.jit(
             lambda s, a, e, k: sim_step(self.params, s, a, e, k,
                                         stochastic=False))
@@ -138,52 +154,72 @@ class Controller:
     # -- one tick ----------------------------------------------------------
 
     def tick(self, t: int) -> TickReport:
+        from ccka_tpu.harness.telemetry import StageTimer
+
+        timer = StageTimer()
         # 1. scrape the latest signals (the 30s AMP pipeline analog).
-        tick_trace = self.source.tick(t, seed=self.seed)
-        exo = jax.tree.map(lambda x: x[0], exo_steps(tick_trace))
-        is_peak = bool(float(exo.is_peak) > 0.5)
+        with timer.stage("scrape"):
+            tick_trace = self.source.tick(t, seed=self.seed)
+            exo = jax.tree.map(lambda x: x[0], exo_steps(tick_trace))
+            is_peak = bool(float(exo.is_peak) > 0.5)
 
         # 2. decide. Receding-horizon backends periodically re-optimize
         #    against the source's forward-looking window (exact future for
         #    synthetic/replay, persistence forecast for live).
-        if self._replan_every and t % self._replan_every == 0:
-            window = self.source.forecast(t, self._horizon, seed=self.seed)
-            self.backend.replan(self.state, window)
-        action = self.backend.decide(self.state, exo, jnp.int32(t))
+        with timer.stage("decide"):
+            if self._replan_every and t % self._replan_every == 0:
+                window = self.source.forecast(t, self._horizon,
+                                              seed=self.seed)
+                self.backend.replan(self.state, window)
+            action = self.backend.decide(self.state, exo, jnp.int32(t))
 
         # 3. render: op mirrors the reference's profile split — peak uses
         #    op:add (demo_21:65), off-peak op:replace (demo_20:69). The
         #    global zone selection is split per region (one Karpenter per
         #    regional cluster); single-region topologies get one entry.
-        per_region = render_region_nodepool_patches(
-            action, self.cfg.cluster, op="add" if is_peak else "replace")
+        with timer.stage("render"):
+            per_region = render_region_nodepool_patches(
+                action, self.cfg.cluster, op="add" if is_peak else "replace")
 
         # 4. apply through each region's sink (kubectl-shaped, with
         #    fallback). With apply_hpa, the tick also realizes the HPA lever
         #    as actual HorizontalPodAutoscaler objects in the home region —
         #    the §2.3 capability the reference installed prometheus-adapter
         #    for but never created.
-        results = []
-        for region, patches in per_region.items():
-            results += self.region_sinks[region].apply_all(patches)
-        if self.apply_hpa:
-            from ccka_tpu.actuation.patches import render_hpa_manifests
-            results += self.sink.apply_manifests(
-                render_hpa_manifests(action, self.cfg.cluster,
-                                     self.cfg.workload))
-        applied = all(r.ok for r in results)
-        fallbacks = sum(1 for r in results if r.used_fallback)
+        with timer.stage("apply"):
+            results = []
+            for region, patches in per_region.items():
+                results += self.region_sinks[region].apply_all(patches)
+            if self.apply_hpa:
+                from ccka_tpu.actuation.patches import render_hpa_manifests
+                results += self.sink.apply_manifests(
+                    render_hpa_manifests(action, self.cfg.cluster,
+                                         self.cfg.workload,
+                                         namespace=self.cfg.workload.namespace))
+            applied = all(r.ok for r in results)
+            fallbacks = sum(1 for r in results if r.used_fallback)
 
         # 5. verify: skeptical read-back against the rendered intent,
         #    region by region.
-        verified = applied and all(
-            _verify_pool(self.region_sinks[region].observed_state(ps.pool), ps)
-            for region, patches in per_region.items()
-            for ps in patches)
+        with timer.stage("verify"):
+            verified = applied and all(
+                _verify_pool(
+                    self.region_sinks[region].observed_state(ps.pool), ps)
+                for region, patches in per_region.items()
+                for ps in patches)
 
         # 6. advance the model-based state estimate (expectation dynamics).
-        self.key, sub = jax.random.split(self.key)
-        self.state, metrics = self._step(self.state, action, exo, sub)
+        with timer.stage("estimate"):
+            self.key, sub = jax.random.split(self.key)
+            self.state, metrics = self._step(self.state, action, exo, sub)
+
+        # 7. measured app-level SLO metrics, when the source scrapes them
+        #    (live Prometheus p95/RPS/queue depth). Timed as its own stage:
+        #    on a slow endpoint these three blocking queries are the tick's
+        #    dominant cost and must show up in timings_ms.
+        with timer.stage("slo_scrape"):
+            slo_metrics = (self.source.slo_snapshot()
+                           if hasattr(self.source, "slo_snapshot") else {})
 
         dt_hr = float(self.params.dt_s) / 3600.0
         profile = ""
@@ -203,8 +239,13 @@ class Controller:
             pending_pods=float(np.asarray(metrics.pending_pods).sum()),
             slo_ok=bool(float(metrics.slo_ok) > 0.5),
             detail="; ".join(r.detail for r in results if r.detail)[:500],
+            latency_p95_ms=float(metrics.latency_p95_ms),
+            slo_metrics=slo_metrics,
+            timings_ms=timer.timings_ms(),
         )
         self.log_fn(report.to_json())
+        if self.telemetry is not None:
+            self.telemetry.write(dataclasses.asdict(report))
         return report
 
     # -- the loop ----------------------------------------------------------
@@ -228,6 +269,15 @@ class Controller:
             if more and self.interval_s > 0:
                 self.sleep_fn(self.interval_s)
         return reports
+
+    def close(self) -> None:
+        """Release the telemetry writer. Owned by the *controller's* owner,
+        not by run(): resumed runs (``run(start_tick=...)``) and direct
+        tick() calls must keep appending — every write is flushed, so an
+        unclosed writer loses nothing on process exit."""
+        if self.telemetry is not None:
+            self.telemetry.close()
+            self.telemetry = None
 
 
 def controller_from_config(cfg: FrameworkConfig, backend: PolicyBackend,

@@ -1,0 +1,116 @@
+"""Telemetry: structured timing, JSONL export, and JAX profiler capture.
+
+The reference's observability is a metrics pipeline it configures but never
+instruments itself with — KSM→ADOT→AMP on a 30s cadence
+(`06_opencost.sh:318-341`) plus port-forwarded dashboards
+(`demo_40_watch_observe.sh:50-110`); the scripts themselves emit only
+colored log lines (`00_common.sh:12-14`). SURVEY §5 calls for the new
+build to carry "JAX profiler traces of the simulator/policy step +
+structured timing of the scrape→decide→act loop". This module is that:
+
+- :class:`StageTimer` — named-phase wall timing for one control tick;
+- :class:`TelemetryWriter` — append-only JSONL export of tick reports (the
+  remote-write analog: durable, machine-parseable, replayable);
+- :func:`profile_trace` — gated `jax.profiler` capture around any block
+  (simulate/bench/controller), viewable in TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Iterator, Mapping
+
+
+class StageTimer:
+    """Wall-clock timing for the named phases of one control tick.
+
+    Usage::
+
+        timer = StageTimer()
+        with timer.stage("scrape"):
+            ...
+        report["timings_ms"] = timer.timings_ms()
+
+    Re-entering a stage accumulates (for per-pool apply loops).
+    """
+
+    def __init__(self):
+        self._acc: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc[name] = self._acc.get(name, 0.0) + (
+                time.perf_counter() - t0)
+
+    def timings_ms(self) -> dict[str, float]:
+        return {k: round(v * 1000.0, 3) for k, v in self._acc.items()}
+
+    @property
+    def total_ms(self) -> float:
+        return round(sum(self._acc.values()) * 1000.0, 3)
+
+
+class TelemetryWriter:
+    """Append-only JSONL sink for structured tick records.
+
+    One JSON object per line, flushed per write — the controller daemon's
+    counterpart of the reference's Prometheus remote-write stream (durable
+    history that dashboards and replays read back). ``path`` parents are
+    created on demand; writer doubles as a context manager.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def write(self, record: Mapping) -> None:
+        self._fh.write(json.dumps(dict(record), sort_keys=True) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self) -> "TelemetryWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_telemetry(path: str) -> list[dict]:
+    """Load a JSONL telemetry file back into records (skips blank lines)."""
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None) -> Iterator[None]:
+    """JAX profiler capture around a block, gated on ``log_dir``.
+
+    With a falsy ``log_dir`` this is a no-op, so call sites can thread a
+    CLI flag straight through. The captured trace lands under
+    ``log_dir/plugins/profile/...`` for TensorBoard's profile plugin /
+    XProf — device timelines, XLA op breakdown, fusion inspection.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir):
+        yield

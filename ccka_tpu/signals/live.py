@@ -155,6 +155,69 @@ class OpenCostClient:
         return out
 
 
+class SLOMetricsClient:
+    """App-level SLO metrics: p95 latency, RPS, queue depth.
+
+    The reference *advertises* these as the autoscaler's SLO inputs
+    (`README.md:21` "latency SLOs", proposal PDF p.1) yet its pipeline
+    scrapes only kube-state-metrics (`06_opencost.sh:324-327`) — no app
+    latency, request-rate or queue metric is ever collected (§2.3). This
+    client issues the standard PromQL for all three against the same
+    Prometheus-compatible endpoint, degrading to ``None`` per metric when
+    series are absent (a cluster without app instrumentation), so callers
+    can log gaps instead of fabricating numbers.
+    """
+
+    def __init__(self, prom: PrometheusClient,
+                 namespace: str = "nov-22"):
+        self.prom = prom
+        self.namespace = namespace
+
+    def _scalar(self, promql: str) -> float | None:
+        try:
+            rows = self.prom.query(promql)
+        except SignalUnavailable:
+            return None
+        if not rows:
+            return None
+        val = rows[0][1]
+        return None if val != val else val  # NaN → absent histogram
+
+    def latency_p95_s(self) -> float | None:
+        """p95 request latency over 5m, histogram-quantile form."""
+        return self._scalar(
+            "histogram_quantile(0.95, sum(rate("
+            f'http_request_duration_seconds_bucket{{namespace="{self.namespace}"}}'
+            "[5m])) by (le))")
+
+    def rps(self) -> float | None:
+        """Served request rate over 5m."""
+        return self._scalar(
+            f'sum(rate(http_requests_total{{namespace="{self.namespace}"}}[5m]))')
+
+    def queue_depth(self) -> float | None:
+        """Scheduler queue depth: Pending pods in the workload namespace —
+        the series the burst observer tabulates
+        (`demo_30_burst_observe.sh:20-28`)."""
+        return self._scalar(
+            'sum(kube_pod_status_phase{phase="Pending",'
+            f'namespace="{self.namespace}"}})')
+
+    def snapshot(self) -> dict[str, float]:
+        """All available metrics (absent ones omitted), ms-normalized."""
+        out: dict[str, float] = {}
+        p95 = self.latency_p95_s()
+        if p95 is not None:
+            out["latency_p95_ms"] = p95 * 1000.0
+        rps = self.rps()
+        if rps is not None:
+            out["rps"] = rps
+        q = self.queue_depth()
+        if q is not None:
+            out["queue_depth"] = q
+        return out
+
+
 class CarbonIntensityClient:
     """ElectricityMaps-style carbon intensity client.
 
@@ -226,6 +289,12 @@ class LiveSignalSource(SignalSource):
             timeout_s=signals.request_timeout_s)
         self._synth = SyntheticSignalSource(cluster, workload, sim, signals,
                                             start_unix_s=self.start_unix_s)
+        self.slo = SLOMetricsClient(self.prom, namespace=workload.namespace)
+
+    def slo_snapshot(self) -> dict[str, float]:
+        """Measured app-level SLO metrics for the controller's KPI line
+        (absent series omitted — see :class:`SLOMetricsClient`)."""
+        return self.slo.snapshot()
 
     def meta(self) -> TraceMeta:
         return TraceMeta(source="live", start_unix_s=self.start_unix_s,

@@ -209,11 +209,29 @@ def step(params: SimParams,
     # $/req and gCO2/req denominators can't be inflated by overscaling.
     effective = jnp.minimum(running, exo.demand_pods)     # [C]
     requests = effective.sum() * params.rps_per_pod * params.dt_s
+
+    # Latency proxy — the app-level p95 the reference named as an SLO input
+    # (README.md:21) but never scraped (§2.3: the pipeline carries only
+    # kube-state-metrics). An M/M/1-shaped queueing curve over the fleet
+    # load factor: p95 ≈ base · (1 + 3ρ²/(1−ρ)), ρ = demand/capacity
+    # clipped below 1 so overload saturates (~150× base) instead of
+    # diverging. Smooth in capacity, so diff-MPC gradients see latency.
+    load = exo.demand_pods.sum() / (cap_ct.sum() + _EPS)
+    rho = jnp.clip(load, 0.0, 0.98)
+    latency_p95_ms = params.latency_base_ms * (
+        1.0 + 3.0 * rho * rho / (1.0 - rho))
+    queue_depth = pending.sum()
+
     # SLO is judged per class against *raw* demand, not the HPA-scaled
     # target — otherwise a policy could "meet" SLO by zeroing its own target
     # (hpa_scale=0) or by overserving one class while starving the other.
+    # With a configured p95 bound, the latency gate must hold too.
     met_c = running >= params.slo_served_fraction * exo.demand_pods - _EPS
-    slo_ok = met_c.all().astype(jnp.float32)
+    latency_ok = jnp.where(
+        params.latency_slo_ms > 0,
+        (latency_p95_ms <= params.latency_slo_ms).astype(jnp.float32),
+        1.0)
+    slo_ok = met_c.all().astype(jnp.float32) * latency_ok
 
     new_state = ClusterState(
         nodes=nodes,
@@ -239,5 +257,7 @@ def step(params: SimParams,
         slo_ok=slo_ok,
         interrupted_nodes=interrupted_total,
         evicted_pods=evicted,
+        latency_p95_ms=latency_p95_ms,
+        queue_depth=queue_depth,
     )
     return new_state, metrics

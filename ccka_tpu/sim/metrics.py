@@ -33,6 +33,9 @@ class EpisodeSummary(NamedTuple):
     waste_frac: jnp.ndarray          # [] unused capacity fraction (proposal "waste%")
     evictions: jnp.ndarray           # [] total consolidation evictions
     interruptions: jnp.ndarray       # [] total spot reclaims
+    latency_p95_ms_mean: jnp.ndarray  # [] mean p95 proxy over the episode
+    latency_p95_ms_max: jnp.ndarray   # [] worst tick p95
+    queue_depth_mean: jnp.ndarray     # [] mean pending-pod backlog
 
 
 def summarize(params: SimParams, metrics: StepMetrics) -> EpisodeSummary:
@@ -77,4 +80,7 @@ def summarize(params: SimParams, metrics: StepMetrics) -> EpisodeSummary:
         waste_frac=waste_frac,
         evictions=metrics.evicted_pods.sum(axis=-1),
         interruptions=metrics.interrupted_nodes.sum(axis=-1),
+        latency_p95_ms_mean=metrics.latency_p95_ms.mean(axis=-1),
+        latency_p95_ms_max=metrics.latency_p95_ms.max(axis=-1),
+        queue_depth_mean=metrics.queue_depth.mean(axis=-1),
     )

@@ -52,6 +52,8 @@ class SimParams(NamedTuple):
     rps_per_pod: jnp.ndarray          # [] request throughput proxy
     slo_served_fraction: jnp.ndarray  # [] served/desired to count SLO-met
     consolidate_tau_s: jnp.ndarray    # [] softness of the consolidate-after gate
+    latency_base_ms: jnp.ndarray      # [] idle p95 of the latency proxy
+    latency_slo_ms: jnp.ndarray       # [] p95 SLO bound (0 = disabled)
 
     @classmethod
     def from_config(cls, cfg: FrameworkConfig) -> "SimParams":
@@ -85,6 +87,8 @@ class SimParams(NamedTuple):
             rps_per_pod=jnp.float32(sm.rps_per_pod),
             slo_served_fraction=jnp.float32(sm.slo_served_fraction),
             consolidate_tau_s=jnp.float32(0.25 * sm.dt_s),
+            latency_base_ms=jnp.float32(sm.latency_base_ms),
+            latency_slo_ms=jnp.float32(sm.latency_slo_ms),
         )
 
 
@@ -148,6 +152,9 @@ class StepMetrics(NamedTuple):
     demand_pods: jnp.ndarray     # [C] raw exogenous demand (SLO/req basis)
     nodes_by_ct: jnp.ndarray     # [T_CT] active node totals
     nodes_by_zone: jnp.ndarray   # [Z] active node totals (region placement)
-    slo_ok: jnp.ndarray          # [] {0,1} served-fraction SLO met this tick
+    slo_ok: jnp.ndarray          # [] {0,1} SLO met this tick (served fraction
+                                 #    and, when configured, the p95 bound)
     interrupted_nodes: jnp.ndarray  # [] spot nodes reclaimed this tick
     evicted_pods: jnp.ndarray    # [] consolidation evictions this tick
+    latency_p95_ms: jnp.ndarray  # [] queueing-curve p95 proxy (app latency)
+    queue_depth: jnp.ndarray     # [] pending-pod backlog (scheduler queue)

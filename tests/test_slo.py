@@ -1,0 +1,194 @@
+"""Latency/RPS/queue-depth SLO metrics (VERDICT row 16).
+
+The reference advertises latency SLOs as autoscaler inputs (`README.md:21`,
+proposal PDF p.1) but its pipeline scrapes only kube-state-metrics
+(`06_opencost.sh:324-327`). These tests cover the realized version: the
+simulator's queueing-curve p95 proxy + latency SLO gate, episode latency
+KPIs, and the live PromQL client for measured p95/RPS/queue depth.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import default_config
+from ccka_tpu.sim import SimParams, initial_state, step, summarize
+from ccka_tpu.sim.dynamics import ExoStep
+from ccka_tpu.sim.types import Action
+
+
+def _exo(cfg, demand):
+    z = cfg.cluster.n_zones
+    return ExoStep(
+        spot_price_hr=jnp.full((z,), 0.035, jnp.float32),
+        od_price_hr=jnp.full((z,), 0.096, jnp.float32),
+        carbon_g_kwh=jnp.full((z,), 400.0, jnp.float32),
+        demand_pods=jnp.asarray(demand, jnp.float32),
+        is_peak=jnp.float32(0.0),
+    )
+
+
+def _neutral(cfg):
+    return Action.neutral(cfg.cluster.n_pools, cfg.cluster.n_zones)
+
+
+class TestLatencyProxy:
+    def test_idle_near_base_overload_saturates(self):
+        cfg = default_config()
+        params = SimParams.from_config(cfg)
+        s0 = initial_state(cfg)
+        key = jax.random.key(0)
+
+        # Near-idle: 2 pods on 27-pod base capacity → p95 ≈ base.
+        _, light = step(params, s0, _neutral(cfg), _exo(cfg, [0.0, 2.0]), key)
+        assert float(light.latency_p95_ms) < 1.3 * cfg.sim.latency_base_ms
+
+        # Overload: demand far above capacity → saturated queueing curve,
+        # far above base, and a deep pending backlog.
+        _, heavy = step(params, s0, _neutral(cfg), _exo(cfg, [0.0, 200.0]),
+                        key)
+        assert float(heavy.latency_p95_ms) > 20 * cfg.sim.latency_base_ms
+        assert float(heavy.queue_depth) > 150.0
+        assert float(light.queue_depth) == pytest.approx(0.0)
+
+    def test_latency_monotone_in_load(self):
+        cfg = default_config()
+        params = SimParams.from_config(cfg)
+        s0 = initial_state(cfg)
+        key = jax.random.key(0)
+        p95s = [
+            float(step(params, s0, _neutral(cfg), _exo(cfg, [0.0, d]),
+                       key)[1].latency_p95_ms)
+            for d in (2.0, 16.0, 24.0, 26.0)
+        ]
+        assert p95s == sorted(p95s)
+        assert p95s[-1] > p95s[0]
+
+
+class TestLatencySLOGate:
+    def test_unenforceable_bound_rejected(self):
+        """An SLO at/above the proxy's saturation ceiling (~145x base)
+        could never trip — config validation must refuse it instead of
+        silently disabling the gate."""
+        from ccka_tpu.config import ConfigError
+        with pytest.raises(ConfigError, match="saturation ceiling"):
+            default_config().with_overrides(**{"sim.latency_slo_ms": 3000.0})
+        # Just below the ceiling is allowed.
+        default_config().with_overrides(**{"sim.latency_slo_ms": 2800.0})
+
+    def test_disabled_by_default(self):
+        cfg = default_config()
+        assert cfg.sim.latency_slo_ms == 0.0
+        params = SimParams.from_config(cfg)
+        s0 = initial_state(cfg)
+        # On-demand demand near base capacity (27): fully served, hot.
+        _, m = step(params, s0, _neutral(cfg), _exo(cfg, [0.0, 26.0]),
+                    jax.random.key(0))
+        assert float(m.slo_ok) == 1.0  # served-fraction gate only
+
+    def test_tight_bound_fails_hot_tick(self):
+        cfg = default_config().with_overrides(**{"sim.latency_slo_ms": 40.0})
+        params = SimParams.from_config(cfg)
+        s0 = initial_state(cfg)
+        key = jax.random.key(0)
+        # Fully served but hot (ρ≈26/27 on base capacity): p95 breaches
+        # the 40ms bound → SLO fails even though serving succeeded.
+        _, hot = step(params, s0, _neutral(cfg), _exo(cfg, [0.0, 26.0]), key)
+        assert float(hot.served_pods.sum()) == pytest.approx(26.0)
+        assert float(hot.latency_p95_ms) > 40.0
+        assert float(hot.slo_ok) == 0.0
+        # Cool tick passes both gates.
+        _, cool = step(params, s0, _neutral(cfg), _exo(cfg, [0.0, 2.0]), key)
+        assert float(cool.slo_ok) == 1.0
+
+    def test_episode_summary_carries_latency_kpis(self):
+        cfg = default_config()
+        params = SimParams.from_config(cfg)
+        s0 = initial_state(cfg)
+        key = jax.random.key(0)
+        mets = []
+        s = s0
+        for d in (2.0, 26.0, 2.0):
+            s, m = step(params, s, _neutral(cfg), _exo(cfg, [0.0, d]), key)
+            mets.append(m)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *mets)
+        summ = summarize(params, stacked)
+        assert float(summ.latency_p95_ms_max) >= float(
+            summ.latency_p95_ms_mean) > 0.0
+        assert float(summ.queue_depth_mean) >= 0.0
+
+
+class TestSLOMetricsClient:
+    def _client(self, responses):
+        from ccka_tpu.signals.live import PrometheusClient, SLOMetricsClient
+
+        def fetch(url, headers):
+            for frag, payload in responses.items():
+                if frag in url:
+                    return json.dumps(payload).encode()
+            return json.dumps({"status": "success",
+                               "data": {"result": []}}).encode()
+
+        return SLOMetricsClient(
+            PrometheusClient("http://prom", fetch=fetch))
+
+    @staticmethod
+    def _instant(value):
+        return {"status": "success", "data": {"result": [
+            {"metric": {}, "value": [0, str(value)]}]}}
+
+    def test_parses_all_three(self):
+        client = self._client({
+            "histogram_quantile": self._instant(0.042),
+            "http_requests_total": self._instant(350.0),
+            "kube_pod_status_phase": self._instant(7.0),
+        })
+        snap = client.snapshot()
+        assert snap["latency_p95_ms"] == pytest.approx(42.0)
+        assert snap["rps"] == pytest.approx(350.0)
+        assert snap["queue_depth"] == pytest.approx(7.0)
+
+    def test_absent_series_omitted(self):
+        client = self._client({})  # empty result sets everywhere
+        assert client.snapshot() == {}
+        assert client.latency_p95_s() is None
+
+    def test_nan_histogram_treated_absent(self):
+        client = self._client({"histogram_quantile": self._instant("NaN")})
+        assert client.latency_p95_s() is None
+
+    def test_unreachable_endpoint_degrades(self):
+        from ccka_tpu.signals.live import PrometheusClient, SLOMetricsClient
+
+        def fetch(url, headers):
+            raise OSError("no route to host")
+
+        client = SLOMetricsClient(PrometheusClient("http://prom", fetch=fetch))
+        assert client.snapshot() == {}
+
+
+class TestControllerSLOReport:
+    def test_report_carries_model_latency_and_measured_snapshot(self):
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        cfg = default_config()
+
+        class SourceWithSLO(SyntheticSignalSource):
+            def slo_snapshot(self):
+                return {"latency_p95_ms": 35.0, "rps": 120.0}
+
+        src = SourceWithSLO(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, DryRunSink(),
+                          interval_s=0.0, log_fn=lambda _line: None)
+        report = ctrl.tick(0)
+        assert report.latency_p95_ms > 0.0
+        assert report.slo_metrics == {"latency_p95_ms": 35.0, "rps": 120.0}
+        # JSON log line round-trips the new fields.
+        rec = json.loads(report.to_json())
+        assert "slo_metrics" in rec and "latency_p95_ms" in rec
