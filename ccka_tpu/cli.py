@@ -470,6 +470,10 @@ def _cmd_burst(cfg: FrameworkConfig, args) -> int:
                                           render_burst_rbac)
 
     ns = args.namespace or cfg.workload.namespace
+    if args.json and (args.delete or args.status):
+        raise SystemExit("ccka: burst --json renders the creation "
+                         "manifests and conflicts with --delete/--status "
+                         "(--status output is already JSON)")
     if args.json:
         docs = render_burst_rbac(ns)
         docs.append(render_burst_pdb(cfg.workload, ns))
@@ -481,8 +485,8 @@ def _cmd_burst(cfg: FrameworkConfig, args) -> int:
     sink = KubectlSink() if args.live else DryRunSink(echo=True)
     if args.delete:
         ok = delete_burst(sink, ns)
-        print(f"[{'ok' if ok else 'err'}] burst workload removed"
-              if ok else "[err] burst delete failed", file=sys.stderr)
+        print("[ok] burst workload removed" if ok
+              else "[err] burst delete failed", file=sys.stderr)
         return 0 if ok else 1
     if args.status:
         print(json.dumps(burst_status(sink, ns), indent=2))

@@ -24,10 +24,16 @@ from typing import Any, Mapping, Tuple
 
 ENV_PREFIX = "CCKA_"
 
-# The simulator's queueing-curve latency proxy clips utilization at
-# rho=0.98, so p95 saturates at base*(1 + 3*0.98^2/0.02) — an SLO bound at
-# or above this can never be violated (`sim/dynamics.py` latency proxy).
-LATENCY_SATURATION_FACTOR = 1.0 + 3.0 * 0.98 * 0.98 / 0.02
+# Latency-proxy curve constants — the single source of truth shared by the
+# simulator (`sim/dynamics.py` imports these) and the config validation
+# below: p95 = base * (1 + COEF*rho^2/(1-rho)) with rho clipped at RHO_CLIP,
+# so p95 saturates at base * LATENCY_SATURATION_FACTOR and an SLO bound at
+# or above that ceiling can never be violated.
+LATENCY_RHO_CLIP = 0.98
+LATENCY_CURVE_COEF = 3.0
+LATENCY_SATURATION_FACTOR = 1.0 + (
+    LATENCY_CURVE_COEF * LATENCY_RHO_CLIP * LATENCY_RHO_CLIP
+    / (1.0 - LATENCY_RHO_CLIP))
 
 
 class ConfigError(ValueError):

@@ -214,12 +214,12 @@ class Controller:
             self.state, metrics = self._step(self.state, action, exo, sub)
 
         # 7. measured app-level SLO metrics, when the source scrapes them
-        #    (live Prometheus p95/RPS/queue depth). Timed as its own stage:
-        #    on a slow endpoint these three blocking queries are the tick's
-        #    dominant cost and must show up in timings_ms.
+        #    (live Prometheus p95/RPS/queue depth; {} for sources without
+        #    an app-metrics path). Timed as its own stage: on a slow
+        #    endpoint these three blocking queries are the tick's dominant
+        #    cost and must show up in timings_ms.
         with timer.stage("slo_scrape"):
-            slo_metrics = (self.source.slo_snapshot()
-                           if hasattr(self.source, "slo_snapshot") else {})
+            slo_metrics = self.source.slo_snapshot()
 
         dt_hr = float(self.params.dt_s) / 3600.0
         profile = ""

@@ -129,6 +129,13 @@ class SignalSource(abc.ABC):
         traces = [self.trace(steps, seed=int(s)) for s in seeds]
         return jax.tree.map(lambda *xs: jnp.stack(xs), *traces)
 
+    def slo_snapshot(self) -> dict:
+        """Measured app-level SLO metrics (p95/RPS/queue depth) for the
+        controller's KPI line. Default: none — only sources with an
+        app-metrics path (live Prometheus) override; absent metrics are
+        omitted rather than fabricated."""
+        return {}
+
 
 def as_f32(x) -> jnp.ndarray:
     """float32 device array; jax inputs stay on device (no numpy round-trip)."""

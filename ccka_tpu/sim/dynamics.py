@@ -19,6 +19,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ccka_tpu.config import LATENCY_CURVE_COEF, LATENCY_RHO_CLIP
 from ccka_tpu.sim.types import (
     CT_OD,
     CT_SPOT,
@@ -213,13 +214,16 @@ def step(params: SimParams,
     # Latency proxy — the app-level p95 the reference named as an SLO input
     # (README.md:21) but never scraped (§2.3: the pipeline carries only
     # kube-state-metrics). An M/M/1-shaped queueing curve over the fleet
-    # load factor: p95 ≈ base · (1 + 3ρ²/(1−ρ)), ρ = demand/capacity
-    # clipped below 1 so overload saturates (~150× base) instead of
-    # diverging. Smooth in capacity, so diff-MPC gradients see latency.
+    # load factor: p95 ≈ base · (1 + c·ρ²/(1−ρ)), ρ = demand/capacity
+    # clipped below 1 so overload saturates (~145× base) instead of
+    # diverging. Constants shared with the config-level SLO-bound
+    # validation (`LATENCY_SATURATION_FACTOR`) so the ceiling check can
+    # never drift from the curve. Smooth in capacity, so diff-MPC
+    # gradients see latency.
     load = exo.demand_pods.sum() / (cap_ct.sum() + _EPS)
-    rho = jnp.clip(load, 0.0, 0.98)
+    rho = jnp.clip(load, 0.0, LATENCY_RHO_CLIP)
     latency_p95_ms = params.latency_base_ms * (
-        1.0 + 3.0 * rho * rho / (1.0 - rho))
+        1.0 + LATENCY_CURVE_COEF * rho * rho / (1.0 - rho))
     queue_depth = pending.sum()
 
     # SLO is judged per class against *raw* demand, not the HPA-scaled

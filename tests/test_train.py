@@ -138,14 +138,15 @@ def test_ppo_backend_decides_feasible_actions(cfg, source):
 
 
 def test_ppo_reward_improves_on_tiny_problem(cfg, source):
-    # Learnability smoke: 12 iterations on a tiny batch should move mean
-    # reward up (or at least not collapse). Loose bound — this is a
-    # mechanics test, not a benchmark.
+    # Learnability: 12 iterations on the tiny fixture must genuinely move
+    # mean reward up. Measured margin is +0.08 across seeds 0-2 on this
+    # exact config; the bound sits at half that, so regression to
+    # "didn't collapse" fails while seed jitter passes.
     trainer = PPOTrainer(cfg)
     ts, history = trainer.train(source, iterations=12, log_every=1)
     first = np.mean([h["mean_reward"] for h in history[:3]])
     last = np.mean([h["mean_reward"] for h in history[-3:]])
-    assert last > first - 0.05  # no collapse; usually improves
+    assert last > first + 0.04
 
 
 def test_checkpoint_round_trip(tmp_path, cfg):
