@@ -74,6 +74,9 @@ def _build_parser() -> argparse.ArgumentParser:
     sr.add_argument("--hpa", action="store_true",
                     help="also realize the policy's HPA lever as "
                          "HorizontalPodAutoscaler objects each tick")
+    sr.add_argument("--keda", action="store_true",
+                    help="also apply a KEDA SQS ScaledObject each tick "
+                         "(needs workload.sqs_queue_name + aws_account_id)")
     sr.add_argument("--seed", type=int, default=0)
     sr.add_argument("--telemetry", default="",
                     help="append per-tick JSONL records (incl. per-phase "
@@ -157,6 +160,13 @@ def _build_parser() -> argparse.ArgumentParser:
     sg.add_argument("--steps", type=int, default=2880,
                     help="ticks to record (default: one day at 30s)")
     sg.add_argument("--seed", type=int, default=0)
+
+    sp = sub.add_parser(
+        "report", help="summarize a controller telemetry JSONL into a "
+                       "session scoreboard (the demo_40 watch dashboard, "
+                       "machine-readable)")
+    sp.add_argument("--telemetry", required=True,
+                    help="JSONL file written by `ccka run --telemetry`")
 
     sub.add_parser("show-config", help="print the resolved config")
     return p
@@ -283,13 +293,18 @@ def _cmd_observe(cfg: FrameworkConfig, backend_name: str,
 
 def _cmd_run(cfg: FrameworkConfig, backend_name: str, checkpoint: str,
              ticks: int, interval: float | None, live: bool,
-             seed: int, hpa: bool = False, telemetry: str = "") -> int:
+             seed: int, hpa: bool = False, keda: bool = False,
+             telemetry: str = "") -> int:
     from ccka_tpu.harness.controller import controller_from_config
 
     backend = make_backend(cfg, backend_name, checkpoint)
-    ctrl = controller_from_config(cfg, backend, live=live,
-                                  interval_s=interval, seed=seed,
-                                  apply_hpa=hpa, telemetry_path=telemetry)
+    try:
+        ctrl = controller_from_config(cfg, backend, live=live,
+                                      interval_s=interval, seed=seed,
+                                      apply_hpa=hpa, apply_keda=keda,
+                                      telemetry_path=telemetry)
+    except ValueError as e:  # e.g. --keda without the SQS config
+        raise SystemExit(f"ccka: {e}")
     try:
         reports = ctrl.run(ticks if ticks > 0 else None)
     finally:
@@ -537,7 +552,20 @@ def main(argv: list[str] | None = None) -> int:
         if args.command == "run":
             return _cmd_run(cfg, args.backend, args.checkpoint, args.ticks,
                             args.interval, args.live, args.seed, args.hpa,
-                            args.telemetry)
+                            args.keda, args.telemetry)
+        if args.command == "report":
+            from ccka_tpu.harness.telemetry import (read_telemetry,
+                                                    summarize_telemetry)
+            try:
+                records = read_telemetry(args.telemetry)
+            except OSError as e:
+                raise SystemExit(f"ccka: cannot read telemetry: {e}")
+            except json.JSONDecodeError as e:
+                # e.g. a partial line from a controller killed mid-write
+                raise SystemExit(f"ccka: corrupt telemetry line in "
+                                 f"{args.telemetry}: {e}")
+            print(json.dumps(summarize_telemetry(records), indent=2))
+            return 0
         if args.command == "train":
             return _cmd_train(cfg, args.backend, args.iterations,
                               args.checkpoint_dir, args.seed, args.log_every)

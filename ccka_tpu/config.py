@@ -251,6 +251,11 @@ class WorkloadConfig:
     # Fraction of pods labeled critical=true — these may never tolerate spot
     # (Kyverno ClusterPolicy `critical-no-spot-without-pdb`, `04_kyverno.sh:47-75`).
     critical_fraction: float = 0.0
+    # KEDA/SQS queue-driven scaling — realizes the reference's stub
+    # (`.env:10-12`: CREATE_SQS=false, SQS_QUEUE_NAME). Both must be set for
+    # the controller's --keda path; empty = disabled, like CREATE_SQS=false.
+    sqs_queue_name: str = ""
+    aws_account_id: str = ""
     # PDB minAvailable=50% on the burst group (`demo_10_setup_configure.sh:46-57`).
     pdb_min_available: float = 0.5
 

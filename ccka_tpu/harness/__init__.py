@@ -18,4 +18,5 @@ from ccka_tpu.harness.telemetry import (  # noqa: F401
     TelemetryWriter,
     profile_trace,
     read_telemetry,
+    summarize_telemetry,
 )

@@ -105,6 +105,7 @@ class Controller:
                  interval_s: float | None = None,
                  seed: int = 0,
                  apply_hpa: bool = False,
+                 apply_keda: bool = False,
                  telemetry_path: str = "",
                  log_fn: Callable[[str], None] | None = None,
                  sleep_fn: Callable[[float], None] = time.sleep):
@@ -132,6 +133,13 @@ class Controller:
         self.interval_s = (cfg.signals.scrape_interval_s
                            if interval_s is None else interval_s)
         self.apply_hpa = apply_hpa
+        self.apply_keda = apply_keda
+        if apply_keda and not (cfg.workload.sqs_queue_name
+                               and cfg.workload.aws_account_id):
+            raise ValueError(
+                "apply_keda requires workload.sqs_queue_name and "
+                "workload.aws_account_id (the reference's CREATE_SQS/"
+                "SQS_QUEUE_NAME stub, `.env:10-12`)")
         self.seed = seed
         self.log_fn = log_fn if log_fn is not None else (
             lambda line: print(line, flush=True))
@@ -196,6 +204,14 @@ class Controller:
                     render_hpa_manifests(action, self.cfg.cluster,
                                          self.cfg.workload,
                                          namespace=self.cfg.workload.namespace))
+            if self.apply_keda:
+                from ccka_tpu.actuation.patches import render_keda_scaledobject
+                wl = self.cfg.workload
+                results.append(self.sink.apply_manifest(
+                    render_keda_scaledobject(
+                        action, wl.sqs_queue_name, wl.aws_account_id,
+                        namespace=wl.namespace,
+                        region=self.cfg.cluster.region)))
             applied = all(r.ok for r in results)
             fallbacks = sum(1 for r in results if r.used_fallback)
 

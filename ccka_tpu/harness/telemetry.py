@@ -97,6 +97,57 @@ def read_telemetry(path: str) -> list[dict]:
     return out
 
 
+def summarize_telemetry(records: list[dict]) -> dict:
+    """Reduce controller tick records to a session scoreboard — the
+    demo_40 watch dashboard (`demo_40_watch_observe.sh:50-110`) as a
+    machine-readable report: SLO attainment, cost/carbon rates, latency,
+    apply/verify health, and per-phase timing distribution.
+    """
+    if not records:
+        return {"ticks": 0}
+
+    def _vals(key):
+        return [float(r[key]) for r in records if key in r]
+
+    def _frac(key):
+        vals = [bool(r.get(key)) for r in records]
+        return sum(vals) / len(vals)
+
+    def _stats(vals):
+        if not vals:
+            return {}
+        arr = sorted(vals)
+        # Nearest-rank p95: ceil(0.95·n)−1. The naive int(0.95·n) is one
+        # rank high and collapses to max for n ≤ 20 — every short session.
+        rank = max(0, -(-95 * len(arr) // 100) - 1)
+        return {"mean": round(sum(arr) / len(arr), 3),
+                "p95": round(arr[rank], 3),
+                "max": round(arr[-1], 3)}
+
+    phases: dict[str, list[float]] = {}
+    for r in records:
+        for phase, ms in (r.get("timings_ms") or {}).items():
+            phases.setdefault(phase, []).append(float(ms))
+
+    peak_ticks = sum(1 for r in records if r.get("is_peak"))
+    return {
+        "ticks": len(records),
+        "peak_ticks": peak_ticks,
+        "slo_attainment": round(_frac("slo_ok"), 4),
+        "applied_frac": round(_frac("applied"), 4),
+        "verified_frac": round(_frac("verified"), 4),
+        "fallbacks": int(sum(_vals("fallbacks"))),
+        "cost_usd_hr": _stats(_vals("cost_usd_hr")),
+        "carbon_g_hr": _stats(_vals("carbon_g_hr")),
+        "latency_p95_ms": _stats(_vals("latency_p95_ms")),
+        "pending_pods": _stats(_vals("pending_pods")),
+        "nodes_spot": _stats(_vals("nodes_spot")),
+        "nodes_od": _stats(_vals("nodes_od")),
+        "timings_ms": {k: _stats(v) for k, v in sorted(phases.items())},
+        "profiles": sorted({r.get("profile", "") for r in records} - {""}),
+    }
+
+
 @contextlib.contextmanager
 def profile_trace(log_dir: str | None) -> Iterator[None]:
     """JAX profiler capture around a block, gated on ``log_dir``.

@@ -95,6 +95,113 @@ class TestControllerTelemetry:
         ctrl.close()  # idempotent
 
 
+class TestSummarizeTelemetry:
+    def test_empty(self):
+        from ccka_tpu.harness.telemetry import summarize_telemetry
+        assert summarize_telemetry([]) == {"ticks": 0}
+
+    def test_scoreboard_from_controller_run(self, tmp_path):
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.harness.telemetry import (read_telemetry,
+                                                summarize_telemetry)
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        cfg = default_config()
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals,
+                                    start_unix_s=8 * 3600 + 59 * 60)
+        path = str(tmp_path / "t.jsonl")
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, DryRunSink(),
+                          interval_s=0.0, telemetry_path=path,
+                          log_fn=lambda _line: None)
+        ctrl.run(ticks=6)
+        ctrl.close()
+
+        board = summarize_telemetry(read_telemetry(path))
+        assert board["ticks"] == 6
+        # The 09:00 peak edge lands inside the run (started 08:59).
+        assert 0 < board["peak_ticks"] < 6
+        assert board["applied_frac"] == 1.0
+        assert board["verified_frac"] == 1.0
+        assert board["cost_usd_hr"]["mean"] > 0
+        assert set(board["profiles"]) == {"offpeak", "peak"}
+        assert board["timings_ms"]["decide"]["p95"] >= 0
+        assert board["latency_p95_ms"]["max"] >= board[
+            "latency_p95_ms"]["mean"]
+
+    def test_p95_is_nearest_rank_not_max(self):
+        from ccka_tpu.harness.telemetry import summarize_telemetry
+
+        # 20 ticks, one outlier: nearest-rank p95 (19th of 20) must pick
+        # the outlier-free tail value, not collapse to max.
+        records = [{"cost_usd_hr": 1.0} for _ in range(19)]
+        records.append({"cost_usd_hr": 100.0})
+        stats = summarize_telemetry(records)["cost_usd_hr"]
+        assert stats["p95"] == 1.0
+        assert stats["max"] == 100.0
+
+    def test_report_cli_rejects_corrupt_line(self, tmp_path):
+        from ccka_tpu.cli import main
+
+        path = str(tmp_path / "bad.jsonl")
+        with open(path, "w") as fh:
+            fh.write('{"t": 0}\n{"t": 1, "cost')  # killed mid-write
+        with pytest.raises(SystemExit, match="corrupt telemetry"):
+            main(["report", "--telemetry", path])
+
+    def test_report_cli(self, tmp_path, capsys):
+        from ccka_tpu.cli import main
+        from ccka_tpu.harness.telemetry import TelemetryWriter
+
+        path = str(tmp_path / "t.jsonl")
+        with TelemetryWriter(path) as w:
+            w.write({"t": 0, "slo_ok": True, "applied": True,
+                     "verified": True, "cost_usd_hr": 0.3,
+                     "timings_ms": {"decide": 1.0}})
+        assert main(["report", "--telemetry", path]) == 0
+        board = json.loads(capsys.readouterr().out)
+        assert board["ticks"] == 1 and board["slo_attainment"] == 1.0
+
+
+class TestKedaApplyPath:
+    def test_controller_applies_scaledobject(self):
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        cfg = default_config().with_overrides(**{
+            "workload.sqs_queue_name": "burst-queue",
+            "workload.aws_account_id": "123456789012"})
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        sink = DryRunSink()
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, sink,
+                          interval_s=0.0, apply_keda=True,
+                          log_fn=lambda _line: None)
+        reports = ctrl.run(ticks=2)
+        assert all(r.applied for r in reports)
+        so = sink.get_object("ScaledObject", "scaled-burst-queue",
+                             namespace="nov-22")
+        assert so["spec"]["triggers"][0]["metadata"]["queueURL"].endswith(
+            "123456789012/burst-queue")
+
+    def test_keda_without_config_rejected(self):
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        cfg = default_config()
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        with pytest.raises(ValueError, match="sqs_queue_name"):
+            Controller(cfg, RulePolicy(cfg.cluster), src, DryRunSink(),
+                       interval_s=0.0, apply_keda=True)
+
+
 class TestProfileTrace:
     def test_noop_without_dir(self):
         with profile_trace(""):
