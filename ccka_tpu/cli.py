@@ -151,6 +151,14 @@ def _build_parser() -> argparse.ArgumentParser:
     ss.add_argument("--profile-dir", default="",
                     help="capture a JAX profiler trace of the rollout into "
                          "this directory (TensorBoard profile plugin)")
+    ss.add_argument("--mesh", action="store_true",
+                    help="shard the cluster batch over all devices "
+                         "(BASELINE config #5 fleet scale; batch must be "
+                         "divisible by the data-axis size)")
+    ss.add_argument("--device-traces", action="store_true",
+                    help="synthesize exogenous traces on device "
+                         "(associative-scan AR(1)) — required pace for "
+                         "10k-cluster batches; synthetic backend only")
 
     sg = sub.add_parser(
         "capture", help="record exogenous signals from the configured "
@@ -323,7 +331,8 @@ def jax_tree_first(tree):
 
 def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
                   clusters: int, seed: int, stochastic: bool,
-                  checkpoint: str = "", profile_dir: str = "") -> int:
+                  checkpoint: str = "", profile_dir: str = "",
+                  mesh: bool = False, device_traces: bool = False) -> int:
     import jax
     import jax.numpy as jnp
 
@@ -343,6 +352,11 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
     else:
         action_fn = make_backend(cfg, backend, checkpoint).action_fn()
 
+    if clusters == 1 and (mesh or device_traces):
+        raise SystemExit("ccka: --mesh/--device-traces are batch-path "
+                         "flags; set --clusters > 1 (they would be "
+                         "silently ignored on the single-cluster path)")
+
     with profile_trace(profile_dir):
         if clusters == 1:
             trace = src.trace(steps, seed=seed)
@@ -351,16 +365,44 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
                                      stochastic=stochastic)
             )(initial_state(cfg), jax.random.key(seed))
         else:
-            traces = jax.tree.map(
-                lambda *xs: jnp.stack(xs),
-                *[src.trace(steps, seed=seed + i) for i in range(clusters)])
+            dev_mesh = None
+            if mesh:
+                from ccka_tpu.parallel import make_mesh
+                dev_mesh = make_mesh(cfg.mesh)
+            if device_traces:
+                # Fleet scale (BASELINE config #5): per-seed host stacking
+                # for a 10k batch is minutes of numpy; the device path
+                # synthesizes the whole [B, T, ...] batch in one jitted
+                # associative-scan program — directly into the mesh's
+                # batch sharding, so the multi-GB batch never materializes
+                # on a single device.
+                if not hasattr(src, "batch_trace_device"):
+                    raise SystemExit(
+                        "ccka: --device-traces requires the synthetic "
+                        "signals backend")
+                out_sharding = None
+                if dev_mesh is not None:
+                    from ccka_tpu.parallel import batch_sharding
+                    out_sharding = batch_sharding(dev_mesh)
+                traces = src.batch_trace_device(
+                    steps, jax.random.key(seed + 7919), clusters,
+                    sharding=out_sharding)
+            else:
+                traces = src.batch_trace(
+                    steps, [seed + i for i in range(clusters)])
             states = jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (clusters,) + x.shape),
                 initial_state(cfg))
             keys = jax.random.split(jax.random.key(seed), clusters)
-            final, metrics = batched_rollout(params, states, action_fn,
-                                             traces, keys,
-                                             stochastic=stochastic)
+            if dev_mesh is not None:
+                from ccka_tpu.parallel.sharded import sharded_batched_rollout
+                final, metrics = sharded_batched_rollout(
+                    dev_mesh, params, states, action_fn, traces, keys,
+                    stochastic=stochastic)
+            else:
+                final, metrics = batched_rollout(params, states, action_fn,
+                                                 traces, keys,
+                                                 stochastic=stochastic)
         jax.block_until_ready(metrics)
     s = summarize(params, metrics)
     import numpy as np
@@ -576,7 +618,8 @@ def main(argv: list[str] | None = None) -> int:
         if args.command == "simulate":
             return _cmd_simulate(cfg, args.backend, args.days, args.clusters,
                                  args.seed, args.stochastic, args.checkpoint,
-                                 args.profile_dir)
+                                 args.profile_dir, args.mesh,
+                                 args.device_traces)
         if args.command == "capture":
             return _cmd_capture(cfg, args.out, args.steps, args.seed)
         if args.command == "preroll":

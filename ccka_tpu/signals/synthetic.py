@@ -166,8 +166,8 @@ class SyntheticSignalSource(SignalSource):
                  rho=0.9, sigma=0.5),
         )
 
-    def batch_trace_device(self, steps: int, key, batch: int
-                           ) -> ExogenousTrace:
+    def batch_trace_device(self, steps: int, key, batch: int,
+                           *, sharding=None) -> ExogenousTrace:
         """[B, T, ...] trace batch synthesized entirely on device.
 
         TPU-native path for training-scale generation: noise comes from
@@ -177,11 +177,16 @@ class SyntheticSignalSource(SignalSource):
         identical family to :meth:`batch_trace` (same diurnal structure,
         same AR(1) ρ/σ) but a different RNG stream, so use one or the other
         within an experiment; keyed reproducibly by ``key``.
+
+        ``sharding`` (e.g. ``batch_sharding(mesh)``) makes the jitted
+        program *produce* every leaf already distributed over the mesh's
+        batch axis — at fleet scale the multi-GB trace batch must never
+        materialize on one device just to be resharded afterwards.
         """
         import jax
         import jax.numpy as jnp
 
-        fn = self._device_fns.get((steps, batch))
+        fn = self._device_fns.get((steps, batch, sharding))
         if fn is None:
             z = self.cluster.n_zones
 
@@ -198,8 +203,8 @@ class SyntheticSignalSource(SignalSource):
             # dispatch every associative_scan stage as its own XLA program
             # (minutes of compile through the TPU tunnel); jitted it is one
             # fused program, ~1s to compile, ~ms to run.
-            fn = jax.jit(generate)
-            self._device_fns[(steps, batch)] = fn
+            fn = jax.jit(generate, out_shardings=sharding)
+            self._device_fns[(steps, batch, sharding)] = fn
         return fn(key)
 
     def _assemble(self, steps: int, noise: tuple, xp=np) -> ExogenousTrace:

@@ -59,6 +59,43 @@ def test_cli_simulate_small(capsys):
     assert 0.0 <= doc["slo_attainment"] <= 1.0
 
 
+def test_cli_simulate_fleet_mesh_device_traces(capsys):
+    """BASELINE config #5 path: batch sharded over the 8-device mesh with
+    device-synthesized traces. 16 clusters / 8 devices = 2 per shard."""
+    assert main(["simulate", "--days", "0.01", "--backend", "carbon",
+                 "--clusters", "16", "--mesh", "--device-traces",
+                 "--stochastic"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["clusters"] == 16
+    assert doc["cost_usd"] > 0
+    assert 0.0 <= doc["slo_attainment"] <= 1.0
+
+
+def test_cli_simulate_fleet_flags_rejected_on_single_cluster():
+    """--mesh/--device-traces only act on the batch path; silently running
+    the single-cluster path instead would fake a fleet benchmark."""
+    with pytest.raises(SystemExit, match="--clusters"):
+        main(["simulate", "--days", "0.01", "--mesh"])
+    with pytest.raises(SystemExit, match="--clusters"):
+        main(["simulate", "--days", "0.01", "--device-traces"])
+
+
+def test_cli_simulate_device_traces_requires_synthetic(tmp_path):
+    from ccka_tpu.signals.replay import save_trace
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+    cfg = default_config()
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    path = str(tmp_path / "t.npz")
+    save_trace(path, src.trace(32), src.meta())
+    with pytest.raises(SystemExit, match="synthetic"):
+        main(["--set", "signals.backend=replay",
+              "--set", f"signals.replay_path={path}",
+              "simulate", "--days", "0.01", "--clusters", "4",
+              "--device-traces"])
+
+
 def test_preroll_passes_offline(capsys):
     cfg = default_config()
     assert run_preroll(cfg, live=False) == 0
