@@ -12,7 +12,9 @@ deterministically for policy training and evaluation on held-out data
 from __future__ import annotations
 
 import json
+import math
 import os
+import warnings
 from typing import Mapping
 
 import numpy as np
@@ -78,8 +80,11 @@ class ReplaySignalSource(SignalSource):
 
     def trace(self, steps: int, *, seed: int = 0) -> ExogenousTrace:
         del seed  # replay is deterministic
+        return self._trace_at(self.offset_steps, steps)
+
+    def _trace_at(self, offset: int, steps: int) -> ExogenousTrace:
         stored = self._trace.steps
-        need = self.offset_steps + steps
+        need = offset + steps
         if need > stored:
             reps = -(-need // stored)  # ceil
             full = ExogenousTrace(*[
@@ -90,7 +95,43 @@ class ReplaySignalSource(SignalSource):
             full = ExogenousTrace(*[as_f32(a) for a in full])
         else:
             full = self._trace
-        return full.slice_steps(self.offset_steps, steps)
+        return full.slice_steps(offset, steps)
+
+    def batch_trace(self, steps: int, seeds) -> ExogenousTrace:
+        """[B, T, ...] batch of *distinct windows* into the stored trace.
+
+        The base default stacks ``trace(steps, seed=s)`` per seed, but
+        replay ignores seeds — that would hand a PPO batch B identical
+        clusters, silently collapsing BASELINE config #3 ("256 clusters
+        vmap'd on replayed traces") to one. Instead seed ``s`` replays
+        from offset ``s·step mod stored`` with ``step`` coprime to the
+        stored length (≈ golden-ratio spacing): a bijection on offsets,
+        so distinct seeds give distinct windows whenever that is possible
+        at all (seeds colliding mod ``stored`` is pigeonhole — warned).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        seeds = [int(s) for s in seeds]
+        stored = self._trace.steps
+        # Multiplier near stored/φ, nudged to coprimality → offset bijection.
+        step = max(1, round(stored * 0.6180339887498949))
+        while math.gcd(step, stored) != 1:
+            step += 1
+        if len({s % stored for s in seeds}) < len(seeds):
+            warnings.warn(
+                f"replay batch_trace: {len(seeds)} seeds over a "
+                f"{stored}-step store must repeat windows (pigeonhole); "
+                "capture a longer trace for a fully distinct batch",
+                stacklevel=2)
+        # Tile the periodic extension ONCE (every offset lies in
+        # [0, stored)), so the per-seed work is pure slicing — not a
+        # device round-trip + re-tile per element.
+        ext = self._trace_at(0, stored + steps)
+        windows = [ext.slice_steps((self.offset_steps + s * step) % stored,
+                                   steps)
+                   for s in seeds]
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *windows)
 
 
 def trace_from_arrays(arrays: Mapping[str, np.ndarray], dt_s: float,
