@@ -38,9 +38,16 @@ def _time_best(fn, repeats: int = 3) -> float:
     return best
 
 
-def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int) -> dict:
+def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int,
+                  summary_batch_sizes=()) -> dict:
+    """Batched rollout sweep. ``batch_sizes`` use the metric-stacking path
+    (per-tick StepMetrics over the horizon); ``summary_batch_sizes`` use
+    the O(B)-memory summarize-in-scan path, which is how fleet-scale
+    scoring actually runs (B=32k × a day OOMs on metric stacking alone).
+    """
     from ccka_tpu.policy import RulePolicy
-    from ccka_tpu.sim import SimParams, batched_rollout, initial_state
+    from ccka_tpu.sim import (SimParams, batched_rollout,
+                              batched_rollout_summary, initial_state)
     from ccka_tpu.signals.synthetic import SyntheticSignalSource
 
     params = SimParams.from_config(cfg)
@@ -49,17 +56,23 @@ def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int) -> dict:
     action_fn = RulePolicy(cfg.cluster).action_fn()
     days_per_traj = horizon_steps * cfg.sim.dt_s / 86400.0
 
-    run = jax.jit(lambda s, tr, k: batched_rollout(
+    run_metrics = jax.jit(lambda s, tr, k: batched_rollout(
+        params, s, action_fn, tr, k, stochastic=True))
+    run_summary = jax.jit(lambda s, tr, k: batched_rollout_summary(
         params, s, action_fn, tr, k, stochastic=True))
 
     results = {}
-    for b in batch_sizes:
-        # Device-side synthesis: setup stays off the host even at B=8192.
+    sweep = ([(b, "metrics") for b in batch_sizes]
+             + [(b, "summary") for b in summary_batch_sizes])
+    for b, mode in sweep:
+        key = f"{b}:{mode}"
+        # Device-side synthesis: setup stays off the host even at B=32768.
         traces = src.batch_trace_device(horizon_steps, jax.random.key(7), b)
         states = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (b,) + x.shape), initial_state(cfg))
         keys = jax.random.split(jax.random.key(0), b)
         states, traces, keys = jax.device_put((states, traces, keys))
+        run = run_summary if mode == "summary" else run_metrics
 
         def once():
             final, _ = run(states, traces, keys)
@@ -67,14 +80,17 @@ def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int) -> dict:
 
         once()  # compile
         dt = _time_best(once, repeats)
-        results[b] = {
+        results[key] = {
+            "batch": b,
             "seconds": dt,
+            "mode": mode,
             "cluster_days_per_sec": b * days_per_traj / dt,
             "cluster_steps_per_sec": b * horizon_steps / dt,
         }
-        print(f"# rollout B={b}: {dt:.3f}s -> "
-              f"{results[b]['cluster_days_per_sec']:,.0f} cluster-days/sec",
+        print(f"# rollout B={b} [{mode}]: {dt:.3f}s -> "
+              f"{results[key]['cluster_days_per_sec']:,.0f} cluster-days/sec",
               file=sys.stderr)
+        del traces, states, keys
     return results
 
 
@@ -168,21 +184,24 @@ def main(argv=None) -> int:
 
     if args.quick:
         batch_sizes, horizon, repeats = [64, 256], 240, 2
+        summary_sizes = [512]
         ppo_iters, plans = 3, 5
         ppo_cfg = default_config().with_overrides(**{
             "train.batch_clusters": 64, "train.unroll_steps": 16})
     else:
         batch_sizes, horizon, repeats = [256, 2048, 8192], 2880, 3
+        summary_sizes = [16384, 32768]
         ppo_iters, plans = 10, 20
         ppo_cfg = default_config()  # config #3: 256 clusters, 64 steps
 
     cfg = default_config()
-    rollout = bench_rollout(cfg, batch_sizes, horizon, repeats)
+    rollout = bench_rollout(cfg, batch_sizes, horizon, repeats,
+                            summary_batch_sizes=summary_sizes)
     ppo = bench_ppo(ppo_cfg, ppo_iters)
     mpc = bench_mpc(cfg, plans)
 
-    best_b = max(rollout, key=lambda b: rollout[b]["cluster_days_per_sec"])
-    headline = rollout[best_b]["cluster_days_per_sec"]
+    best_k = max(rollout, key=lambda k: rollout[k]["cluster_days_per_sec"])
+    headline = rollout[best_k]["cluster_days_per_sec"]
     line = {
         "metric": "sim_cluster_days_per_sec_per_chip",
         "value": round(headline, 1),
@@ -190,9 +209,11 @@ def main(argv=None) -> int:
         "vs_baseline": round(headline / _JUDGE_R1_BASELINE, 3),
         "baseline": f"{_JUDGE_R1_BASELINE:.0f} (judge r1, B=2048, 1 chip)",
         "device": f"{dev.device_kind}/{dev.platform}",
-        "best_batch": best_b,
-        "rollout": {str(b): {k: round(v, 3) for k, v in r.items()}
-                    for b, r in rollout.items()},
+        "best_batch": rollout[best_k]["batch"],
+        "best_mode": rollout[best_k]["mode"],
+        "rollout": {kk: {k: (round(v, 3) if isinstance(v, float) else v)
+                         for k, v in r.items()}
+                    for kk, r in rollout.items()},
         "ppo": {k: round(v, 3) for k, v in ppo.items()},
         "mpc": {k: round(float(v), 3) for k, v in mpc.items()},
     }

@@ -337,8 +337,8 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
     import jax.numpy as jnp
 
     from ccka_tpu.harness.telemetry import profile_trace
-    from ccka_tpu.sim import (SimParams, batched_rollout, initial_state,
-                              rollout, summarize)
+    from ccka_tpu.sim import (SimParams, batched_rollout_summary,
+                              initial_state, rollout, summarize)
     from ccka_tpu.sim.types import Action
     from ccka_tpu.signals.live import make_signal_source
 
@@ -364,6 +364,7 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
                 lambda s, k: rollout(params, s, action_fn, trace, k,
                                      stochastic=stochastic)
             )(initial_state(cfg), jax.random.key(seed))
+            s = summarize(params, metrics)
         else:
             dev_mesh = None
             if mesh:
@@ -394,17 +395,19 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
                 lambda x: jnp.broadcast_to(x, (clusters,) + x.shape),
                 initial_state(cfg))
             keys = jax.random.split(jax.random.key(seed), clusters)
+            # Fleet scoring runs summarize-in-scan: O(B) memory regardless
+            # of horizon, so --clusters 32768 over a day fits one chip.
             if dev_mesh is not None:
-                from ccka_tpu.parallel.sharded import sharded_batched_rollout
-                final, metrics = sharded_batched_rollout(
+                from ccka_tpu.parallel.sharded import (
+                    sharded_batched_rollout_summary)
+                final, s = sharded_batched_rollout_summary(
                     dev_mesh, params, states, action_fn, traces, keys,
                     stochastic=stochastic)
             else:
-                final, metrics = batched_rollout(params, states, action_fn,
-                                                 traces, keys,
-                                                 stochastic=stochastic)
-        jax.block_until_ready(metrics)
-    s = summarize(params, metrics)
+                final, s = batched_rollout_summary(params, states, action_fn,
+                                                   traces, keys,
+                                                   stochastic=stochastic)
+        jax.block_until_ready(s)
     import numpy as np
     report = {k: np.asarray(v).mean().item() for k, v in s._asdict().items()}
     report["backend"] = backend

@@ -53,6 +53,35 @@ def _jitted_rollout(action_fn, stochastic: bool):
                                      action_fn=action_fn))
 
 
+def sharded_batched_rollout_summary(mesh: Mesh,
+                                    params: SimParams,
+                                    states0: ClusterState,
+                                    action_fn,
+                                    traces: ExogenousTrace,
+                                    keys: jax.Array,
+                                    *,
+                                    stochastic: bool = False):
+    """Mesh-sharded summarize-in-scan rollout: per-cluster
+    :class:`~ccka_tpu.sim.metrics.EpisodeSummary` without ever stacking
+    per-tick metrics — the fleet-scoring path at B beyond what metric
+    stacking fits (see `sim/rollout.rollout_summary`)."""
+    params = replicate(mesh, params)
+    states0 = shard_batch(mesh, states0)
+    traces = shard_batch(mesh, traces)
+    keys = shard_batch(mesh, keys)
+    fn = _jitted_summary_rollout(action_fn, stochastic)
+    return fn(params, states0, traces=traces, keys=keys)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_summary_rollout(action_fn, stochastic: bool):
+    from ccka_tpu.sim.rollout import batched_rollout_summary
+
+    return jax.jit(functools.partial(batched_rollout_summary,
+                                     stochastic=stochastic,
+                                     action_fn=action_fn))
+
+
 def shard_ppo_state(mesh: Mesh, ts):
     """Place a PPOTrainState on the mesh: env batch sharded, rest replicated.
 

@@ -39,8 +39,14 @@ from ccka_tpu.sim.types import (  # noqa: F401
 from ccka_tpu.sim.dynamics import step  # noqa: F401
 from ccka_tpu.sim.rollout import (  # noqa: F401
     batched_rollout,
+    batched_rollout_summary,
     initial_state,
     rollout,
     rollout_actions,
+    rollout_summary,
 )
-from ccka_tpu.sim.metrics import EpisodeSummary, summarize  # noqa: F401
+from ccka_tpu.sim.metrics import (  # noqa: F401
+    EpisodeSummary,
+    finalize_summary,
+    summarize,
+)

@@ -13,7 +13,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from ccka_tpu.sim.types import CT_SPOT, SimParams, StepMetrics
+from ccka_tpu.sim.types import CT_SPOT, ClusterState, N_CT, SimParams, StepMetrics
 
 _EPS = 1e-9
 
@@ -36,6 +36,92 @@ class EpisodeSummary(NamedTuple):
     latency_p95_ms_mean: jnp.ndarray  # [] mean p95 proxy over the episode
     latency_p95_ms_max: jnp.ndarray   # [] worst tick p95
     queue_depth_mean: jnp.ndarray     # [] mean pending-pod backlog
+
+
+class SummaryAcc(NamedTuple):
+    """Sufficient statistics for :class:`EpisodeSummary`, carried through a
+    scan so fleet-scale rollouts never materialize per-tick metrics
+    (O(B) memory instead of O(B·T) — see
+    :func:`ccka_tpu.sim.rollout.rollout_summary`). The episode totals the
+    dynamics already fold into :class:`ClusterState` accumulators (cost,
+    carbon, requests, SLO seconds, evictions) are not duplicated here."""
+
+    nodes_ct_sum: jnp.ndarray    # [T_CT] Σ_t active nodes per capacity type
+    served_sum: jnp.ndarray      # [] Σ_t served pods
+    capacity_sum: jnp.ndarray    # [] Σ_t whole-fleet pod capacity
+    waste_sum: jnp.ndarray       # [] Σ_t max(capacity − served, 0)
+    latency_sum: jnp.ndarray     # [] Σ_t p95 proxy
+    latency_max: jnp.ndarray     # [] max_t p95 proxy
+    queue_sum: jnp.ndarray       # [] Σ_t pending backlog
+    interrupts_sum: jnp.ndarray  # [] Σ_t spot reclaims
+
+    @classmethod
+    def zero(cls, params: SimParams) -> "SummaryAcc":
+        z = jnp.float32(0.0)
+        return cls(nodes_ct_sum=jnp.zeros((N_CT,), jnp.float32),
+                   served_sum=z, capacity_sum=z, waste_sum=z,
+                   latency_sum=z, latency_max=z, queue_sum=z,
+                   interrupts_sum=z)
+
+    def update(self, params: SimParams,
+               metrics: StepMetrics) -> "SummaryAcc":
+        nodes_total = metrics.nodes_by_ct.sum()
+        capacity = (nodes_total + params.base_od_nodes) * params.pods_per_node
+        served = metrics.served_pods.sum()
+        return SummaryAcc(
+            nodes_ct_sum=self.nodes_ct_sum + metrics.nodes_by_ct,
+            served_sum=self.served_sum + served,
+            capacity_sum=self.capacity_sum + capacity,
+            waste_sum=self.waste_sum + jnp.maximum(capacity - served, 0.0),
+            latency_sum=self.latency_sum + metrics.latency_p95_ms,
+            latency_max=jnp.maximum(self.latency_max,
+                                    metrics.latency_p95_ms),
+            queue_sum=self.queue_sum + metrics.queue_depth,
+            interrupts_sum=self.interrupts_sum + metrics.interrupted_nodes,
+        )
+
+
+def finalize_summary(params: SimParams, initial: ClusterState,
+                     final: ClusterState, acc: SummaryAcc,
+                     n_ticks: int) -> EpisodeSummary:
+    """Episode KPIs from the state accumulators + scan-carried sufficient
+    statistics — field-for-field identical to :func:`summarize` over the
+    stacked metrics (asserted by `tests/test_sim.py`'s parity test).
+
+    The :class:`ClusterState` accumulators are *lifetime* totals, so the
+    episode's share is the delta against ``initial`` — a warm-started
+    rollout (state carried over from a previous episode) must not inherit
+    the prior episode's cost/SLO/request totals.
+    """
+    dt_hr = params.dt_s / 3600.0
+    t = jnp.float32(n_ticks)
+    cost = final.acc_cost_usd - initial.acc_cost_usd
+    carbon_g = final.acc_carbon_g - initial.acc_carbon_g
+    requests = final.acc_requests - initial.acc_requests
+    slo_ok_s = final.acc_slo_ok_s - initial.acc_slo_ok_s
+    slo_hours = slo_ok_s / 3600.0
+    hours = t * dt_hr
+    node_hours = acc.nodes_ct_sum.sum() * dt_hr
+    spot_hours = acc.nodes_ct_sum[CT_SPOT] * dt_hr
+    return EpisodeSummary(
+        cost_usd=cost,
+        carbon_kg=carbon_g / 1000.0,
+        requests=requests,
+        slo_hours=slo_hours,
+        hours=hours,
+        usd_per_slo_hour=cost / (slo_hours + _EPS),
+        g_co2_per_kreq=carbon_g / (requests / 1000.0 + _EPS),
+        usd_per_kreq=cost / (requests / 1000.0 + _EPS),
+        slo_attainment=slo_ok_s / (t * params.dt_s),
+        mean_nodes=acc.nodes_ct_sum.sum() / t,
+        spot_exposure=spot_hours / (node_hours + _EPS),
+        waste_frac=acc.waste_sum / (acc.capacity_sum + _EPS),
+        evictions=final.acc_evictions - initial.acc_evictions,
+        interruptions=acc.interrupts_sum,
+        latency_p95_ms_mean=acc.latency_sum / t,
+        latency_p95_ms_max=acc.latency_max,
+        queue_depth_mean=acc.queue_sum / t,
+    )
 
 
 def summarize(params: SimParams, metrics: StepMetrics) -> EpisodeSummary:

@@ -116,6 +116,61 @@ def rollout_actions(params: SimParams,
     return final, metrics
 
 
+def rollout_summary(params: SimParams,
+                    state0: ClusterState,
+                    action_fn: ActionFn,
+                    trace: ExogenousTrace,
+                    key: jax.Array,
+                    *,
+                    stochastic: bool = False):
+    """Closed-loop rollout that reduces to episode KPIs *inside* the scan.
+
+    :func:`rollout` materializes per-tick :class:`StepMetrics` stacked over
+    the horizon — O(B·T·fields) HBM writes, which caps the fleet batch
+    (B=32k × one day OOMs a v5e chip on metric stacking alone). This
+    variant carries the summary sufficient statistics in the scan state
+    and emits no per-tick output, so memory is O(B) regardless of horizon
+    — the fleet-scoring path. Returns ``(final_state, EpisodeSummary)``
+    identical (same keys, same dynamics) to
+    ``summarize(params, rollout(...)[1])``.
+    """
+    from ccka_tpu.sim.metrics import SummaryAcc, finalize_summary
+
+    xs = exo_steps(trace)
+    steps = xs.is_peak.shape[0]
+    t0 = jnp.arange(steps, dtype=jnp.int32)
+    acc0 = SummaryAcc.zero(params)
+
+    def body(carry, inp):
+        state, k, acc = carry
+        exo, t = inp
+        k, sub = jax.random.split(k)
+        action = action_fn(state, exo, t)
+        state, metrics = step(params, state, action, exo, sub,
+                              stochastic=stochastic)
+        return (state, k, acc.update(params, metrics)), None
+
+    (final, _, acc), _ = jax.lax.scan(body, (state0, key, acc0), (xs, t0),
+                                      unroll=_UNROLL)
+    return final, finalize_summary(params, state0, final, acc, steps)
+
+
+def batched_rollout_summary(params: SimParams,
+                            states0: ClusterState,
+                            action_fn: ActionFn,
+                            traces: ExogenousTrace,
+                            keys: jax.Array,
+                            *,
+                            stochastic: bool = False):
+    """`vmap` of :func:`rollout_summary` — per-cluster KPI summaries for
+    fleet batches too large to stack per-tick metrics for."""
+    fn = jax.vmap(
+        lambda s, tr, k: rollout_summary(params, s, action_fn, tr, k,
+                                         stochastic=stochastic),
+        in_axes=(0, 0, 0))
+    return fn(states0, traces, keys)
+
+
 def batched_rollout(params: SimParams,
                     states0: ClusterState,
                     action_fn: ActionFn,

@@ -379,3 +379,75 @@ def test_underutil_threshold_gates_aggressive_repack(cfg, params):
 
     # util ~30/45: threshold 0.95 → repack engaged; threshold 0.05 → not.
     assert run(0.95) < run(0.05) - 0.1
+
+
+class TestRolloutSummaryParity:
+    """rollout_summary (O(B) memory, fleet-scoring path) must produce the
+    exact EpisodeSummary that summarize() computes over stacked per-tick
+    metrics — same scan, same key splits, so parity is bitwise-tight."""
+
+    def test_matches_summarize_deterministic(self, cfg, params, trace):
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.sim import rollout_summary
+
+        fn = RulePolicy(cfg.cluster).action_fn()
+        key = jax.random.key(3)
+        s0 = initial_state(cfg)
+        final_a, metrics = rollout(params, s0, fn, trace, key)
+        want = summarize(params, metrics)
+        final_b, got = rollout_summary(params, s0, fn, trace, key)
+        for name in want._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(want, name)), rtol=1e-5, atol=1e-5,
+                err_msg=name)
+        np.testing.assert_allclose(np.asarray(final_b.nodes),
+                                   np.asarray(final_a.nodes), rtol=1e-6)
+
+    def test_warm_start_excludes_prior_episode(self, cfg, params, trace):
+        """Accumulators in ClusterState are lifetime totals; a summary over
+        a warm-started rollout must report only THIS episode's share (and
+        slo_attainment must stay <= 1)."""
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.sim import rollout_summary
+
+        fn = RulePolicy(cfg.cluster).action_fn()
+        key = jax.random.key(5)
+        mid, _ = rollout(params, initial_state(cfg), fn, trace, key)
+        assert float(mid.acc_cost_usd) > 0  # warm state carries totals
+
+        _, metrics = rollout(params, mid, fn, trace, key)
+        want = summarize(params, metrics)
+        _, got = rollout_summary(params, mid, fn, trace, key)
+        for name in want._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(want, name)), rtol=1e-4, atol=1e-4,
+                err_msg=name)
+        assert float(got.slo_attainment) <= 1.0 + 1e-6
+
+    def test_matches_summarize_stochastic_batched(self, cfg, params):
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.sim import batched_rollout_summary
+        from ccka_tpu.signals import SyntheticSignalSource
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        b, t = 4, 48
+        traces = src.batch_trace(t, range(b))
+        states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (b,) + x.shape),
+            initial_state(cfg))
+        keys = jax.random.split(jax.random.key(0), b)
+        fn = RulePolicy(cfg.cluster).action_fn()
+        _, metrics = batched_rollout(params, states, fn, traces, keys,
+                                     stochastic=True)
+        want = summarize(params, metrics)
+        _, got = batched_rollout_summary(params, states, fn, traces, keys,
+                                         stochastic=True)
+        for name in want._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(want, name)), rtol=1e-5, atol=1e-5,
+                err_msg=name)
+        assert got.cost_usd.shape == (b,)
