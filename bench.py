@@ -171,6 +171,63 @@ def bench_mpc(cfg, plans: int) -> dict:
     return out
 
 
+def bench_quality(ppo_iters: int = 30, eval_steps: int = 1440,
+                  n_traces: int = 2) -> dict:
+    """Policy quality vs the rule baseline — the other half of
+    BASELINE.json's metric ("$/SLO-hour & gCO2/req vs rule baseline").
+
+    Trains a short PPO run (synthetic world, training seeds), then scores
+    rule / carbon / ppo on held-out stochastic traces; plus the
+    multi-region check (config #4): carbon-aware zone selection must cut
+    gCO2/kreq on the diverging-carbon fleet at comparable SLO.
+    """
+    from ccka_tpu.config import default_config, multi_region_config
+    from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+    from ccka_tpu.train.evaluate import compare_backends, heldout_traces
+    from ccka_tpu.train.ppo import ppo_train
+
+    cfg = default_config()
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    ppo_backend, _ = ppo_train(cfg, src, ppo_iters)
+    backends = {
+        "rule": RulePolicy(cfg.cluster),
+        "carbon": CarbonAwarePolicy(cfg.cluster),
+        "ppo": ppo_backend,
+    }
+    traces = heldout_traces(src, steps=eval_steps, n=n_traces)
+    board = compare_backends(cfg, backends, traces, stochastic=True)
+
+    mcfg = multi_region_config()
+    msrc = SyntheticSignalSource(mcfg.cluster, mcfg.workload, mcfg.sim,
+                                 mcfg.signals)
+    mboard = compare_backends(
+        mcfg,
+        {"rule": RulePolicy(mcfg.cluster),
+         "carbon": CarbonAwarePolicy(mcfg.cluster)},
+        heldout_traces(msrc, steps=eval_steps, n=1), stochastic=True)
+
+    def pick(r):
+        return {k: round(r[k], 4) for k in (
+            "usd_per_slo_hour", "g_co2_per_kreq", "slo_attainment",
+            "vs_rule_usd_per_slo_hour", "vs_rule_g_co2_per_kreq",
+            "vs_rule_objective") if k in r}
+
+    out = {
+        "ppo_iters": ppo_iters,
+        "eval_steps": eval_steps,
+        **{name: pick(r) for name, r in board.items()},
+        "multiregion_carbon": pick(mboard["carbon"]),
+    }
+    print(f"# quality: ppo vs rule objective="
+          f"{board['ppo'].get('vs_rule_objective', float('nan')):.3f}, "
+          f"multiregion carbon gCO2 ratio="
+          f"{mboard['carbon']['vs_rule_g_co2_per_kreq']:.3f}",
+          file=sys.stderr)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -199,6 +256,9 @@ def main(argv=None) -> int:
                             summary_batch_sizes=summary_sizes)
     ppo = bench_ppo(ppo_cfg, ppo_iters)
     mpc = bench_mpc(cfg, plans)
+    quality = None
+    if not args.quick:
+        quality = bench_quality()
 
     best_k = max(rollout, key=lambda k: rollout[k]["cluster_days_per_sec"])
     headline = rollout[best_k]["cluster_days_per_sec"]
@@ -217,6 +277,8 @@ def main(argv=None) -> int:
         "ppo": {k: round(v, 3) for k, v in ppo.items()},
         "mpc": {k: round(float(v), 3) for k, v in mpc.items()},
     }
+    if quality is not None:
+        line["quality"] = quality
     print(json.dumps(line))
     return 0
 

@@ -56,7 +56,7 @@ class SummaryAcc(NamedTuple):
     interrupts_sum: jnp.ndarray  # [] Σ_t spot reclaims
 
     @classmethod
-    def zero(cls, params: SimParams) -> "SummaryAcc":
+    def zero(cls) -> "SummaryAcc":
         z = jnp.float32(0.0)
         return cls(nodes_ct_sum=jnp.zeros((N_CT,), jnp.float32),
                    served_sum=z, capacity_sum=z, waste_sum=z,

@@ -139,7 +139,7 @@ def rollout_summary(params: SimParams,
     xs = exo_steps(trace)
     steps = xs.is_peak.shape[0]
     t0 = jnp.arange(steps, dtype=jnp.int32)
-    acc0 = SummaryAcc.zero(params)
+    acc0 = SummaryAcc.zero()
 
     def body(carry, inp):
         state, k, acc = carry
