@@ -54,3 +54,47 @@ def test_jitted_day_rollout_on_chip(cfg, accel):
     cost = float(np.asarray(final.acc_cost_usd))
     assert np.isfinite(cost) and 1.0 < cost < 100.0
     assert float(final.acc_slo_ok_s) > 0.0
+
+
+def test_fleet_summary_rollout_on_chip(cfg, accel):
+    """The bench-headline path on the real chip: device-synthesized trace
+    batch + summarize-in-scan fleet rollout, KPIs finite and sane."""
+    from ccka_tpu.sim import batched_rollout_summary
+
+    params = SimParams.from_config(cfg)
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    b, t = 512, 2880
+    traces = src.batch_trace_device(t, jax.random.key(7), b)
+    states = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (b,) + x.shape), initial_state(cfg))
+    keys = jax.random.split(jax.random.key(0), b)
+    _, summary = jax.jit(
+        lambda s, tr, k: batched_rollout_summary(
+            params, s, RulePolicy(cfg.cluster).action_fn(), tr, k,
+            stochastic=True))(states, traces, keys)
+    cost = np.asarray(summary.cost_usd)
+    assert cost.shape == (b,)
+    assert np.isfinite(cost).all() and (cost > 0).all()
+    slo = np.asarray(summary.slo_attainment)
+    assert ((0.0 <= slo) & (slo <= 1.0 + 1e-6)).all()
+
+
+def test_carbon_policy_on_chip(accel):
+    """Multi-region carbon-aware decide + rollout on the accelerator."""
+    from ccka_tpu.config import multi_region_config
+    from ccka_tpu.policy import CarbonAwarePolicy
+    from ccka_tpu.sim import rollout_summary
+
+    mcfg = multi_region_config()
+    params = SimParams.from_config(mcfg)
+    src = SyntheticSignalSource(mcfg.cluster, mcfg.workload, mcfg.sim,
+                                mcfg.signals)
+    trace = src.forecast(1080, 720)  # daytime window
+    fn = CarbonAwarePolicy(mcfg.cluster).action_fn()
+    state0, key = jax.device_put(
+        (initial_state(mcfg), jax.random.key(0)), accel)
+    _, summary = jax.jit(
+        lambda s, k: rollout_summary(params, s, fn, trace, k))(state0, key)
+    assert np.isfinite(float(summary.g_co2_per_kreq))
+    assert float(summary.slo_attainment) > 0.5
