@@ -36,6 +36,8 @@ from ccka_tpu.actuation.sink import (  # noqa: F401
 from ccka_tpu.actuation.bootstrap import (  # noqa: F401
     bootstrap,
     cleanup,
+    ensure_node_role_mapping,
+    karpenter_node_role,
     render_ec2nodeclass_manifest,
     render_nodepool_manifest,
 )

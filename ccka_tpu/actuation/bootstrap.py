@@ -82,6 +82,14 @@ def render_nodepool_manifest(cluster: ClusterConfig,
     }
 
 
+def karpenter_node_role(cluster: ClusterConfig) -> str:
+    """Node IAM role name, `05_karpenter.sh:33-53` convention — the single
+    encoding shared by the EC2NodeClass, the aws-auth mapping and the
+    preroll gate (divergence would launch nodes under one role while
+    mapping another)."""
+    return f"KarpenterNodeRole-{cluster.name}"
+
+
 def render_ec2nodeclass_manifest(cluster: ClusterConfig) -> dict:
     """The EC2NodeClass every NodePool references; discovery by the
     standard `karpenter.sh/discovery=<cluster>` tag convention."""
@@ -92,7 +100,7 @@ def render_ec2nodeclass_manifest(cluster: ClusterConfig) -> dict:
         "metadata": {"name": NODECLASS_NAME},
         "spec": {
             "amiSelectorTerms": [{"alias": "al2023@latest"}],
-            "role": f"KarpenterNodeRole-{cluster.name}",  # 05_karpenter:33
+            "role": karpenter_node_role(cluster),  # 05_karpenter:33
             "subnetSelectorTerms": [{"tags": discovery}],
             "securityGroupSelectorTerms": [{"tags": discovery}],
         },
@@ -109,6 +117,74 @@ def bootstrap(cfg: FrameworkConfig, sink: ActuationSink) -> list[ApplyResult]:
         results.append(
             sink.apply_manifest(render_nodepool_manifest(cfg.cluster, pool)))
     return results
+
+
+def _arn_mapped(map_roles: str, role_arn: str) -> bool:
+    """True iff ``role_arn`` appears as an exact rolearn entry. Substring
+    matching would false-positive on prefix collisions (cluster ``demo1``
+    vs an existing ``KarpenterNodeRole-demo10`` mapping) and skip the very
+    mapping this module exists to add."""
+    for line in map_roles.splitlines():
+        token = line.strip().removeprefix("- ").strip()
+        if token == f"rolearn: {role_arn}":
+            return True
+    return False
+
+
+def _role_mapping_block(role_arn: str) -> str:
+    """One mapRoles entry, the exact block demo_15 patches in (`:55-63`)."""
+    return ("- rolearn: " + role_arn + "\n"
+            "  username: system:node:{{EC2PrivateDNSName}}\n"
+            "  groups:\n"
+            "    - system:bootstrappers\n"
+            "    - system:nodes\n")
+
+
+def ensure_node_role_mapping(cfg: FrameworkConfig, sink: ActuationSink,
+                             *, account_id: str) -> ApplyResult:
+    """Map the Karpenter node role into aws-auth — `demo_15_map_karp_nodes.sh`.
+
+    Without this mapping, Karpenter provisions EC2 instances that can never
+    join the cluster (the failure mode demo_15 exists to prevent, `:5-12`).
+    Same discipline as the reference's ConfigMap fallback path (`:49-72`):
+    grep-check the mapRoles blob for the role, append the mapping block if
+    absent, re-apply, verify by read-back. Idempotent — a present mapping
+    is a no-op success, like the reference's early exit (`:33-36`).
+    """
+    if not account_id:
+        return ApplyResult("configmap/aws-auth", ok=False,
+                           used_fallback=False,
+                           detail="account_id required to form the role ARN")
+    role = karpenter_node_role(cfg.cluster)
+    role_arn = f"arn:aws:iam::{account_id}:role/{role}"
+    cm = sink.get_object("configmap", "aws-auth", namespace="kube-system")
+    if not cm:
+        return ApplyResult("configmap/aws-auth", ok=False,
+                           used_fallback=False,
+                           detail="aws-auth ConfigMap not found (is this an "
+                                  "EKS cluster with kubectl access?)")
+    data = dict(cm.get("data", {}))
+    map_roles = data.get("mapRoles", "") or ""
+    if _arn_mapped(map_roles, role_arn):  # demo_15:33-36 early exit
+        return ApplyResult("configmap/aws-auth", ok=True,
+                           used_fallback=False, detail="already mapped")
+    sep = "" if (not map_roles or map_roles.endswith("\n")) else "\n"
+    data["mapRoles"] = map_roles + sep + _role_mapping_block(role_arn)
+    updated = {**cm, "data": data}
+    updated.setdefault("metadata", {}).setdefault("name", "aws-auth")
+    updated["metadata"].setdefault("namespace", "kube-system")
+    result = sink.apply_manifest(updated)
+    if not result.ok:
+        return result
+    # demo_15:80-85 verify: read back and grep again.
+    back = sink.get_object("configmap", "aws-auth", namespace="kube-system")
+    if not _arn_mapped(back.get("data", {}).get("mapRoles", "") or "",
+                       role_arn):
+        return ApplyResult("configmap/aws-auth", ok=False,
+                           used_fallback=False,
+                           detail="mapping not present after apply")
+    return ApplyResult("configmap/aws-auth", ok=True, used_fallback=False,
+                       detail=f"mapped {role}")
 
 
 def cleanup(cfg: FrameworkConfig, sink: ActuationSink, *,

@@ -92,6 +92,13 @@ def _build_parser() -> argparse.ArgumentParser:
     sb.add_argument("--json", action="store_true",
                     help="print the manifests instead of applying")
 
+    sm = sub.add_parser(
+        "map-nodes", help="map the Karpenter node role into aws-auth so "
+                          "provisioned nodes can join (demo_15 analog)")
+    sm.add_argument("--account-id", required=True,
+                    help="AWS account id owning the node role")
+    sm.add_argument("--live", action="store_true")
+
     sc = sub.add_parser(
         "cleanup", help="teardown in demo_50 order: namespace, NodePools "
                         "first, NodeClaims w/ finalizer scrub")
@@ -563,6 +570,25 @@ def _cmd_burst(cfg: FrameworkConfig, args) -> int:
     return 0 if ok else 1
 
 
+def _cmd_map_nodes(cfg: FrameworkConfig, account_id: str, live: bool) -> int:
+    from ccka_tpu.actuation import DryRunSink, KubectlSink
+    from ccka_tpu.actuation.bootstrap import ensure_node_role_mapping
+
+    sink = KubectlSink() if live else DryRunSink(echo=True)
+    if not live:
+        # Seed a representative aws-auth so the dry-run demonstrates the
+        # patch it WOULD make against a real cluster.
+        sink.objects[("configmap", "kube-system", "aws-auth")] = {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "aws-auth", "namespace": "kube-system"},
+            "data": {"mapRoles": ""},
+        }
+    r = ensure_node_role_mapping(cfg, sink, account_id=account_id)
+    print(f"[{'ok' if r.ok else 'FAILED'}] {r.pool}"
+          + (f" — {r.detail}" if r.detail else ""), file=sys.stderr)
+    return 0 if r.ok else 1
+
+
 def _cmd_cleanup(cfg: FrameworkConfig, live: bool,
                  wipe_nodeclass: bool) -> int:
     from ccka_tpu.actuation import DryRunSink, KubectlSink, cleanup
@@ -631,6 +657,8 @@ def main(argv: list[str] | None = None) -> int:
             return _cmd_bootstrap(cfg, args.live, args.json)
         if args.command == "burst":
             return _cmd_burst(cfg, args)
+        if args.command == "map-nodes":
+            return _cmd_map_nodes(cfg, args.account_id, args.live)
         if args.command == "cleanup":
             return _cmd_cleanup(cfg, args.live, args.wipe_nodeclass)
         if args.command == "show-config":

@@ -8,8 +8,9 @@ in two tiers:
 
 - always: config validity, JAX backend present, simulator compiles a step,
   signal source produces a sane tick;
-- --live additionally: kubectl reachable, both NodePools exist, NodePools
-  currently neutral (consolidationPolicy WhenEmpty, `demo_18:42-55`).
+- --live additionally: both NodePools exist and are neutral
+  (`demo_18:42-55`), zero leftover burst workloads (`demo_18:30-39`), and
+  the Karpenter node role is mapped in aws-auth (`demo_18:67-81`).
 
 Each check returns (ok, detail) and the runner prints a pass/fail table —
 the same contract as the bash gate, machine-checkable from pytest.
@@ -114,6 +115,53 @@ def check_nodepools_live(cfg: FrameworkConfig, runner) -> list[PrerollCheck]:
     return out
 
 
+def check_no_leftover_burst(cfg: FrameworkConfig, runner) -> PrerollCheck:
+    """Zero leftover burst workloads (demo_18:30-39) — a stale burst set
+    would contaminate the scale-out the new run is about to measure."""
+    from ccka_tpu.actuation.burst import BURST_GROUP
+    ns = cfg.workload.namespace
+    rc, got = runner(["kubectl", "get", "deploy", "-n", ns,
+                      "-l", f"group={BURST_GROUP}", "-o", "name"])
+    if rc != 0:
+        # A missing namespace is genuinely clean; any other kubectl failure
+        # (no binary, unreachable API server) must fail the gate — "can't
+        # see the cluster" is not "the cluster is clean".
+        if "NotFound" in got:
+            return PrerollCheck("no-leftover-burst", True,
+                                "namespace absent")
+        return PrerollCheck("no-leftover-burst", False, got[:200],
+                            hint="kubectl unreachable — fix cluster access")
+    leftovers = [ln for ln in got.strip().splitlines() if ln.strip()]
+    if leftovers:
+        return PrerollCheck("no-leftover-burst", False,
+                            f"{len(leftovers)} burst deployment(s) present",
+                            hint="run `ccka burst --delete` (demo_50 subset)")
+    return PrerollCheck("no-leftover-burst", True)
+
+
+def check_aws_auth(cfg: FrameworkConfig, runner) -> PrerollCheck:
+    """Karpenter node role mapped in aws-auth (demo_18:67-81) — without it
+    provisioned nodes never join and every burst pod stays Pending."""
+    import re
+
+    from ccka_tpu.actuation.bootstrap import karpenter_node_role
+    role = karpenter_node_role(cfg.cluster)
+    rc, got = runner(["kubectl", "get", "configmap", "aws-auth",
+                      "-n", "kube-system",
+                      "-o", "jsonpath={.data.mapRoles}"])
+    if rc != 0:
+        return PrerollCheck("aws-auth-mapping", False, got[:200],
+                            hint="is this an EKS cluster with kubectl access?")
+    # Token-terminated match: a bare substring test would pass cluster
+    # `demo1` on another cluster's `KarpenterNodeRole-demo10` entry.
+    if not re.search(re.escape(role) + r"(?![\w-])", got):
+        return PrerollCheck("aws-auth-mapping", False,
+                            f"{role} not in mapRoles",
+                            hint="run `ccka map-nodes --account-id ...` "
+                                 "(demo_15 analog)")
+    return PrerollCheck("aws-auth-mapping", True)
+
+
 def run_preroll(cfg: FrameworkConfig, *, live: bool = False,
                 runner=None, echo: bool = True) -> int:
     """Run all checks; returns 0 iff all pass (exit-code contract of
@@ -126,7 +174,10 @@ def run_preroll(cfg: FrameworkConfig, *, live: bool = False,
     ]
     if live:
         from ccka_tpu.actuation.sink import _subprocess_runner
-        checks.extend(check_nodepools_live(cfg, runner or _subprocess_runner))
+        r = runner or _subprocess_runner
+        checks.extend(check_nodepools_live(cfg, r))
+        checks.append(check_no_leftover_burst(cfg, r))
+        checks.append(check_aws_auth(cfg, r))
 
     ok = True
     for c in checks:

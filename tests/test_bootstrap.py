@@ -225,3 +225,156 @@ def test_cli_bootstrap_then_cleanup_dry_run(capsys):
     out = capsys.readouterr()
     assert "kubectl delete nodepool spot-preferred" in out.out
     assert "ec2nodeclass" in out.out
+
+
+class TestAwsAuthMapping:
+    """demo_15_map_karp_nodes.sh analog: without the node-role mapping,
+    provisioned instances never join (demo_15:5-12)."""
+
+    def _sink_with_aws_auth(self, map_roles=""):
+        from ccka_tpu.actuation import DryRunSink
+        sink = DryRunSink()
+        sink.objects[("configmap", "kube-system", "aws-auth")] = {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "aws-auth", "namespace": "kube-system"},
+            "data": {"mapRoles": map_roles},
+        }
+        return sink
+
+    def test_adds_mapping_and_verifies(self):
+        from ccka_tpu.actuation import ensure_node_role_mapping
+        from ccka_tpu.config import default_config
+
+        cfg = default_config()
+        sink = self._sink_with_aws_auth("- rolearn: arn:aws:iam::1:role/x\n")
+        r = ensure_node_role_mapping(cfg, sink, account_id="123456789012")
+        assert r.ok
+        cm = sink.get_object("configmap", "aws-auth",
+                             namespace="kube-system")
+        roles = cm["data"]["mapRoles"]
+        assert "arn:aws:iam::123456789012:role/KarpenterNodeRole-demo1" in roles
+        assert "system:node:{{EC2PrivateDNSName}}" in roles
+        assert "system:bootstrappers" in roles
+        # Pre-existing mappings survive (the awk-patch discipline,
+        # demo_15:49-72 appends, never rewrites).
+        assert "arn:aws:iam::1:role/x" in roles
+
+    def test_idempotent(self):
+        from ccka_tpu.actuation import ensure_node_role_mapping
+        from ccka_tpu.config import default_config
+
+        cfg = default_config()
+        sink = self._sink_with_aws_auth()
+        assert ensure_node_role_mapping(cfg, sink,
+                                        account_id="123456789012").ok
+        r2 = ensure_node_role_mapping(cfg, sink, account_id="123456789012")
+        assert r2.ok and r2.detail == "already mapped"
+        roles = sink.get_object("configmap", "aws-auth",
+                                namespace="kube-system")["data"]["mapRoles"]
+        assert roles.count("KarpenterNodeRole-demo1") == 1
+
+    def test_missing_configmap_fails(self):
+        from ccka_tpu.actuation import DryRunSink, ensure_node_role_mapping
+        from ccka_tpu.config import default_config
+
+        r = ensure_node_role_mapping(default_config(), DryRunSink(),
+                                     account_id="123456789012")
+        assert not r.ok and "not found" in r.detail
+
+    def test_cli_dry_run(self, capsys):
+        from ccka_tpu.cli import main
+
+        assert main(["map-nodes", "--account-id", "123456789012"]) == 0
+        assert "[ok] configmap/aws-auth" in capsys.readouterr().err
+
+
+class TestPrerollLiveGates:
+    """The demo_18 live assertions added this round: leftover burst
+    workloads (:30-39) and the aws-auth mapping (:67-81)."""
+
+    def _runner(self, responses):
+        def runner(argv):
+            key = " ".join(argv)
+            for frag, (rc, out) in responses.items():
+                if frag in key:
+                    return rc, out
+            return 0, "WhenEmpty"
+        return runner
+
+    def test_leftover_burst_fails_gate(self):
+        from ccka_tpu.config import default_config
+        from ccka_tpu.harness.preroll import check_no_leftover_burst
+
+        cfg = default_config()
+        bad = self._runner({"get deploy": (0, "deployment.apps/burst-web-1\n")})
+        c = check_no_leftover_burst(cfg, bad)
+        assert not c.ok and "ccka burst --delete" in c.hint
+        clean = self._runner({"get deploy": (0, "")})
+        assert check_no_leftover_burst(cfg, clean).ok
+
+    def test_aws_auth_gate(self):
+        from ccka_tpu.config import default_config
+        from ccka_tpu.harness.preroll import check_aws_auth
+
+        cfg = default_config()
+        unmapped = self._runner({"configmap aws-auth": (0, "- rolearn: other\n")})
+        c = check_aws_auth(cfg, unmapped)
+        assert not c.ok and "map-nodes" in c.hint
+        mapped = self._runner({"configmap aws-auth":
+                               (0, "- rolearn: arn:aws:iam::1:role/"
+                                   "KarpenterNodeRole-demo1\n")})
+        assert check_aws_auth(cfg, mapped).ok
+
+    def test_live_preroll_includes_new_gates(self):
+        from ccka_tpu.config import default_config
+        from ccka_tpu.harness.preroll import run_preroll
+
+        ok_runner = self._runner({
+            "get deploy": (0, ""),
+            "configmap aws-auth": (0, "KarpenterNodeRole-demo1"),
+        })
+        assert run_preroll(default_config(), live=True, runner=ok_runner,
+                           echo=False) == 0
+
+
+class TestMappingPrefixCollisions:
+    """Exact-token matching: `demo1` must not be satisfied by another
+    cluster's `KarpenterNodeRole-demo10` entry (prefix collision)."""
+
+    def test_ensure_mapping_ignores_prefix_collision(self):
+        from ccka_tpu.actuation import DryRunSink, ensure_node_role_mapping
+        from ccka_tpu.config import default_config
+
+        sink = DryRunSink()
+        sink.objects[("configmap", "kube-system", "aws-auth")] = {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "aws-auth", "namespace": "kube-system"},
+            "data": {"mapRoles": "- rolearn: arn:aws:iam::123456789012:"
+                                 "role/KarpenterNodeRole-demo10\n"},
+        }
+        r = ensure_node_role_mapping(default_config(), sink,
+                                     account_id="123456789012")
+        assert r.ok and r.detail != "already mapped"
+        roles = sink.get_object("configmap", "aws-auth",
+                                namespace="kube-system")["data"]["mapRoles"]
+        assert "role/KarpenterNodeRole-demo1\n" in roles
+
+    def test_preroll_gate_rejects_prefix_collision(self):
+        from ccka_tpu.config import default_config
+        from ccka_tpu.harness.preroll import check_aws_auth
+
+        def runner(argv):
+            return 0, "- rolearn: arn:aws:iam::1:role/KarpenterNodeRole-demo10"
+        assert not check_aws_auth(default_config(), runner).ok
+
+    def test_burst_gate_fails_on_unreachable_kubectl(self):
+        from ccka_tpu.config import default_config
+        from ccka_tpu.harness.preroll import check_no_leftover_burst
+
+        def broken(argv):
+            return 127, "kubectl: command not found"
+        c = check_no_leftover_burst(default_config(), broken)
+        assert not c.ok
+        def notfound(argv):
+            return 1, 'Error from server (NotFound): namespaces "nov-22"'
+        assert check_no_leftover_burst(default_config(), notfound).ok

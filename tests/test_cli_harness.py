@@ -107,16 +107,25 @@ def test_preroll_passes_offline(capsys):
 def test_preroll_live_checks_with_fake_kubectl():
     cfg = default_config()
 
-    def neutral_runner(argv):
-        return 0, "WhenEmpty"
+    def healthy_env(policy):
+        # One fake kubectl serving all three live gates: NodePool
+        # disruption reads, leftover-burst listing, aws-auth mapRoles.
+        def runner(argv):
+            joined = " ".join(argv)
+            if "get deploy" in joined:
+                return 0, ""
+            if "configmap aws-auth" in joined:
+                return 0, "- rolearn: arn:aws:iam::1:role/KarpenterNodeRole-demo1"
+            return 0, policy
+        return runner
 
-    assert run_preroll(cfg, live=True, runner=neutral_runner, echo=False) == 0
-
-    def hot_runner(argv):
-        return 0, "WhenEmptyOrUnderutilized"
+    assert run_preroll(cfg, live=True, runner=healthy_env("WhenEmpty"),
+                       echo=False) == 0
 
     # demo_18:42-55 — non-neutral pools must fail the gate
-    assert run_preroll(cfg, live=True, runner=hot_runner, echo=False) == 1
+    assert run_preroll(cfg, live=True,
+                       runner=healthy_env("WhenEmptyOrUnderutilized"),
+                       echo=False) == 1
 
     def missing_runner(argv):
         return 1, "Error from server (NotFound)"
