@@ -119,14 +119,32 @@ def bootstrap(cfg: FrameworkConfig, sink: ActuationSink) -> list[ApplyResult]:
     return results
 
 
-def _arn_mapped(map_roles: str, role_arn: str) -> bool:
-    """True iff ``role_arn`` appears as an exact rolearn entry. Substring
-    matching would false-positive on prefix collisions (cluster ``demo1``
-    vs an existing ``KarpenterNodeRole-demo10`` mapping) and skip the very
-    mapping this module exists to add."""
+def mapped_role_arns(map_roles: str) -> list[str]:
+    """All rolearn values in a mapRoles blob, unquoted — the one parser
+    shared by the mapping writer and the preroll gate, so the two can
+    never disagree about the same ConfigMap."""
+    arns = []
     for line in map_roles.splitlines():
         token = line.strip().removeprefix("- ").strip()
-        if token == f"rolearn: {role_arn}":
+        if token.startswith("rolearn:"):
+            value = token[len("rolearn:"):].strip().strip("'\"")
+            if value:
+                arns.append(value)
+    return arns
+
+
+def role_mapped(map_roles: str, *, role_arn: str | None = None,
+                role_name: str | None = None) -> bool:
+    """True iff a rolearn entry matches exactly. ``role_arn`` compares the
+    full ARN; ``role_name`` compares the ARN's trailing role segment
+    (for callers like preroll that don't know the account id). Exact
+    matching, never substrings — a prefix collision (cluster ``demo1`` vs
+    ``KarpenterNodeRole-demo10``) or the role name appearing in a
+    username/groups value must not count as mapped."""
+    for arn in mapped_role_arns(map_roles):
+        if role_arn is not None and arn == role_arn:
+            return True
+        if role_name is not None and arn.rsplit("/", 1)[-1] == role_name:
             return True
     return False
 
@@ -165,7 +183,7 @@ def ensure_node_role_mapping(cfg: FrameworkConfig, sink: ActuationSink,
                                   "EKS cluster with kubectl access?)")
     data = dict(cm.get("data", {}))
     map_roles = data.get("mapRoles", "") or ""
-    if _arn_mapped(map_roles, role_arn):  # demo_15:33-36 early exit
+    if role_mapped(map_roles, role_arn=role_arn):  # demo_15:33-36 early exit
         return ApplyResult("configmap/aws-auth", ok=True,
                            used_fallback=False, detail="already mapped")
     sep = "" if (not map_roles or map_roles.endswith("\n")) else "\n"
@@ -178,8 +196,8 @@ def ensure_node_role_mapping(cfg: FrameworkConfig, sink: ActuationSink,
         return result
     # demo_15:80-85 verify: read back and grep again.
     back = sink.get_object("configmap", "aws-auth", namespace="kube-system")
-    if not _arn_mapped(back.get("data", {}).get("mapRoles", "") or "",
-                       role_arn):
+    if not role_mapped(back.get("data", {}).get("mapRoles", "") or "",
+                       role_arn=role_arn):
         return ApplyResult("configmap/aws-auth", ok=False,
                            used_fallback=False,
                            detail="mapping not present after apply")

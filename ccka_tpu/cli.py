@@ -183,6 +183,13 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--telemetry", required=True,
                     help="JSONL file written by `ccka run --telemetry`")
 
+    sd = sub.add_parser(
+        "dashboard", help="render/apply Grafana provisioning for the "
+                          "proposal's planned panels (demo_40 analog)")
+    sd.add_argument("--live", action="store_true")
+    sd.add_argument("--json", action="store_true",
+                    help="print the ConfigMaps instead of applying")
+
     sub.add_parser("show-config", help="print the resolved config")
     return p
 
@@ -313,12 +320,17 @@ def _cmd_run(cfg: FrameworkConfig, backend_name: str, checkpoint: str,
     from ccka_tpu.harness.controller import controller_from_config
 
     backend = make_backend(cfg, backend_name, checkpoint)
+    from ccka_tpu.harness.controller import ControllerLockHeld
     try:
+        # lock=live: only live daemons take the per-cluster single-writer
+        # lock (two dry-run sims use in-memory sinks and cannot conflict).
         ctrl = controller_from_config(cfg, backend, live=live,
                                       interval_s=interval, seed=seed,
                                       apply_hpa=hpa, apply_keda=keda,
-                                      telemetry_path=telemetry)
+                                      lock=live, telemetry_path=telemetry)
     except ValueError as e:  # e.g. --keda without the SQS config
+        raise SystemExit(f"ccka: {e}")
+    except ControllerLockHeld as e:
         raise SystemExit(f"ccka: {e}")
     try:
         reports = ctrl.run(ticks if ticks > 0 else None)
@@ -624,6 +636,21 @@ def main(argv: list[str] | None = None) -> int:
             return _cmd_run(cfg, args.backend, args.checkpoint, args.ticks,
                             args.interval, args.live, args.seed, args.hpa,
                             args.keda, args.telemetry)
+        if args.command == "dashboard":
+            from ccka_tpu.actuation import DryRunSink, KubectlSink
+            from ccka_tpu.harness.dashboard import render_dashboard_configmap
+            docs = render_dashboard_configmap(cfg.signals.prometheus_url,
+                                              cfg.workload.namespace)
+            if args.json:
+                print(json.dumps(docs, indent=2))
+                return 0
+            sink = KubectlSink() if args.live else DryRunSink(echo=True)
+            results = sink.apply_manifests(docs)
+            ok = all(r.ok for r in results)
+            print(f"[{'ok' if ok else 'err'}] dashboard provisioning "
+                  f"{'applied' if args.live else 'rendered (dry-run)'}",
+                  file=sys.stderr)
+            return 0 if ok else 1
         if args.command == "report":
             from ccka_tpu.harness.telemetry import (read_telemetry,
                                                     summarize_telemetry)

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
+import tempfile
 import time
 from typing import Callable, Sequence
 
@@ -73,6 +75,66 @@ class TickReport:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
 
 
+class ControllerLockHeld(RuntimeError):
+    """Another controller daemon holds this cluster's single-writer lock."""
+
+
+class ControllerLock:
+    """Advisory single-writer lock per cluster — the race guard.
+
+    The reference's concurrency discipline is ad hoc: port-collision
+    preflight (`demo_18_preroll_check.sh:58-65`) and killing stale
+    port-forwards (`demo_19_reset_policies.sh:39-55`); nothing stops two
+    operators applying demo_20 and demo_21 simultaneously, which would
+    ping-pong the NodePool disruption settings and churn real nodes. Two
+    controller daemons on one cluster are the same hazard, so the
+    controller takes an exclusive `flock` on a per-cluster lockfile; a
+    second instance fails fast (:class:`ControllerLockHeld`, with the
+    holder's pid) instead of silently interleaving patches.
+
+    The lockfile is never unlinked: removing it on release would let a
+    waiter that already opened the old inode lock it while a third opener
+    locks a fresh file at the same path — two "exclusive" holders (the
+    classic flock-unlink race). The default lock dir is per-uid so a
+    second user's daemon gets the lock-held diagnostic, not an
+    unrelated PermissionError on another user's directory.
+    """
+
+    def __init__(self, cluster_name: str, *, lock_dir: str | None = None):
+        d = lock_dir or os.path.join(tempfile.gettempdir(),
+                                     f"ccka-locks-{os.getuid()}")
+        os.makedirs(d, exist_ok=True)
+        self.path = os.path.join(d, f"controller-{cluster_name}.lock")
+        self._fh = None
+
+    def acquire(self) -> None:
+        import fcntl
+
+        fh = open(self.path, "a+")
+        try:
+            fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            fh.seek(0)
+            holder = fh.read().strip() or "unknown pid"
+            fh.close()
+            raise ControllerLockHeld(
+                f"another controller holds {self.path} ({holder}); two "
+                "control loops on one cluster would ping-pong NodePool "
+                "patches — stop the other instance first")
+        fh.truncate(0)
+        fh.write(f"pid={os.getpid()}\n")
+        fh.flush()
+        self._fh = fh
+
+    def release(self) -> None:
+        if self._fh is not None:
+            import fcntl
+
+            fcntl.flock(self._fh, fcntl.LOCK_UN)
+            self._fh.close()
+            self._fh = None
+
+
 def _verify_pool(observed: dict, ps) -> bool:
     """Rendered intent vs sink read-back (never vs what we meant to send)."""
     want_policy = ps.disruption_merge["spec"]["disruption"][
@@ -106,6 +168,8 @@ class Controller:
                  seed: int = 0,
                  apply_hpa: bool = False,
                  apply_keda: bool = False,
+                 lock: bool = False,
+                 lock_dir: str | None = None,
                  telemetry_path: str = "",
                  log_fn: Callable[[str], None] | None = None,
                  sleep_fn: Callable[[float], None] = time.sleep):
@@ -152,6 +216,12 @@ class Controller:
         if telemetry_path:
             from ccka_tpu.harness.telemetry import TelemetryWriter
             self.telemetry = TelemetryWriter(telemetry_path)
+        # Single-writer guard (see ControllerLock): on for daemons, off for
+        # in-process test harnesses that drive ticks directly.
+        self._lock = None
+        if lock:
+            self._lock = ControllerLock(cfg.cluster.name, lock_dir=lock_dir)
+            self._lock.acquire()
         self._step = jax.jit(
             lambda s, a, e, k: sim_step(self.params, s, a, e, k,
                                         stochastic=False))
@@ -294,6 +364,9 @@ class Controller:
         if self.telemetry is not None:
             self.telemetry.close()
             self.telemetry = None
+        if self._lock is not None:
+            self._lock.release()
+            self._lock = None
 
 
 def controller_from_config(cfg: FrameworkConfig, backend: PolicyBackend,

@@ -1,0 +1,105 @@
+"""Dashboard rendering — the demo_40 observability stage as manifests.
+
+The reference deploys a namespace-local Grafana and provisions it with an
+AMP datasource ConfigMap (`demo_40_watch_config.sh:51-72,75-138`), then
+port-forwards dashboards for the operator (`demo_40_watch_observe.sh`).
+The proposal names the dashboards it wanted: "SLO burn, $/1k req,
+gCO2e/1k req, waste%, Spot exposure" (proposal PDF p.5) — none were built.
+
+This module renders both halves as declarative objects:
+
+- :func:`render_datasource_configmap` — the Grafana datasource provisioning
+  ConfigMap pointed at any Prometheus-compatible endpoint (the SigV4-proxy
+  AMP URL in the reference's case);
+- :func:`render_dashboard` — a Grafana dashboard JSON with exactly the
+  proposal's panels, fed by the controller's exported metric names (the
+  telemetry JSONL fields double as the metric vocabulary).
+
+Both apply through any ActuationSink (`kubectl apply -f` equivalents), so
+`ccka dashboard --live` is the whole demo_40 configure stage.
+"""
+
+from __future__ import annotations
+
+import json
+
+_PANEL_DEFS = (
+    # (title, expr, unit) — expr uses the controller's exported series
+    # names; on a live stack these come from scraping the telemetry JSONL
+    # (or remote-writing TickReports) into Prometheus.
+    ("Cost rate", "ccka_cost_usd_hr", "currencyUSD"),
+    ("Carbon rate", "ccka_carbon_g_hr", "massg"),
+    ("SLO burn", "1 - ccka_slo_ok", "percentunit"),
+    ("$ per 1k requests", "ccka_usd_per_kreq", "currencyUSD"),
+    ("gCO2e per 1k requests", "ccka_g_co2_per_kreq", "massg"),
+    ("Waste %", "ccka_waste_frac", "percentunit"),
+    ("Spot exposure", "ccka_nodes_spot / clamp_min(ccka_nodes_spot + "
+     "ccka_nodes_od, 1)", "percentunit"),
+    ("p95 latency", "ccka_latency_p95_ms", "ms"),
+    ("Pending pods", "ccka_pending_pods", "short"),
+)
+
+
+def render_dashboard(title: str = "CCKA autoscaler") -> dict:
+    """Grafana dashboard JSON: the proposal's planned panels, realized."""
+    panels = []
+    for i, (name, expr, unit) in enumerate(_PANEL_DEFS):
+        panels.append({
+            "id": i + 1,
+            "title": name,
+            "type": "timeseries",
+            "gridPos": {"h": 8, "w": 8, "x": (i % 3) * 8,
+                        "y": (i // 3) * 8},
+            "fieldConfig": {"defaults": {"unit": unit}},
+            "targets": [{"expr": expr, "refId": "A"}],
+        })
+    return {
+        "title": title,
+        "uid": "ccka-autoscaler",
+        "timezone": "utc",
+        "refresh": "30s",  # the scrape cadence, 06_opencost.sh:323
+        "panels": panels,
+        "schemaVersion": 39,
+    }
+
+
+def render_datasource_configmap(prometheus_url: str,
+                                namespace: str = "nov-22") -> dict:
+    """Grafana datasource provisioning ConfigMap —
+    `demo_40_watch_config.sh:51-72` with the AMP-via-SigV4-proxy URL
+    generalized to any Prometheus-compatible endpoint."""
+    datasource = {
+        "apiVersion": 1,
+        "datasources": [{
+            "name": "ccka-prometheus",
+            "type": "prometheus",
+            "access": "proxy",
+            "url": prometheus_url,
+            "isDefault": True,
+            "jsonData": {"timeInterval": "30s"},
+        }],
+    }
+    return {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "ccka-grafana-datasource",
+                     "namespace": namespace,
+                     "labels": {"grafana_datasource": "1"}},
+        "data": {"ccka-datasource.yaml": json.dumps(datasource, indent=2)},
+    }
+
+
+def render_dashboard_configmap(prometheus_url: str,
+                               namespace: str = "nov-22") -> list[dict]:
+    """Both provisioning objects: datasource + dashboard ConfigMaps (the
+    dashboard rides the standard `grafana_dashboard: "1"` sidecar label)."""
+    return [
+        render_datasource_configmap(prometheus_url, namespace),
+        {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "ccka-grafana-dashboard",
+                         "namespace": namespace,
+                         "labels": {"grafana_dashboard": "1"}},
+            "data": {"ccka-dashboard.json":
+                     json.dumps(render_dashboard(), indent=2)},
+        },
+    ]

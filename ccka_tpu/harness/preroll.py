@@ -142,9 +142,7 @@ def check_no_leftover_burst(cfg: FrameworkConfig, runner) -> PrerollCheck:
 def check_aws_auth(cfg: FrameworkConfig, runner) -> PrerollCheck:
     """Karpenter node role mapped in aws-auth (demo_18:67-81) — without it
     provisioned nodes never join and every burst pod stays Pending."""
-    import re
-
-    from ccka_tpu.actuation.bootstrap import karpenter_node_role
+    from ccka_tpu.actuation.bootstrap import karpenter_node_role, role_mapped
     role = karpenter_node_role(cfg.cluster)
     rc, got = runner(["kubectl", "get", "configmap", "aws-auth",
                       "-n", "kube-system",
@@ -152,9 +150,9 @@ def check_aws_auth(cfg: FrameworkConfig, runner) -> PrerollCheck:
     if rc != 0:
         return PrerollCheck("aws-auth-mapping", False, got[:200],
                             hint="is this an EKS cluster with kubectl access?")
-    # Token-terminated match: a bare substring test would pass cluster
-    # `demo1` on another cluster's `KarpenterNodeRole-demo10` entry.
-    if not re.search(re.escape(role) + r"(?![\w-])", got):
+    # Shared matcher with ensure_node_role_mapping: exact rolearn entries
+    # only (no prefix collisions, no username/groups false positives).
+    if not role_mapped(got, role_name=role):
         return PrerollCheck("aws-auth-mapping", False,
                             f"{role} not in mapRoles",
                             hint="run `ccka map-nodes --account-id ...` "

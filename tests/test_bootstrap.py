@@ -331,7 +331,8 @@ class TestPrerollLiveGates:
 
         ok_runner = self._runner({
             "get deploy": (0, ""),
-            "configmap aws-auth": (0, "KarpenterNodeRole-demo1"),
+            "configmap aws-auth": (0, "- rolearn: arn:aws:iam::1:role/"
+                                      "KarpenterNodeRole-demo1"),
         })
         assert run_preroll(default_config(), live=True, runner=ok_runner,
                            echo=False) == 0
@@ -378,3 +379,17 @@ class TestMappingPrefixCollisions:
         def notfound(argv):
             return 1, 'Error from server (NotFound): namespaces "nov-22"'
         assert check_no_leftover_burst(default_config(), notfound).ok
+
+
+def test_role_matcher_handles_quotes_and_rejects_nonarn_mentions():
+    """Shared matcher edge cases: quoted rolearn values count; the role
+    name appearing in a username/groups value does not."""
+    from ccka_tpu.actuation.bootstrap import role_mapped
+
+    quoted = '- rolearn: "arn:aws:iam::1:role/KarpenterNodeRole-demo1"\n'
+    assert role_mapped(quoted, role_name="KarpenterNodeRole-demo1")
+    assert role_mapped(quoted,
+                       role_arn="arn:aws:iam::1:role/KarpenterNodeRole-demo1")
+    stray = ("- rolearn: arn:aws:iam::1:role/other\n"
+             "  username: KarpenterNodeRole-demo1\n")
+    assert not role_mapped(stray, role_name="KarpenterNodeRole-demo1")

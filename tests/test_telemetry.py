@@ -238,3 +238,41 @@ class TestCaptureCLI:
             np.asarray(replay.trace(64).carbon_g_kwh),
             np.asarray(synth.trace(64, seed=0).carbon_g_kwh), rtol=1e-6)
         assert replay.meta().zones == cfg.cluster.zones
+
+
+class TestDashboard:
+    """demo_40 analog: Grafana provisioning for the proposal's planned
+    panels ("SLO burn, $/1k req, gCO2e/1k req, waste%, Spot exposure")."""
+
+    def test_dashboard_has_proposal_panels(self):
+        from ccka_tpu.harness.dashboard import render_dashboard
+
+        dash = render_dashboard()
+        titles = {p["title"] for p in dash["panels"]}
+        for wanted in ("SLO burn", "$ per 1k requests",
+                       "gCO2e per 1k requests", "Waste %", "Spot exposure"):
+            assert wanted in titles
+        assert dash["refresh"] == "30s"  # the scrape cadence
+
+    def test_provisioning_configmaps_apply(self):
+        from ccka_tpu.actuation import DryRunSink
+        from ccka_tpu.harness.dashboard import render_dashboard_configmap
+
+        sink = DryRunSink()
+        docs = render_dashboard_configmap("http://prom:9090", "nov-22")
+        results = sink.apply_manifests(docs)
+        assert all(r.ok for r in results)
+        ds = sink.get_object("ConfigMap", "ccka-grafana-datasource",
+                             namespace="nov-22")
+        assert "http://prom:9090" in ds["data"]["ccka-datasource.yaml"]
+        dash = sink.get_object("ConfigMap", "ccka-grafana-dashboard",
+                               namespace="nov-22")
+        assert json.loads(dash["data"]["ccka-dashboard.json"])["uid"] == (
+            "ccka-autoscaler")
+
+    def test_cli_dashboard_json(self, capsys):
+        from ccka_tpu.cli import main
+
+        assert main(["dashboard", "--json"]) == 0
+        docs = json.loads(capsys.readouterr().out)
+        assert [d["kind"] for d in docs] == ["ConfigMap", "ConfigMap"]
