@@ -41,6 +41,12 @@ from ccka_tpu.actuation.bootstrap import (  # noqa: F401
     render_ec2nodeclass_manifest,
     render_nodepool_manifest,
 )
+from ccka_tpu.actuation.guardrails import (  # noqa: F401
+    apply_guardrails,
+    render_critical_no_spot,
+    render_guardrails,
+    render_require_requests_limits,
+)
 from ccka_tpu.actuation.burst import (  # noqa: F401
     apply_burst,
     burst_status,

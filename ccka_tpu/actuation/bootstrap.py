@@ -120,17 +120,19 @@ def bootstrap(cfg: FrameworkConfig, sink: ActuationSink) -> list[ApplyResult]:
 
 
 def mapped_role_arns(map_roles: str) -> list[str]:
-    """All rolearn values in a mapRoles blob, unquoted — the one parser
-    shared by the mapping writer and the preroll gate, so the two can
-    never disagree about the same ConfigMap."""
-    arns = []
-    for line in map_roles.splitlines():
-        token = line.strip().removeprefix("- ").strip()
-        if token.startswith("rolearn:"):
-            value = token[len("rolearn:"):].strip().strip("'\"")
-            if value:
-                arns.append(value)
-    return arns
+    """All rolearn values in a mapRoles blob — the one parser shared by
+    the mapping writer and the preroll gate, so the two can never disagree
+    about the same ConfigMap. Tolerant of every encoding
+    aws-iam-authenticator accepts: block-style YAML (what demo_15 and
+    this module write), flow mappings (``- {rolearn: ..., username: ...}``)
+    and JSON strings (``"rolearn": "arn:..."``)."""
+    import re
+
+    # "," is excluded from the value class: IAM technically allows it in
+    # role names, but in flow mappings it is the entry delimiter — and a
+    # comma'd role name in aws-auth is unheard of.
+    return [m.group(1) for m in re.finditer(
+        r"rolearn[\"']?\s*:\s*[\"']?([A-Za-z0-9:/._+=@-]+)", map_roles)]
 
 
 def role_mapped(map_roles: str, *, role_arn: str | None = None,

@@ -92,6 +92,14 @@ def _build_parser() -> argparse.ArgumentParser:
     sb.add_argument("--json", action="store_true",
                     help="print the manifests instead of applying")
 
+    sg2 = sub.add_parser(
+        "guardrails", help="apply the Kyverno admission ClusterPolicies "
+                           "(04_kyverno analog: require-requests-limits, "
+                           "critical-no-spot)")
+    sg2.add_argument("--live", action="store_true")
+    sg2.add_argument("--json", action="store_true",
+                     help="print the ClusterPolicies instead of applying")
+
     sm = sub.add_parser(
         "map-nodes", help="map the Karpenter node role into aws-auth so "
                           "provisioned nodes can join (demo_15 analog)")
@@ -540,6 +548,22 @@ def _cmd_bootstrap(cfg: FrameworkConfig, live: bool, as_json: bool) -> int:
     return 0 if ok else 1
 
 
+def _apply_docs(docs: list, live: bool, label: str) -> int:
+    """Shared render→sink→per-result-report path for manifest commands
+    (bootstrap/guardrails/dashboard all follow the same discipline)."""
+    from ccka_tpu.actuation import DryRunSink, KubectlSink
+
+    sink = KubectlSink() if live else DryRunSink(echo=True)
+    results = sink.apply_manifests(docs)
+    ok = all(r.ok for r in results)
+    for r in results:
+        print(f"[{'ok' if r.ok else 'FAILED'}] {r.pool}"
+              + (f" — {r.detail}" if r.detail else ""), file=sys.stderr)
+    print(f"[{'ok' if ok else 'err'}] {label} "
+          f"{'applied' if live else 'rendered (dry-run)'}", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def _cmd_burst(cfg: FrameworkConfig, args) -> int:
     from ccka_tpu.actuation import DryRunSink, KubectlSink
     from ccka_tpu.actuation.burst import (apply_burst, burst_status,
@@ -637,20 +661,13 @@ def main(argv: list[str] | None = None) -> int:
                             args.interval, args.live, args.seed, args.hpa,
                             args.keda, args.telemetry)
         if args.command == "dashboard":
-            from ccka_tpu.actuation import DryRunSink, KubectlSink
             from ccka_tpu.harness.dashboard import render_dashboard_configmap
             docs = render_dashboard_configmap(cfg.signals.prometheus_url,
                                               cfg.workload.namespace)
             if args.json:
                 print(json.dumps(docs, indent=2))
                 return 0
-            sink = KubectlSink() if args.live else DryRunSink(echo=True)
-            results = sink.apply_manifests(docs)
-            ok = all(r.ok for r in results)
-            print(f"[{'ok' if ok else 'err'}] dashboard provisioning "
-                  f"{'applied' if args.live else 'rendered (dry-run)'}",
-                  file=sys.stderr)
-            return 0 if ok else 1
+            return _apply_docs(docs, args.live, "dashboard provisioning")
         if args.command == "report":
             from ccka_tpu.harness.telemetry import (read_telemetry,
                                                     summarize_telemetry)
@@ -684,6 +701,12 @@ def main(argv: list[str] | None = None) -> int:
             return _cmd_bootstrap(cfg, args.live, args.json)
         if args.command == "burst":
             return _cmd_burst(cfg, args)
+        if args.command == "guardrails":
+            from ccka_tpu.actuation import render_guardrails
+            if args.json:
+                print(json.dumps(render_guardrails(), indent=2))
+                return 0
+            return _apply_docs(render_guardrails(), args.live, "guardrails")
         if args.command == "map-nodes":
             return _cmd_map_nodes(cfg, args.account_id, args.live)
         if args.command == "cleanup":

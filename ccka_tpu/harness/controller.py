@@ -211,17 +211,19 @@ class Controller:
         self.params = SimParams.from_config(cfg)
         self.state: ClusterState = initial_state(cfg)
         self.key = jax.random.key(seed)
+        # Single-writer guard (see ControllerLock): on for daemons, off for
+        # in-process test harnesses that drive ticks directly. Acquired
+        # FIRST so a lock-held refusal is side-effect-free — no telemetry
+        # file created or fd leaked by a half-constructed controller.
+        self._lock = None
+        if lock:
+            self._lock = ControllerLock(cfg.cluster.name, lock_dir=lock_dir)
+            self._lock.acquire()
         # Durable JSONL telemetry (the remote-write analog); "" disables.
         self.telemetry = None
         if telemetry_path:
             from ccka_tpu.harness.telemetry import TelemetryWriter
             self.telemetry = TelemetryWriter(telemetry_path)
-        # Single-writer guard (see ControllerLock): on for daemons, off for
-        # in-process test harnesses that drive ticks directly.
-        self._lock = None
-        if lock:
-            self._lock = ControllerLock(cfg.cluster.name, lock_dir=lock_dir)
-            self._lock.acquire()
         self._step = jax.jit(
             lambda s, a, e, k: sim_step(self.params, s, a, e, k,
                                         stochastic=False))

@@ -393,3 +393,18 @@ def test_role_matcher_handles_quotes_and_rejects_nonarn_mentions():
     stray = ("- rolearn: arn:aws:iam::1:role/other\n"
              "  username: KarpenterNodeRole-demo1\n")
     assert not role_mapped(stray, role_name="KarpenterNodeRole-demo1")
+
+
+def test_role_matcher_flow_and_json_styles():
+    """aws-iam-authenticator accepts flow mappings and JSON too; the
+    matcher must see rolearn values in all encodings (a block-only parse
+    would fail the preroll gate on a correctly mapped cluster and make
+    map-nodes append duplicates)."""
+    from ccka_tpu.actuation.bootstrap import role_mapped
+
+    flow = "- {rolearn: arn:aws:iam::1:role/KarpenterNodeRole-demo1, username: x}\n"
+    assert role_mapped(flow, role_name="KarpenterNodeRole-demo1")
+    js = '[{"rolearn": "arn:aws:iam::1:role/KarpenterNodeRole-demo1"}]'
+    assert role_mapped(js, role_name="KarpenterNodeRole-demo1")
+    # Exactness still holds across styles.
+    assert not role_mapped(flow, role_name="KarpenterNodeRole-demo")

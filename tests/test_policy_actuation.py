@@ -321,3 +321,59 @@ def test_kubectl_sink_fails_when_merge_patch_rejected(cfg):
         render_nodepool_patches(offpeak_action(cfg.cluster), cfg.cluster)[0])
     assert not res.ok
     assert "merge patch rejected" in res.detail
+
+
+class TestKyvernoGuardrailManifests:
+    """04_kyverno.sh parity: the cluster-side ClusterPolicies themselves,
+    matching the semantics the feasibility projection enforces client-side."""
+
+    def test_require_requests_limits_shape(self):
+        from ccka_tpu.actuation import render_require_requests_limits
+
+        doc = render_require_requests_limits()
+        assert doc["metadata"]["name"] == "require-requests-limits"
+        assert doc["spec"]["validationFailureAction"] == "Enforce"
+        pattern = doc["spec"]["rules"][0]["validate"]["pattern"]
+        resources = pattern["spec"]["containers"][0]["resources"]
+        assert set(resources["requests"]) == {"cpu", "memory"}
+        assert set(resources["limits"]) == {"cpu", "memory"}
+
+    def test_critical_no_spot_shape(self):
+        from ccka_tpu.actuation import render_critical_no_spot
+        from ccka_tpu.actuation.guardrails import EXCLUDED_NAMESPACES
+
+        doc = render_critical_no_spot()
+        rule = doc["spec"]["rules"][0]
+        sel = rule["match"]["any"][0]["resources"]["selector"]
+        assert sel["matchLabels"] == {"critical": "true"}
+        excluded = rule["exclude"]["any"][0]["resources"]["namespaces"]
+        assert set(excluded) == set(EXCLUDED_NAMESPACES)  # 04:66-69
+        cond = rule["validate"]["deny"]["conditions"]["any"][0]
+        assert "capacity-type" in cond["key"] and "spot" in cond["key"]
+
+    def test_apply_and_burst_compliance(self, cfg):
+        """Guardrails apply through the sink; the burst workload the
+        framework generates satisfies both policies by construction."""
+        from ccka_tpu.actuation import DryRunSink, apply_guardrails
+        from ccka_tpu.actuation.burst import render_burst_deployments
+
+        sink = DryRunSink()
+        assert all(r.ok for r in apply_guardrails(sink))
+        assert sink.get_object("ClusterPolicy", "require-requests-limits")
+
+        for doc in render_burst_deployments(cfg.workload):
+            pod = doc["spec"]["template"]["spec"]
+            res = pod["containers"][0]["resources"]
+            assert res["requests"] and res["limits"]  # policy 1
+            labels = doc["spec"]["template"]["metadata"]["labels"]
+            if labels.get("critical") == "true":      # policy 2 (vacuous
+                assert all(t.get("key") != "karpenter.sh/capacity-type"
+                           for t in pod["tolerations"])  # unless labeled)
+
+    def test_cli_guardrails_json(self, capsys):
+        from ccka_tpu.cli import main
+
+        assert main(["guardrails", "--json"]) == 0
+        docs = json.loads(capsys.readouterr().out)
+        assert [d["metadata"]["name"] for d in docs] == [
+            "require-requests-limits", "critical-no-spot-without-pdb"]
