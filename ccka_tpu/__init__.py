@@ -30,6 +30,6 @@ Subpackages
 - ``harness``    preroll checks, paired configure/observe lifecycle, telemetry
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from ccka_tpu.config import FrameworkConfig, default_config  # noqa: F401

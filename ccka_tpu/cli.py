@@ -156,7 +156,7 @@ def _build_parser() -> argparse.ArgumentParser:
 
     ss = sub.add_parser("simulate", help="batched simulator + KPI report")
     ss.add_argument("--backend", default="rule",
-                    choices=("rule", "carbon", "neutral", "ppo"))
+                    choices=("rule", "carbon", "neutral", "mpc", "ppo"))
     ss.add_argument("--checkpoint", default="",
                     help="orbax checkpoint dir (required for ppo)")
     ss.add_argument("--days", type=float, default=1.0)
@@ -373,19 +373,32 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
     steps = int(days * 86400.0 / cfg.sim.dt_s)
     src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
 
-    if backend == "neutral":
-        neutral = Action.neutral(cfg.cluster.n_pools, cfg.cluster.n_zones)
-        action_fn = lambda s, e, t: neutral  # noqa: E731
-    else:
-        action_fn = make_backend(cfg, backend, checkpoint).action_fn()
-
     if clusters == 1 and (mesh or device_traces):
         raise SystemExit("ccka: --mesh/--device-traces are batch-path "
                          "flags; set --clusters > 1 (they would be "
                          "silently ignored on the single-cluster path)")
+    if backend == "mpc" and clusters != 1:
+        # Receding-horizon MPC replans against host-side state; its jitted
+        # closed-loop evaluate() covers the single-cluster path only.
+        raise SystemExit("ccka: --backend mpc simulates one cluster "
+                         "(receding-horizon); use `ccka evaluate "
+                         "--backends mpc` for paired comparisons")
+
+    if backend == "neutral":
+        neutral = Action.neutral(cfg.cluster.n_pools, cfg.cluster.n_zones)
+        action_fn = lambda s, e, t: neutral  # noqa: E731
+    elif backend != "mpc":
+        action_fn = make_backend(cfg, backend, checkpoint).action_fn()
 
     with profile_trace(profile_dir):
-        if clusters == 1:
+        if backend == "mpc":
+            mpc = make_backend(cfg, "mpc", checkpoint)
+            trace = src.trace(steps, seed=seed)
+            final, metrics = mpc.evaluate(initial_state(cfg), trace,
+                                          jax.random.key(seed),
+                                          stochastic=stochastic)
+            s = summarize(params, metrics)
+        elif clusters == 1:
             trace = src.trace(steps, seed=seed)
             final, metrics = jax.jit(
                 lambda s, k: rollout(params, s, action_fn, trace, k,

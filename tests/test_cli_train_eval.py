@@ -78,3 +78,22 @@ def test_ppo_backend_requires_checkpoint():
     import pytest
     with pytest.raises(SystemExit):
         main(["observe", "--backend", "ppo"])
+
+
+def test_simulate_mpc_backend(capsys):
+    """simulate --backend mpc runs the receding-horizon closed loop for a
+    single cluster, and refuses a multi-cluster batch."""
+    import json
+
+    from ccka_tpu.cli import main
+
+    assert main(["--set", "train.mpc_horizon=8", "--set",
+                 "train.mpc_iters=3", "simulate", "--backend", "mpc",
+                 "--days", "0.005"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["backend"] == "mpc" and doc["cost_usd"] > 0
+
+    import pytest
+    with pytest.raises(SystemExit, match="one cluster"):
+        main(["simulate", "--backend", "mpc", "--clusters", "2",
+              "--days", "0.005"])
