@@ -377,33 +377,37 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
         raise SystemExit("ccka: --mesh/--device-traces are batch-path "
                          "flags; set --clusters > 1 (they would be "
                          "silently ignored on the single-cluster path)")
-    if backend == "mpc" and clusters != 1:
-        # Receding-horizon MPC replans against host-side state; its jitted
-        # closed-loop evaluate() covers the single-cluster path only.
-        raise SystemExit("ccka: --backend mpc simulates one cluster "
-                         "(receding-horizon); use `ccka evaluate "
-                         "--backends mpc` for paired comparisons")
 
+    backend_obj = None
+    receding = False
     if backend == "neutral":
         neutral = Action.neutral(cfg.cluster.n_pools, cfg.cluster.n_zones)
         action_fn = lambda s, e, t: neutral  # noqa: E731
-    elif backend != "mpc":
-        action_fn = make_backend(cfg, backend, checkpoint).action_fn()
+    else:
+        backend_obj = make_backend(cfg, backend, checkpoint)
+        # Same routing flag train/evaluate.py uses: receding-horizon
+        # backends carry host-side plan state a jitted action_fn would
+        # freeze, and provide a jitted closed-loop evaluate() instead.
+        receding = getattr(backend_obj, "requires_receding_horizon", False)
+        if not receding:
+            action_fn = backend_obj.action_fn()
+    if receding and clusters != 1:
+        raise SystemExit(f"ccka: --backend {backend} simulates one cluster "
+                         "(receding-horizon); use `ccka evaluate "
+                         f"--backends {backend}` for paired comparisons")
 
     with profile_trace(profile_dir):
-        if backend == "mpc":
-            mpc = make_backend(cfg, "mpc", checkpoint)
+        if clusters == 1:
             trace = src.trace(steps, seed=seed)
-            final, metrics = mpc.evaluate(initial_state(cfg), trace,
-                                          jax.random.key(seed),
-                                          stochastic=stochastic)
-            s = summarize(params, metrics)
-        elif clusters == 1:
-            trace = src.trace(steps, seed=seed)
-            final, metrics = jax.jit(
-                lambda s, k: rollout(params, s, action_fn, trace, k,
-                                     stochastic=stochastic)
-            )(initial_state(cfg), jax.random.key(seed))
+            if receding:
+                final, metrics = backend_obj.evaluate(
+                    initial_state(cfg), trace, jax.random.key(seed),
+                    stochastic=stochastic)
+            else:
+                final, metrics = jax.jit(
+                    lambda s, k: rollout(params, s, action_fn, trace, k,
+                                         stochastic=stochastic)
+                )(initial_state(cfg), jax.random.key(seed))
             s = summarize(params, metrics)
         else:
             dev_mesh = None

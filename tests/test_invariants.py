@@ -1,0 +1,143 @@
+"""Property-style fuzz tests: simulator and projection invariants.
+
+SURVEY §4 prescribes "property tests on policy invariants" as part of the
+test substrate the reference lacked. These fuzz randomized actions,
+states and exogenous inputs through the dynamics and the feasibility
+projection and assert the invariants that must hold for *any* input —
+the safety net under the learned backends, whose outputs are arbitrary
+before projection.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import default_config, multi_region_config
+from ccka_tpu.policy import project_feasible
+from ccka_tpu.policy.constraints import CONSOLIDATE_AFTER_MAX_S
+from ccka_tpu.sim import CT_OD, CT_SPOT, SimParams, initial_state, step
+from ccka_tpu.sim.dynamics import ExoStep
+from ccka_tpu.sim.types import Action
+
+N_FUZZ = 64
+
+
+def _random_action(key, n_pools, n_zones, scale=5.0):
+    ks = jax.random.split(key, 5)
+    # Deliberately out-of-domain magnitudes: the projection must tame them.
+    return Action(
+        zone_weight=scale * jax.random.normal(ks[0], (n_pools, n_zones)),
+        ct_allow=scale * jax.random.normal(ks[1], (n_pools, 2)),
+        consolidation_aggr=scale * jax.random.normal(ks[2], (n_pools,)),
+        consolidate_after_s=1e4 * jax.random.normal(ks[3], (n_pools,)),
+        hpa_scale=scale * jax.random.normal(ks[4], (2,)),
+    )
+
+
+def _random_exo(key, n_zones):
+    ks = jax.random.split(key, 4)
+    return ExoStep(
+        spot_price_hr=jax.random.uniform(ks[0], (n_zones,), minval=0.005,
+                                         maxval=0.09),
+        od_price_hr=jnp.full((n_zones,), 0.096),
+        carbon_g_kwh=jax.random.uniform(ks[1], (n_zones,), minval=50.0,
+                                        maxval=900.0),
+        demand_pods=jax.random.uniform(ks[2], (2,), minval=0.0,
+                                       maxval=300.0),
+        is_peak=(jax.random.uniform(ks[3], ()) > 0.5).astype(jnp.float32),
+    )
+
+
+@pytest.fixture(scope="module", params=["single", "multi"])
+def cfg(request):
+    return (default_config() if request.param == "single"
+            else multi_region_config())
+
+
+class TestProjectionInvariants:
+    def test_any_action_projects_feasible(self, cfg):
+        cl = cfg.cluster
+        for i in range(N_FUZZ):
+            a = project_feasible(
+                _random_action(jax.random.key(i), cl.n_pools, cl.n_zones),
+                cl)
+            zw = np.asarray(a.zone_weight)
+            assert ((0.0 <= zw) & (zw <= 1.0)).all()
+            # Never an unsatisfiable zone requirement.
+            assert (zw.sum(axis=-1) > 0).all()
+            ct = np.asarray(a.ct_allow)
+            assert ((0.0 <= ct) & (ct <= 1.0)).all()
+            for p, pool in enumerate(cl.pools):
+                # Intrinsic capacity types only (Kyverno guarantee):
+                # the SLO pool can never offer spot...
+                if "spot" not in pool.capacity_types:
+                    assert ct[p, CT_SPOT] == 0.0
+                # ...and SLO pools always offer on-demand.
+                if pool.strategy == "slo":
+                    assert ct[p, CT_OD] >= 1.0 - 1e-6
+            after = np.asarray(a.consolidate_after_s)
+            assert ((0.0 <= after)
+                    & (after <= CONSOLIDATE_AFTER_MAX_S)).all()
+            hpa = np.asarray(a.hpa_scale)
+            assert ((0.1 <= hpa) & (hpa <= 4.0)).all()
+
+
+class TestDynamicsInvariants:
+    def test_step_preserves_physical_invariants(self, cfg):
+        """For any projected action and any sane exogenous tick, one step
+        must keep the state physical: non-negative fleet/pipeline,
+        serving bounded by demand-target, finite accounting that only
+        accumulates forward."""
+        params = SimParams.from_config(cfg)
+        cl = cfg.cluster
+        jstep = jax.jit(lambda s, a, e, k: step(params, s, a, e, k,
+                                                stochastic=True))
+        state = initial_state(cfg)
+        for i in range(N_FUZZ):
+            k = jax.random.key(1000 + i)
+            ka, ke, ks = jax.random.split(k, 3)
+            action = project_feasible(
+                _random_action(ka, cl.n_pools, cl.n_zones), cl)
+            exo = _random_exo(ke, cl.n_zones)
+            prev = state
+            state, m = jstep(state, action, exo, ks)
+
+            assert (np.asarray(state.nodes) >= 0).all()
+            assert (np.asarray(state.pipeline) >= 0).all()
+            assert (np.asarray(state.running) >= -1e-5).all()
+            # Serving never exceeds the HPA-scaled target.
+            target = np.asarray(exo.demand_pods) * np.asarray(
+                action.hpa_scale)
+            assert (np.asarray(state.running) <= target + 1e-3).all()
+            # Pool caps respected (active + in-flight).
+            pool_total = (np.asarray(state.nodes).sum(axis=(1, 2))
+                          + np.asarray(state.pipeline).sum(axis=(0, 2, 3)))
+            assert (pool_total <= np.asarray(params.max_nodes) + 1e-3).all()
+            # Accounting is finite and monotone.
+            for field in ("acc_cost_usd", "acc_carbon_g", "acc_requests",
+                          "acc_slo_ok_s", "acc_evictions"):
+                now = float(getattr(state, field))
+                assert np.isfinite(now)
+                assert now >= float(getattr(prev, field)) - 1e-6
+            # Tick metrics are physical too.
+            assert float(m.cost_usd) >= 0.0
+            assert float(m.carbon_g) >= 0.0
+            assert float(m.latency_p95_ms) >= 0.0
+            assert 0.0 <= float(m.slo_ok) <= 1.0
+
+    def test_no_nan_under_degenerate_inputs(self, cfg):
+        """Zero demand, zero prices... the step must stay finite (guards
+        against division blowups in utilization/latency/accounting)."""
+        params = SimParams.from_config(cfg)
+        cl = cfg.cluster
+        z = cl.n_zones
+        exo = ExoStep(
+            spot_price_hr=jnp.zeros((z,)), od_price_hr=jnp.zeros((z,)),
+            carbon_g_kwh=jnp.zeros((z,)), demand_pods=jnp.zeros((2,)),
+            is_peak=jnp.float32(0.0))
+        action = project_feasible(Action.neutral(cl.n_pools, z), cl)
+        state, m = step(params, initial_state(cfg), action, exo,
+                        jax.random.key(0), stochastic=True)
+        for leaf in jax.tree.leaves(state) + jax.tree.leaves(m):
+            assert np.isfinite(np.asarray(leaf)).all()
