@@ -465,6 +465,17 @@ class FrameworkConfig:
         self.signals.validate()
         self.train.validate()
         self.mesh.validate()
+        # Cross-section: a live multi-region fleet must name each region's
+        # grid zone — silently falling back to the global carbon_zone would
+        # price one region's zones by another region's grid, flattening the
+        # very divergence multi-region exists to exploit.
+        if self.signals.backend == "live" and self.cluster.regions:
+            missing = [r.name for r in self.cluster.regions
+                       if not r.carbon_zone]
+            if missing:
+                raise ConfigError(
+                    f"signals: live backend with regions {missing} lacking "
+                    "carbon_zone — set RegionSpec.carbon_zone per region")
         return self
 
     # -- serialization ------------------------------------------------------

@@ -237,11 +237,17 @@ class CarbonIntensityClient:
         self.default_g_kwh = default_g_kwh
         self.fetch = fetch or _default_fetch(timeout_s)
 
-    def latest(self, zone: str | None = None) -> float:
-        """Latest gCO2eq/kWh for the zone; default on any failure."""
+    def latest(self, zone: str | None = None,
+               default: float | None = None) -> float:
+        """Latest gCO2eq/kWh for the zone; falls back to ``default`` (the
+        configured global default if omitted) on any failure. Callers with
+        a zone-specific prior pass it — a flat global fallback for one
+        zone of a multi-region fleet could invert the cross-region carbon
+        ordering a migration policy acts on."""
         zone = zone or self.zone
+        fallback = self.default_g_kwh if default is None else default
         if not self.api_key:
-            return self.default_g_kwh
+            return fallback
         url = (f"{self.base_url}/carbon-intensity/latest?"
                f"{urllib.parse.urlencode({'zone': zone})}")
         try:
@@ -249,7 +255,7 @@ class CarbonIntensityClient:
             doc = json.loads(raw)
             return float(doc["carbonIntensity"])
         except Exception:  # noqa: BLE001 — documented graceful fallback
-            return self.default_g_kwh
+            return fallback
 
 
 class LiveSignalSource(SignalSource):
@@ -290,6 +296,24 @@ class LiveSignalSource(SignalSource):
         self._synth = SyntheticSignalSource(cluster, workload, sim, signals,
                                             start_unix_s=self.start_unix_s)
         self.slo = SLOMetricsClient(self.prom, namespace=workload.namespace)
+        # Grid zone + fallback intensity per cluster zone: in a multi-region
+        # fleet each zone carries its region's ElectricityMaps zone id and
+        # its region's base intensity as the API-failure fallback, so the
+        # live carbon tick preserves cross-region divergence (a flat global
+        # fallback for one failed zone could invert the ordering the
+        # carbon-aware policy migrates on). Single-region: every zone
+        # shares signals.carbon_zone and the global default.
+        if cluster.regions:
+            regs = [cluster.regions[i] for i in cluster.zone_region_index]
+            self._zone_grid = [r.carbon_zone or signals.carbon_zone
+                               for r in regs]
+            self._zone_default = [r.carbon_base_g_kwh
+                                  or signals.carbon_default_g_kwh
+                                  for r in regs]
+        else:
+            self._zone_grid = [signals.carbon_zone] * cluster.n_zones
+            self._zone_default = ([signals.carbon_default_g_kwh]
+                                  * cluster.n_zones)
 
     def slo_snapshot(self) -> dict[str, float]:
         """Measured app-level SLO metrics for the controller's KPI line
@@ -329,8 +353,15 @@ class LiveSignalSource(SignalSource):
         except SignalUnavailable:
             pass
 
-        carbon_val = self.carbon.latest()
-        carbon = np.full((1, z), carbon_val, dtype=np.float32)
+        # One API call per distinct grid zone (ElectricityMaps bills per
+        # request; a 2-region 4-zone fleet makes 2 calls, not 4), each
+        # falling back to its own region's base intensity.
+        defaults = {g: d for g, d in zip(self._zone_grid,
+                                         self._zone_default)}
+        by_grid = {g: self.carbon.latest(zone=g, default=defaults[g])
+                   for g in dict.fromkeys(self._zone_grid)}
+        carbon = np.asarray([[by_grid[g] for g in self._zone_grid]],
+                            dtype=np.float32)
 
         return ExogenousTrace(
             spot_price_hr=base.spot_price_hr, od_price_hr=as_f32(od),
@@ -389,14 +420,19 @@ class LiveSignalSource(SignalSource):
 
         d_ratio = _lvl(now.demand_pods) / max(
             _lvl(prior.demand_pods[:1]), 1e-6)
-        c_ratio = _lvl(now.carbon_g_kwh) / max(
-            _lvl(prior.carbon_g_kwh[:1]), 1e-6)
+        # Carbon anomaly is PER ZONE: tick() measures each region's grid
+        # separately, and collapsing to one scalar would hand the planner
+        # the synthetic prior's cross-region ordering even when live
+        # measurements disagree with it.
+        c_ratio = (np.asarray(now.carbon_g_kwh)[0]
+                   / np.maximum(np.asarray(prior.carbon_g_kwh)[0], 1e-6))
         od_now = _lvl(now.od_price_hr)
         return ExogenousTrace(
             spot_price_hr=prior.spot_price_hr,
             od_price_hr=as_f32(np.full_like(
                 np.asarray(prior.od_price_hr), od_now)),
-            carbon_g_kwh=as_f32(np.asarray(prior.carbon_g_kwh) * c_ratio),
+            carbon_g_kwh=as_f32(
+                np.asarray(prior.carbon_g_kwh) * c_ratio[None, :]),
             demand_pods=as_f32(np.asarray(prior.demand_pods) * d_ratio),
             is_peak=prior.is_peak,
         )

@@ -400,3 +400,108 @@ class TestMPCLearnsMigration:
         g_per_req_init, slo_init = stats(init)
         assert slo_opt > slo_init                                   # (c)
         assert g_per_req_opt < 1.05 * g_per_req_init
+
+
+# ---------------------------------------------------------------------------
+# Live signals: per-region grid carbon
+# ---------------------------------------------------------------------------
+
+
+class TestLiveMultiRegionCarbon:
+    def test_tick_carries_per_region_carbon(self, mcfg):
+        """The live carbon tick must preserve cross-region divergence: each
+        zone is priced by ITS region's grid zone, not one global value
+        (a flat tick would blind the carbon-aware policy in live mode)."""
+        import json as _json
+
+        from ccka_tpu.signals.live import LiveSignalSource
+
+        grid_values = {"US-MIDW-MISO": 540.0, "US-CAL-CISO": 210.0}
+        calls = []
+
+        def fetch(url, headers):
+            if "carbon-intensity" in url:
+                zone = url.split("zone=")[-1].split("&")[0]
+                zone = zone.replace("%2F", "/")
+                calls.append(zone)
+                return _json.dumps(
+                    {"carbonIntensity": grid_values[zone]}).encode()
+            raise OSError("no prometheus in this test")
+
+        cfg2 = mcfg.with_overrides(**{"signals.carbon_api_key": "k"})
+        src = LiveSignalSource(cfg2.cluster, cfg2.workload, cfg2.sim,
+                               cfg2.signals, fetch=fetch, start_unix_s=0.0)
+        tick = src.tick(0)
+        carbon = np.asarray(tick.carbon_g_kwh)[0]  # [4]
+        np.testing.assert_allclose(carbon[:2], 540.0)  # east zones
+        np.testing.assert_allclose(carbon[2:], 210.0)  # west zones
+        # One API call per distinct grid zone, not per cluster zone.
+        assert sorted(set(calls)) == ["US-CAL-CISO", "US-MIDW-MISO"]
+        assert len(calls) == 2
+
+    def test_single_region_unchanged(self):
+        from ccka_tpu.config import default_config
+        from ccka_tpu.signals.live import LiveSignalSource
+
+        cfg = default_config()
+
+        def fetch(url, headers):
+            raise OSError("offline")
+
+        src = LiveSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                               cfg.signals, fetch=fetch, start_unix_s=0.0)
+        carbon = np.asarray(src.tick(0).carbon_g_kwh)[0]
+        # No key + offline → documented 400 g/kWh fallback, all zones.
+        np.testing.assert_allclose(carbon, 400.0)
+
+    def test_api_failure_falls_back_to_region_base(self, mcfg):
+        """One region's API blip must not invert the cross-region carbon
+        ordering: each zone falls back to ITS region's base intensity,
+        never the flat global default."""
+        import json as _json
+
+        from ccka_tpu.signals.live import LiveSignalSource
+
+        def fetch(url, headers):
+            if "US-CAL-CISO" in url:
+                return _json.dumps({"carbonIntensity": 210.0}).encode()
+            raise OSError("MISO endpoint timeout")  # east fails this tick
+
+        cfg2 = mcfg.with_overrides(**{"signals.carbon_api_key": "k"})
+        src = LiveSignalSource(cfg2.cluster, cfg2.workload, cfg2.sim,
+                               cfg2.signals, fetch=fetch, start_unix_s=0.0)
+        carbon = np.asarray(src.tick(0).carbon_g_kwh)[0]
+        np.testing.assert_allclose(carbon[:2], 520.0)  # east region base
+        np.testing.assert_allclose(carbon[2:], 210.0)  # live west value
+        assert carbon[:2].min() > carbon[2:].max()     # ordering preserved
+
+    def test_live_multiregion_requires_carbon_zones(self, mcfg):
+        regions = [dict(r.__dict__) for r in mcfg.cluster.regions]
+        regions[0]["carbon_zone"] = ""
+        with pytest.raises(ConfigError, match="carbon_zone"):
+            mcfg.with_overrides(**{"signals.backend": "live",
+                                   "cluster.regions": regions})
+
+    def test_forecast_preserves_per_zone_live_anomaly(self, mcfg):
+        """The planner's forecast must scale each zone by ITS measured
+        anomaly — live divergence that disagrees with the synthetic prior
+        has to reach the horizon window."""
+        import json as _json
+
+        from ccka_tpu.signals.live import LiveSignalSource
+
+        # Live says east is CLEANER than west — opposite of the prior.
+        grid_values = {"US-MIDW-MISO": 200.0, "US-CAL-CISO": 600.0}
+
+        def fetch(url, headers):
+            if "carbon-intensity" in url:
+                zone = url.split("zone=")[-1].split("&")[0].replace("%2F", "/")
+                return _json.dumps(
+                    {"carbonIntensity": grid_values[zone]}).encode()
+            raise OSError("no prometheus")
+
+        cfg2 = mcfg.with_overrides(**{"signals.carbon_api_key": "k"})
+        src = LiveSignalSource(cfg2.cluster, cfg2.workload, cfg2.sim,
+                               cfg2.signals, fetch=fetch, start_unix_s=0.0)
+        window = np.asarray(src.forecast(0, 8).carbon_g_kwh)  # [8, 4]
+        assert window[:, :2].mean() < window[:, 2:].mean()
