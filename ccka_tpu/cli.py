@@ -81,6 +81,13 @@ def _build_parser() -> argparse.ArgumentParser:
     sr.add_argument("--telemetry", default="",
                     help="append per-tick JSONL records (incl. per-phase "
                          "timings) to this file")
+    sr.add_argument("--metrics-port", type=int, default=-1,
+                    help="serve the ccka_* Prometheus gauges on "
+                         "127.0.0.1:PORT/metrics (0 = pick a free port); "
+                         "the scrape target the dashboards query")
+    sr.add_argument("--metrics-textfile", default="",
+                    help="also write the gauges to this .prom file each "
+                         "tick (node-exporter textfile collector)")
 
     sp = sub.add_parser("preroll", help="environment assertions (demo_18)")
     sp.add_argument("--live", action="store_true")
@@ -192,11 +199,15 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="JSONL file written by `ccka run --telemetry`")
 
     sd = sub.add_parser(
-        "dashboard", help="render/apply Grafana provisioning for the "
-                          "proposal's planned panels (demo_40 analog)")
+        "dashboard", help="render/apply the demo_40 observability stage: "
+                          "Grafana Deployment/Service/admin-Secret plus "
+                          "datasource+dashboard provisioning")
     sd.add_argument("--live", action="store_true")
     sd.add_argument("--json", action="store_true",
-                    help="print the ConfigMaps instead of applying")
+                    help="print the manifests instead of applying")
+    sd.add_argument("--provision-only", action="store_true",
+                    help="render only the ConfigMaps (for a Grafana that "
+                         "already exists, e.g. kube-prometheus-stack's)")
 
     sub.add_parser("show-config", help="print the resolved config")
     return p
@@ -324,26 +335,43 @@ def _cmd_observe(cfg: FrameworkConfig, backend_name: str,
 def _cmd_run(cfg: FrameworkConfig, backend_name: str, checkpoint: str,
              ticks: int, interval: float | None, live: bool,
              seed: int, hpa: bool = False, keda: bool = False,
-             telemetry: str = "") -> int:
+             telemetry: str = "", metrics_port: int = -1,
+             metrics_textfile: str = "") -> int:
     from ccka_tpu.harness.controller import controller_from_config
 
     backend = make_backend(cfg, backend_name, checkpoint)
     from ccka_tpu.harness.controller import ControllerLockHeld
+    exporter = None
+    if metrics_port >= 0 or metrics_textfile:
+        from ccka_tpu.harness.promexport import MetricsExporter
+        exporter = MetricsExporter(
+            port=metrics_port if metrics_port >= 0 else None,
+            textfile=metrics_textfile, cluster=cfg.cluster.name)
+        if exporter.port is not None:
+            print(f"[ok] metrics: http://127.0.0.1:{exporter.port}/metrics",
+                  file=sys.stderr)
     try:
         # lock=live: only live daemons take the per-cluster single-writer
         # lock (two dry-run sims use in-memory sinks and cannot conflict).
         ctrl = controller_from_config(cfg, backend, live=live,
                                       interval_s=interval, seed=seed,
                                       apply_hpa=hpa, apply_keda=keda,
-                                      lock=live, telemetry_path=telemetry)
+                                      lock=live, telemetry_path=telemetry,
+                                      exporter=exporter)
     except ValueError as e:  # e.g. --keda without the SQS config
+        if exporter is not None:
+            exporter.close()
         raise SystemExit(f"ccka: {e}")
     except ControllerLockHeld as e:
+        if exporter is not None:
+            exporter.close()
         raise SystemExit(f"ccka: {e}")
     try:
         reports = ctrl.run(ticks if ticks > 0 else None)
     finally:
         ctrl.close()
+        if exporter is not None:
+            exporter.close()
     ok = all(r.applied and r.verified for r in reports) if reports else True
     print(f"[{'ok' if ok else 'err'}] controller ran "
           f"{len(reports)} tick(s)", file=sys.stderr)
@@ -565,12 +593,13 @@ def _cmd_bootstrap(cfg: FrameworkConfig, live: bool, as_json: bool) -> int:
     return 0 if ok else 1
 
 
-def _apply_docs(docs: list, live: bool, label: str) -> int:
+def _apply_docs(docs: list, live: bool, label: str, *, sink=None) -> int:
     """Shared render→sink→per-result-report path for manifest commands
     (bootstrap/guardrails/dashboard all follow the same discipline)."""
     from ccka_tpu.actuation import DryRunSink, KubectlSink
 
-    sink = KubectlSink() if live else DryRunSink(echo=True)
+    if sink is None:
+        sink = KubectlSink() if live else DryRunSink(echo=True)
     results = sink.apply_manifests(docs)
     ok = all(r.ok for r in results)
     for r in results:
@@ -676,15 +705,37 @@ def main(argv: list[str] | None = None) -> int:
         if args.command == "run":
             return _cmd_run(cfg, args.backend, args.checkpoint, args.ticks,
                             args.interval, args.live, args.seed, args.hpa,
-                            args.keda, args.telemetry)
+                            args.keda, args.telemetry, args.metrics_port,
+                            args.metrics_textfile)
         if args.command == "dashboard":
-            from ccka_tpu.harness.dashboard import render_dashboard_configmap
-            docs = render_dashboard_configmap(cfg.signals.prometheus_url,
-                                              cfg.workload.namespace)
+            from ccka_tpu.actuation import DryRunSink, KubectlSink
+            from ccka_tpu.harness.dashboard import (
+                render_dashboard_configmap, render_observability_stack)
+            if args.provision_only:
+                docs = render_dashboard_configmap(cfg.signals.prometheus_url,
+                                                  cfg.workload.namespace)
+            else:
+                # The whole demo_40 configure stage: provisioning +
+                # admin Secret + Grafana Deployment/Service.
+                docs = render_observability_stack(cfg.signals.prometheus_url,
+                                                  cfg.workload.namespace)
             if args.json:
                 print(json.dumps(docs, indent=2))
                 return 0
-            return _apply_docs(docs, args.live, "dashboard provisioning")
+            sink = KubectlSink() if args.live else DryRunSink(echo=True)
+            # Re-applying must not rotate an existing admin Secret: the
+            # running Grafana resolved its password at container start, so
+            # overwriting the Secret would lock the operator out until the
+            # next pod restart (which would then silently rotate creds) —
+            # same create-once discipline as demo_40_watch_config.sh:36-48.
+            existing = sink.get_object("Secret", "ccka-grafana-admin",
+                                       namespace=cfg.workload.namespace)
+            if existing:
+                docs = [d for d in docs if d.get("kind") != "Secret"]
+                print("[ok] existing grafana admin secret preserved",
+                      file=sys.stderr)
+            return _apply_docs(docs, args.live, "dashboard stack",
+                               sink=sink)
         if args.command == "report":
             from ccka_tpu.harness.telemetry import (read_telemetry,
                                                     summarize_telemetry)

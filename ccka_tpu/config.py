@@ -122,6 +122,11 @@ class RegionSpec:
     name: str
     zones: Tuple[str, ...]
     carbon_zone: str = ""            # ElectricityMaps zone id, e.g. "US-CAL-CISO"
+    # kubeconfig context naming this region's cluster (`kubectl --context`).
+    # Required for live multi-region actuation: each region is its own EKS
+    # cluster, and patching both regions' NodePools through one context
+    # would ping-pong a single cluster between the two zone sets.
+    kube_context: str = ""
     carbon_base_g_kwh: float = 0.0   # 0 → signals.carbon_default_g_kwh
     solar_frac: float = 0.45         # depth of the midday solar dip [0,1)
     tz_offset_hr: float = 0.0        # local solar time vs the trace clock
@@ -365,6 +370,10 @@ class SignalsConfig:
 
     backend: str = "synthetic"  # "synthetic" | "replay" | "live"
     replay_path: str = ""       # .npz trace for the replay backend
+    # Live spot-price feed: "" (disabled — synthetic prior passes through,
+    # the reference's level of spot awareness) or "aws" (per-AZ
+    # `describe-spot-price-history` via the AWS CLI each tick).
+    spot_feed: str = ""
     carbon_api_key: str = ""
     carbon_zone: str = "US-CAL-CISO"
     carbon_default_g_kwh: float = 400.0
@@ -377,6 +386,8 @@ class SignalsConfig:
     def validate(self) -> None:
         if self.backend not in ("synthetic", "replay", "live"):
             raise ConfigError(f"signals: unknown backend {self.backend!r}")
+        if self.spot_feed not in ("", "aws"):
+            raise ConfigError(f"signals: unknown spot_feed {self.spot_feed!r}")
         if self.backend == "replay" and not self.replay_path:
             raise ConfigError("signals: replay backend requires replay_path")
         if self.carbon_default_g_kwh <= 0:
@@ -397,10 +408,32 @@ class TrainConfig:
     # instead of host numpy — same signal family, different RNG stream;
     # sources without a device path (replay/live) ignore this.
     device_traces: bool = True
-    # Objective weights: J = cost + carbon_weight * gCO2 + slo_weight * burn.
-    carbon_weight: float = 5e-5  # $ per gCO2 (≈ $50/tCO2e social cost)
-    slo_weight: float = 0.05     # $ per pending-pod-step
+    # Objective weights: J = cost + carbon_weight * gCO2
+    #   + slo_weight * pending + slo_violation_weight * (1 - slo_ok).
+    # Carbon price: $500/tCO2e — the upper band of published social-cost
+    # estimates, deliberately above the $50 central value so the carbon
+    # term is *material* against fleet dollars at demo scale (at $50/t the
+    # term is ~5% of spend and optimizers ignore zone carbon entirely —
+    # the round-2 failure mode). The published gCO2/kreq scoreboard metric
+    # is unweighted; this only shapes what learned backends optimize.
+    carbon_weight: float = 5e-4  # $ per gCO2
+    # Pending-pod price: the smooth gradient carrier for diff-MPC. Sized at
+    # ~2.5x an on-demand node-tick ($0.0008) so shedding a pod is never
+    # cheaper than the node that would serve it, but one bad tick no longer
+    # outweighs hundreds of ticks of fleet spend (round-2 value 0.05 did,
+    # and PPO learned 1.5x overprovisioning from it).
+    slo_weight: float = 0.002    # $ per pending-pod-step
+    # Price of a tick failing the SLO gate — the exact event the scoreboard
+    # denominators count (usd_per_slo_hour, slo_attainment). ~7x the
+    # per-tick fleet spend of the rule baseline ($0.003): violations must
+    # be rare, but buying one with a doubled fleet is a losing trade.
+    slo_violation_weight: float = 0.02  # $ per SLO-violated tick
     # PPO-specific.
+    # Cosine-decay the learning rate to ~0 over this many iterations
+    # (0 = constant LR). Long flagship runs drift at constant LR — the
+    # selection loop kept rejecting late checkpoints — while decayed runs
+    # anneal into a stable policy.
+    lr_decay_iters: int = 0
     ppo_clip: float = 0.2
     ppo_epochs: int = 4
     # Early-stop epochs once approx-KL exceeds this (masked inside the
@@ -413,6 +446,13 @@ class TrainConfig:
     # MPC-specific.
     mpc_horizon: int = 32
     mpc_iters: int = 20
+    # Terminal cost: price the end-of-horizon standing fleet at its
+    # cost+carbon run-rate for this many further ticks. Node placement
+    # pays off over node *lifetimes* (hours), not the 16-minute horizon —
+    # without a terminal term the planner is myopic about zone carbon and
+    # lingering slack (round-3 finding: MPC's carbon ratio immovable at
+    # ~1.005 under any carbon price until this term landed).
+    mpc_terminal_ticks: int = 120  # one further hour at 30s ticks
 
     def validate(self) -> None:
         if self.batch_clusters <= 0 or self.unroll_steps <= 0:

@@ -62,6 +62,12 @@ class TickReport:
     detail: str = ""
     # Model-estimated app p95 (queueing-curve proxy, `sim/dynamics.py`).
     latency_p95_ms: float = 0.0
+    # Tick-rate KPI gauges (the dashboard's $/1k-req, gCO2e/1k-req and
+    # waste% panels, proposal PDF p.5). Episode-level versions live in
+    # EpisodeSummary; these are the instantaneous rates a live scrape sees.
+    usd_per_kreq: float = 0.0
+    g_co2_per_kreq: float = 0.0
+    waste_frac: float = 0.0
     # Measured app-level SLO metrics when the signal source scrapes them
     # (live Prometheus: p95/RPS/queue depth — the §2.3 inputs the
     # reference advertised but never collected). Empty for sources
@@ -171,9 +177,13 @@ class Controller:
                  lock: bool = False,
                  lock_dir: str | None = None,
                  telemetry_path: str = "",
+                 exporter=None,
                  log_fn: Callable[[str], None] | None = None,
                  sleep_fn: Callable[[float], None] = time.sleep):
         self.cfg = cfg
+        # Prometheus exposition of the tick KPIs (harness.promexport);
+        # None disables. Updated after every tick.
+        self.exporter = exporter
         self.backend = backend
         self.source = source
         # Multi-region fleets (BASELINE config #4) run one Karpenter per
@@ -313,6 +323,16 @@ class Controller:
         profile = ""
         if hasattr(self.backend, "profile_name"):
             profile = self.backend.profile_name(is_peak)
+        # Tick-rate KPIs (same formulas as EpisodeSummary, one-tick window;
+        # requests clamp at raw demand exactly like the simulator does).
+        effective = float(np.minimum(np.asarray(metrics.served_pods),
+                                     np.asarray(metrics.demand_pods)).sum())
+        kreq = effective * float(self.params.rps_per_pod) \
+            * float(self.params.dt_s) / 1000.0
+        served_total = float(np.asarray(metrics.served_pods).sum())
+        capacity = ((float(np.asarray(metrics.nodes_by_ct).sum())
+                     + float(self.params.base_od_nodes))
+                    * float(self.params.pods_per_node))
         report = TickReport(
             t=t,
             is_peak=is_peak,
@@ -328,12 +348,18 @@ class Controller:
             slo_ok=bool(float(metrics.slo_ok) > 0.5),
             detail="; ".join(r.detail for r in results if r.detail)[:500],
             latency_p95_ms=float(metrics.latency_p95_ms),
+            usd_per_kreq=float(metrics.cost_usd) / max(kreq, 1e-9),
+            g_co2_per_kreq=float(metrics.carbon_g) / max(kreq, 1e-9),
+            waste_frac=max(capacity - served_total, 0.0) / max(capacity,
+                                                               1e-9),
             slo_metrics=slo_metrics,
             timings_ms=timer.timings_ms(),
         )
         self.log_fn(report.to_json())
         if self.telemetry is not None:
             self.telemetry.write(dataclasses.asdict(report))
+        if self.exporter is not None:
+            self.exporter.update(report)
         return report
 
     # -- the loop ----------------------------------------------------------
@@ -373,26 +399,53 @@ class Controller:
 
 def controller_from_config(cfg: FrameworkConfig, backend: PolicyBackend,
                            *, live: bool = False,
-                           runner=None, **kwargs) -> Controller:
+                           runner=None, region_runners=None,
+                           **kwargs) -> Controller:
     """Wire a controller with the configured signal source and a sink:
     DryRunSink by default, KubectlSink with ``live=True`` (runner
-    injectable for tests)."""
-    from ccka_tpu.actuation.sink import DryRunSink, KubectlSink
+    injectable for tests).
+
+    Live multi-region requires a kubectl path per region: either
+    ``region_runners`` (``{region_name: runner}``, tests) or
+    ``RegionSpec.kube_context`` set on every region (operators — the CLI
+    reaches this via config). Sharing one context would apply both regions'
+    NodePool patches (same pool names, different zone sets) to ONE cluster
+    each tick — requirements ping-ponging that only surfaces at verify
+    time — so that wiring is refused outright, like the controller's
+    ``--keda`` config gate.
+    """
+    from ccka_tpu.actuation.sink import (DryRunSink, KubectlSink,
+                                         context_runner)
     from ccka_tpu.signals.live import make_signal_source
 
     source = make_signal_source(cfg.cluster, cfg.workload, cfg.sim,
                                 cfg.signals)
 
-    def make_sink():
-        if live:
-            return KubectlSink(runner) if runner else KubectlSink()
-        return DryRunSink()
-
     if cfg.cluster.regions:
-        # One sink per regional cluster. Live multi-region operation needs
-        # per-region kubectl contexts wired into each runner; the default
-        # shares one runner (suitable for dry-run and single-context tests).
-        sink = {r.name: make_sink() for r in cfg.cluster.regions}
+        # One sink per regional cluster.
+        if live:
+            runners = dict(region_runners or {})
+            for r in cfg.cluster.regions:
+                if r.name not in runners and r.kube_context:
+                    runners[r.name] = context_runner(r.kube_context)
+            missing = [r.name for r in cfg.cluster.regions
+                       if r.name not in runners]
+            if missing:
+                raise ValueError(
+                    "live multi-region controller requires one kubectl "
+                    f"runner per region; missing for {missing}. Set "
+                    "RegionSpec.kube_context on every region (e.g. "
+                    'CCKA_CLUSTER_REGIONS=\'[{"name": ..., '
+                    '"kube_context": ...}]\') or pass region_runners= — a '
+                    "shared kube-context would ping-pong the same "
+                    "NodePools between the regions' zone sets every tick.")
+            sink = {r.name: KubectlSink(runners[r.name])
+                    for r in cfg.cluster.regions}
+        else:
+            sink = {r.name: DryRunSink() for r in cfg.cluster.regions}
     else:
-        sink = make_sink()
+        if live:
+            sink = KubectlSink(runner) if runner else KubectlSink()
+        else:
+            sink = DryRunSink()
     return Controller(cfg, backend, source, sink, **kwargs)

@@ -103,3 +103,141 @@ def render_dashboard_configmap(prometheus_url: str,
                      json.dumps(render_dashboard(), indent=2)},
         },
     ]
+
+
+GRAFANA_IMAGE = "grafana/grafana:10.4.2"  # demo_40_watch_config.sh:94
+
+
+def render_grafana_admin_secret(namespace: str = "nov-22",
+                                password: str | None = None) -> dict:
+    """Grafana admin Secret (`demo_40_watch_config.sh:36-48`). A random
+    password is generated unless supplied (supply one for golden tests);
+    stringData keeps the manifest reviewable in dry-run output."""
+    if password is None:
+        import secrets
+        password = secrets.token_urlsafe(12)
+    return {
+        "apiVersion": "v1", "kind": "Secret",
+        "metadata": {"name": "ccka-grafana-admin", "namespace": namespace,
+                     "labels": {"app": "ccka-grafana"}},
+        "type": "Opaque",
+        "stringData": {"admin-user": "admin", "admin-password": password},
+    }
+
+
+def render_grafana_deployment(namespace: str = "nov-22") -> list[dict]:
+    """Namespace-local Grafana Deployment + Service + dashboard-provider
+    ConfigMap (`demo_40_watch_config.sh:75-138`), redesigned to pass this
+    framework's own guardrails:
+
+    - every container carries requests+limits (the `require-requests-limits`
+      ClusterPolicy in `actuation/guardrails.py` would reject the
+      reference's Grafana pod, which has none);
+    - non-root + no privilege escalation + dropped caps, like the burst
+      workload's hardened pod spec;
+    - unlike the reference (datasources only), the committed dashboard is
+      provisioned too, via a file provider — no manual import step.
+    """
+    provider = {
+        "apiVersion": 1,
+        "providers": [{
+            "name": "ccka",
+            "type": "file",
+            "options": {"path": "/var/lib/grafana/dashboards"},
+        }],
+    }
+    provider_cm = {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "ccka-grafana-dashboard-provider",
+                     "namespace": namespace,
+                     "labels": {"app": "ccka-grafana"}},
+        "data": {"provider.yaml": json.dumps(provider, indent=2)},
+    }
+    secret_env = [
+        {"name": f"GF_SECURITY_ADMIN_{k.upper()}",
+         "valueFrom": {"secretKeyRef": {"name": "ccka-grafana-admin",
+                                        "key": f"admin-{k}"}}}
+        for k in ("user", "password")]
+    deployment = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "ccka-grafana", "namespace": namespace,
+                     "labels": {"app": "ccka-grafana"}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "ccka-grafana"}},
+            "template": {
+                "metadata": {"labels": {"app": "ccka-grafana"}},
+                "spec": {
+                    "securityContext": {
+                        "runAsNonRoot": True,
+                        "runAsUser": 472,  # grafana image uid
+                        "seccompProfile": {"type": "RuntimeDefault"},
+                    },
+                    "containers": [{
+                        "name": "grafana",
+                        "image": GRAFANA_IMAGE,
+                        "imagePullPolicy": "IfNotPresent",
+                        "ports": [{"containerPort": 3000, "name": "http"}],
+                        "env": secret_env + [
+                            {"name": "GF_AUTH_ANONYMOUS_ENABLED",
+                             "value": "false"},
+                        ],
+                        "resources": {
+                            "requests": {"cpu": "100m", "memory": "128Mi"},
+                            "limits": {"cpu": "500m", "memory": "256Mi"},
+                        },
+                        "securityContext": {
+                            "allowPrivilegeEscalation": False,
+                            "capabilities": {"drop": ["ALL"]},
+                        },
+                        "readinessProbe": {
+                            "httpGet": {"path": "/login", "port": 3000},
+                            "initialDelaySeconds": 5, "periodSeconds": 5},
+                        "livenessProbe": {
+                            "httpGet": {"path": "/api/health", "port": 3000},
+                            "initialDelaySeconds": 10, "periodSeconds": 10},
+                        "volumeMounts": [
+                            {"name": "datasources",
+                             "mountPath":
+                                 "/etc/grafana/provisioning/datasources"},
+                            {"name": "dashboard-provider",
+                             "mountPath":
+                                 "/etc/grafana/provisioning/dashboards"},
+                            {"name": "dashboards",
+                             "mountPath": "/var/lib/grafana/dashboards"},
+                        ],
+                    }],
+                    "volumes": [
+                        {"name": "datasources",
+                         "configMap": {"name": "ccka-grafana-datasource"}},
+                        {"name": "dashboard-provider",
+                         "configMap":
+                             {"name": "ccka-grafana-dashboard-provider"}},
+                        {"name": "dashboards",
+                         "configMap": {"name": "ccka-grafana-dashboard"}},
+                    ],
+                },
+            },
+        },
+    }
+    service = {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "ccka-grafana", "namespace": namespace,
+                     "labels": {"app": "ccka-grafana"}},
+        "spec": {
+            "selector": {"app": "ccka-grafana"},
+            "ports": [{"name": "http", "port": 3000, "targetPort": 3000}],
+        },
+    }
+    return [provider_cm, deployment, service]
+
+
+def render_observability_stack(prometheus_url: str,
+                               namespace: str = "nov-22",
+                               *, admin_password: str | None = None
+                               ) -> list[dict]:
+    """The WHOLE demo_40 configure stage as manifests, apply-ordered:
+    provisioning ConfigMaps, admin Secret, then Deployment + Service."""
+    return (render_dashboard_configmap(prometheus_url, namespace)
+            + [render_grafana_admin_secret(namespace, admin_password)]
+            + render_grafana_deployment(namespace))

@@ -9,8 +9,10 @@ in two tiers:
 - always: config validity, JAX backend present, simulator compiles a step,
   signal source produces a sane tick;
 - --live additionally: both NodePools exist and are neutral
-  (`demo_18:42-55`), zero leftover burst workloads (`demo_18:30-39`), and
-  the Karpenter node role is mapped in aws-auth (`demo_18:67-81`).
+  (`demo_18:42-55`), zero leftover burst workloads (`demo_18:30-39`), the
+  Karpenter node role is mapped in aws-auth (`demo_18:67-81`), and the
+  operator dashboard ports are free (`demo_18:58-65` — a stale
+  port-forward squatting 3000/8005/9090 breaks the observe session).
 
 Each check returns (ok, detail) and the runner prints a pass/fail table —
 the same contract as the bash gate, machine-checkable from pytest.
@@ -96,6 +98,51 @@ def check_signals(cfg: FrameworkConfig) -> PrerollCheck:
                             hint="check signals.* config / endpoints")
 
 
+# Grafana's operator port (`demo_40_watch_observe.sh:56`); the AMP-proxy
+# (8005) and OpenCost (9090) ports come from the signals URLs.
+GRAFANA_PORT = 3000
+
+
+def _local_ports(cfg: FrameworkConfig) -> list[int]:
+    """Ports the observe session will port-forward onto this host: Grafana
+    plus any localhost endpoint in the signals config (the framework analog
+    of demo_18's hardcoded 3000/8005/9090 list)."""
+    from urllib.parse import urlparse
+
+    ports = [GRAFANA_PORT]
+    for url in (cfg.signals.prometheus_url, cfg.signals.opencost_url):
+        u = urlparse(url)
+        if u.hostname in ("localhost", "127.0.0.1") and u.port:
+            ports.append(u.port)
+    return sorted(set(ports))
+
+
+def check_ports_free(cfg: FrameworkConfig,
+                     ports: Sequence[int] | None = None) -> list[PrerollCheck]:
+    """Dashboard ports are bindable (`demo_18_preroll_check.sh:58-65`).
+
+    A port already bound almost always means a stale `kubectl port-forward`
+    from a previous observe session — the reference's remediation (kill the
+    PF, `demo_19_reset_policies.sh:39-55`) is the hint here.
+    """
+    import socket
+
+    out = []
+    for port in (ports if ports is not None else _local_ports(cfg)):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.bind(("127.0.0.1", port))
+            out.append(PrerollCheck(f"port-{port}-free", True))
+        except OSError as e:
+            out.append(PrerollCheck(
+                f"port-{port}-free", False, str(e),
+                hint="stale port-forward? run `ccka reset` or kill the "
+                     f"process listening on {port} (demo_19:39-55)"))
+        finally:
+            s.close()
+    return out
+
+
 def check_nodepools_live(cfg: FrameworkConfig, runner) -> list[PrerollCheck]:
     """Live-cluster checks (demo_18:42-55): pools exist and are neutral."""
     out = []
@@ -176,6 +223,7 @@ def run_preroll(cfg: FrameworkConfig, *, live: bool = False,
         checks.extend(check_nodepools_live(cfg, r))
         checks.append(check_no_leftover_burst(cfg, r))
         checks.append(check_aws_auth(cfg, r))
+        checks.extend(check_ports_free(cfg))
 
     ok = True
     for c in checks:

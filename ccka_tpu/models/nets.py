@@ -29,6 +29,14 @@ from ccka_tpu.sim.types import Action, N_CT
 # latent policy can reach the entire feasible consolidateAfter range.
 _AFTER_MAX_S = CONSOLIDATE_AFTER_MAX_S
 _HPA_LO, _HPA_HI = 0.1, 4.0
+# Zero-latent bias: sigmoid(0 + bias) must decode to hpa_scale = 1.0 (serve
+# demand exactly), not the range midpoint 2.05. A zero-initialized policy
+# head otherwise *starts* at 2x overprovisioning and PPO spends its whole
+# budget walking that down (round-3 sweep: attainment pinned at 0.996 and
+# carbon 1.6x rule at every weight setting until this bias landed).
+import math as _math
+
+_HPA_BIAS = _math.log((1.0 - _HPA_LO) / (_HPA_HI - 1.0))  # logit of 0.2308
 _EPS = 1e-6
 
 
@@ -48,7 +56,8 @@ def latent_to_action(u: jnp.ndarray, cluster: ClusterConfig,
     ct = jax.nn.sigmoid(parts[1]).reshape(u.shape[:-1] + (p, N_CT))
     aggr = jax.nn.sigmoid(parts[2])
     after = _AFTER_MAX_S * jax.nn.sigmoid(parts[3])
-    hpa = _HPA_LO + (_HPA_HI - _HPA_LO) * jax.nn.sigmoid(parts[4])
+    hpa = _HPA_LO + (_HPA_HI - _HPA_LO) * jax.nn.sigmoid(
+        parts[4] + _HPA_BIAS)
     return project_feasible(
         Action(zone_weight=zone_w, ct_allow=ct, consolidation_aggr=aggr,
                consolidate_after_s=after, hpa_scale=hpa),
@@ -67,7 +76,7 @@ def action_to_latent(action: Action, cluster: ClusterConfig) -> jnp.ndarray:
         logit(action.ct_allow).reshape(action.ct_allow.shape[:-2] + (-1,)),
         logit(action.consolidation_aggr),
         logit(action.consolidate_after_s, 0.0, _AFTER_MAX_S),
-        logit(action.hpa_scale, _HPA_LO, _HPA_HI),
+        logit(action.hpa_scale, _HPA_LO, _HPA_HI) - _HPA_BIAS,
     ]
     return jnp.concatenate(parts, axis=-1)
 
@@ -104,9 +113,12 @@ class ActorCritic(nn.Module):
 
     The actor emits (mean, log_std) over the latent action space; log_std is
     a learned state-independent vector (standard for continuous PPO). The
-    zero-init mean head makes the initial policy the codec midpoint — all
-    zones open, both capacity types allowed, mild consolidation — i.e. close
-    to the reference's neutral profile (`demo_19_reset_policies.sh`).
+    zero-init mean head makes the initial policy the codec's zero point —
+    all zones open, both capacity types allowed, mild consolidation, and
+    (via the codec's hpa bias) serve-demand-exactly hpa_scale=1 — i.e. the
+    reference's neutral profile (`demo_19_reset_policies.sh`), which is
+    also a *sane operating point*: training refines a working autoscaler
+    instead of first unlearning 2x overprovisioning.
     """
 
     act_dim: int

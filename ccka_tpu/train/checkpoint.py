@@ -44,6 +44,58 @@ def load_state(path: str, *, step: int | None = None,
     return restored
 
 
+def save_params_npz(path: str, params: Any, *,
+                    meta: dict | None = None) -> str:
+    """Single-file pytree snapshot (np.savez) for params that ship in-repo.
+
+    Orbax step directories are the right tool for training resume, but the
+    flagship policy checkpoint is committed to git and loaded by bench.py —
+    one small reviewable file beats a directory tree there. Keys are
+    '/'-joined tree paths; ``meta`` (JSON-serializable) rides along under
+    ``__meta__`` for provenance (training config, eval scores).
+    """
+    import json as _json
+
+    flat = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(_path_part(p) for p in kp)
+        flat[key] = np.asarray(jax.device_get(leaf))
+    if meta is not None:
+        flat["__meta__"] = np.frombuffer(
+            _json.dumps(meta).encode(), dtype=np.uint8)
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, **flat)
+    return path
+
+
+def load_params_npz(path: str) -> tuple[Any, dict]:
+    """Inverse of :func:`save_params_npz`: (nested-dict params, meta)."""
+    import json as _json
+
+    with np.load(path) as z:
+        meta = {}
+        tree: dict = {}
+        for key in z.files:
+            if key == "__meta__":
+                meta = _json.loads(bytes(z[key]).decode())
+                continue
+            node = tree
+            parts = key.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = z[key]
+    return tree, meta
+
+
+def _path_part(p: Any) -> str:
+    # DictKey('x') -> 'x'; SequenceKey(i) -> str(i); attr -> name.
+    for attr in ("key", "idx", "name"):
+        if hasattr(p, attr):
+            return str(getattr(p, attr))
+    return str(p)
+
+
 def latest_step(path: str) -> int | None:
     path = os.path.abspath(path)
     if not os.path.isdir(path):

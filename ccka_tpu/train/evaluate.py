@@ -57,6 +57,11 @@ def evaluate_backend(cfg: FrameworkConfig, backend: PolicyBackend,
     out = {k: float(np.mean([np.asarray(getattr(s, k)) for s in summaries]))
            for k in summaries[0]._fields}
     out["objective_usd"] = float(np.mean([np.asarray(o) for o in objectives]))
+    # Per-trace headline values, so scoreboards can report spread — a mean
+    # ratio within noise of 1.0 must be distinguishable from a real win.
+    out["per_trace"] = {
+        k: [float(np.asarray(getattr(s, k))) for s in summaries]
+        for k in ("usd_per_slo_hour", "g_co2_per_kreq", "slo_attainment")}
     out["backend"] = backend.name
     return out
 

@@ -49,9 +49,30 @@ def optimize_plan(params: SimParams,
 
     def objective(latent):
         actions = jax.vmap(lambda u: latent_to_action(u, cluster))(latent)
-        _, metrics = rollout_actions(params, state0, actions, trace,
-                                     jax.random.key(0), stochastic=False)
-        return episode_objective(metrics, tcfg)
+        final, metrics = rollout_actions(params, state0, actions, trace,
+                                         jax.random.key(0),
+                                         stochastic=False)
+        j = episode_objective(metrics, tcfg)
+        if tcfg.mpc_terminal_ticks > 0:
+            # Terminal cost: the standing fleet keeps billing and emitting
+            # after the window closes. Priced at the final tick's
+            # prices/carbon with a mid-load power draw — enough signal for
+            # zone placement and slack trimming to carry their true
+            # lifetime weight (see TrainConfig.mpc_terminal_ticks).
+            last = jax.tree.map(lambda x: x[-1], exo_steps(trace))
+            dt_hr = params.dt_s / 3600.0
+            z = last.spot_price_hr.shape[-1]
+            price_zc = jnp.stack([last.spot_price_hr, last.od_price_hr],
+                                 axis=-1)                       # [Z, T_CT]
+            nodes_zc = final.nodes.sum(axis=0)                  # [Z, T_CT]
+            nodes_zc = nodes_zc.at[:, 1].add(params.base_od_nodes / z)
+            cost_rate = (nodes_zc * price_zc).sum() * dt_hr
+            watts_mid = 0.5 * (params.watts_idle + params.watts_full)
+            kwh_z = nodes_zc.sum(axis=-1) * watts_mid / 1000.0 * dt_hr
+            carbon_rate = (kwh_z * last.carbon_g_kwh).sum()
+            j = j + tcfg.mpc_terminal_ticks * (
+                cost_rate + tcfg.carbon_weight * carbon_rate)
+        return j
 
     opt = optax.adam(tcfg.learning_rate * 10.0)  # plans tolerate larger steps
 
@@ -66,6 +87,28 @@ def optimize_plan(params: SimParams,
     latent, _, losses = jax.lax.fori_loop(
         0, iters, body, (init_latent, opt.init(init_latent), losses0))
     return PlanResult(plan_latent=latent, losses=losses)
+
+
+@partial(jax.jit, static_argnames=("cluster", "tcfg", "iters"))
+def optimize_plan_batch(params: SimParams,
+                        cluster: ClusterConfig,
+                        tcfg: TrainConfig,
+                        states0: ClusterState,
+                        traces: ExogenousTrace,
+                        init_latents: jnp.ndarray,
+                        *,
+                        iters: int = 50) -> PlanResult:
+    """Fleet-scale planning: `vmap` of :func:`optimize_plan` over a cluster
+    batch ([N, ...] states / traces / latent plans → [N, H, A] plans).
+
+    One dispatch plans every cluster's receding-horizon window at once —
+    the N-cluster analog the round-2 review noted was missing (single-
+    cluster MPC at 8.5 plans/sec is two orders short of fleet control;
+    batching rides the same vmap economics as the rollout bench)."""
+    return jax.vmap(
+        lambda s, tr, lat: optimize_plan(params, cluster, tcfg, s, tr, lat,
+                                         iters=iters)
+    )(states0, traces, init_latents)
 
 
 @partial(jax.jit, static_argnames=("cluster", "tcfg", "horizon",
@@ -154,8 +197,16 @@ class MPCBackend(PolicyBackend):
         self.horizon = horizon or cfg.train.mpc_horizon
         self.iters = iters or cfg.train.mpc_iters
         self.replan_every = replan_every
-        # Warm start at the neutral profile rather than random actions.
-        base = action_to_latent(neutral_action(self.cluster), self.cluster)
+        # Warm start at the codec ZERO point, not action_to_latent(neutral):
+        # the neutral profile has zone_weight/ct_allow exactly 1.0, whose
+        # clipped logits (±9.2) saturate the sigmoid — gradients through
+        # those coordinates are ~1e-4 and Adam can never move zone or
+        # capacity-type choices off the warm start (observed round 3: MPC's
+        # carbon ratio stuck at 1.005 regardless of carbon_weight). The
+        # zero latent decodes to the same *behavior* (all zones open, both
+        # capacity types, hpa=1 via the codec bias) at full gradient.
+        base = jnp.zeros_like(
+            action_to_latent(neutral_action(self.cluster), self.cluster))
         self._plan = jnp.broadcast_to(base, (self.horizon,) + base.shape)
         self._plan_age = 0
 

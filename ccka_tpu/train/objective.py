@@ -6,10 +6,20 @@ across the rule baseline and learned policies. The scalarization prices the
 three signal families in dollars:
 
     J = cost_usd
-      + carbon_weight · carbon_g          (default ≈ $50/tCO2e social cost)
-      + slo_weight · pending_pod·ticks    (SLO burn proxy: unserved demand)
+      + carbon_weight · carbon_g           (default ≈ $50/tCO2e social cost)
+      + slo_weight · pending_pod·ticks     (smooth SLO-burn proxy)
+      + slo_violation_weight · (1−slo_ok)  (the tick failed the SLO gate)
 
 Lower is better. Rewards for PPO are the per-tick negative increments of J.
+
+Why two SLO terms: the scoreboard's headline denominators are *SLO-met
+hours* (usd_per_slo_hour) and attainment — a per-tick pass/fail — not
+pending-pod volume. Pricing only pending (round 2) made one bad tick with
+~20 pending pods cost ~$1 ≈ 300 ticks of fleet spend, so PPO bought 0.998
+attainment by overprovisioning 1.5× — losing both headline metrics. The
+violation term prices exactly what the scoreboard measures (a failed tick),
+while the small pending term remains the smooth gradient carrier diff-MPC
+needs (slo_ok is a hard gate with zero gradient).
 """
 
 from __future__ import annotations
@@ -26,7 +36,8 @@ def step_cost(metrics: StepMetrics, tcfg: TrainConfig) -> jnp.ndarray:
         metrics.demand_pods - metrics.served_pods, 0.0).sum(axis=-1)
     return (metrics.cost_usd
             + tcfg.carbon_weight * metrics.carbon_g
-            + tcfg.slo_weight * pending)
+            + tcfg.slo_weight * pending
+            + tcfg.slo_violation_weight * (1.0 - metrics.slo_ok))
 
 
 def step_reward(metrics: StepMetrics, tcfg: TrainConfig) -> jnp.ndarray:

@@ -70,9 +70,17 @@ class PPOTrainer:
         self.params_sim = SimParams.from_config(cfg)
         self.act_dim = latent_dim(cfg.cluster)
         self.net = ActorCritic(act_dim=self.act_dim)
+        if self.tcfg.lr_decay_iters > 0:
+            # One optimizer step per epoch per iteration.
+            lr = optax.cosine_decay_schedule(
+                self.tcfg.learning_rate,
+                self.tcfg.lr_decay_iters * self.tcfg.ppo_epochs,
+                alpha=0.05)
+        else:
+            lr = self.tcfg.learning_rate
         self.opt = optax.chain(
             optax.clip_by_global_norm(1.0),
-            optax.adam(self.tcfg.learning_rate),
+            optax.adam(lr),
         )
         self._iteration_fn = jax.jit(self._iteration)
 

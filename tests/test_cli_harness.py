@@ -133,6 +133,33 @@ def test_preroll_live_checks_with_fake_kubectl():
     assert run_preroll(cfg, live=True, runner=missing_runner, echo=False) == 1
 
 
+def test_preroll_port_checks():
+    """demo_18:58-65 analog: a squatted dashboard port fails the gate with
+    the stale-port-forward hint; free ports pass."""
+    import socket
+
+    from ccka_tpu.harness.preroll import _local_ports, check_ports_free
+
+    cfg = default_config()
+    # Ports derive from the signals URLs + Grafana: 3000/8005/9090 for the
+    # default config — exactly the reference's list.
+    assert _local_ports(cfg) == [3000, 8005, 9090]
+
+    # Grab an ephemeral port, hold it, and assert the check flags it.
+    holder = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    holder.bind(("127.0.0.1", 0))
+    port = holder.getsockname()[1]
+    holder.listen(1)
+    try:
+        checks = check_ports_free(cfg, ports=[port])
+        assert len(checks) == 1 and not checks[0].ok
+        assert "port-forward" in checks[0].hint
+    finally:
+        holder.close()
+    free = check_ports_free(cfg, ports=[port])
+    assert free[0].ok
+
+
 def test_configure_observe_pair():
     cfg = default_config()
     co = ConfigureObserve(DryRunSink())

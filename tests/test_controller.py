@@ -7,6 +7,7 @@ with it — the automation of the operator's demo_20->demo_21 switch.
 """
 
 import json
+import time
 
 import numpy as np
 import pytest
@@ -161,6 +162,63 @@ def test_controller_from_config_wires_dry_run(cfg_edge):
     assert reports[0].applied
 
 
+def test_controller_from_config_refuses_live_multiregion_shared_context():
+    """Live multi-region with one shared kubectl context would apply both
+    regions' NodePool patches (same pool names, different zone sets) to one
+    cluster each tick — refused up front, not discovered at verify time."""
+    from ccka_tpu.config import multi_region_config
+
+    cfg = multi_region_config()
+    with pytest.raises(ValueError, match="runner per region"):
+        controller_from_config(cfg, RulePolicy(cfg.cluster), live=True,
+                               runner=lambda argv: (0, "{}"))
+    # Per-region runners satisfy the gate, and a live tick drives EVERY
+    # region's runner (no region silently actuated through another's).
+    calls = {r.name: 0 for r in cfg.cluster.regions}
+
+    def make_runner(name):
+        def run(argv):
+            calls[name] += 1
+            return (0, "{}")
+        return run
+
+    ctrl = controller_from_config(
+        cfg, RulePolicy(cfg.cluster), live=True,
+        region_runners={n: make_runner(n) for n in calls},
+        interval_s=0.0, lock=False, log_fn=lambda _l: None)
+    assert set(ctrl.region_sinks) == {r.name for r in cfg.cluster.regions}
+    assert all(isinstance(s, KubectlSink)
+               for s in ctrl.region_sinks.values())
+    ctrl.run(ticks=1)
+    assert all(c > 0 for c in calls.values()), calls
+
+
+def test_controller_from_config_builds_runners_from_kube_contexts():
+    """RegionSpec.kube_context is the operator/CLI path through the live
+    multi-region gate: each region's sink gets a runner pinned to that
+    region's kubeconfig context via `kubectl --context`."""
+    import dataclasses
+
+    from ccka_tpu.config import FrameworkConfig, multi_region_config
+
+    base = multi_region_config()
+    regions = tuple(dataclasses.replace(r, kube_context=f"ctx-{r.name}")
+                    for r in base.cluster.regions)
+    cluster = dataclasses.replace(base.cluster, regions=regions)
+    cfg = FrameworkConfig(cluster=cluster).validate()
+    ctrl = controller_from_config(cfg, RulePolicy(cfg.cluster), live=True,
+                                  interval_s=0.0, lock=False,
+                                  log_fn=lambda _l: None)
+    assert set(ctrl.region_sinks) == {r.name for r in regions}
+    # The wired runner really pins --context.
+    from ccka_tpu.actuation.sink import context_runner
+    seen = []
+    runner = context_runner("ctx-a", base=lambda argv: (seen.append(argv),
+                                                        (0, "{}"))[1])
+    runner(["kubectl", "get", "nodepool", "x"])
+    assert seen[0][:3] == ["kubectl", "--context", "ctx-a"]
+
+
 def test_controller_with_mpc_backend_replans(cfg_edge):
     """The receding-horizon path: controller triggers replan() on schedule
     and MPC decide() drives valid patches end to end."""
@@ -179,6 +237,64 @@ def test_controller_with_mpc_backend_replans(cfg_edge):
     pools = {c.name for c in sink.commands}
     assert pools == {p.name for p in cfg.cluster.pools}
     assert np.isfinite([r.cost_usd_hr for r in reports]).all()
+
+
+class TestSubprocessRunnerHardening:
+    """VERDICT r2 weak #10: a hung kubectl must not freeze the control
+    loop; transient API failures get bounded backoff, real errors none."""
+
+    def test_hanging_command_times_out(self):
+        from ccka_tpu.actuation.sink import _subprocess_runner
+
+        t0 = time.monotonic()
+        rc, out = _subprocess_runner(["sleep", "30"], timeout_s=0.2,
+                                     retries=1, backoff_s=0.01)
+        assert rc == 124 and "timed out" in out
+        assert time.monotonic() - t0 < 5  # (2 attempts x 0.2s) + slack
+
+    def test_transient_failure_retries_with_backoff(self, tmp_path):
+        from ccka_tpu.actuation.sink import _subprocess_runner
+
+        # Script fails with a transient-looking error once, then succeeds.
+        marker = tmp_path / "attempted"
+        script = tmp_path / "flaky.sh"
+        script.write_text(
+            "#!/bin/sh\n"
+            f"if [ -e {marker} ]; then echo recovered; exit 0; fi\n"
+            f"touch {marker}\n"
+            "echo 'dial tcp: connection refused' >&2\n"
+            "exit 1\n")
+        script.chmod(0o755)
+        sleeps = []
+        rc, out = _subprocess_runner([str(script)], retries=2,
+                                     backoff_s=0.5, sleep=sleeps.append)
+        assert rc == 0 and "recovered" in out
+        assert sleeps == [0.5]  # one retry, first backoff step
+
+    def test_permanent_failure_does_not_retry(self, tmp_path):
+        from ccka_tpu.actuation.sink import _subprocess_runner
+
+        count = tmp_path / "count"
+        script = tmp_path / "notfound.sh"
+        script.write_text(
+            "#!/bin/sh\n"
+            f"echo x >> {count}\n"
+            "echo 'Error from server (NotFound): nodepool not found' >&2\n"
+            "exit 1\n")
+        script.chmod(0o755)
+        sleeps = []
+        rc, out = _subprocess_runner([str(script)], retries=2,
+                                     backoff_s=0.5, sleep=sleeps.append)
+        assert rc == 1 and "NotFound" in out
+        assert len(count.read_text().splitlines()) == 1  # exactly 1 attempt
+        assert sleeps == []
+
+    def test_missing_binary_fails_fast(self):
+        from ccka_tpu.actuation.sink import _subprocess_runner
+
+        rc, out = _subprocess_runner(["/nonexistent/kubectl-xyz", "get"],
+                                     retries=2, backoff_s=0.01)
+        assert rc == 127
 
 
 class TestControllerLock:

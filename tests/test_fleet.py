@@ -1,0 +1,119 @@
+"""Fleet controller: one batched decide driving N per-cluster sinks.
+
+VERDICT r2 missing #5 / BASELINE config #5: fleet-scale *control*, not just
+fleet-scale simulation — a single on-device batched inference tick whose
+actions fan out to per-cluster actuation sinks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import default_config
+from ccka_tpu.harness.fleet import (FleetController,
+                                    fleet_controller_from_config)
+from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # Tiny pipeline depth keeps state small across 128 clusters.
+    return default_config().with_overrides(**{"sim.horizon_steps": 16})
+
+
+def test_one_batched_tick_drives_128_sinks(cfg):
+    """>=100 dry-run sinks per the VERDICT done-criterion: every cluster's
+    sink receives that cluster's patches from ONE batched decide."""
+    n = 128
+    ctrl = fleet_controller_from_config(
+        cfg, RulePolicy(cfg.cluster), n, horizon_ticks=8, seed=3)
+    reports = ctrl.run(ticks=2)
+    for rep in reports:
+        assert rep.n_clusters == n
+        assert rep.applied == n          # all dry-run applies succeed
+        assert rep.cost_usd_hr > 0
+    pool_names = {p.name for p in cfg.cluster.pools}
+    for sink in ctrl.sinks:
+        # Both pools patched on every tick for every cluster.
+        assert {c.name for c in sink.commands} == pool_names
+        # Tick 2 state is readable back per cluster (observe discipline).
+        state = sink.observed_state(cfg.cluster.pools[0].name)
+        assert state.get("zones")
+
+
+def test_fleet_actions_vary_with_per_cluster_signals(cfg):
+    """Clusters see independent signal streams; a signal-dependent policy
+    (carbon-aware zone weights) must be able to diverge across the fleet —
+    i.e. the batch axis carries real per-cluster state, not one broadcast
+    decision."""
+    n = 16
+    ctrl = fleet_controller_from_config(
+        cfg, CarbonAwarePolicy(cfg.cluster), n, horizon_ticks=8, seed=11)
+    # Probe the device tick directly: actions for distinct clusters.
+    exo = ctrl._exo_at(0)
+    carbon = np.asarray(exo.carbon_g_kwh)
+    assert np.std(carbon[:, 0]) > 0  # streams genuinely differ
+    actions, _, _ = ctrl._fleet_tick(ctrl.states, exo, jnp.int32(0),
+                                     jax.random.key(0))
+    zw = np.asarray(actions.zone_weight)
+    assert zw.shape[0] == n
+    assert np.std(zw[:, 0, 0]) > 1e-6  # decisions diverge across clusters
+
+
+def test_fleet_state_advances_and_accumulates(cfg):
+    ctrl = fleet_controller_from_config(
+        cfg, RulePolicy(cfg.cluster), 8, horizon_ticks=8, seed=0)
+    ctrl.run(ticks=3)
+    t = np.asarray(ctrl.states.time_s)
+    assert t.shape == (8,)
+    assert np.all(t == 3 * cfg.sim.dt_s)
+    assert np.all(np.asarray(ctrl.states.acc_cost_usd) > 0)
+
+
+def test_fleet_requires_device_batched_source(cfg):
+    from ccka_tpu.actuation.sink import DryRunSink
+
+    class NoBatch:  # a replay/live-shaped source without the device path
+        pass
+
+    with pytest.raises(ValueError, match="device-batched"):
+        FleetController(cfg, RulePolicy(cfg.cluster), NoBatch(),
+                        [DryRunSink()])
+
+
+def test_optimize_plan_batch_matches_single(cfg):
+    """vmap'd fleet planning is the same optimization per item."""
+    from ccka_tpu.models import action_to_latent
+    from ccka_tpu.policy.rule import neutral_action
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+    from ccka_tpu.sim import SimParams, initial_state
+    from ccka_tpu.train.mpc import optimize_plan, optimize_plan_batch
+
+    params = SimParams.from_config(cfg)
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    h, iters, n = 6, 3, 3
+    base = action_to_latent(neutral_action(cfg.cluster), cfg.cluster)
+    lat0 = jnp.broadcast_to(base, (h,) + base.shape)
+    traces = [src.trace(h, seed=i) for i in range(n)]
+    state0 = initial_state(cfg)
+
+    singles = [optimize_plan(params, cfg.cluster, cfg.train, state0,
+                             tr, lat0, iters=iters).plan_latent
+               for tr in traces]
+    batched = optimize_plan_batch(
+        params, cfg.cluster, cfg.train,
+        jax.tree.map(lambda x: jnp.broadcast_to(x, (n,) + x.shape), state0),
+        jax.tree.map(lambda *xs: jnp.stack(xs), *traces),
+        jnp.broadcast_to(lat0, (n,) + lat0.shape), iters=iters)
+    assert batched.plan_latent.shape == (n, h, base.shape[-1])
+    for i in range(n):
+        np.testing.assert_allclose(np.asarray(batched.plan_latent[i]),
+                                   np.asarray(singles[i]),
+                                   rtol=2e-3, atol=2e-3)
+    # Distinct traces → distinct plans (the batch isn't degenerate).
+    assert not np.allclose(np.asarray(batched.plan_latent[0]),
+                           np.asarray(batched.plan_latent[1]))

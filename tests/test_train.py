@@ -139,14 +139,16 @@ def test_ppo_backend_decides_feasible_actions(cfg, source):
 
 def test_ppo_reward_improves_on_tiny_problem(cfg, source):
     # Learnability: 12 iterations on the tiny fixture must genuinely move
-    # mean reward up. Measured margin is +0.08 across seeds 0-2 on this
-    # exact config; the bound sits at half that, so regression to
-    # "didn't collapse" fails while seed jitter passes.
+    # mean reward up. Calibration on the round-3 objective (carbon 5e-4,
+    # pending 0.002, violation 0.02): deltas +0.011..+0.020 across seeds
+    # 0-3. Bound sits at roughly half the weakest seed — fails a
+    # didn't-learn regression without pinning seed luck (round-2 advisor:
+    # RL variance across platforms makes near-margin bounds flaky).
     trainer = PPOTrainer(cfg)
     ts, history = trainer.train(source, iterations=12, log_every=1)
     first = np.mean([h["mean_reward"] for h in history[:3]])
     last = np.mean([h["mean_reward"] for h in history[-3:]])
-    assert last > first + 0.04
+    assert last > first + 0.005
 
 
 def test_checkpoint_round_trip(tmp_path, cfg):
@@ -159,3 +161,39 @@ def test_checkpoint_round_trip(tmp_path, cfg):
     back = jax.tree.leaves(restored)
     assert all(np.allclose(np.asarray(a), np.asarray(b))
                for a, b in zip(orig, back))
+
+
+def test_params_npz_round_trip_drives_policy(tmp_path, cfg, source):
+    """The single-file flagship format: params + provenance survive, and
+    the restored tree actually drives the policy net (not just shapes)."""
+    import jax.numpy as jnp
+
+    from ccka_tpu.sim import initial_state
+    from ccka_tpu.sim.rollout import exo_steps
+    from ccka_tpu.train.checkpoint import load_params_npz, save_params_npz
+    from ccka_tpu.train.ppo import PPOBackend
+
+    trainer = PPOTrainer(cfg)
+    ts = trainer.init_state()
+    meta = {"iterations_total": 7, "wins_both": False}
+    path = save_params_npz(str(tmp_path / "flag.npz"), ts.params, meta=meta)
+    params, got_meta = load_params_npz(path)
+    assert got_meta == meta
+    # Same decide() output from original and restored params.
+    exo = jax.tree.map(lambda x: x[0], exo_steps(source.trace(1)))
+    state = initial_state(cfg)
+    a1 = PPOBackend(cfg, ts.params).decide(state, exo, jnp.int32(0))
+    a2 = PPOBackend(cfg, params).decide(state, exo, jnp.int32(0))
+    for x, y in zip(jax.tree.leaves(a1), jax.tree.leaves(a2)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)
+
+
+def test_flagship_checkpoint_path_is_topology_keyed():
+    from ccka_tpu.config import default_config, multi_region_config
+    from ccka_tpu.train.flagship import flagship_checkpoint_path
+
+    single = flagship_checkpoint_path(default_config())
+    multi = flagship_checkpoint_path(multi_region_config())
+    assert single.endswith("ppo_flagship.npz")
+    assert multi.endswith("ppo_flagship_multiregion.npz")
+    assert flagship_checkpoint_path() == single
