@@ -99,6 +99,17 @@ def _build_parser() -> argparse.ArgumentParser:
     sb.add_argument("--json", action="store_true",
                     help="print the manifests instead of applying")
 
+    sf = sub.add_parser(
+        "fleet", help="fleet-scale dry-run control: one batched on-device "
+                      "decide over N clusters fanning out to N sinks per "
+                      "tick (report PDF p.4 s9 productization)")
+    sf.add_argument("--clusters", type=int, default=64)
+    sf.add_argument("--ticks", type=int, default=10)
+    sf.add_argument("--backend", default="rule",
+                    choices=("rule", "carbon", "ppo"))
+    sf.add_argument("--checkpoint", default="")
+    sf.add_argument("--seed", type=int, default=0)
+
     sg2 = sub.add_parser(
         "guardrails", help="apply the Kyverno admission ClusterPolicies "
                            "(04_kyverno analog: require-requests-limits, "
@@ -292,10 +303,22 @@ def make_backend(cfg: FrameworkConfig, name: str, checkpoint: str = ""):
             backend._plan = jnp.asarray(restored["plan"])
         return backend
     if name == "ppo":
-        if not checkpoint:
-            raise SystemExit("ccka: --backend ppo requires --checkpoint DIR")
-        from ccka_tpu.train.checkpoint import load_state
         from ccka_tpu.train.ppo import PPOBackend, PPOTrainer
+        if not checkpoint:
+            # Default to the shipped flagship checkpoint (topology-keyed).
+            from ccka_tpu.train.flagship import load_flagship_backend
+            backend, _meta = load_flagship_backend(cfg)
+            if backend is None:
+                raise SystemExit(
+                    "ccka: --backend ppo needs --checkpoint (no flagship "
+                    "checkpoint shipped for this topology; train one with "
+                    "`python -m ccka_tpu.train.flagship`)")
+            return backend
+        if checkpoint.endswith(".npz"):
+            from ccka_tpu.train.checkpoint import load_params_npz
+            params, _meta = load_params_npz(checkpoint)
+            return PPOBackend(cfg, params)
+        from ccka_tpu.train.checkpoint import load_state
         target = PPOTrainer(cfg).init_state().params
         params = load_state(checkpoint, target=target)
         return PPOBackend(cfg, params)
@@ -763,6 +786,33 @@ def main(argv: list[str] | None = None) -> int:
                                  args.device_traces)
         if args.command == "capture":
             return _cmd_capture(cfg, args.out, args.steps, args.seed)
+        if args.command == "fleet":
+            from ccka_tpu.harness.fleet import fleet_controller_from_config
+            if args.clusters < 1 or args.ticks < 1:
+                raise SystemExit("ccka: fleet needs --clusters >= 1 and "
+                                 "--ticks >= 1")
+            backend = make_backend(cfg, args.backend, args.checkpoint)
+            ctrl = fleet_controller_from_config(
+                cfg, backend, args.clusters,
+                horizon_ticks=max(args.ticks + 2, 8), seed=args.seed,
+                log_fn=lambda s: print(s, file=sys.stderr))
+            reports = ctrl.run(args.ticks)
+            ok = all(r.applied == r.n_clusters for r in reports)
+            summary = {
+                "clusters": args.clusters,
+                "ticks": args.ticks,
+                "applied_frac": sum(r.applied for r in reports)
+                / (args.clusters * max(len(reports), 1)),
+                "slo_ok_frac": sum(r.slo_ok for r in reports)
+                / (args.clusters * max(len(reports), 1)),
+                "fleet_cost_usd_hr_last": reports[-1].cost_usd_hr,
+                "decide_ms_mean": round(sum(r.decide_ms for r in reports)
+                                        / len(reports), 2),
+                "fanout_ms_mean": round(sum(r.fanout_ms for r in reports)
+                                        / len(reports), 2),
+            }
+            print(json.dumps(summary, indent=2))
+            return 0 if ok else 1
         if args.command == "preroll":
             return _cmd_preroll(cfg, args.live)
         if args.command == "bootstrap":

@@ -434,6 +434,10 @@ class TrainConfig:
     # selection loop kept rejecting late checkpoints — while decayed runs
     # anneal into a stable policy.
     lr_decay_iters: int = 0
+    # Initial policy stddev (log). -0.5 explores broadly; flagship
+    # refinement runs (near-optimal init) use ~-1.5 so exploration noise
+    # doesn't destroy the operating point before the critic calibrates.
+    init_log_std: float = -0.5
     ppo_clip: float = 0.2
     ppo_epochs: int = 4
     # Early-stop epochs once approx-KL exceeds this (masked inside the

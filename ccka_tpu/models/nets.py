@@ -123,6 +123,12 @@ class ActorCritic(nn.Module):
 
     act_dim: int
     hidden: Sequence[int] = (128, 128)
+    # Initial exploration scale. -0.5 (sigma~0.6) explores broadly — right
+    # when the init policy is far from optimal; flagship refinement runs
+    # start from a near-optimal init and use a smaller sigma so early
+    # exploration doesn't wreck the operating point before the critic
+    # learns (TrainConfig.init_log_std threads through PPOTrainer).
+    init_log_std: float = -0.5
 
     @nn.compact
     def __call__(self, obs: jnp.ndarray):
@@ -134,7 +140,8 @@ class ActorCritic(nn.Module):
         mean = nn.Dense(self.act_dim, dtype=jnp.float32,
                         kernel_init=nn.initializers.zeros,
                         name="actor_mean")(x)
-        log_std = self.param("log_std", nn.initializers.constant(-0.5),
+        log_std = self.param("log_std",
+                             nn.initializers.constant(self.init_log_std),
                              (self.act_dim,))
         value = nn.Dense(1, dtype=jnp.float32, name="critic")(x)
         return mean, log_std, jnp.squeeze(value, axis=-1)

@@ -17,6 +17,7 @@ is unreachable — the reference's dummy-carbon behavior, generalized.
 from __future__ import annotations
 
 import json
+import re
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -351,6 +352,12 @@ class LiveSignalSource(SignalSource):
 
     PENDING_QUERY = 'sum(kube_pod_status_phase{phase="Pending"})'
     RUNNING_QUERY = 'sum(kube_pod_status_phase{phase="Running"})'
+    # Per-pod series scoped to the workload namespace: classification into
+    # the simulator's two demand classes (class 0 spot / class 1 od — the
+    # burst generator's odd/even split) happens host-side from the pod
+    # name, since kube_pod_status_phase carries no nodeSelector labels.
+    POD_QUERY_TMPL = ('kube_pod_status_phase{{phase=~"Pending|Running",'
+                      'namespace="{ns}"}} > 0')
 
     def __init__(self, cluster: ClusterConfig, workload: WorkloadConfig,
                  sim: SimConfig, signals: SignalsConfig,
@@ -375,6 +382,7 @@ class LiveSignalSource(SignalSource):
             timeout_s=signals.request_timeout_s)
         self._synth = SyntheticSignalSource(cluster, workload, sim, signals,
                                             start_unix_s=self.start_unix_s)
+        self.namespace = workload.namespace
         self.slo = SLOMetricsClient(self.prom, namespace=workload.namespace)
         # Spot feed: enabled by signals.spot_feed="aws" (CLI transport) or
         # by injecting a runner directly (tests / alternate transports).
@@ -411,6 +419,32 @@ class LiveSignalSource(SignalSource):
         (absent series omitted — see :class:`SLOMetricsClient`)."""
         return self.slo.snapshot()
 
+    _BURST_POD = re.compile(r"^burst-web-(\d+)-")
+
+    def _demand_by_class(self) -> np.ndarray | None:
+        """[C] per-class pod demand from namespace-scoped per-pod series;
+        None when the query returns nothing (caller falls back)."""
+        rows = self.prom.query(
+            self.POD_QUERY_TMPL.format(ns=self.namespace))
+        # Only per-pod series count: an endpoint that answers every query
+        # with one anonymous aggregate (recording rules, test fakes) has
+        # no class information — fall back to the aggregate path.
+        rows = [(labels, val) for labels, val in rows if labels.get("pod")]
+        if not rows:
+            return None
+        by_class = np.zeros(2, dtype=np.float64)
+        for labels, val in rows:
+            m = self._BURST_POD.match(labels.get("pod", ""))
+            if m:
+                # Generator convention (`actuation/burst.py`): odd index →
+                # spot nodeSelector (class 0), even → on-demand (class 1).
+                cls = 0 if int(m.group(1)) % 2 == 1 else 1
+                by_class[cls] += val
+            else:
+                # Non-burst namespace pods: no capacity-type pin; spread.
+                by_class += val / 2.0
+        return by_class
+
     def meta(self) -> TraceMeta:
         return TraceMeta(source="live", start_unix_s=self.start_unix_s,
                          dt_s=self.sim.dt_s, zones=self.cluster.zones,
@@ -443,12 +477,22 @@ class LiveSignalSource(SignalSource):
         except SignalUnavailable:
             pass
 
+        # Demand: namespace-scoped per-pod series classified into the
+        # simulator's spot/od demand classes (burst-web-<i> odd→spot,
+        # even→od — the generator's own convention); falls back to the
+        # round-2 whole-cluster aggregate with an even split when per-pod
+        # series are unavailable (e.g. a stripped-down KSM).
         try:
-            pending = self.prom.query(self.PENDING_QUERY)
-            running = self.prom.query(self.RUNNING_QUERY)
-            if pending or running:
-                total = sum(v for _, v in pending) + sum(v for _, v in running)
-                demand[0, :] = total / demand.shape[-1]
+            by_class = self._demand_by_class()
+            if by_class is not None:
+                demand[0, :] = by_class
+            else:
+                pending = self.prom.query(self.PENDING_QUERY)
+                running = self.prom.query(self.RUNNING_QUERY)
+                if pending or running:
+                    total = (sum(v for _, v in pending)
+                             + sum(v for _, v in running))
+                    demand[0, :] = total / demand.shape[-1]
         except SignalUnavailable:
             pass
 

@@ -71,9 +71,20 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
                    eval_steps: int = 2880,
                    n_eval_traces: int = 5,
                    seed: int = 0,
+                   init_from: str = "scratch",
+                   distill_iterations: int = 2000,
                    log: Callable[[str], None] | None = None) -> dict:
     """Train + select. Returns {params, meta, history}; ``meta`` carries the
-    selection-trace scoreboard of the returned checkpoint."""
+    selection-trace scoreboard of the returned checkpoint.
+
+    ``init_from``: "scratch" (fresh net) or "distill:<teacher>" — behavior-
+    clone the named teacher first (`train/imitate.py`) and PPO-refine from
+    there. Distillation sidesteps PPO's early overprovision excursion (the
+    sharp violation-spike advantages that wreck a near-optimal init before
+    the critic calibrates; see round-3 trajectory in the module docstring
+    history) by starting BOTH the actor and critic at the teacher's
+    operating point.
+    """
     log = log or (lambda s: print(s, file=sys.stderr))
     cfg = cfg or default_config()
     trainer = PPOTrainer(cfg)
@@ -89,8 +100,20 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
         f"attain={rule_res['slo_attainment']:.4f}")
 
     ts = trainer.init_state(seed)
+    if init_from.startswith("distill:"):
+        from ccka_tpu.train.imitate import distill_teacher
+        teacher = init_from.split(":", 1)[1]
+        log(f"distilling teacher {teacher!r} into the policy net...")
+        params0, hist = distill_teacher(cfg, teacher, seed=seed,
+                                        iterations=distill_iterations)
+        log(f"distilled: actor_mse {hist[-1]['actor_mse']:.4f} "
+            f"critic_mse {hist[-1]['critic_mse']:.4f}")
+        ts = ts._replace(params=params0,
+                         opt_state=trainer.opt.init(params0))
+    elif init_from != "scratch":
+        raise ValueError(f"unknown init_from {init_from!r}")
     t_len = cfg.train.unroll_steps
-    # The INIT policy (neutral profile via the codec's zero point) is a
+    # The INIT policy (codec zero point, or the distilled teacher) is a
     # real candidate — round-3 diagnostics showed it near rule parity
     # while early training can wander worse; selection must see it.
     res0 = evaluate_backend(cfg, PPOBackend(cfg, ts.params), sel_traces)
@@ -147,6 +170,7 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
 
     meta = {
         "iterations_total": iterations,
+        "init_from": init_from,
         "selected_iteration": best["iteration"],
         "wins_both": bool(best["wins"]),
         "selection_seed0": _SELECTION_SEED0,
@@ -215,6 +239,9 @@ def main(argv=None) -> int:
     ap.add_argument("--traces", type=int, default=5)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--preset", default="default", choices=sorted(PRESETS))
+    ap.add_argument("--init-from", default="scratch",
+                    help='"scratch" or "distill:<teacher>" '
+                         '(carbon | rule)')
     ap.add_argument("--out", default="",
                     help="checkpoint path (default: the package's "
                          "topology-keyed flagship location, where "
@@ -234,7 +261,8 @@ def main(argv=None) -> int:
     out = train_flagship(cfg, iterations=args.iterations,
                          eval_every=args.eval_every,
                          eval_steps=args.eval_steps,
-                         n_eval_traces=args.traces, seed=args.seed)
+                         n_eval_traces=args.traces, seed=args.seed,
+                         init_from=args.init_from)
     out["meta"]["preset"] = args.preset
     # Default to the loader's own path — a CWD-relative default would ship
     # checkpoints to wherever the trainer happened to run while

@@ -1,0 +1,171 @@
+"""Behavior cloning: distill a teacher PolicyBackend into the policy net.
+
+Why this exists: PPO-from-scratch explores its way into gross
+overprovisioning before the diffuse cost/carbon gradient can walk it back
+(round-3 trajectory: x1.5 overprovision by iteration 100, still x1.3 at
+800) — the sharp SLO-violation reward spikes dominate early advantage
+estimates. But strong *traceable* teachers exist: the carbon-aware policy
+already beats the rule baseline on the multiregion fleet. Distilling a
+teacher into the ActorCritic net gives a LEARNED policy at the teacher's
+operating point, which `train/flagship.py` then selects or PPO-refines
+with small exploration.
+
+TPU mapping: dataset collection is one jitted `lax.scan` over the horizon
+`vmap`'d over a cluster batch (the teacher runs *inside* the scan — it is
+traceable by the PolicyBackend contract); distillation is plain minibatch
+Adam on two MSEs (actor mean → teacher latent, critic → observed
+discounted return), all on device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ccka_tpu.config import FrameworkConfig
+from ccka_tpu.models import ActorCritic, action_to_latent, latent_dim
+from ccka_tpu.policy.base import PolicyBackend, observe
+from ccka_tpu.sim.dynamics import step as sim_step
+from ccka_tpu.sim.rollout import exo_steps
+from ccka_tpu.sim.types import SimParams
+from ccka_tpu.train.objective import step_reward
+from ccka_tpu.train.ppo import PPOTrainer, _REWARD_SCALE
+
+# Teacher actions sit at the corners of the feasible box (one-hot zone
+# weights etc.); the exact inverse-codec logits are clipped at ~±9.2 where
+# the sigmoid saturates. Regressing onto ±9.2 would both blow up the MSE
+# scale and park the student in the same zero-gradient corner that froze
+# warm-started MPC plans — ±3 (sigmoid ≈ 0.95/0.05) reproduces the
+# teacher's *behavior* while keeping every coordinate trainable.
+_TARGET_CLIP = 3.0
+
+
+class ImitationBatch(NamedTuple):
+    obs: jnp.ndarray      # [N, F]
+    target: jnp.ndarray   # [N, A] clipped teacher latents
+    returns: jnp.ndarray  # [N] discounted reward-to-go (critic target)
+
+
+def collect_dataset(cfg: FrameworkConfig, teacher: PolicyBackend,
+                    source, *, batch_clusters: int | None = None,
+                    steps: int | None = None,
+                    seed: int = 0) -> ImitationBatch:
+    """Roll the teacher through stochastic dynamics; record
+    (observation, teacher latent, discounted return) flattened over
+    [B, T]. One jitted scan; nothing leaves the device until the end."""
+    b = batch_clusters or cfg.train.batch_clusters
+    t = steps or cfg.train.unroll_steps * 4
+    params = SimParams.from_config(cfg)
+    trainer = PPOTrainer(cfg)  # reuse obs/broadcast helpers
+    states = trainer._broadcast_state(b)
+    traces = source.batch_trace_device(t, jax.random.key(seed), b) \
+        if cfg.train.device_traces and hasattr(source, "batch_trace_device") \
+        else source.batch_trace(t, range(seed, seed + b))
+    xs = jax.tree.map(lambda x: jnp.moveaxis(x, 1, 0), exo_steps(traces))
+
+    action_fn = teacher.action_fn()
+
+    @jax.jit
+    def run(states, xs, key):
+        def body(carry, exo_t):
+            st, k, ti = carry
+            obs = trainer._obs(st, exo_t)                      # [B, F]
+            acts = jax.vmap(lambda s, e: action_fn(s, e, ti))(st, exo_t)
+            lat = jax.vmap(
+                lambda a: action_to_latent(a, cfg.cluster))(acts)
+            k, sub = jax.random.split(k)
+            keys = jax.random.split(sub, obs.shape[0])
+            st, metrics = jax.vmap(
+                partial(sim_step, params, stochastic=True)
+            )(st, acts, exo_t, keys)
+            r = step_reward(metrics, cfg.train) * _REWARD_SCALE
+            return (st, k, ti + 1), (obs, lat, r)
+
+        (_, _, _), (obs_t, lat_t, rew_t) = jax.lax.scan(
+            body, (states, key, jnp.int32(0)), xs)
+
+        # Discounted reward-to-go per (t, b) — the critic's target.
+        def disc(carry, r):
+            g = r + cfg.train.gamma * carry
+            return g, g
+
+        _, ret_rev = jax.lax.scan(disc, jnp.zeros_like(rew_t[0]),
+                                  rew_t[::-1])
+        returns = ret_rev[::-1]
+        return obs_t, lat_t, returns
+
+    obs_t, lat_t, returns = run(states, xs, jax.random.key(seed + 1))
+    flat = lambda x: x.reshape((-1,) + x.shape[2:])  # noqa: E731
+    return ImitationBatch(
+        obs=flat(obs_t),
+        target=jnp.clip(flat(lat_t), -_TARGET_CLIP, _TARGET_CLIP),
+        returns=flat(returns))
+
+
+def imitate(cfg: FrameworkConfig, teacher: PolicyBackend, source, *,
+            iterations: int = 2000, minibatch: int = 4096,
+            learning_rate: float = 1e-3, seed: int = 0,
+            dataset: ImitationBatch | None = None):
+    """Distill ``teacher`` into a fresh ActorCritic. Returns params ready
+    for PPOBackend / PPO fine-tuning (actor at the teacher, critic at the
+    teacher's value surface)."""
+    data = dataset if dataset is not None else collect_dataset(
+        cfg, teacher, source, seed=seed)
+    net = ActorCritic(act_dim=latent_dim(cfg.cluster),
+                      init_log_std=cfg.train.init_log_std)
+    key = jax.random.key(seed + 2)
+    params = net.init(key, data.obs[0])
+    opt = optax.adam(learning_rate)
+    opt_state = opt.init(params)
+    n = data.obs.shape[0]
+
+    @jax.jit
+    def step(params, opt_state, key):
+        idx = jax.random.randint(key, (minibatch,), 0, n)
+        obs, tgt, ret = (data.obs[idx], data.target[idx],
+                         data.returns[idx])
+
+        def loss_fn(p):
+            mean, _log_std, value = net.apply(p, obs)
+            actor = jnp.square(mean - tgt).mean()
+            critic = jnp.square(value - ret).mean()
+            return actor + 0.5 * critic, (actor, critic)
+
+        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, aux
+
+    history = []
+    for it in range(iterations):
+        key, sub = jax.random.split(key)
+        params, opt_state, (actor_l, critic_l) = step(params, opt_state,
+                                                      sub)
+        if it % max(1, iterations // 10) == 0 or it == iterations - 1:
+            history.append({"iteration": it,
+                            "actor_mse": float(actor_l),
+                            "critic_mse": float(critic_l)})
+    return params, history
+
+
+def distill_teacher(cfg: FrameworkConfig, teacher_name: str = "carbon",
+                    *, seed: int = 0, iterations: int = 2000):
+    """Convenience: build the named teacher, collect, distill.
+    Returns (params, history)."""
+    from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+    teachers = {
+        "carbon": lambda: CarbonAwarePolicy(cfg.cluster),
+        "rule": lambda: RulePolicy(cfg.cluster),
+    }
+    if teacher_name not in teachers:
+        raise ValueError(f"unknown teacher {teacher_name!r}")
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    return imitate(cfg, teachers[teacher_name](), src, seed=seed,
+                   iterations=iterations)

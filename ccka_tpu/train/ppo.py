@@ -69,7 +69,8 @@ class PPOTrainer:
         self.tcfg = cfg.train
         self.params_sim = SimParams.from_config(cfg)
         self.act_dim = latent_dim(cfg.cluster)
-        self.net = ActorCritic(act_dim=self.act_dim)
+        self.net = ActorCritic(act_dim=self.act_dim,
+                               init_log_std=self.tcfg.init_log_std)
         if self.tcfg.lr_decay_iters > 0:
             # One optimizer step per epoch per iteration.
             lr = optax.cosine_decay_schedule(

@@ -41,6 +41,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: smoke tests for the real accelerator "
         "(run with CCKA_TEST_TPU=1)")
+    config.addinivalue_line(
+        "markers", "slow: compile-heavy tests (8-device mesh, receding-"
+        "horizon MPC, end-to-end CLI train) — `-m 'not slow'` is the "
+        "quick lane (~3 min vs ~14 min full)")
 
 
 def pytest_collection_modifyitems(config, items):

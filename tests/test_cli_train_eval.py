@@ -4,7 +4,12 @@ unreachable from the CLI)."""
 
 import json
 
+import pytest
+
 from ccka_tpu.cli import main
+
+# End-to-end CLI train/evaluate runs: compile-heavy.
+pytestmark = pytest.mark.slow
 
 _TINY = ["--set", "train.batch_clusters=4", "--set", "train.unroll_steps=8",
          "--set", "train.mpc_horizon=8", "--set", "train.mpc_iters=3"]
@@ -74,10 +79,26 @@ def test_run_with_ppo_checkpoint(tmp_path, capsys):
     assert len(lines) == 2 and all(r["applied"] for r in lines)
 
 
-def test_ppo_backend_requires_checkpoint():
-    import pytest
-    with pytest.raises(SystemExit):
-        main(["observe", "--backend", "ppo"])
+def test_ppo_backend_defaults_to_flagship_checkpoint(capsys):
+    """`--backend ppo` without --checkpoint loads the shipped flagship
+    checkpoint for the topology; the hard error only fires when no
+    checkpoint ships (asserted via a topology with none)."""
+    import os
+    from unittest import mock
+
+    from ccka_tpu.config import default_config
+    from ccka_tpu.train.flagship import flagship_checkpoint_path
+
+    # Default topology: the shipped checkpoint makes ppo work out of the
+    # box (package-absolute path — the same one the loader resolves).
+    if os.path.exists(flagship_checkpoint_path(default_config())):
+        assert main(["observe", "--backend", "ppo"]) == 0
+        capsys.readouterr()
+    # No shipped checkpoint -> actionable SystemExit.
+    with mock.patch("ccka_tpu.train.flagship.flagship_checkpoint_path",
+                    return_value="/nonexistent/ckpt.npz"):
+        with pytest.raises(SystemExit, match="flagship"):
+            main(["observe", "--backend", "ppo"])
 
 
 def test_simulate_mpc_backend(capsys):

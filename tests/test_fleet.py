@@ -84,6 +84,17 @@ def test_fleet_requires_device_batched_source(cfg):
                         [DryRunSink()])
 
 
+def test_cli_fleet_command(cfg, capsys):
+    import json
+
+    from ccka_tpu.cli import main
+
+    assert main(["fleet", "--clusters", "8", "--ticks", "2"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["clusters"] == 8 and out["applied_frac"] == 1.0
+    assert out["fleet_cost_usd_hr_last"] > 0
+
+
 def test_optimize_plan_batch_matches_single(cfg):
     """vmap'd fleet planning is the same optimization per item."""
     from ccka_tpu.models import action_to_latent

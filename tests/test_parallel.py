@@ -26,6 +26,9 @@ from ccka_tpu.policy import RulePolicy
 from ccka_tpu.sim import SimParams, batched_rollout, initial_state
 from ccka_tpu.signals.synthetic import SyntheticSignalSource
 
+# Compile-heavy: every test jits over the 8-device virtual mesh.
+pytestmark = pytest.mark.slow
+
 
 def _batch(cfg, b, steps, seed=0):
     src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)

@@ -329,6 +329,37 @@ def test_live_tick_uses_measured_spot_prices():
     assert spot[2] == pytest.approx(prior[2])
 
 
+def test_live_demand_classified_per_class_from_pod_series():
+    """VERDICT r2 weak #4: demand should be namespace-scoped and split by
+    workload class (burst odd→spot / even→od), not a whole-cluster total
+    spread evenly."""
+    cfg = default_config()
+    pods = [
+        ({"pod": "burst-web-1-abc-x"}, 1.0),   # odd → spot (x3 replicas)
+        ({"pod": "burst-web-1-abc-y"}, 1.0),
+        ({"pod": "burst-web-1-abc-z"}, 1.0),
+        ({"pod": "burst-web-2-def-x"}, 1.0),   # even → od
+        ({"pod": "helper-7d9-q"}, 1.0),        # unpinned → split
+    ]
+    from urllib.parse import quote
+    fetch = _canned_fetch({
+        # URL fragment is percent-encoded by the client.
+        quote('phase=~"Pending|Running"'): {
+            "status": "success",
+            "data": {"result": [
+                {"metric": m, "value": [0, str(v)]} for m, v in pods]},
+        },
+        "/allocation": {"data": []},
+        "/assets": {"data": {}},
+    })
+    src = LiveSignalSource(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+                           fetch=fetch)
+    tick = src.tick(0)
+    demand = np.asarray(tick.demand_pods)[0]
+    assert demand[0] == pytest.approx(3.5)   # 3 spot + half the helper
+    assert demand[1] == pytest.approx(1.5)   # 1 od + half the helper
+
+
 def test_spot_feed_config_gate():
     """signals.spot_feed="aws" wires the CLI clients (one per region);
     default config leaves the feed disabled; bad values are ConfigError."""

@@ -188,6 +188,47 @@ def test_params_npz_round_trip_drives_policy(tmp_path, cfg, source):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)
 
 
+def test_imitation_distills_teacher(cfg, source):
+    """Behavior cloning: actor MSE collapses and the student's decisions
+    track the teacher's on fresh states (the PPO-warm-start path that
+    sidesteps the early overprovision excursion)."""
+    import jax.numpy as jnp
+
+    from ccka_tpu.policy import CarbonAwarePolicy
+    from ccka_tpu.sim.rollout import exo_steps, initial_state
+    from ccka_tpu.train.imitate import collect_dataset, imitate
+
+    teacher = CarbonAwarePolicy(cfg.cluster)
+    data = collect_dataset(cfg, teacher, source, steps=16, seed=0)
+    assert data.obs.shape[0] == data.target.shape[0] == 4 * 16
+    # Targets are inside the trainable band, not the saturated corners.
+    assert float(jnp.abs(data.target).max()) <= 3.0
+    params, hist = imitate(cfg, teacher, source, iterations=300,
+                           minibatch=64, dataset=data)
+    assert hist[-1]["actor_mse"] < hist[0]["actor_mse"] * 0.3
+    # Student ~ teacher on a state outside the dataset.
+    exo = jax.tree.map(lambda x: x[0], exo_steps(source.trace(1, seed=77)))
+    s0 = initial_state(cfg)
+    a_t = teacher.decide(s0, exo, jnp.int32(0))
+    a_s = PPOBackend(cfg, params).decide(s0, exo, jnp.int32(0))
+    # hpa is the costly coordinate: both must be near serve-exactly.
+    np.testing.assert_allclose(np.asarray(a_s.hpa_scale),
+                               np.asarray(a_t.hpa_scale), atol=0.25)
+
+
+def test_flagship_init_from_distill(cfg):
+    from ccka_tpu.train.flagship import train_flagship
+
+    out = train_flagship(cfg, iterations=2, eval_every=2, eval_steps=64,
+                         n_eval_traces=1, init_from="distill:carbon",
+                         distill_iterations=50, log=lambda s: None)
+    assert out["meta"]["init_from"] == "distill:carbon"
+    with pytest.raises(ValueError, match="init_from"):
+        train_flagship(cfg, iterations=2, eval_every=2, eval_steps=64,
+                       n_eval_traces=1, init_from="nonsense",
+                       log=lambda s: None)
+
+
 def test_flagship_checkpoint_path_is_topology_keyed():
     from ccka_tpu.config import default_config, multi_region_config
     from ccka_tpu.train.flagship import flagship_checkpoint_path
