@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from ccka_tpu.config import ConfigError, FrameworkConfig, config_from_env
 
@@ -109,6 +110,17 @@ def _build_parser() -> argparse.ArgumentParser:
                     choices=("rule", "carbon", "ppo"))
     sf.add_argument("--checkpoint", default="")
     sf.add_argument("--seed", type=int, default=0)
+
+    swatch = sub.add_parser(
+        "watch", help="the demo_40 observe session: port-forward Grafana/"
+                      "Prometheus/OpenCost and smoke-query the metrics "
+                      "store (dry-run prints the tunnel plan)")
+    swatch.add_argument("--live", action="store_true",
+                        help="actually spawn kubectl port-forwards and "
+                             "hold them until interrupted")
+    swatch.add_argument("--duration", type=float, default=0.0,
+                        help="with --live: seconds to hold the tunnels "
+                             "(0 = until Ctrl-C)")
 
     sg2 = sub.add_parser(
         "guardrails", help="apply the Kyverno admission ClusterPolicies "
@@ -786,6 +798,41 @@ def main(argv: list[str] | None = None) -> int:
                                  args.device_traces)
         if args.command == "capture":
             return _cmd_capture(cfg, args.out, args.steps, args.seed)
+        if args.command == "watch":
+            from ccka_tpu.harness.watch import WatchSession, watch_plan
+            if not args.live:
+                plan = watch_plan(cfg)
+                for fw in plan:
+                    print(f"[dry-run] would run: {' '.join(fw.argv())}",
+                          file=sys.stderr)
+                smoke = WatchSession(cfg).smoke()
+                print(json.dumps({"plan": [fw.name for fw in plan],
+                                  "smoke": smoke}, indent=2))
+                return 0
+            with WatchSession(cfg) as session:
+                try:
+                    ready = session.start()
+                except RuntimeError as e:  # e.g. kubectl missing
+                    raise SystemExit(f"ccka: {e}")
+                for name, ok in ready.items():
+                    print(f"[{'ok' if ok else 'err'}] tunnel {name}",
+                          file=sys.stderr)
+                smoke = session.smoke()
+                print(json.dumps({"ready": ready, "smoke": smoke},
+                                 indent=2))
+                if not all(ready.values()):
+                    return 1
+                try:
+                    if args.duration > 0:
+                        time.sleep(args.duration)
+                    else:
+                        print("[ok] tunnels up — Ctrl-C to stop",
+                              file=sys.stderr)
+                        while True:
+                            time.sleep(3600)
+                except KeyboardInterrupt:
+                    pass
+            return 0
         if args.command == "fleet":
             from ccka_tpu.harness.fleet import fleet_controller_from_config
             if args.clusters < 1 or args.ticks < 1:

@@ -25,8 +25,9 @@ import json
 
 _PANEL_DEFS = (
     # (title, expr, unit) — expr uses the controller's exported series
-    # names; on a live stack these come from scraping the telemetry JSONL
-    # (or remote-writing TickReports) into Prometheus.
+    # names, served by `harness.promexport` (`ccka run --metrics-port` /
+    # --metrics-textfile); `tests/test_telemetry.py::TestPromExport` pins
+    # panel-expr <-> exported-series parity both ways.
     ("Cost rate", "ccka_cost_usd_hr", "currencyUSD"),
     ("Carbon rate", "ccka_carbon_g_hr", "massg"),
     ("SLO burn", "1 - ccka_slo_ok", "percentunit"),

@@ -98,23 +98,15 @@ def check_signals(cfg: FrameworkConfig) -> PrerollCheck:
                             hint="check signals.* config / endpoints")
 
 
-# Grafana's operator port (`demo_40_watch_observe.sh:56`); the AMP-proxy
-# (8005) and OpenCost (9090) ports come from the signals URLs.
-GRAFANA_PORT = 3000
-
-
 def _local_ports(cfg: FrameworkConfig) -> list[int]:
-    """Ports the observe session will port-forward onto this host: Grafana
-    plus any localhost endpoint in the signals config (the framework analog
-    of demo_18's hardcoded 3000/8005/9090 list)."""
-    from urllib.parse import urlparse
+    """Ports the observe session will port-forward onto this host — derived
+    from the SAME tunnel plan `ccka watch` opens (`harness.watch.
+    watch_plan`), so the preroll port gate can never drift from the
+    session it protects (the framework analog of demo_18's hardcoded
+    3000/8005/9090 list)."""
+    from ccka_tpu.harness.watch import watch_plan
 
-    ports = [GRAFANA_PORT]
-    for url in (cfg.signals.prometheus_url, cfg.signals.opencost_url):
-        u = urlparse(url)
-        if u.hostname in ("localhost", "127.0.0.1") and u.port:
-            ports.append(u.port)
-    return sorted(set(ports))
+    return sorted({fw.local_port for fw in watch_plan(cfg)})
 
 
 def check_ports_free(cfg: FrameworkConfig,

@@ -139,6 +139,11 @@ def summarize_telemetry(records: list[dict]) -> dict:
         "fallbacks": int(sum(_vals("fallbacks"))),
         "cost_usd_hr": _stats(_vals("cost_usd_hr")),
         "carbon_g_hr": _stats(_vals("carbon_g_hr")),
+        # Proposal-p.5 KPI rates (tick-level gauges exported to Prometheus
+        # by harness.promexport; summarized here for `ccka report`).
+        "usd_per_kreq": _stats(_vals("usd_per_kreq")),
+        "g_co2_per_kreq": _stats(_vals("g_co2_per_kreq")),
+        "waste_frac": _stats(_vals("waste_frac")),
         "latency_p95_ms": _stats(_vals("latency_p95_ms")),
         "pending_pods": _stats(_vals("pending_pods")),
         "nodes_spot": _stats(_vals("nodes_spot")),

@@ -160,6 +160,154 @@ def test_preroll_port_checks():
     assert free[0].ok
 
 
+class TestWatchSession:
+    """demo_40_watch_observe analog: tunnel plan from config, injectable
+    spawner/fetch, socket wait, smoke queries."""
+
+    def test_plan_derives_from_config(self):
+        from ccka_tpu.harness.watch import watch_plan
+
+        plan = watch_plan(default_config())
+        by_name = {fw.name: fw for fw in plan}
+        assert set(by_name) == {"grafana", "prometheus", "opencost"}
+        assert by_name["grafana"].local_port == 3000
+        assert by_name["prometheus"].local_port == 8005   # from signals URL
+        assert by_name["opencost"].local_port == 9090
+        argv = by_name["grafana"].argv()
+        assert argv[:2] == ["kubectl", "port-forward"]
+        assert "svc/ccka-grafana" in argv
+
+    def test_session_spawns_waits_and_smokes(self):
+        import json as _json
+        import socket as _socket
+
+        from ccka_tpu.harness.watch import WatchSession
+
+        cfg = default_config()
+        spawned, terminated = [], []
+
+        # Fake PF: actually listen on the planned local ports so the
+        # socket wait succeeds without kubectl.
+        class FakePF:
+            def __init__(self, argv):
+                spawned.append(argv)
+                port = int(argv[-1].split(":")[0])
+                self.sock = _socket.socket()
+                self.sock.setsockopt(_socket.SOL_SOCKET,
+                                     _socket.SO_REUSEADDR, 1)
+                self.sock.bind(("127.0.0.1", port))
+                self.sock.listen(1)
+
+            def terminate(self):
+                terminated.append(1)
+                self.sock.close()
+
+        def fetch(url, headers):
+            if "label/__name__" in url:
+                return _json.dumps({"status": "success",
+                                    "data": ["up", "ccka_cost_usd_hr"]}
+                                   ).encode()
+            return _json.dumps({"status": "success", "data": {"result": [
+                {"metric": {}, "value": [0, "1"]}]}}).encode()
+
+        with WatchSession(cfg, spawner=FakePF, fetch=fetch,
+                          sleep=lambda _s: None,
+                          socket_timeout_s=2.0) as session:
+            ready = session.start()
+            assert all(ready.values()), ready
+            smoke = session.smoke()
+        assert smoke["reachable"] and smoke["has_ccka_series"]
+        assert smoke["metric_names"] == 2
+        assert len(spawned) == 3 and len(terminated) == 3
+
+    def test_stale_port_reports_not_ready(self):
+        """A listener already squatting a planned port (stale PF) must NOT
+        count as a ready tunnel — the socket would answer but it's the
+        wrong service (the demo_19 stale-port-forward hazard)."""
+        import socket as _socket
+
+        from ccka_tpu.harness.watch import WatchSession
+
+        holder = _socket.socket()
+        holder.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        holder.bind(("127.0.0.1", 3000))
+        holder.listen(1)
+        spawned = []
+
+        class NeverPF:
+            def __init__(self, argv):
+                spawned.append(argv)
+
+            def terminate(self):
+                pass
+
+        try:
+            session = WatchSession(default_config(), spawner=NeverPF,
+                                   sleep=lambda _s: None,
+                                   socket_timeout_s=0.5)
+            ready = session.start()
+            session.stop()
+        finally:
+            holder.close()
+        assert ready["grafana"] is False
+        # And no tunnel was spawned onto the occupied port.
+        assert not any("3000:3000" in " ".join(a) for a in spawned)
+
+    def test_dead_child_fails_readiness(self):
+        """kubectl exiting immediately (e.g. bad target) must not report
+        ready even if some other socket answers."""
+        import socket as _socket
+
+        from ccka_tpu.harness.watch import WatchSession
+
+        listeners = []
+
+        class DiesPF:
+            def __init__(self, argv):
+                # Something answers the port (simulating a race)...
+                port = int(argv[-1].split(":")[0])
+                s = _socket.socket()
+                s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", port))
+                s.listen(1)
+                listeners.append(s)
+
+            def poll(self):
+                return 1  # ...but the child itself is dead
+
+            def terminate(self):
+                pass
+
+        session = WatchSession(default_config(), spawner=DiesPF,
+                               sleep=lambda _s: None, socket_timeout_s=1.0)
+        try:
+            ready = session.start()
+        finally:
+            session.stop()
+            for s in listeners:
+                s.close()
+        assert not any(ready.values())
+
+    def test_smoke_degrades_unreachable(self):
+        from ccka_tpu.harness.watch import WatchSession
+
+        def dead_fetch(url, headers):
+            raise OSError("connection refused")
+
+        smoke = WatchSession(default_config(), fetch=dead_fetch).smoke()
+        assert smoke["reachable"] is False
+
+    def test_cli_watch_dry_run(self, capsys):
+        from ccka_tpu.cli import main
+
+        assert main(["watch"]) == 0
+        captured = capsys.readouterr()
+        assert "would run: kubectl port-forward" in captured.err
+        import json as _json
+        doc = _json.loads(captured.out)
+        assert doc["plan"] == ["grafana", "prometheus", "opencost"]
+
+
 def test_configure_observe_pair():
     cfg = default_config()
     co = ConfigureObserve(DryRunSink())

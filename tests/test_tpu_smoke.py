@@ -98,3 +98,34 @@ def test_carbon_policy_on_chip(accel):
         lambda s, k: rollout_summary(params, s, fn, trace, k))(state0, key)
     assert np.isfinite(float(summary.g_co2_per_kreq))
     assert float(summary.slo_attainment) > 0.5
+
+
+@pytest.mark.parametrize("preset", ["default", "multiregion"])
+def test_flagship_checkpoints_decide_on_chip(accel, preset):
+    """The SHIPPED flagship checkpoints drive decisions on the real chip:
+    load the topology-keyed .npz, run one jitted decide, and assert the
+    multiregion one's provenance records the dual win. Parametrized so a
+    missing checkpoint skips only ITS topology, never the other's
+    assertions."""
+    import os
+
+    from ccka_tpu.config import default_config, multi_region_config
+    from ccka_tpu.sim.rollout import exo_steps
+    from ccka_tpu.train.flagship import (flagship_checkpoint_path,
+                                         load_flagship_backend)
+
+    cfg = (default_config if preset == "default" else multi_region_config)()
+    if not os.path.exists(flagship_checkpoint_path(cfg)):
+        pytest.skip(f"no shipped checkpoint for {preset}")
+    backend, meta = load_flagship_backend(cfg)
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    exo = jax.tree.map(lambda x: x[0], exo_steps(src.trace(1)))
+    state0, key = jax.device_put(
+        (initial_state(cfg), jax.random.key(0)), accel)
+    action = jax.jit(
+        lambda s, e: backend.decide(s, e, jnp.int32(0)))(state0, exo)
+    for leaf in jax.tree.leaves(action):
+        assert bool(jnp.isfinite(leaf).all())
+    if cfg.cluster.regions:
+        assert meta["wins_both"] is True
