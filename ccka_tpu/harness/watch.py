@@ -15,7 +15,7 @@ from __future__ import annotations
 import socket
 import subprocess
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Sequence
 from urllib.parse import urlparse
 
@@ -115,9 +115,11 @@ class WatchSession:
         """
         from ccka_tpu.harness.preroll import check_ports_free
 
-        free = {int(c.name.split("-")[1]): c.ok
-                for c in check_ports_free(
-                    self.cfg, ports=[fw.local_port for fw in self.plan])}
+        ports = [fw.local_port for fw in self.plan]
+        # check_ports_free returns one check per requested port, in order.
+        free = {port: check.ok
+                for port, check in zip(
+                    ports, check_ports_free(self.cfg, ports=ports))}
         ready = {}
         children_by_name = {}
         for fw in self.plan:
