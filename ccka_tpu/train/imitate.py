@@ -24,12 +24,11 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from ccka_tpu.config import FrameworkConfig
 from ccka_tpu.models import ActorCritic, action_to_latent, latent_dim
-from ccka_tpu.policy.base import PolicyBackend, observe
+from ccka_tpu.policy.base import PolicyBackend
 from ccka_tpu.sim.dynamics import step as sim_step
 from ccka_tpu.sim.rollout import exo_steps
 from ccka_tpu.sim.types import SimParams

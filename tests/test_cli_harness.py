@@ -183,7 +183,22 @@ class TestWatchSession:
 
         from ccka_tpu.harness.watch import WatchSession
 
-        cfg = default_config()
+        # Route the derived tunnels to ephemeral free ports so the test
+        # never depends on 3000/8005/9090 being free on the CI host
+        # (grafana's 3000 is fixed; probe it and skip its assertion if a
+        # real service owns it).
+        def free_port():
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        p1, p2 = free_port(), free_port()
+        cfg = default_config().with_overrides(**{
+            "signals.prometheus_url":
+                f"http://localhost:{p1}/workspaces/local",
+            "signals.opencost_url": f"http://localhost:{p2}"})
         spawned, terminated = [], []
 
         # Fake PF: actually listen on the planned local ports so the
@@ -210,15 +225,28 @@ class TestWatchSession:
             return _json.dumps({"status": "success", "data": {"result": [
                 {"metric": {}, "value": [0, "1"]}]}}).encode()
 
+        import socket as _sock2
+        probe = _sock2.socket()
+        try:
+            probe.bind(("127.0.0.1", 3000))
+            grafana_port_free = True
+        except OSError:
+            grafana_port_free = False
+        finally:
+            probe.close()
+
         with WatchSession(cfg, spawner=FakePF, fetch=fetch,
                           sleep=lambda _s: None,
                           socket_timeout_s=2.0) as session:
             ready = session.start()
-            assert all(ready.values()), ready
+            assert ready["prometheus"] and ready["opencost"], ready
+            if grafana_port_free:
+                assert ready["grafana"], ready
             smoke = session.smoke()
         assert smoke["reachable"] and smoke["has_ccka_series"]
         assert smoke["metric_names"] == 2
-        assert len(spawned) == 3 and len(terminated) == 3
+        expected = 3 if grafana_port_free else 2
+        assert len(spawned) == expected and len(terminated) == expected
 
     def test_stale_port_reports_not_ready(self):
         """A listener already squatting a planned port (stale PF) must NOT
@@ -230,7 +258,11 @@ class TestWatchSession:
 
         holder = _socket.socket()
         holder.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
-        holder.bind(("127.0.0.1", 3000))
+        try:
+            holder.bind(("127.0.0.1", 3000))
+        except OSError:
+            holder.close()
+            pytest.skip("port 3000 already owned on this host")
         holder.listen(1)
         spawned = []
 
