@@ -81,9 +81,9 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
     clone the named teacher first (`train/imitate.py`) and PPO-refine from
     there. Distillation sidesteps PPO's early overprovision excursion (the
     sharp violation-spike advantages that wreck a near-optimal init before
-    the critic calibrates; see round-3 trajectory in the module docstring
-    history) by starting BOTH the actor and critic at the teacher's
-    operating point.
+    the critic calibrates; measured trajectories in `train/imitate.py`'s
+    module docstring and ARCHITECTURE.md §5) by starting BOTH the actor
+    and critic at the teacher's operating point.
     """
     log = log or (lambda s: print(s, file=sys.stderr))
     cfg = cfg or default_config()
