@@ -437,24 +437,38 @@ def context_runner(context: str, base: Runner | None = None) -> Runner:
 # `set -e`; a long-running controller daemon must instead bound each
 # command (a hung kubectl would freeze the control loop mid-tick — VERDICT
 # r2 weak #10) and absorb transient API-server hiccups with a short
-# bounded backoff, never an unbounded retry storm.
-_RUNNER_TIMEOUT_S = 30.0
+# bounded backoff, never an unbounded retry storm. All attempts + backoff
+# share ONE total deadline: a degraded API server costs a tick at most
+# ``_RUNNER_DEADLINE_S`` per command, not retries x timeout (the 30s
+# control cadence survives a few slow commands, never a multi-minute one).
+_RUNNER_TIMEOUT_S = 30.0     # cap for any single attempt
+_RUNNER_DEADLINE_S = 45.0    # total budget across attempts + backoff
 _RUNNER_RETRIES = 2          # total attempts = 1 + retries
 _RUNNER_BACKOFF_S = 0.5      # doubled per retry: 0.5s, 1s
 
 
 def _subprocess_runner(argv: Sequence[str], *,
                        timeout_s: float = _RUNNER_TIMEOUT_S,
+                       deadline_s: float = _RUNNER_DEADLINE_S,
                        retries: int = _RUNNER_RETRIES,
                        backoff_s: float = _RUNNER_BACKOFF_S,
-                       sleep=time.sleep) -> tuple[int, str]:
+                       sleep=time.sleep,
+                       clock=time.monotonic) -> tuple[int, str]:
     last: tuple[int, str] = (127, "not attempted")
+    t_end = clock() + deadline_s
     for attempt in range(1 + retries):
         if attempt:
-            sleep(backoff_s * (2 ** (attempt - 1)))
+            pause = backoff_s * (2 ** (attempt - 1))
+            if clock() + pause >= t_end:
+                break        # no budget left for another attempt
+            sleep(pause)
+        budget = t_end - clock()
+        if budget <= 0:
+            break
         try:
             proc = subprocess.run(list(argv), capture_output=True,
-                                  text=True, timeout=timeout_s, check=False)
+                                  text=True, timeout=min(timeout_s, budget),
+                                  check=False)
             # kubectl writes error detail to stderr; fold it in so failures
             # surface their reason to the operator (dump-state discipline).
             out = proc.stdout
@@ -463,21 +477,29 @@ def _subprocess_runner(argv: Sequence[str], *,
             if proc.returncode == 0:
                 return proc.returncode, out
             last = (proc.returncode, out)
-            if not _transient(out):
+            if not _transient(proc.stderr or out):
                 return last          # real errors (NotFound, Forbidden,
                                      # invalid patch) don't deserve retries
         except subprocess.TimeoutExpired as e:
-            last = (124, f"timed out after {timeout_s}s: {e}")
+            last = (124, f"timed out after {min(timeout_s, budget):.0f}s: {e}")
         except OSError as e:
             return 127, str(e)       # no kubectl binary — retry can't help
     return last
 
 
 def _transient(detail: str) -> bool:
-    """Retry-worthy failure modes: connectivity + API-server pressure."""
-    needles = ("connection refused", "i/o timeout", "tls handshake",
-               "etcdserver", "too many requests", "serviceunavailable",
-               "timeout", "eof")
+    """Retry-worthy failure modes: connectivity + API-server pressure.
+
+    Needles are anchored to specific kubectl/client-go/API-server error
+    phrases — a bare "timeout"/"eof" substring would also match
+    non-transient output such as `kubectl wait`'s "timed out waiting for
+    the condition", re-issuing a command that already mutated state.
+    """
+    needles = ("connection refused", "connection reset by peer",
+               "i/o timeout", "client.timeout exceeded", "dial tcp",
+               "no route to host", "tls handshake", "unexpected eof",
+               "error from server: eof",  # apiserver dropped mid-request
+               "etcdserver", "too many requests", "serviceunavailable")
     low = detail.lower()
     return any(n in low for n in needles)
 

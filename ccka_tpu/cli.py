@@ -801,13 +801,15 @@ def main(argv: list[str] | None = None) -> int:
         if args.command == "watch":
             from ccka_tpu.harness.watch import WatchSession, watch_plan
             if not args.live:
+                # Dry-run prints the tunnel plan ONLY — no network I/O.
+                # Smoke queries against the configured Prometheus belong to
+                # --live (they run real HTTP against whatever URL is set).
                 plan = watch_plan(cfg)
                 for fw in plan:
                     print(f"[dry-run] would run: {' '.join(fw.argv())}",
                           file=sys.stderr)
-                smoke = WatchSession(cfg).smoke()
-                print(json.dumps({"plan": [fw.name for fw in plan],
-                                  "smoke": smoke}, indent=2))
+                print(json.dumps({"plan": [fw.name for fw in plan]},
+                                 indent=2))
                 return 0
             with WatchSession(cfg) as session:
                 try:

@@ -66,10 +66,19 @@ def referenced_series(expr: str) -> set[str]:
     return {tok for tok in _LABEL.findall(expr) if tok.startswith("ccka_")}
 
 
+def _escape_label_value(value: str) -> str:
+    """Escape per the text exposition format: backslash, double-quote and
+    newline must be escaped inside label values or scrapers reject the
+    whole exposition."""
+    return (value.replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
 def render_exposition(report, *, cluster: str = "") -> str:
     """One TickReport (or its dict) as Prometheus text format 0.0.4."""
     rec: Mapping = report if isinstance(report, Mapping) else asdict(report)
-    label = f'{{cluster="{cluster}"}}' if cluster else ""
+    label = (f'{{cluster="{_escape_label_value(cluster)}"}}'
+             if cluster else "")
     lines = []
     for name, (field, help_text) in SERIES.items():
         value = rec.get(field)

@@ -226,6 +226,13 @@ def load_flagship_backend(cfg: FrameworkConfig):
     if not os.path.exists(path):
         return None, None
     params, meta = load_params_npz(path)
+    # Provenance surfaces at load time, not only in bench JSON: an
+    # operator driving `ccka run --backend ppo` must see whether the
+    # params they run were a trained winner or a fallback init.
+    print(f"# flagship checkpoint {os.path.basename(path)}: "
+          f"selected_iteration={meta.get('selected_iteration')} "
+          f"init_from={meta.get('init_from')} "
+          f"wins_both={meta.get('wins_both')}", file=sys.stderr)
     return PPOBackend(cfg, params), meta
 
 

@@ -338,6 +338,9 @@ class TestWatchSession:
         import json as _json
         doc = _json.loads(captured.out)
         assert doc["plan"] == ["grafana", "prometheus", "opencost"]
+        # ADVICE r3: dry-run performs NO network I/O — smoke queries
+        # against the configured Prometheus URL belong to --live only.
+        assert "smoke" not in doc
 
 
 def test_configure_observe_pair():

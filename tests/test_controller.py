@@ -296,6 +296,61 @@ class TestSubprocessRunnerHardening:
                                      retries=2, backoff_s=0.01)
         assert rc == 127
 
+    def test_total_deadline_bounds_all_attempts(self):
+        """ADVICE r3: retries share ONE deadline — a degraded API server
+        can cost a command ~deadline_s total, never retries x timeout."""
+        from ccka_tpu.actuation.sink import _subprocess_runner
+
+        t0 = time.monotonic()
+        rc, out = _subprocess_runner(["sleep", "30"], timeout_s=10.0,
+                                     deadline_s=0.5, retries=5,
+                                     backoff_s=0.01)
+        elapsed = time.monotonic() - t0
+        assert rc == 124
+        assert elapsed < 3.0  # one ~0.5s attempt + slack, NOT 6 x 10s
+
+    def test_backoff_beyond_deadline_stops_retrying(self, tmp_path):
+        from ccka_tpu.actuation.sink import _subprocess_runner
+
+        count = tmp_path / "count"
+        script = tmp_path / "flaky.sh"
+        script.write_text(
+            "#!/bin/sh\n"
+            f"echo x >> {count}\n"
+            "echo 'dial tcp: connection refused' >&2\n"
+            "exit 1\n")
+        script.chmod(0o755)
+        # Backoff (10s) would overshoot the 0.3s deadline: no second try.
+        rc, out = _subprocess_runner([str(script)], retries=3,
+                                     deadline_s=0.3, backoff_s=10.0)
+        assert rc == 1
+        assert len(count.read_text().splitlines()) == 1
+
+    def test_wait_condition_timeout_is_not_transient(self, tmp_path):
+        """ADVICE r3: `kubectl wait`'s "timed out waiting for the
+        condition" is a real failure (the mutate may have succeeded) —
+        a bare "timeout" substring match would re-issue it."""
+        from ccka_tpu.actuation.sink import _subprocess_runner, _transient
+
+        assert not _transient("error: timed out waiting for the condition")
+        assert not _transient("error: unknown flag: --timeout-x")
+        assert _transient("unexpected EOF")  # client-go disconnect
+        assert _transient("Error from server: EOF")  # apiserver drop
+        assert _transient("net/http: TLS handshake timeout")
+
+        count = tmp_path / "count"
+        script = tmp_path / "wait.sh"
+        script.write_text(
+            "#!/bin/sh\n"
+            f"echo x >> {count}\n"
+            "echo 'error: timed out waiting for the condition' >&2\n"
+            "exit 1\n")
+        script.chmod(0o755)
+        rc, out = _subprocess_runner([str(script)], retries=3,
+                                     backoff_s=0.01)
+        assert rc == 1
+        assert len(count.read_text().splitlines()) == 1  # no retry
+
 
 class TestControllerLock:
     """Single-writer race guard: two control loops on one cluster would

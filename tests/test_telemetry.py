@@ -414,6 +414,19 @@ class TestPromExport:
             if line.startswith("ccka_"):
                 assert math.isfinite(float(line.rsplit(" ", 1)[1]))
 
+    def test_label_value_escaping(self):
+        """ADVICE r3: a cluster name containing '"', '\\' or newline must
+        render as a valid exposition, not break the whole scrape."""
+        from ccka_tpu.harness.promexport import render_exposition
+
+        text = render_exposition({"t": 1}, cluster='we"ird\\name\nx')
+        line = next(l for l in text.splitlines()
+                    if l.startswith("ccka_tick{"))
+        assert line == 'ccka_tick{cluster="we\\"ird\\\\name\\nx"} 1'
+        # And a benign name is untouched.
+        benign = render_exposition({"t": 1}, cluster="demo1")
+        assert 'ccka_tick{cluster="demo1"} 1' in benign
+
     def test_textfile_export_atomic(self, tmp_path):
         from ccka_tpu.harness.promexport import MetricsExporter
 
