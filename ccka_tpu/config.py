@@ -440,6 +440,27 @@ class TrainConfig:
     init_log_std: float = -0.5
     ppo_clip: float = 0.2
     ppo_epochs: int = 4
+    # -- Refinement-from-a-teacher mechanics (VERDICT r3 #1): the levers
+    # that let RL improve ON a near-optimal distilled init instead of
+    # wrecking it before the critic calibrates.
+    # Iterations at the start where the policy-gradient (and entropy) term
+    # is zeroed — only the critic (+ torso via the value loss) trains. The
+    # distilled critic regressed no-bootstrap window returns; it must
+    # re-calibrate on-policy before its advantages steer the actor.
+    critic_warmup_iters: int = 0
+    # KL-anchor to the init policy: coefficient on ||mean - anchor_mean||^2
+    # (the Gaussian KL with shared std, up to scale). Keeps refinement in a
+    # trust region around the teacher the init was distilled from. 0 = off;
+    # active only when the trainer is given anchor params.
+    anchor_coef: float = 0.0
+    # Clip *normalized* advantages to +/- this value (0 = off): a single
+    # violation-spike tick can contribute at most adv_clip sigmas to the
+    # policy gradient instead of dominating the whole batch.
+    adv_clip: float = 0.0
+    # Scale actor-head updates (mean head + log_std) relative to the
+    # shared torso/critic learning rate; <1 slows the actor so the critic
+    # stays ahead of the policy it evaluates.
+    actor_lr_scale: float = 1.0
     # Early-stop epochs once approx-KL exceeds this (masked inside the
     # jitted epoch scan; prevents destructive late-training updates).
     ppo_target_kl: float = 0.05
@@ -465,6 +486,11 @@ class TrainConfig:
             raise ConfigError("train: non-positive learning rate")
         if not 0.0 < self.gamma <= 1.0:
             raise ConfigError("train: gamma out of (0,1]")
+        if (self.critic_warmup_iters < 0 or self.anchor_coef < 0
+                or self.adv_clip < 0 or self.actor_lr_scale <= 0):
+            raise ConfigError("train: refinement knobs out of range "
+                              "(warmup/anchor/adv_clip >= 0, "
+                              "actor_lr_scale > 0)")
 
 
 @dataclass(frozen=True)

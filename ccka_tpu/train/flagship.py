@@ -61,6 +61,21 @@ def score_vs_rule(res: dict, rule: dict) -> tuple[bool, float]:
     return wins, max(usd, co2) + 25.0 * shortfall
 
 
+def beats_teacher(res: dict, teacher: dict) -> bool:
+    """Training earned its keep: strictly better than the teacher on at
+    least one headline, no worse on the other (within stochastic-eval
+    noise), at the teacher's attainment or better. This is the VERDICT r3
+    #1 criterion — a refined checkpoint must improve on the policy it was
+    distilled from, not merely match it."""
+    usd = res["usd_per_slo_hour"] / max(teacher["usd_per_slo_hour"], 1e-9)
+    co2 = res["g_co2_per_kreq"] / max(teacher["g_co2_per_kreq"], 1e-9)
+    attain_ok = (res["slo_attainment"]
+                 >= teacher["slo_attainment"] - _ATTAIN_EPS)
+    both_leq = usd <= 1.0 + 1e-4 and co2 <= 1.0 + 1e-4
+    one_strict = usd < 0.999 or co2 < 0.999
+    return both_leq and one_strict and attain_ok
+
+
 def train_flagship(cfg: FrameworkConfig | None = None, *,
                    iterations: int = 1200,
                    eval_every: int = 100,
@@ -99,23 +114,48 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
         f"gCO2/kreq={rule_res['g_co2_per_kreq']:.4f} "
         f"attain={rule_res['slo_attainment']:.4f}")
 
-    ts = trainer.init_state(seed)
+    teacher_res = None
     if init_from.startswith("distill:"):
-        from ccka_tpu.train.imitate import distill_teacher
+        from ccka_tpu.train.imitate import build_teacher, distill_teacher
         teacher = init_from.split(":", 1)[1]
+        # Resolve the teacher BEFORE the expensive distillation so an
+        # unknown name fails fast instead of after 2000 iterations.
+        teacher_backend = build_teacher(cfg, teacher)
         log(f"distilling teacher {teacher!r} into the policy net...")
         params0, hist = distill_teacher(cfg, teacher, seed=seed,
                                         iterations=distill_iterations)
         log(f"distilled: actor_mse {hist[-1]['actor_mse']:.4f} "
             f"critic_mse {hist[-1]['critic_mse']:.4f}")
-        ts = ts._replace(params=params0,
-                         opt_state=trainer.opt.init(params0))
-    elif init_from != "scratch":
+        if cfg.train.anchor_coef > 0:
+            # Rebuild the trainer with the distilled init as the KL
+            # anchor: refinement explores around the teacher, not away.
+            trainer = PPOTrainer(cfg, anchor_params=params0)
+        ts = trainer.init_state(seed)._replace(
+            params=params0, opt_state=trainer.opt.init(params0))
+        # The teacher itself on the selection traces — the bar a refined
+        # candidate must clear for training to have earned its keep.
+        teacher_res = evaluate_backend(cfg, teacher_backend, sel_traces)
+        log(f"teacher {teacher!r}: "
+            f"usd x{teacher_res['usd_per_slo_hour'] / rule_res['usd_per_slo_hour']:.3f} "
+            f"co2 x{teacher_res['g_co2_per_kreq'] / rule_res['g_co2_per_kreq']:.3f} "
+            f"attain {teacher_res['slo_attainment']:.4f}")
+    elif init_from == "scratch":
+        ts = trainer.init_state(seed)
+    else:
         raise ValueError(f"unknown init_from {init_from!r}")
     t_len = cfg.train.unroll_steps
     # The INIT policy (codec zero point, or the distilled teacher) is a
     # real candidate — round-3 diagnostics showed it near rule parity
     # while early training can wander worse; selection must see it.
+    def candidate_tier(res: dict, wins: bool) -> int:
+        """2 = wins vs rule AND improves on the teacher (the full VERDICT
+        r3 #1 bar); 1 = wins vs rule; 0 = neither. Selection prefers the
+        highest tier, then the lowest score."""
+        if wins and teacher_res is not None and beats_teacher(res,
+                                                              teacher_res):
+            return 2
+        return 1 if wins else 0
+
     res0 = evaluate_backend(cfg, PPOBackend(cfg, ts.params), sel_traces)
     wins0, score0 = score_vs_rule(res0, rule_res)
     log(f"it     0: usd x{res0['usd_per_slo_hour'] / rule_res['usd_per_slo_hour']:.3f} "
@@ -123,6 +163,7 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
         f"attain {res0['slo_attainment']:.4f} "
         f"{'WIN' if wins0 else '   '} score {score0:.3f}")
     best = {"score": score0, "wins": wins0,
+            "tier": candidate_tier(res0, wins0),
             "params": jax.device_get(ts.params), "iteration": 0,
             "res": res0}
     history = []
@@ -146,6 +187,7 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
         it_total += chunk_iters
         res = evaluate_backend(cfg, PPOBackend(cfg, ts.params), sel_traces)
         wins, score = score_vs_rule(res, rule_res)
+        tier = candidate_tier(res, wins)
         rec = {
             "iteration": it_total,
             "mean_reward": float(diag.mean_reward),
@@ -155,16 +197,24 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
             "wins_both": wins,
             "score": score,
         }
+        if teacher_res is not None:
+            rec["usd_vs_teacher"] = (res["usd_per_slo_hour"]
+                                     / teacher_res["usd_per_slo_hour"])
+            rec["co2_vs_teacher"] = (res["g_co2_per_kreq"]
+                                     / teacher_res["g_co2_per_kreq"])
+            rec["beats_teacher"] = beats_teacher(res, teacher_res)
         history.append(rec)
         log(f"it {it_total:5d}: usd x{rec['usd_ratio']:.3f} "
             f"co2 x{rec['co2_ratio']:.3f} attain {rec['slo_attainment']:.4f} "
-            f"{'WIN' if wins else '   '} score {score:.3f} "
-            f"({time.time() - t0:.0f}s)")
-        # Prefer winners; among equals, the lower score.
-        better = ((wins and not best["wins"])
-                  or (wins == best["wins"] and score < best["score"]))
+            f"{'WIN' if wins else '   '}"
+            f"{' >TEACHER' if rec.get('beats_teacher') else ''} "
+            f"score {score:.3f} ({time.time() - t0:.0f}s)")
+        # Prefer the higher tier (rule win + teacher improvement beats a
+        # bare rule win); among equals, the lower score.
+        better = (tier > best["tier"]
+                  or (tier == best["tier"] and score < best["score"]))
         if better:
-            best = {"score": score, "wins": wins,
+            best = {"score": score, "wins": wins, "tier": tier,
                     "params": jax.device_get(ts.params),
                     "iteration": it_total, "res": res}
 
@@ -173,6 +223,8 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
         "init_from": init_from,
         "selected_iteration": best["iteration"],
         "wins_both": bool(best["wins"]),
+        "beats_teacher": bool(teacher_res is not None
+                              and beats_teacher(best["res"], teacher_res)),
         "selection_seed0": _SELECTION_SEED0,
         "eval_steps": eval_steps,
         "n_eval_traces": n_eval_traces,
@@ -184,11 +236,21 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
             "batch_clusters": cfg.train.batch_clusters,
             "unroll_steps": cfg.train.unroll_steps,
             "learning_rate": cfg.train.learning_rate,
+            "critic_warmup_iters": cfg.train.critic_warmup_iters,
+            "anchor_coef": cfg.train.anchor_coef,
+            "adv_clip": cfg.train.adv_clip,
+            "actor_lr_scale": cfg.train.actor_lr_scale,
+            "init_log_std": cfg.train.init_log_std,
+            "lr_decay_iters": cfg.train.lr_decay_iters,
         },
         "selection_scoreboard": {
             "rule": {k: float(rule_res[k]) for k in
                      ("usd_per_slo_hour", "g_co2_per_kreq",
                       "slo_attainment")},
+            "teacher": ({k: float(teacher_res[k]) for k in
+                         ("usd_per_slo_hour", "g_co2_per_kreq",
+                          "slo_attainment")}
+                        if teacher_res is not None else None),
             "ppo": {k: float(best["res"][k]) for k in
                     ("usd_per_slo_hour", "g_co2_per_kreq",
                      "slo_attainment")} if best["res"] else None,

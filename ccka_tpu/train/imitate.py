@@ -151,20 +151,28 @@ def imitate(cfg: FrameworkConfig, teacher: PolicyBackend, source, *,
     return params, history
 
 
-def distill_teacher(cfg: FrameworkConfig, teacher_name: str = "carbon",
-                    *, seed: int = 0, iterations: int = 2000):
-    """Convenience: build the named teacher, collect, distill.
-    Returns (params, history)."""
+def build_teacher(cfg: FrameworkConfig, teacher_name: str) -> PolicyBackend:
+    """The ONE teacher-name registry (flagship's init_from=distill:<name>
+    resolves here too, so the two sites can never drift)."""
     from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy
-    from ccka_tpu.signals.synthetic import SyntheticSignalSource
 
     teachers = {
         "carbon": lambda: CarbonAwarePolicy(cfg.cluster),
         "rule": lambda: RulePolicy(cfg.cluster),
     }
     if teacher_name not in teachers:
-        raise ValueError(f"unknown teacher {teacher_name!r}")
+        raise ValueError(f"unknown teacher {teacher_name!r} "
+                         f"(known: {sorted(teachers)})")
+    return teachers[teacher_name]()
+
+
+def distill_teacher(cfg: FrameworkConfig, teacher_name: str = "carbon",
+                    *, seed: int = 0, iterations: int = 2000):
+    """Convenience: build the named teacher, collect, distill.
+    Returns (params, history)."""
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
     src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
                                 cfg.signals)
-    return imitate(cfg, teachers[teacher_name](), src, seed=seed,
+    return imitate(cfg, build_teacher(cfg, teacher_name), src, seed=seed,
                    iterations=iterations)

@@ -61,12 +61,21 @@ def _gaussian_logp(u, mean, log_std):
 
 
 class PPOTrainer:
-    """Builds and drives the jitted PPO iteration."""
+    """Builds and drives the jitted PPO iteration.
 
-    def __init__(self, cfg: FrameworkConfig):
+    ``anchor_params``: optional frozen ActorCritic params defining a trust
+    region — when set (and ``train.anchor_coef > 0``) the loss carries a
+    ||mean − anchor_mean||² penalty pulling the refined policy toward the
+    anchor's action means (the Gaussian KL for a shared std, up to scale).
+    The flagship driver passes the distilled teacher init here so PPO
+    refinement explores *around* the teacher instead of away from it.
+    """
+
+    def __init__(self, cfg: FrameworkConfig, *, anchor_params=None):
         self.cfg = cfg
         self.cluster = cfg.cluster
         self.tcfg = cfg.train
+        self.anchor_params = anchor_params
         self.params_sim = SimParams.from_config(cfg)
         self.act_dim = latent_dim(cfg.cluster)
         self.net = ActorCritic(act_dim=self.act_dim,
@@ -118,6 +127,19 @@ class PPOTrainer:
         return jax.vmap(
             lambda s, e: observe(self.params_sim, s, e).flatten()
         )(states, exo)
+
+    def _scale_actor_updates(self, updates):
+        """Scale actor-head leaves (mean head + log_std) of an optimizer
+        update by ``train.actor_lr_scale`` — a per-head learning rate that
+        keeps the critic ahead of the policy it evaluates."""
+        scale = self.tcfg.actor_lr_scale
+
+        def leaf(path, u):
+            keys = {getattr(p, "key", getattr(p, "name", "")) for p in path}
+            is_actor = bool(keys & {"actor_mean", "log_std"})
+            return u * scale if is_actor else u
+
+        return jax.tree_util.tree_map_with_path(leaf, updates)
 
     # -- one PPO iteration (collect + GAE + update), fully jitted -----------
 
@@ -176,10 +198,29 @@ class PPOTrainer:
         returns = advantages + value_t
         advantages = ((advantages - advantages.mean())
                       / (advantages.std() + 1e-8))
+        if tcfg.adv_clip > 0:
+            # One violation-spike tick contributes at most adv_clip sigmas
+            # to the policy gradient (the spike still reaches the critic
+            # unclipped through `returns`).
+            advantages = jnp.clip(advantages, -tcfg.adv_clip, tcfg.adv_clip)
 
         flat = lambda x: x.reshape((-1,) + x.shape[2:])           # noqa: E731
         obs_f, u_f = flat(obs_t), flat(u_t)
         logp_f, adv_f, ret_f = flat(logp_t), flat(advantages), flat(returns)
+
+        # Critic-first warmup: zero the policy-gradient (and entropy) term
+        # while iteration < critic_warmup_iters — the critic re-calibrates
+        # to on-policy returns before its advantages steer the actor.
+        # Branch-free so one compiled iteration serves the whole run.
+        policy_coef = jnp.where(
+            ts.iteration < self.tcfg.critic_warmup_iters, 0.0, 1.0)
+
+        # Anchor means are a constant target (teacher init, frozen).
+        use_anchor = (self.anchor_params is not None
+                      and tcfg.anchor_coef > 0)
+        if use_anchor:
+            anchor_mean, _, _ = self.net.apply(self.anchor_params, obs_f)
+            anchor_mean = jax.lax.stop_gradient(anchor_mean)
 
         def loss_fn(params):
             mean, log_std, value = self.net.apply(params, obs_f)
@@ -189,8 +230,15 @@ class PPOTrainer:
             policy_loss = -jnp.minimum(ratio * adv_f, clipped * adv_f).mean()
             value_loss = jnp.square(value - ret_f).mean()
             entropy = (log_std + 0.5 * jnp.log(2.0 * jnp.pi * jnp.e)).sum()
-            total = (policy_loss + tcfg.value_coef * value_loss
-                     - tcfg.entropy_coef * entropy)
+            total = (policy_coef * policy_loss
+                     + tcfg.value_coef * value_loss
+                     - policy_coef * tcfg.entropy_coef * entropy)
+            if use_anchor:
+                # Not gated by policy_coef: the anchor also pins the actor
+                # against drift induced through the shared torso during
+                # critic-only warmup.
+                total = total + tcfg.anchor_coef * jnp.square(
+                    mean - anchor_mean).mean()
             kl = (logp_f - logp).mean()
             return total, (policy_loss, value_loss, entropy, kl)
 
@@ -204,6 +252,8 @@ class PPOTrainer:
             # late-epoch policy drift).
             stop_now = jnp.logical_or(stopped, kl > tcfg.ppo_target_kl)
             updates, new_opt_state = self.opt.update(grads, opt_state, params)
+            if tcfg.actor_lr_scale != 1.0:
+                updates = self._scale_actor_updates(updates)
             updates = jax.tree.map(
                 lambda u: jnp.where(stop_now, jnp.zeros_like(u), u), updates)
             params = optax.apply_updates(params, updates)
