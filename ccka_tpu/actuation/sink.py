@@ -50,18 +50,23 @@ class PatchCommand:
 
 @dataclass(frozen=True)
 class ManifestCommand:
-    """A whole-object mutation: `kubectl apply -f` / `kubectl delete`.
+    """A whole-object mutation: `kubectl apply -f` / `kubectl delete` /
+    node lifecycle verbs (`cordon`/`drain` — the spot-interruption
+    response path the reference disabled with Karpenter's
+    ``settings.interruptionQueue=""``, `05_karpenter.sh:136`).
 
     ``selector`` (label selector) replaces ``name`` for bulk deletes —
     e.g. NodeClaims, whose names are Karpenter-generated and only reachable
     via their `karpenter.sh/nodepool` label."""
 
     action: str           # "apply" | "delete" | "scrub-finalizers"
+                          # | "cordon" | "drain"
     kind: str
     name: str = ""
     namespace: str = ""
     doc: object = None    # full manifest for "apply"
     selector: str = ""    # label selector (delete only), e.g. "k=v"
+    grace_s: int = 30     # pod grace period for "drain"
 
     def kubectl_argv(self) -> list[str]:
         ns = ["-n", self.namespace] if self.namespace else []
@@ -71,6 +76,16 @@ class ManifestCommand:
             return ["kubectl", "patch", self.kind, self.name, *ns,
                     "--type=merge", "-p",
                     json.dumps({"metadata": {"finalizers": []}})]
+        if self.action == "cordon":
+            return ["kubectl", "cordon", self.name]
+        if self.action == "drain":
+            # --force covers bare pods the burst generator never creates
+            # but an operator might; the grace period stays inside the
+            # 120s spot interruption notice window.
+            return ["kubectl", "drain", self.name, "--ignore-daemonsets",
+                    "--delete-emptydir-data", "--force",
+                    f"--grace-period={self.grace_s}",
+                    f"--timeout={max(self.grace_s * 2, 60)}s"]
         target = (["-l", self.selector] if self.selector else [self.name])
         return ["kubectl", "delete", self.kind, *target, *ns,
                 "--ignore-not-found", "--wait=false"]
@@ -178,6 +193,17 @@ class ActuationSink:
                                                  namespace))
         return ok
 
+    def drain_node(self, name: str, *, grace_s: int = 30) -> bool:
+        """Cordon then drain — the interruption-warning response the
+        reference's disabled interruptionQueue would have provided
+        (`05_karpenter.sh:136`). Cordon first so the scheduler stops
+        placing pods the drain would immediately evict; the displaced
+        pods go Pending, and Karpenter reprovisions under the active
+        NodePool requirements (the reprovision half of the sequence)."""
+        ok = self._apply(ManifestCommand("cordon", "node", name))
+        return self._apply(ManifestCommand("drain", "node", name,
+                                           grace_s=grace_s)) and ok
+
     def get_object(self, kind: str, name: str, *,
                    namespace: str = "") -> dict:
         """Full-object read-back; {} when absent."""
@@ -276,6 +302,17 @@ class DryRunSink(ActuationSink):
                 self.objects.pop(key, None)
             if cmd.kind.lower() == "nodepool":
                 self.store.pop(cmd.name, None)
+        elif cmd.action in ("cordon", "drain"):
+            # Simulated node lifecycle: cordon marks unschedulable, drain
+            # additionally evicts (recorded as an annotation — the node
+            # object survives; Karpenter terminates it asynchronously).
+            node = self.objects.get(("node", "", cmd.name))
+            if node is None:
+                return False          # draining an unknown node fails
+            node.setdefault("spec", {})["unschedulable"] = True
+            if cmd.action == "drain":
+                node.setdefault("metadata", {}).setdefault(
+                    "annotations", {})["ccka.io/drained"] = "true"
         # scrub-finalizers is a no-op on the simulated store.
         return True
 

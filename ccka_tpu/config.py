@@ -374,6 +374,13 @@ class SignalsConfig:
     # the reference's level of spot awareness) or "aws" (per-AZ
     # `describe-spot-price-history` via the AWS CLI each tick).
     spot_feed: str = ""
+    # Live spot-interruption warnings: the EventBridge→SQS queue URL the
+    # controller polls each tick for `EC2 Spot Instance Interruption
+    # Warning` events — the pipeline the reference disabled with
+    # Karpenter's `settings.interruptionQueue=""` (`05_karpenter.sh:136`).
+    # "" disables; the simulator's stochastic process still prices
+    # interruptions in training either way.
+    interruption_queue_url: str = ""
     carbon_api_key: str = ""
     carbon_zone: str = "US-CAL-CISO"
     carbon_default_g_kwh: float = 400.0

@@ -39,7 +39,7 @@ from ccka_tpu.config import FrameworkConfig
 from ccka_tpu.policy.base import PolicyBackend
 from ccka_tpu.sim.dynamics import step as sim_step
 from ccka_tpu.sim.rollout import exo_steps, initial_state
-from ccka_tpu.sim.types import Action, ClusterState, SimParams
+from ccka_tpu.sim.types import CT_SPOT, Action, ClusterState, SimParams
 from ccka_tpu.signals.base import SignalSource
 
 
@@ -68,6 +68,11 @@ class TickReport:
     usd_per_kreq: float = 0.0
     g_co2_per_kreq: float = 0.0
     waste_frac: float = 0.0
+    # Spot interruption warnings consumed this tick and nodes drained in
+    # response (the live half of the capability the reference disabled at
+    # `05_karpenter.sh:136`; 0/0 when no feed is wired).
+    interruption_warnings: int = 0
+    nodes_drained: int = 0
     # Measured app-level SLO metrics when the signal source scrapes them
     # (live Prometheus: p95/RPS/queue depth — the §2.3 inputs the
     # reference advertised but never collected). Empty for sources
@@ -178,9 +183,16 @@ class Controller:
                  lock_dir: str | None = None,
                  telemetry_path: str = "",
                  exporter=None,
+                 interruption_feed=None,
                  log_fn: Callable[[str], None] | None = None,
                  sleep_fn: Callable[[float], None] = time.sleep):
         self.cfg = cfg
+        # Spot interruption/rebalance warning source (SpotInterruptionFeed
+        # or any object with poll() -> [InterruptionWarning]); None
+        # disables the drain path.
+        self.interruption_feed = interruption_feed
+        # insertion-ordered: oldest evicted first (see _remember_drained)
+        self._drained_instances: dict[str, None] = {}
         # Prometheus exposition of the tick KPIs (harness.promexport);
         # None disables. Updated after every tick.
         self.exporter = exporter
@@ -241,6 +253,83 @@ class Controller:
         self._replan_every = getattr(backend, "replan_every", 0)
         self._horizon = getattr(backend, "horizon", 0)
 
+    # -- spot interruption response -----------------------------------------
+
+    def _drain_for_warnings(self, warnings) -> int:
+        """Cordon+drain the spot nodes named by interruption warnings and
+        fold the capacity loss into the state estimate immediately.
+
+        Instance-ids map to nodes via ``spec.providerID`` (AWS shape:
+        ``aws:///us-east-2a/i-0abc...``) over each region sink's spot-node
+        listing. Only ``terminate`` warnings drain — a rebalance
+        recommendation is advisory (Karpenter itself treats it as
+        optional) and is surfaced in the report count without action.
+        The estimate decrement means the very next decide sees the lost
+        capacity instead of discovering it a scrape-cadence later."""
+        from ccka_tpu.config import ConfigError
+
+        drained = 0
+        by_instance: dict[str, tuple[dict, ActuationSink]] = {}
+        for sink in dict.fromkeys(self.region_sinks.values()):
+            try:
+                nodes = sink.list_objects(
+                    "node", selector="karpenter.sh/capacity-type=spot")
+            except NotImplementedError:
+                continue
+            for node in nodes:
+                provider = str(node.get("spec", {}).get("providerID", ""))
+                if provider:
+                    by_instance[provider.rsplit("/", 1)[-1]] = (node, sink)
+        zones = list(self.cfg.cluster.zones)
+        for w in warnings:
+            if w.action != "terminate":
+                self.log_fn(f"# rebalance recommendation: {w!r} (no action)")
+                continue
+            # SQS standard queues deliver at-least-once (and the ack can
+            # fail): a redelivered warning for an instance already drained
+            # must not drain/decrement twice.
+            if w.instance_id in self._drained_instances:
+                self.log_fn(f"# duplicate interruption warning for "
+                            f"{w.instance_id} (already drained)")
+                continue
+            hit = by_instance.get(w.instance_id)
+            if hit is None:
+                self.log_fn(f"# interruption warning for unknown instance "
+                            f"{w.instance_id} (already gone?)")
+                continue
+            node, sink = hit
+            name = node.get("metadata", {}).get("name", "")
+            if not name or not sink.drain_node(name):
+                continue
+            self._remember_drained(w.instance_id)
+            drained += 1
+            labels = node.get("metadata", {}).get("labels", {})
+            zone = labels.get("topology.kubernetes.io/zone", "")
+            pool = labels.get("karpenter.sh/nodepool", "")
+            try:
+                zi = zones.index(zone)
+                pi = self.cfg.cluster.pool_index(pool)
+            except (ValueError, ConfigError):
+                # A freshly-registered node may not carry zone/pool labels
+                # yet; decrementing an arbitrary cell would misattribute
+                # the loss — skip the estimate adjustment (the drain
+                # itself still happened; dynamics reconcile via demand).
+                self.log_fn(f"# drained {name} but cannot attribute "
+                            f"zone={zone!r} pool={pool!r} — estimate "
+                            f"unchanged")
+                continue
+            new_nodes = self.state.nodes.at[pi, zi, CT_SPOT].add(-1.0)
+            self.state = self.state._replace(
+                nodes=jnp.maximum(new_nodes, 0.0))
+        return drained
+
+    def _remember_drained(self, instance_id: str) -> None:
+        """Bounded already-drained memory (dedupe across redeliveries)."""
+        self._drained_instances[instance_id] = None
+        while len(self._drained_instances) > 256:
+            self._drained_instances.pop(
+                next(iter(self._drained_instances)))
+
     # -- one tick ----------------------------------------------------------
 
     def tick(self, t: int) -> TickReport:
@@ -252,6 +341,19 @@ class Controller:
             tick_trace = self.source.tick(t, seed=self.seed)
             exo = jax.tree.map(lambda x: x[0], exo_steps(tick_trace))
             is_peak = bool(float(exo.is_peak) > 0.5)
+
+        # 1b. spot interruption warnings → cordon+drain BEFORE the decide,
+        #     so displaced pods go Pending under the profile this tick is
+        #     about to apply and Karpenter reprovisions under it (the
+        #     response loop `settings.interruptionQueue=""` disabled,
+        #     `05_karpenter.sh:136`).
+        n_warnings = n_drained = 0
+        if self.interruption_feed is not None:
+            with timer.stage("interruptions"):
+                warnings = self.interruption_feed.poll()
+                n_warnings = len(warnings)
+                if warnings:
+                    n_drained = self._drain_for_warnings(warnings)
 
         # 2. decide. Receding-horizon backends periodically re-optimize
         #    against the source's forward-looking window (exact future for
@@ -352,6 +454,8 @@ class Controller:
             g_co2_per_kreq=float(metrics.carbon_g) / max(kreq, 1e-9),
             waste_frac=max(capacity - served_total, 0.0) / max(capacity,
                                                                1e-9),
+            interruption_warnings=n_warnings,
+            nodes_drained=n_drained,
             slo_metrics=slo_metrics,
             timings_ms=timer.timings_ms(),
         )
@@ -400,6 +504,7 @@ class Controller:
 def controller_from_config(cfg: FrameworkConfig, backend: PolicyBackend,
                            *, live: bool = False,
                            runner=None, region_runners=None,
+                           interruption_runner=None,
                            **kwargs) -> Controller:
     """Wire a controller with the configured signal source and a sink:
     DryRunSink by default, KubectlSink with ``live=True`` (runner
@@ -420,6 +525,14 @@ def controller_from_config(cfg: FrameworkConfig, backend: PolicyBackend,
 
     source = make_signal_source(cfg.cluster, cfg.workload, cfg.sim,
                                 cfg.signals)
+
+    # Spot interruption feed: configured queue URL enables it (live AWS
+    # CLI transport by default; tests inject interruption_runner).
+    if cfg.signals.interruption_queue_url and "interruption_feed" not in kwargs:
+        from ccka_tpu.signals.live import SpotInterruptionFeed
+        kwargs["interruption_feed"] = SpotInterruptionFeed(
+            cfg.signals.interruption_queue_url, region=cfg.cluster.region,
+            runner=interruption_runner)
 
     if cfg.cluster.regions:
         # One sink per regional cluster.

@@ -49,6 +49,11 @@ SERIES: dict[str, tuple[str, str]] = {
                             "App p95 latency proxy, milliseconds"),
     "ccka_pending_pods": ("pending_pods", "Unschedulable pod backlog"),
     "ccka_is_peak": ("is_peak", "1 during configured peak hours"),
+    "ccka_interruption_warnings": (
+        "interruption_warnings",
+        "Spot interruption/rebalance warnings consumed this tick"),
+    "ccka_nodes_drained": (
+        "nodes_drained", "Nodes cordoned+drained for interruption warnings"),
     "ccka_applied": ("applied", "1 if every patch applied this tick"),
     "ccka_verified": ("verified", "1 if read-back matched intent"),
     "ccka_tick": ("t", "Controller tick counter"),

@@ -338,6 +338,106 @@ class SpotPriceClient:
         return {az: price for az, (_ts, price) in best.items()}
 
 
+class InterruptionWarning:
+    """One EC2 spot lifecycle event (EventBridge shape)."""
+
+    __slots__ = ("instance_id", "action", "detail_type", "region")
+
+    def __init__(self, instance_id: str, action: str, detail_type: str,
+                 region: str = ""):
+        self.instance_id = instance_id
+        self.action = action              # "terminate" | "rebalance"
+        self.detail_type = detail_type
+        self.region = region
+
+    def __repr__(self) -> str:  # diagnostics in controller logs
+        return (f"InterruptionWarning({self.instance_id!r}, {self.action!r},"
+                f" region={self.region!r})")
+
+
+class SpotInterruptionFeed:
+    """EC2 spot interruption/rebalance warnings from an SQS queue.
+
+    This is the capability the reference explicitly disabled: Karpenter's
+    ``settings.interruptionQueue=""`` (`05_karpenter.sh:136`) turns off the
+    EventBridge→SQS interruption pipeline entirely, so a spot reclaim hits
+    the demo cluster with zero notice. The simulator prices interruptions
+    as a first-class stochastic process; this feed closes the live half:
+    it polls the EventBridge-target SQS queue over the AWS CLI (the
+    reference's only AWS transport, `00_common.sh:24`) with an injectable
+    runner, parses `EC2 Spot Instance Interruption Warning` and
+    `EC2 Instance Rebalance Recommendation` events, and acknowledges
+    (deletes) consumed messages so a warning is acted on exactly once.
+
+    Failures (CLI error, junk JSON, missing queue) return [] — the control
+    loop keeps running on its stochastic prior, mirroring every other live
+    client's graceful degradation.
+    """
+
+    _DETAIL_ACTIONS = {
+        "EC2 Spot Instance Interruption Warning": "terminate",
+        "EC2 Instance Rebalance Recommendation": "rebalance",
+    }
+
+    def __init__(self, queue_url: str, *, region: str = "",
+                 runner=None, ack: bool = True, max_messages: int = 10):
+        self.queue_url = queue_url
+        self.region = region
+        self.ack = ack
+        self.max_messages = max(1, min(int(max_messages), 10))  # SQS cap
+        if runner is None:
+            from ccka_tpu.actuation.sink import _subprocess_runner
+            runner = _subprocess_runner
+        self.runner = runner
+
+    def _region_args(self) -> list[str]:
+        return ["--region", self.region] if self.region else []
+
+    def poll(self) -> list[InterruptionWarning]:
+        rc, out = self.runner([
+            "aws", "sqs", "receive-message", *self._region_args(),
+            "--queue-url", self.queue_url,
+            "--max-number-of-messages", str(self.max_messages),
+            "--wait-time-seconds", "0",
+            "--output", "json"])
+        if rc != 0:
+            return []
+        try:
+            doc = json.loads(out) if out.strip() else {}
+        except json.JSONDecodeError:
+            return []
+        messages = doc.get("Messages", []) or []
+        # Ack every received message in ONE batch call FIRST (including
+        # junk and non-spot events routed here by a broad EventBridge
+        # rule): an unacked message would redeliver and double-drain next
+        # tick, a junk body would redeliver forever, and per-message
+        # delete-message subprocesses would cost the control tick up to
+        # ten sequential CLI spawns.
+        handles = [m.get("ReceiptHandle", "") for m in messages]
+        handles = [h for h in handles if h]
+        if self.ack and handles:
+            entries = [{"Id": str(i), "ReceiptHandle": h}
+                       for i, h in enumerate(handles)]
+            self.runner(["aws", "sqs", "delete-message-batch",
+                         *self._region_args(),
+                         "--queue-url", self.queue_url,
+                         "--entries", json.dumps(entries)])
+        warnings: list[InterruptionWarning] = []
+        for msg in messages:
+            try:
+                event = json.loads(msg.get("Body", ""))
+            except (json.JSONDecodeError, TypeError):
+                continue
+            action = self._DETAIL_ACTIONS.get(event.get("detail-type", ""))
+            instance = (event.get("detail") or {}).get("instance-id", "")
+            if action and instance:
+                warnings.append(InterruptionWarning(
+                    instance_id=instance, action=action,
+                    detail_type=event["detail-type"],
+                    region=event.get("region", self.region)))
+        return warnings
+
+
 class LiveSignalSource(SignalSource):
     """Assembles live clients into the common trace format.
 

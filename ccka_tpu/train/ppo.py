@@ -249,8 +249,12 @@ class PPOTrainer:
             _, _, _, kl = aux
             # Target-KL early stop, branch-free: once KL exceeds target the
             # remaining epochs apply zero updates (stops destructive
-            # late-epoch policy drift).
-            stop_now = jnp.logical_or(stopped, kl > tcfg.ppo_target_kl)
+            # late-epoch policy drift). Gated off during critic warmup:
+            # torso movement under the value loss shifts the policy mean
+            # even with policy_coef=0, and halting on that drift would
+            # freeze the critic updates the warmup exists to run.
+            stop_now = jnp.logical_or(
+                stopped, (kl > tcfg.ppo_target_kl) & (policy_coef > 0))
             updates, new_opt_state = self.opt.update(grads, opt_state, params)
             if tcfg.actor_lr_scale != 1.0:
                 updates = self._scale_actor_updates(updates)

@@ -352,6 +352,220 @@ class TestSubprocessRunnerHardening:
         assert len(count.read_text().splitlines()) == 1  # no retry
 
 
+def _spot_node(name: str, instance_id: str, zone: str,
+               pool: str = "spot-preferred") -> dict:
+    return {
+        "kind": "Node",
+        "metadata": {
+            "name": name,
+            "labels": {"karpenter.sh/capacity-type": "spot",
+                       "karpenter.sh/nodepool": pool,
+                       "topology.kubernetes.io/zone": zone},
+        },
+        "spec": {"providerID": f"aws:///{zone}/{instance_id}"},
+    }
+
+
+def _sqs_event(instance_id: str, detail_type: str, region: str = "us-east-2",
+               handle: str = "rh-1") -> dict:
+    return {
+        "MessageId": "m-" + instance_id,
+        "ReceiptHandle": handle,
+        "Body": json.dumps({
+            "version": "0",
+            "detail-type": detail_type,
+            "source": "aws.ec2",
+            "region": region,
+            "detail": {"instance-id": instance_id,
+                       "instance-action": "terminate"},
+        }),
+    }
+
+
+class TestSpotInterruptions:
+    """VERDICT r3 missing #3: the live half of spot interruptions — the
+    EventBridge→SQS warning feed Karpenter's `settings.interruptionQueue=""`
+    disabled (`05_karpenter.sh:136`), wired into the controller as a
+    cordon+drain response with an immediate state-estimate decrement."""
+
+    def test_feed_parses_and_acks_canned_events(self):
+        from ccka_tpu.signals.live import SpotInterruptionFeed
+
+        calls = []
+
+        def runner(argv):
+            calls.append(list(argv))
+            if argv[:3] == ["aws", "sqs", "receive-message"]:
+                return 0, json.dumps({"Messages": [
+                    _sqs_event("i-0spot1",
+                               "EC2 Spot Instance Interruption Warning",
+                               handle="rh-a"),
+                    _sqs_event("i-0spot2",
+                               "EC2 Instance Rebalance Recommendation",
+                               handle="rh-b"),
+                    {"MessageId": "m-x", "ReceiptHandle": "rh-c",
+                     "Body": "not json"},
+                ]})
+            return 0, ""
+
+        feed = SpotInterruptionFeed("https://sqs.example/q", runner=runner,
+                                    region="us-east-2")
+        warnings = feed.poll()
+        assert [(w.instance_id, w.action) for w in warnings] == [
+            ("i-0spot1", "terminate"), ("i-0spot2", "rebalance")]
+        # Every message acked (including the junk one) in ONE batch call —
+        # no redelivery, no per-message CLI spawns in the tick path.
+        acks = [c for c in calls
+                if c[:3] == ["aws", "sqs", "delete-message-batch"]]
+        assert len(acks) == 1
+        entries = json.loads(acks[0][acks[0].index("--entries") + 1])
+        assert {e["ReceiptHandle"] for e in entries} == {
+            "rh-a", "rh-b", "rh-c"}
+
+    def test_feed_degrades_on_cli_failure(self):
+        from ccka_tpu.signals.live import SpotInterruptionFeed
+
+        feed = SpotInterruptionFeed("https://sqs.example/q",
+                                    runner=lambda argv: (1, "boom"))
+        assert feed.poll() == []
+        feed2 = SpotInterruptionFeed("https://sqs.example/q",
+                                     runner=lambda argv: (0, "not json"))
+        assert feed2.poll() == []
+
+    def test_warning_tick_drains_and_adjusts_estimate(self):
+        """A terminate warning produces the cordon+drain sequence on the
+        owning sink, decrements the spot estimate in the node's zone, and
+        the tick report carries the counts. Rebalance: counted, no drain."""
+        from ccka_tpu.actuation.sink import DryRunSink, ManifestCommand
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.live import InterruptionWarning
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        cfg = default_config()
+        zone = cfg.cluster.zones[1]
+        sink = DryRunSink()
+        node = _spot_node("ip-10-0-1-23", "i-0spot1", zone)
+        sink.objects[("node", "", "ip-10-0-1-23")] = node
+
+        class Feed:
+            def __init__(self):
+                self.polls = 0
+
+            def poll(self):
+                self.polls += 1
+                if self.polls == 1:
+                    return [InterruptionWarning("i-0spot1", "terminate",
+                                                "EC2 Spot..."),
+                            InterruptionWarning("i-0gone", "terminate",
+                                                "EC2 Spot..."),
+                            InterruptionWarning("i-0spot1", "rebalance",
+                                                "Rebalance...")]
+                return []
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, sink,
+                          interval_s=0.0, interruption_feed=Feed(),
+                          log_fn=lambda _l: None)
+        # Seed the estimate with spot capacity in the node's zone.
+        spot_pool = cfg.cluster.pool_index("spot-preferred")
+        ctrl.state = ctrl.state._replace(
+            nodes=ctrl.state.nodes.at[spot_pool, 1, 0].set(3.0))
+        rep = ctrl.tick(0)
+        assert rep.interruption_warnings == 3
+        assert rep.nodes_drained == 1
+        # Cordon then drain hit the sink for the mapped node.
+        lifecycle = [c for c in sink.commands
+                     if isinstance(c, ManifestCommand)
+                     and c.action in ("cordon", "drain")]
+        assert [(c.action, c.name) for c in lifecycle] == [
+            ("cordon", "ip-10-0-1-23"), ("drain", "ip-10-0-1-23")]
+        # Dry-run store marks the node unschedulable + drained.
+        assert node["spec"]["unschedulable"] is True
+        assert node["metadata"]["annotations"]["ccka.io/drained"] == "true"
+        # 'interruptions' stage shows up in the tick timings.
+        assert "interruptions" in rep.timings_ms
+
+    def test_estimate_decrement_lands_in_right_cell(self):
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.live import InterruptionWarning
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        cfg = default_config()
+        zone = cfg.cluster.zones[2]
+        sink = DryRunSink()
+        sink.objects[("node", "", "n1")] = _spot_node("n1", "i-07f", zone)
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, sink,
+                          interval_s=0.0, log_fn=lambda _l: None)
+        spot_pool = cfg.cluster.pool_index("spot-preferred")
+        ctrl.state = ctrl.state._replace(
+            nodes=ctrl.state.nodes.at[spot_pool, 2, 0].set(2.0))
+        n = ctrl._drain_for_warnings(
+            [InterruptionWarning("i-07f", "terminate", "x")])
+        assert n == 1
+        nodes = np.asarray(ctrl.state.nodes)
+        assert nodes[spot_pool, 2, 0] == 2.0 - 1.0
+        # Clipped at zero: a second drain of the same (now empty) cell
+        # cannot go negative.
+        ctrl.state = ctrl.state._replace(
+            nodes=ctrl.state.nodes.at[spot_pool, 2, 0].set(0.0))
+        sink.objects[("node", "", "n1")] = _spot_node("n1", "i-07f", zone)
+        ctrl._drain_for_warnings(
+            [InterruptionWarning("i-07f", "terminate", "x")])
+        assert np.asarray(ctrl.state.nodes).min() >= 0.0
+
+    def test_duplicate_warning_drains_once(self):
+        """At-least-once SQS delivery: a redelivered terminate warning for
+        an already-drained instance must not drain or decrement again."""
+        from ccka_tpu.actuation.sink import DryRunSink, ManifestCommand
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.live import InterruptionWarning
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        cfg = default_config()
+        zone = cfg.cluster.zones[0]
+        sink = DryRunSink()
+        sink.objects[("node", "", "n1")] = _spot_node("n1", "i-0dup", zone)
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, sink,
+                          interval_s=0.0, log_fn=lambda _l: None)
+        spot_pool = cfg.cluster.pool_index("spot-preferred")
+        ctrl.state = ctrl.state._replace(
+            nodes=ctrl.state.nodes.at[spot_pool, 0, 0].set(3.0))
+        w = InterruptionWarning("i-0dup", "terminate", "x")
+        # Same-batch duplicate AND a next-tick redelivery.
+        assert ctrl._drain_for_warnings([w, w]) == 1
+        assert ctrl._drain_for_warnings([w]) == 0
+        assert np.asarray(ctrl.state.nodes)[spot_pool, 0, 0] == 2.0
+        drains = [c for c in sink.commands
+                  if isinstance(c, ManifestCommand) and c.action == "drain"]
+        assert len(drains) == 1
+
+    def test_from_config_wires_feed_from_queue_url(self):
+        from ccka_tpu.harness.controller import controller_from_config
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.live import SpotInterruptionFeed
+
+        cfg = default_config().with_overrides(**{
+            "signals.interruption_queue_url": "https://sqs.example/q"})
+        ctrl = controller_from_config(
+            cfg, RulePolicy(cfg.cluster),
+            interruption_runner=lambda argv: (1, ""))
+        assert isinstance(ctrl.interruption_feed, SpotInterruptionFeed)
+        assert ctrl.interruption_feed.queue_url == "https://sqs.example/q"
+        # No URL -> no feed.
+        ctrl2 = controller_from_config(default_config(),
+                                       RulePolicy(cfg.cluster))
+        assert ctrl2.interruption_feed is None
+
+
 class TestControllerLock:
     """Single-writer race guard: two control loops on one cluster would
     ping-pong demo_20/demo_21 patches (the hazard the reference only
