@@ -270,6 +270,21 @@ def bench_fleet(cfg, n_clusters: int, ticks: int) -> dict:
     return out
 
 
+def _flag_wins(section: dict, rule_row: dict) -> None:
+    """Stamp `beats_rule_both_headlines` on every learned/hand-coded row
+    of a scoreboard section — ONE criterion for synthetic, multiregion
+    and replay scoreboards alike."""
+    for name in ("ppo", "mpc", "carbon"):
+        if name not in section:
+            continue
+        r = section[name]
+        wins = (r.get("vs_rule_usd_per_slo_hour", 9) <= 1.0
+                and r.get("vs_rule_g_co2_per_kreq", 9) <= 1.0
+                and r["slo_attainment"] >= rule_row["slo_attainment"]
+                - 1e-3)
+        r["beats_rule_both_headlines"] = bool(wins)
+
+
 def _paired_ratios(board: dict, name: str) -> dict:
     """Per-trace paired ratios vs rule for the two headline metrics —
     mean alone can't distinguish a ±2% 'win' from trace noise, so the
@@ -368,17 +383,6 @@ def bench_quality(cfg, ppo_iters: int = 30, eval_steps: int = 2880,
         if name != "rule":
             out["multiregion"][name].update(_paired_ratios(mboard, name))
 
-    def _flag_wins(section, rule_row):
-        for name in ("ppo", "mpc", "carbon"):
-            if name not in section:
-                continue
-            r = section[name]
-            wins = (r.get("vs_rule_usd_per_slo_hour", 9) <= 1.0
-                    and r.get("vs_rule_g_co2_per_kreq", 9) <= 1.0
-                    and r["slo_attainment"] >= rule_row["slo_attainment"]
-                    - 1e-3)
-            r["beats_rule_both_headlines"] = bool(wins)
-
     _flag_wins(out, out["rule"])
     _flag_wins(out["multiregion"], out["multiregion"]["rule"])
     for label, section in (("", out), ("multiregion.", out["multiregion"])):
@@ -445,12 +449,18 @@ def bench_quality_replay(cfg, eval_steps: int = 2880, n_windows: int = 3,
         out[name] = pick(r)
         if name != "rule":
             out[name].update(_paired_ratios(board, name))
+    # VERDICT r3 weak #4: the transfer scoreboard carries the SAME win
+    # flag as the synthetic one (shared helper — the criterion cannot
+    # drift between the two), so a replay-family shortfall can't hide
+    # behind raw ratios.
+    _flag_wins(out, out["rule"])
     learned = [n for n in ("mpc", "ppo") if n in out]
     for name in learned:
         print(f"# quality_replay[{name}]: usd x"
               f"{out[name].get('vs_rule_usd_per_slo_hour', float('nan')):.3f}"
               f" co2 x"
-              f"{out[name].get('vs_rule_g_co2_per_kreq', float('nan')):.3f}",
+              f"{out[name].get('vs_rule_g_co2_per_kreq', float('nan')):.3f}"
+              f"{' BEATS RULE' if out[name]['beats_rule_both_headlines'] else ''}",
               file=sys.stderr)
     return out
 

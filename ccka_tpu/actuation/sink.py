@@ -387,6 +387,19 @@ class KubectlSink(ActuationSink):
             finally:
                 os.unlink(path)
             return rc == 0
+        if cmd.action == "drain":
+            # A drain legitimately runs up to its own --timeout (2x the
+            # pod grace period); the default runner's 30s attempt cap
+            # would SIGKILL it mid-eviction. Widen the budget to the
+            # command's declared timeout (+ slack) when the runner
+            # supports it (injected argv-only test runners don't).
+            budget = max(cmd.grace_s * 2, 60) + 15.0
+            try:
+                rc, _ = self.runner(cmd.kubectl_argv(), timeout_s=budget,
+                                    deadline_s=budget + 10.0)
+            except TypeError:
+                rc, _ = self.runner(cmd.kubectl_argv())
+            return rc == 0
         rc, _ = self.runner(cmd.kubectl_argv())
         return rc == 0
 

@@ -468,6 +468,19 @@ class TrainConfig:
     # shared torso/critic learning rate; <1 slows the actor so the critic
     # stays ahead of the policy it evaluates.
     actor_lr_scale: float = 1.0
+    # Adaptive attainment constraint (Lagrangian-PPO style). The
+    # scoreboard treats attainment as a CONSTRAINT (>= the rule
+    # baseline's), not a reward: attainment above the bar earns nothing,
+    # yet a fixed violation price makes buying 0.999 attainment with an
+    # oversized fleet reward-optimal (the round-3/4 excursion). With
+    # attain_target > 0 the per-tick violation price becomes a
+    # multiplier: it decays while measured attainment sits above target
+    # (freeing budget to cut cost/carbon) and rises when below. 0 = off
+    # (fixed slo_violation_weight).
+    attain_target: float = 0.0
+    lagrange_lr: float = 2.0        # multiplicative update rate on the gap
+    lagrange_min: float = 1e-3      # multiplier floor ($/violated tick)
+    lagrange_max: float = 0.2       # multiplier ceiling
     # Early-stop epochs once approx-KL exceeds this (masked inside the
     # jitted epoch scan; prevents destructive late-training updates).
     ppo_target_kl: float = 0.05
@@ -498,6 +511,11 @@ class TrainConfig:
             raise ConfigError("train: refinement knobs out of range "
                               "(warmup/anchor/adv_clip >= 0, "
                               "actor_lr_scale > 0)")
+        if not 0.0 <= self.attain_target < 1.0:
+            raise ConfigError("train: attain_target out of [0, 1)")
+        if self.attain_target > 0 and not (
+                0 < self.lagrange_min <= self.lagrange_max):
+            raise ConfigError("train: lagrange bounds out of order")
 
 
 @dataclass(frozen=True)

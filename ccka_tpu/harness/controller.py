@@ -86,6 +86,12 @@ class TickReport:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
 
 
+# Ticks an acked-but-unmatched terminate warning is retried before being
+# dropped (covers transient node-listing failures and registration lag;
+# at the 30s cadence, 4 ticks = the 2-minute interruption notice window).
+_PENDING_WARNING_TTL = 4
+
+
 class ControllerLockHeld(RuntimeError):
     """Another controller daemon holds this cluster's single-writer lock."""
 
@@ -193,6 +199,13 @@ class Controller:
         self.interruption_feed = interruption_feed
         # insertion-ordered: oldest evicted first (see _remember_drained)
         self._drained_instances: dict[str, None] = {}
+        # Terminate warnings whose instance no node-listing resolved yet.
+        # The SQS ack happens at poll time (before processing), so an
+        # unresolved warning would otherwise be lost forever — e.g. a
+        # transient apiserver blip making list_objects return [] — and
+        # the 2-minute notice wasted. Bounded retry: {instance_id:
+        # (warning, remaining_ticks)}.
+        self._pending_warnings: dict[str, tuple] = {}
         # Prometheus exposition of the tick KPIs (harness.promexport);
         # None disables. Updated after every tick.
         self.exporter = exporter
@@ -281,6 +294,8 @@ class Controller:
                 if provider:
                     by_instance[provider.rsplit("/", 1)[-1]] = (node, sink)
         zones = list(self.cfg.cluster.zones)
+        prev_pending = self._pending_warnings
+        next_pending: dict[str, tuple] = {}
         for w in warnings:
             if w.action != "terminate":
                 self.log_fn(f"# rebalance recommendation: {w!r} (no action)")
@@ -294,8 +309,21 @@ class Controller:
                 continue
             hit = by_instance.get(w.instance_id)
             if hit is None:
-                self.log_fn(f"# interruption warning for unknown instance "
-                            f"{w.instance_id} (already gone?)")
+                # The warning was already acked at poll time; losing it
+                # here would waste the 2-minute notice whenever the node
+                # listing transiently failed or the node hasn't
+                # registered yet. Retry for a bounded number of ticks.
+                _w, ttl = prev_pending.get(w.instance_id,
+                                           (w, _PENDING_WARNING_TTL + 1))
+                if ttl - 1 > 0:
+                    next_pending[w.instance_id] = (w, ttl - 1)
+                    self.log_fn(f"# interruption warning for unresolved "
+                                f"instance {w.instance_id} — retrying "
+                                f"{ttl - 1} more tick(s)")
+                else:
+                    self.log_fn(f"# interruption warning for "
+                                f"{w.instance_id} never matched a node — "
+                                f"dropped (already gone?)")
                 continue
             node, sink = hit
             name = node.get("metadata", {}).get("name", "")
@@ -321,6 +349,7 @@ class Controller:
             new_nodes = self.state.nodes.at[pi, zi, CT_SPOT].add(-1.0)
             self.state = self.state._replace(
                 nodes=jnp.maximum(new_nodes, 0.0))
+        self._pending_warnings = next_pending
         return drained
 
     def _remember_drained(self, instance_id: str) -> None:
@@ -352,8 +381,17 @@ class Controller:
             with timer.stage("interruptions"):
                 warnings = self.interruption_feed.poll()
                 n_warnings = len(warnings)
-                if warnings:
-                    n_drained = self._drain_for_warnings(warnings)
+                # All fresh warnings pass through as-is (one instance can
+                # carry both a rebalance and a terminate); carried-over
+                # unresolved ones are re-offered unless a fresh warning
+                # for the same instance supersedes them.
+                fresh_ids = {w.instance_id for w in warnings}
+                carried = [w for iid, (w, _t)
+                           in self._pending_warnings.items()
+                           if iid not in fresh_ids]
+                batch = list(warnings) + carried
+                if batch:
+                    n_drained = self._drain_for_warnings(batch)
 
         # 2. decide. Receding-horizon backends periodically re-optimize
         #    against the source's forward-looking window (exact future for

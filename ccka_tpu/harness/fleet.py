@@ -121,9 +121,12 @@ class FleetController:
         self.key = jax.random.key(seed + 1)
 
         p, z = cfg.cluster.n_pools, cfg.cluster.n_zones
-        # Host-side unpack plan for the packed action row (Action field
-        # order; trailing column is is_peak).
-        self._action_shapes = [(p, z), (p, N_CT), (p,), (p,), (2,)]
+        # Host-side unpack plan for the packed action row, derived from a
+        # template Action so it tracks the NamedTuple's field order and
+        # leaf shapes by construction (the device pack iterates the same
+        # fields; trailing column is is_peak).
+        template = Action.neutral(p, z)
+        self._action_shapes = [tuple(leaf.shape) for leaf in template]
         self._action_sizes = [int(np.prod(s)) for s in self._action_shapes]
         self._pool = (ThreadPoolExecutor(max_workers=fanout_workers,
                                          thread_name_prefix="ccka-fanout")

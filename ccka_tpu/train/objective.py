@@ -30,18 +30,26 @@ from ccka_tpu.config import TrainConfig
 from ccka_tpu.sim.types import StepMetrics
 
 
-def step_cost(metrics: StepMetrics, tcfg: TrainConfig) -> jnp.ndarray:
-    """Per-tick scalar cost (leading axes preserved)."""
+def step_cost(metrics: StepMetrics, tcfg: TrainConfig,
+              violation_weight=None) -> jnp.ndarray:
+    """Per-tick scalar cost (leading axes preserved).
+
+    ``violation_weight`` overrides the static config price — the
+    Lagrangian-PPO path passes its adapted multiplier here (a traced
+    scalar carried in the train state, `TrainConfig.attain_target`)."""
+    vw = (tcfg.slo_violation_weight if violation_weight is None
+          else violation_weight)
     pending = jnp.maximum(
         metrics.demand_pods - metrics.served_pods, 0.0).sum(axis=-1)
     return (metrics.cost_usd
             + tcfg.carbon_weight * metrics.carbon_g
             + tcfg.slo_weight * pending
-            + tcfg.slo_violation_weight * (1.0 - metrics.slo_ok))
+            + vw * (1.0 - metrics.slo_ok))
 
 
-def step_reward(metrics: StepMetrics, tcfg: TrainConfig) -> jnp.ndarray:
-    return -step_cost(metrics, tcfg)
+def step_reward(metrics: StepMetrics, tcfg: TrainConfig,
+                violation_weight=None) -> jnp.ndarray:
+    return -step_cost(metrics, tcfg, violation_weight)
 
 
 def episode_objective(metrics: StepMetrics, tcfg: TrainConfig) -> jnp.ndarray:

@@ -44,6 +44,12 @@ class PPOTrainState(NamedTuple):
     env_states: ClusterState          # [B, ...] persistent worlds
     key: jax.Array
     iteration: jnp.ndarray            # []
+    # Adaptive SLO-violation price (Lagrange multiplier) when
+    # train.attain_target > 0; otherwise pinned at the static config
+    # value. Carried in the train state so the whole run stays one
+    # compiled iteration. Required (no default): a silently-zeroed price
+    # would train Lagrangian mode with free SLO violations.
+    violation_weight: jnp.ndarray
 
 
 class PPODiagnostics(NamedTuple):
@@ -52,6 +58,8 @@ class PPODiagnostics(NamedTuple):
     value_loss: jnp.ndarray
     entropy: jnp.ndarray
     approx_kl: jnp.ndarray
+    attainment: jnp.ndarray           # mean batch attainment this window
+    violation_weight: jnp.ndarray     # multiplier used this iteration
 
 
 def _gaussian_logp(u, mean, log_std):
@@ -110,6 +118,7 @@ class PPOTrainer:
             env_states=self._broadcast_state(b),
             key=key,
             iteration=jnp.int32(0),
+            violation_weight=jnp.float32(self.tcfg.slo_violation_weight),
         )
 
     def _broadcast_state(self, b: int) -> ClusterState:
@@ -154,6 +163,11 @@ class PPOTrainer:
         xs_t = jax.tree.map(lambda x: x[:-1], xs_all)
         boot_exo = jax.tree.map(lambda x: x[-1], xs_all)
 
+        # Violation price: the adapted multiplier (Lagrangian mode) or the
+        # static config value. A traced scalar either way — one compile.
+        vw = (ts.violation_weight if tcfg.attain_target > 0
+              else jnp.float32(tcfg.slo_violation_weight))
+
         def collect_step(carry, exo_t):
             states, key = carry
             key, k_act, k_step = jax.random.split(key, 3)
@@ -168,12 +182,14 @@ class PPOTrainer:
             states, metrics = jax.vmap(
                 partial(sim_step, self.params_sim, stochastic=True)
             )(states, actions, exo_t, step_keys)
-            reward = step_reward(metrics, tcfg) * _REWARD_SCALE   # [B]
-            return (states, key), (obs, u, logp, value, reward)
+            reward = step_reward(metrics, tcfg, vw) * _REWARD_SCALE  # [B]
+            return (states, key), (obs, u, logp, value, reward,
+                                   metrics.slo_ok)
 
         # unroll: per-step tensors are small, so loop overhead dominates —
         # same rationale as the rollout scan (`sim/rollout.py` _UNROLL).
-        (env_states, key), (obs_t, u_t, logp_t, value_t, reward_t) = \
+        (env_states, key), (obs_t, u_t, logp_t, value_t, reward_t,
+                            slo_ok_t) = \
             jax.lax.scan(collect_step, (ts.env_states, ts.key), xs_t,
                          unroll=4)
 
@@ -271,13 +287,53 @@ class PPOTrainer:
             length=tcfg.ppo_epochs)
         p_loss, v_loss, entropy, kl = jax.tree.map(lambda x: x[-1], aux)
 
+        # Multiplier adaptation (dual ascent on the attainment constraint):
+        # grows while measured attainment sits below target, decays above
+        # it — above-target attainment earns nothing, so the policy's
+        # budget moves to cost/carbon. The constraint is measured on a
+        # DETERMINISTIC (mean-action) shadow rollout of the same window:
+        # the scoreboard evaluates the mean policy, and exploration noise
+        # drags the stochastic batch's attainment far enough below it
+        # that adapting on the noisy number maxes the multiplier out and
+        # re-creates the very overprovision excursion it exists to stop
+        # (measured: run-B flagship, round 4).
+        attain = slo_ok_t.mean()
+        if tcfg.attain_target > 0:
+            def shadow_step(carry, exo_t):
+                states, key = carry
+                key, k_step = jax.random.split(key)
+                obs = self._obs(states, exo_t)
+                mean, _, _ = self.net.apply(params, obs)
+                acts = jax.vmap(
+                    lambda ui: latent_to_action(ui, self.cluster))(mean)
+                step_keys = jax.random.split(k_step, obs.shape[0])
+                states, metrics = jax.vmap(
+                    partial(sim_step, self.params_sim, stochastic=True)
+                )(states, acts, exo_t, step_keys)
+                return (states, key), metrics.slo_ok
+
+            (_, _), shadow_ok = jax.lax.scan(
+                shadow_step,
+                (ts.env_states, jax.random.fold_in(ts.key, 7919)),
+                xs_t, unroll=4)
+            attain_det = shadow_ok.mean()
+            new_vw = jnp.clip(
+                vw * jnp.exp(tcfg.lagrange_lr
+                             * (tcfg.attain_target - attain_det)),
+                tcfg.lagrange_min, tcfg.lagrange_max)
+            attain = attain_det
+        else:
+            new_vw = ts.violation_weight
+
         new_ts = PPOTrainState(
             params=params, opt_state=opt_state, env_states=env_states,
-            key=key, iteration=ts.iteration + 1)
+            key=key, iteration=ts.iteration + 1,
+            violation_weight=new_vw)
         diag = PPODiagnostics(
             mean_reward=reward_t.mean() / _REWARD_SCALE,
             policy_loss=p_loss, value_loss=v_loss,
-            entropy=entropy, approx_kl=kl)
+            entropy=entropy, approx_kl=kl,
+            attainment=attain, violation_weight=vw)
         return new_ts, diag
 
     # -- host-side driver ---------------------------------------------------

@@ -1,0 +1,166 @@
+"""Fixed-action zone probes: is single-region zone carbon monetizable?
+
+The committed evidence for ARCHITECTURE.md §5's load-bearing negative
+result (VERDICT r3 missing #4): on the single-region demo topology the
+zone-to-zone carbon spread (~6%, same grid — the reference's static
+`carbon.simulated=low|medium` labels were a stub for exactly this signal,
+`demo_10_setup_configure.sh:61-62`) is too small for ANY zone-selection
+policy to cut gCO₂/kreq without paying cost or attainment.
+
+Method: paired evaluation (identical traces, identical world randomness)
+of fixed zone-pinning actions — the strongest possible zone commitment a
+policy could make — against the rule baseline, on full-day held-out
+stochastic traces from the bench's scoring family:
+
+- ``neutral``      — all zones open (demo_19 reset profile);
+- ``pin:<zone>``   — all provisioning forced into one zone, for each zone
+  (a *policy* can only mix these; if no pure pin monetizes carbon, no
+  mixture monetizes more than the best pin's margin);
+- ``carbon``       — the per-tick lowest-carbon zone follower with
+  hysteresis (the multiregion flagship's teacher), as the adaptive
+  upper-envelope probe.
+
+A probe "monetizes" the spread if it wins carbon beyond eval noise
+(co2 ratio < 1 − 2σ of the per-trace ratio spread) while holding cost
+(usd ≤ 1) and attainment (≥ rule − 1e-3). The committed artifact
+(`data/zone_spread_probe.json`) records every probe's ratios and the
+verdict; re-running this script reproduces it.
+
+Run from the repo root:
+    python scripts/zone_spread_probe.py --out data/zone_spread_probe.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ccka_tpu.config import default_config  # noqa: E402
+from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy  # noqa: E402
+from ccka_tpu.policy.base import PolicyBackend  # noqa: E402
+from ccka_tpu.sim.types import Action  # noqa: E402
+from ccka_tpu.signals.synthetic import SyntheticSignalSource  # noqa: E402
+from ccka_tpu.train.evaluate import evaluate_backend, heldout_traces  # noqa: E402
+
+_ATTAIN_EPS = 1e-3
+
+
+class FixedActionPolicy(PolicyBackend):
+    """decide() returns one constant action — the pure-probe backend."""
+
+    def __init__(self, action: Action, name: str):
+        self._action = action
+        self._name = name
+
+    @property
+    def name(self) -> str:  # PolicyBackend's display name
+        return self._name
+
+    def decide(self, state, exo, t):
+        return self._action
+
+
+def zone_pin_action(cluster, zone_index: int) -> Action:
+    """Neutral profile with provisioning forced into one zone."""
+    neutral = Action.neutral(cluster.n_pools, cluster.n_zones)
+    w = jnp.zeros((cluster.n_pools, cluster.n_zones), jnp.float32)
+    w = w.at[:, zone_index].set(1.0)
+    return neutral._replace(zone_weight=w)
+
+
+def run_probe(steps: int, n_traces: int, seed0: int) -> dict:
+    cfg = default_config()
+    cluster = cfg.cluster
+    src = SyntheticSignalSource(cluster, cfg.workload, cfg.sim, cfg.signals)
+    traces = heldout_traces(src, steps=steps, n=n_traces, seed0=seed0)
+
+    # Measured zone carbon spread over the evaluation window.
+    carbon = np.stack([np.asarray(tr.carbon_g_kwh) for tr in traces])
+    zone_mean = carbon.mean(axis=(0, 1))          # [Z]
+    spread = float(zone_mean.max() / zone_mean.min() - 1.0)
+
+    backends: dict[str, PolicyBackend] = {
+        "neutral": FixedActionPolicy(
+            Action.neutral(cluster.n_pools, cluster.n_zones), "neutral"),
+        "carbon": CarbonAwarePolicy(cluster),
+    }
+    for zi, zone in enumerate(cluster.zones):
+        backends[f"pin:{zone}"] = FixedActionPolicy(
+            zone_pin_action(cluster, zi), f"pin:{zone}")
+
+    rule = evaluate_backend(cfg, RulePolicy(cluster), traces)
+    probes = {}
+    for name, backend in backends.items():
+        res = evaluate_backend(cfg, backend, traces)
+        usd = res["usd_per_slo_hour"] / max(rule["usd_per_slo_hour"], 1e-9)
+        co2 = res["g_co2_per_kreq"] / max(rule["g_co2_per_kreq"], 1e-9)
+        ratios = [a / max(b, 1e-9) for a, b in zip(
+            res["per_trace"]["g_co2_per_kreq"],
+            rule["per_trace"]["g_co2_per_kreq"])]
+        noise = 2.0 * float(np.std(ratios)) if len(ratios) > 1 else 0.01
+        monetizes = (co2 < 1.0 - noise
+                     and usd <= 1.0
+                     and res["slo_attainment"]
+                     >= rule["slo_attainment"] - _ATTAIN_EPS)
+        probes[name] = {
+            "usd_ratio": round(usd, 4),
+            "co2_ratio": round(co2, 4),
+            "co2_ratio_per_trace": [round(r, 4) for r in ratios],
+            "co2_noise_2sigma": round(noise, 4),
+            "slo_attainment": round(res["slo_attainment"], 4),
+            "monetizes_carbon": bool(monetizes),
+        }
+        print(f"# {name:>16}: usd x{usd:.4f} co2 x{co2:.4f} "
+              f"attain {res['slo_attainment']:.4f}"
+              f"{'  MONETIZES' if monetizes else ''}", file=sys.stderr)
+
+    return {
+        "config": "default (single-region)",
+        "eval_steps": steps,
+        "n_traces": n_traces,
+        "seed0": seed0,
+        "zone_carbon_mean_g_kwh": [round(float(v), 2) for v in zone_mean],
+        "zone_carbon_spread": round(spread, 4),
+        "rule": {
+            "usd_per_slo_hour": round(rule["usd_per_slo_hour"], 4),
+            "g_co2_per_kreq": round(rule["g_co2_per_kreq"], 4),
+            "slo_attainment": round(rule["slo_attainment"], 4),
+        },
+        "probes": probes,
+        "any_probe_monetizes_carbon": bool(
+            any(p["monetizes_carbon"] for p in probes.values())),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=2880,
+                    help="ticks per trace (2880 = one full day; shorter "
+                         "windows never reach peak hours)")
+    ap.add_argument("--traces", type=int, default=5)
+    ap.add_argument("--seed0", type=int, default=10_000,
+                    help="held-out seed block (bench scoring family)")
+    ap.add_argument("--out", default="",
+                    help="write the JSON artifact here (e.g. "
+                         "data/zone_spread_probe.json)")
+    args = ap.parse_args(argv)
+
+    result = run_probe(args.steps, args.traces, args.seed0)
+    text = json.dumps(result, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"# wrote {args.out}", file=sys.stderr)
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,12 +43,34 @@ def pytest_configure(config):
         "(run with CCKA_TEST_TPU=1)")
     config.addinivalue_line(
         "markers", "slow: compile-heavy tests (8-device mesh, receding-"
-        "horizon MPC, end-to-end CLI train) — `-m 'not slow'` is the "
-        "quick lane (~3 min vs ~14 min full)")
+        "horizon MPC, end-to-end CLI train)")
+    config.addinivalue_line(
+        "markers", "quick: the <=2-minute iteration lane (`-m quick`) — "
+        "config/codec, golden patch bytes, bootstrap/burst/harness "
+        "wire formats, telemetry/exposition; no training or long "
+        "rollout compiles")
+    config.addinivalue_line(
+        "markers", "live_cluster: real-kubectl integration lane against a "
+        "kind/k3d cluster (opt in with CCKA_TEST_CLUSTER=1; auto-skips "
+        "when no apiserver answers)")
+
+
+# Modules whose tests are compile-light (host-side wire formats, config,
+# golden patches): together ~1 min on CPU. Auto-marked `quick` so the
+# iteration lane needs no per-test annotations and new tests in these
+# files join it automatically.
+_QUICK_MODULES = {
+    "test_config", "test_policy_actuation", "test_bootstrap",
+    "test_burst", "test_telemetry", "test_cli_harness",
+}
 
 
 def pytest_collection_modifyitems(config, items):
-    """Keep `-m tpu` smoke tests out of the CPU lane (CCKA_TEST_TPU=1 runs them)."""
+    """Auto-mark the quick lane; keep `-m tpu` smoke tests out of the CPU
+    lane (CCKA_TEST_TPU=1 runs them)."""
+    for item in items:
+        if item.module.__name__ in _QUICK_MODULES:
+            item.add_marker(pytest.mark.quick)
     if os.environ.get("CCKA_TEST_TPU", "") == "1":
         return
     skip = pytest.mark.skip(reason="TPU lane: run with CCKA_TEST_TPU=1")

@@ -548,6 +548,70 @@ class TestSpotInterruptions:
                   if isinstance(c, ManifestCommand) and c.action == "drain"]
         assert len(drains) == 1
 
+    def test_unresolved_warning_retries_then_drains(self):
+        """An acked warning whose node listing transiently fails (or whose
+        node hasn't registered) is retried next tick instead of lost —
+        SQS acks at poll time, so the controller is the only memory."""
+        from ccka_tpu.actuation.sink import DryRunSink, ManifestCommand
+        from ccka_tpu.harness.controller import (_PENDING_WARNING_TTL,
+                                                 Controller)
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.live import InterruptionWarning
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        cfg = default_config()
+        sink = DryRunSink()
+
+        class OneShotFeed:
+            def __init__(self):
+                self.fired = False
+
+            def poll(self):
+                if not self.fired:
+                    self.fired = True
+                    return [InterruptionWarning("i-0late", "terminate",
+                                                "x")]
+                return []
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, sink,
+                          interval_s=0.0, interruption_feed=OneShotFeed(),
+                          log_fn=lambda _l: None)
+        # Tick 0: warning arrives but no node matches -> carried over.
+        rep0 = ctrl.tick(0)
+        assert rep0.nodes_drained == 0
+        assert "i-0late" in ctrl._pending_warnings
+        # Node registers late; tick 1 resolves the carried warning.
+        sink.objects[("node", "", "late-node")] = _spot_node(
+            "late-node", "i-0late", cfg.cluster.zones[0])
+        rep1 = ctrl.tick(1)
+        assert rep1.nodes_drained == 1
+        assert ctrl._pending_warnings == {}
+        drains = [c for c in sink.commands
+                  if isinstance(c, ManifestCommand) and c.action == "drain"]
+        assert [c.name for c in drains] == ["late-node"]
+
+    def test_unresolved_warning_expires_after_ttl(self):
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import (_PENDING_WARNING_TTL,
+                                                 Controller)
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.live import InterruptionWarning
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        cfg = default_config()
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, DryRunSink(),
+                          interval_s=0.0, log_fn=lambda _l: None)
+        w = InterruptionWarning("i-0ghost", "terminate", "x")
+        ctrl._drain_for_warnings([w])
+        assert ctrl._pending_warnings["i-0ghost"][1] == _PENDING_WARNING_TTL
+        for _ in range(_PENDING_WARNING_TTL):
+            ctrl._drain_for_warnings([w])
+        assert "i-0ghost" not in ctrl._pending_warnings  # gave up, logged
+
     def test_from_config_wires_feed_from_queue_url(self):
         from ccka_tpu.harness.controller import controller_from_config
         from ccka_tpu.policy import RulePolicy

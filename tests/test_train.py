@@ -305,6 +305,41 @@ class TestRefinementMechanics:
         assert float(np.asarray(p["critic"]["kernel"]).mean()) == 1.0
         assert float(np.asarray(p["Dense_0"]["kernel"]).mean()) == 1.0
 
+    def test_lagrangian_multiplier_tracks_attainment_gap(self, cfg, source):
+        """Dual ascent on the attainment constraint: the violation price
+        rises while measured attainment is under target and decays above
+        it — above-target attainment must stop earning reward."""
+        def run(target):
+            lcfg = cfg.with_overrides(**{"train.attain_target": target})
+            trainer = PPOTrainer(lcfg)
+            ts, hist = trainer.train(source, iterations=3, log_every=1)
+            return float(ts.violation_weight), hist
+
+        w0 = cfg.train.slo_violation_weight
+        # Target nobody meets → multiplier grows.
+        w_hi, hist_hi = run(0.999)
+        assert w_hi > w0
+        # Trivial target → multiplier decays toward the floor.
+        w_lo, _ = run(0.05)
+        assert w_lo < w0
+        # Diagnostics expose the adaptation.
+        assert all("attainment" in h and "violation_weight" in h
+                   for h in hist_hi)
+
+    def test_lagrangian_respects_bounds(self, cfg, source):
+        lcfg = cfg.with_overrides(**{
+            "train.attain_target": 0.999, "train.lagrange_lr": 50.0,
+            "train.lagrange_max": 0.03})
+        trainer = PPOTrainer(lcfg)
+        ts, _ = trainer.train(source, iterations=3)
+        assert float(ts.violation_weight) <= 0.03 + 1e-9
+
+    def test_fixed_weight_mode_unchanged(self, cfg, source):
+        trainer = PPOTrainer(cfg)   # attain_target = 0 (off)
+        ts, _ = trainer.train(source, iterations=2)
+        assert float(ts.violation_weight) == pytest.approx(
+            cfg.train.slo_violation_weight)
+
     def test_beats_teacher_criterion(self):
         from ccka_tpu.train.flagship import beats_teacher
 
