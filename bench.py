@@ -66,20 +66,60 @@ def _time_best(fn, repeats: int = 3, *, min_valid_s: float = 2e-3) -> float:
     return max(raw)
 
 
+def _megakernel_parity_gate(cfg, params, src, *, b: int = 2048,
+                            steps: int = 480) -> dict:
+    """Inline statistical-parity gate (VERDICT r3 #2): the Pallas
+    megakernel may carry the headline ONLY if its batch-mean KPIs match
+    the lax path on every EpisodeSummary field, on this machine, in this
+    run. The full gate (interpret-exact + both modes) lives in
+    `tests/test_megakernel.py`; this is the belt-and-suspenders check at
+    bench time."""
+    from ccka_tpu.policy import RulePolicy
+    from ccka_tpu.policy.rule import offpeak_action, peak_action
+    from ccka_tpu.sim import batched_rollout_summary, initial_state
+    from ccka_tpu.sim.megakernel import (megakernel_rollout_summary,
+                                         mean_parity_violations)
+
+    traces = src.batch_trace_device(steps, jax.random.key(23), b)
+    sk = megakernel_rollout_summary(
+        params, offpeak_action(cfg.cluster), peak_action(cfg.cluster),
+        traces, seed=9, stochastic=True)
+    states = jax.tree.map(lambda x: jnp.broadcast_to(x, (b,) + x.shape),
+                          initial_state(cfg))
+    keys = jax.random.split(jax.random.key(0), b)
+    _, sl = batched_rollout_summary(
+        params, states, RulePolicy(cfg.cluster).action_fn(), traces, keys,
+        stochastic=True)
+    bad = mean_parity_violations(sk, sl)
+    out = {"ok": not bad, "b": b, "steps": steps}
+    if bad:
+        out["failed_fields"] = bad
+        print(f"# megakernel parity gate FAILED: {bad} — kernel excluded "
+              "from the headline", file=sys.stderr)
+    else:
+        print("# megakernel parity gate ok", file=sys.stderr)
+    return out
+
+
 def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int,
-                  summary_batch_sizes=()) -> dict:
+                  summary_batch_sizes=(), mega_batch_sizes=()) -> dict:
     """Batched rollout sweep. ``batch_sizes`` use the metric-stacking path
     (per-tick StepMetrics over the horizon); ``summary_batch_sizes`` use
-    the O(B)-memory summarize-in-scan path, which is how fleet-scale
-    scoring actually runs (B=32k × a day OOMs on metric stacking alone).
+    the O(B)-memory summarize-in-scan path; ``mega_batch_sizes`` use the
+    Pallas megakernel (`sim/megakernel.py`) — gated on an inline
+    statistical-parity check against the lax path, without which its
+    rows are skipped and cannot carry the headline.
     """
     from ccka_tpu.policy import RulePolicy
+    from ccka_tpu.policy.rule import offpeak_action, peak_action
     from ccka_tpu.sim import (SimParams, batched_rollout,
                               batched_rollout_summary, initial_state)
+    from ccka_tpu.sim.megakernel import megakernel_rollout_summary
 
     params = SimParams.from_config(cfg)
     src = _make_src(cfg)
     action_fn = RulePolicy(cfg.cluster).action_fn()
+    off, peak = offpeak_action(cfg.cluster), peak_action(cfg.cluster)
     days_per_traj = horizon_steps * cfg.sim.dt_s / 86400.0
 
     run_metrics = jax.jit(lambda s, tr, k: batched_rollout(
@@ -88,24 +128,54 @@ def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int,
         params, s, action_fn, tr, k, stochastic=True))
 
     results = {}
+    parity = None
+    if mega_batch_sizes:
+        try:
+            parity = _megakernel_parity_gate(
+                cfg, params, src, b=min(2048, max(mega_batch_sizes)),
+                steps=min(480, horizon_steps))
+        except Exception as e:  # noqa: BLE001 — no kernel rows, bench lives
+            print(f"# megakernel parity gate errored: {e!r}",
+                  file=sys.stderr)
+            parity = {"ok": False, "error": repr(e)[:200]}
+        results["megakernel_parity"] = parity
+
     sweep = ([(b, "metrics") for b in batch_sizes]
-             + [(b, "summary") for b in summary_batch_sizes])
+             + [(b, "summary") for b in summary_batch_sizes]
+             + ([(b, "mega") for b in mega_batch_sizes]
+                if parity and parity["ok"] else []))
     for b, mode in sweep:
         key = f"{b}:{mode}"
-        # Device-side synthesis: setup stays off the host even at B=32768.
-        traces = src.batch_trace_device(horizon_steps, jax.random.key(7), b)
-        states = jax.tree.map(
-            lambda x: jnp.broadcast_to(x, (b,) + x.shape), initial_state(cfg))
-        keys = jax.random.split(jax.random.key(0), b)
-        states, traces, keys = jax.device_put((states, traces, keys))
-        run = run_summary if mode == "summary" else run_metrics
+        # Per-row guard: one OOM (e.g. the B=64k packed-exo row on a
+        # smaller-HBM part) must not kill the stages that follow.
+        try:
+            # Device-side synthesis: setup stays off the host at B=32768.
+            traces = src.batch_trace_device(horizon_steps,
+                                            jax.random.key(7), b)
+            states = jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (b,) + x.shape),
+                initial_state(cfg))
+            keys = jax.random.split(jax.random.key(0), b)
+            states, traces, keys = jax.device_put((states, traces, keys))
 
-        def once():
-            final, _ = run(states, traces, keys)
-            jax.block_until_ready(final)
+            if mode == "mega":
+                def once():
+                    s = megakernel_rollout_summary(
+                        params, off, peak, traces, seed=1, stochastic=True)
+                    jax.block_until_ready(s.cost_usd)
+            else:
+                run = run_summary if mode == "summary" else run_metrics
 
-        once()  # compile
-        dt = _time_best(once, repeats)
+                def once():
+                    final, _ = run(states, traces, keys)
+                    jax.block_until_ready(final)
+
+            once()  # compile
+            dt = _time_best(once, repeats)
+        except Exception as e:  # noqa: BLE001
+            print(f"# rollout B={b} [{mode}] failed (skipped): "
+                  f"{repr(e)[:160]}", file=sys.stderr)
+            continue
         results[key] = {
             "batch": b,
             "seconds": dt,
@@ -479,18 +549,21 @@ def main(argv=None) -> int:
     if args.quick:
         batch_sizes, horizon, repeats = [64, 256], 240, 2
         summary_sizes = [512]
+        mega_sizes = [512]
         ppo_iters, plans = 3, 5
         ppo_cfg = default_config().with_overrides(**{
             "train.batch_clusters": 64, "train.unroll_steps": 16})
     else:
         batch_sizes, horizon, repeats = [256, 2048, 8192], 2880, 3
         summary_sizes = [16384, 32768]
+        mega_sizes = [32768, 65536]
         ppo_iters, plans = 10, 20
         ppo_cfg = default_config()  # config #3: 256 clusters, 64 steps
 
     cfg = default_config()
     rollout = bench_rollout(cfg, batch_sizes, horizon, repeats,
-                            summary_batch_sizes=summary_sizes)
+                            summary_batch_sizes=summary_sizes,
+                            mega_batch_sizes=mega_sizes)
     ppo = bench_ppo(ppo_cfg, ppo_iters)
     mpc = bench_mpc(cfg, plans)
     # Guarded like the quality stages: a fleet-tick failure must not
@@ -524,8 +597,10 @@ def main(argv=None) -> int:
               file=sys.stderr)
         quality_replay = None
 
-    best_k = max(rollout, key=lambda k: rollout[k]["cluster_days_per_sec"])
-    headline = rollout[best_k]["cluster_days_per_sec"]
+    rates = {k: v for k, v in rollout.items()
+             if isinstance(v, dict) and "cluster_days_per_sec" in v}
+    best_k = max(rates, key=lambda k: rates[k]["cluster_days_per_sec"])
+    headline = rates[best_k]["cluster_days_per_sec"]
     line = {
         "metric": "sim_cluster_days_per_sec_per_chip",
         "value": round(headline, 1),

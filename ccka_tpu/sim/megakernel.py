@@ -1,0 +1,610 @@
+"""Pallas rollout megakernel: the whole day-rollout in one TPU kernel.
+
+Why this exists (ARCHITECTURE.md §6, VERDICT r3 weak #7): the lax rollout
+is fusion-boundary-bound — ~15 fused kernels per simulated tick, each
+paying a kernel launch plus an HBM round trip for every intermediate, with
+a measured ~9x gap to the HBM roofline (0.53s vs ~0.06s for a B=32k day).
+This kernel keeps the ENTIRE cluster state resident in VMEM across the
+scanned horizon and touches HBM only for the exogenous trace stream (the
+irreducible traffic) and one final summary block per batch.
+
+Design (the round-3 sketch, realized):
+
+- **Feature-first layout**: every array is ``[rows, B_BLK]`` with the
+  cluster batch in lanes — the VPU's 8x128 registers see 128 clusters per
+  op, and all the simulator's tiny feature dims (P=2, Z=3, CT=2, C=2)
+  become static row slices instead of trailing dims XLA must pad.
+- **Grid (batch blocks x time chunks)**: the time dimension is innermost
+  and sequential; the packed state lives in a VMEM scratch that persists
+  across time chunks of the same batch block (zeroed at t==0, summarized
+  into the output block at t==nT-1). Exogenous signals stream in as
+  ``[T_CHUNK, 16, B_BLK]`` blocks, auto-double-buffered by pallas.
+- **pltpu PRNG for interruptions**: the same truncated-CDF + rounded-
+  Gaussian Poisson sampler as `dynamics._poisson_small`, fed by
+  `pltpu.prng_random_bits` (a per-grid-cell seed) — statistically
+  identical, not bitwise (threefry does not lower to Mosaic).
+- **Rule policy fused in**: the bench headline's policy is a per-tick
+  select between two constant profiles on the is_peak signal
+  (`policy/rule.py`); both profiles enter as a tiny [2, 16] input and the
+  select happens in-register. This kernel is specialized to
+  profile-select policies — the general `PolicyBackend` path stays on
+  the lax rollout (`sim/rollout.py`), which remains the reference
+  implementation the parity suite pins this kernel against.
+
+Semantics contract: identical to
+``batched_rollout_summary(params, zeros, RulePolicy(...).action_fn(),
+traces, keys, stochastic=...)`` — exact (float-tolerance) in
+deterministic mode, distribution-level in stochastic mode (different
+PRNG streams). `tests/test_megakernel.py` enforces both, plus every
+EpisodeSummary field. Fresh-state episodes only (the bench/fleet-scoring
+path): warm starts stay on the lax path.
+
+Simplification used (always true by construction, `SimParams.from_config`
+builds ``class_ct = eye(2)``): workload class c consumes capacity type c,
+so class-indexed and ct-indexed quantities coincide.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ccka_tpu.config import LATENCY_CURVE_COEF, LATENCY_RHO_CLIP
+from ccka_tpu.sim.types import Action, ClusterState, SimParams
+from ccka_tpu.signals.base import ExogenousTrace
+
+# Fixed topology of the kernel (the default + multiregion presets both
+# compile: P/Z/CT/C/K enter as static python ints).
+_EPS = 1e-6
+
+# ---- packed state rows (feature-first; [S, B] scratch) -------------------
+# nodes[(ct, p, z)] = ct*P*Z + p*Z + z — spot rows contiguous first.
+
+
+def _state_rows(P: int, Z: int, K: int) -> dict:
+    n = P * Z * 2
+    rows = {"nodes": (0, n)}
+    off = n
+    rows["pipe"] = (off, off + K * n)
+    off += K * n
+    rows["running"] = (off, off + 2)
+    off += 2
+    rows["timer"] = (off, off + P)
+    off += P
+    for name in ("acc_cost", "acc_carbon", "acc_requests", "acc_slo",
+                 "acc_evict", "nct_spot", "nct_od", "served_sum",
+                 "capacity_sum", "waste_sum", "latency_sum", "latency_max",
+                 "queue_sum", "interrupts_sum"):
+        rows[name] = (off, off + 1)
+        off += 1
+    rows["_total"] = (0, off)
+    return rows
+
+
+# Exo rows inside the [T, rows, B] packed stream — offsets depend on the
+# zone count (the multiregion preset has Z=4), so they are computed, not
+# constants: spot[0:Z], od[Z:2Z], carbon[2Z:3Z], demand[3Z:3Z+2],
+# is_peak[3Z+2]; padded to a sublane multiple.
+
+
+def _exo_rows(Z: int) -> int:
+    return math.ceil((3 * Z + 3) / 8) * 8
+
+
+def _act_rows(P: int, Z: int) -> int:
+    # zone_weight P*Z + ct_allow 2P + aggr P + after P + hpa 2.
+    return P * Z + 2 * P + P + P + 2
+
+# Packed scalar params (SMEM [1, NP]).
+_PARAM_NAMES = (
+    "dt_s", "ppn", "base_od", "maxn0", "maxn1",
+    "sa00", "sa01", "sa10", "sa11",           # static_ct_allow[p, ct]
+    "interrupt_p", "pdb", "frag", "underutil",
+    "watts_idle", "watts_full", "rps", "slo_frac", "tau_s",
+    "lat_base", "lat_slo",
+)
+_PI = {n: i for i, n in enumerate(_PARAM_NAMES)}
+
+
+def _pack_params(params: SimParams) -> jnp.ndarray:
+    sa = params.static_ct_allow
+    vals = [params.dt_s, params.pods_per_node, params.base_od_nodes,
+            params.max_nodes[0], params.max_nodes[1],
+            sa[0, 0], sa[0, 1], sa[1, 0], sa[1, 1],
+            params.interrupt_p_step, params.pdb_min_available,
+            params.fragmentation, params.underutil_threshold,
+            params.watts_idle, params.watts_full, params.rps_per_pod,
+            params.slo_served_fraction, params.consolidate_tau_s,
+            params.latency_base_ms, params.latency_slo_ms]
+    return jnp.asarray(vals, jnp.float32).reshape(1, -1)
+
+
+def _pack_action(a: Action) -> jnp.ndarray:
+    """One profile's Action -> [16] coordinate vector (kernel order)."""
+    return jnp.concatenate([
+        jnp.reshape(a.zone_weight, (-1,)),
+        jnp.reshape(a.ct_allow, (-1,)),
+        jnp.reshape(a.consolidation_aggr, (-1,)),
+        jnp.reshape(a.consolidate_after_s, (-1,)),
+        jnp.reshape(a.hpa_scale, (-1,)),
+    ]).astype(jnp.float32)
+
+
+def _uniform(shape) -> jnp.ndarray:
+    """U(0,1) from the pltpu PRNG (never exactly 0): top 24 bits via a
+    LOGICAL shift (the raw bits lower as int32 — an arithmetic shift
+    would keep the sign and hand back negative 'uniforms')."""
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.int32)
+    bits24 = jax.lax.shift_right_logical(bits, 8)
+    return (bits24.astype(jnp.float32) * (1.0 / (1 << 24))
+            + (0.5 / (1 << 24)))
+
+
+def _poisson_small_kernel(lam: jnp.ndarray, cap: jnp.ndarray) -> jnp.ndarray:
+    """`dynamics._poisson_small`, on the in-kernel PRNG: truncated CDF
+    inversion below lambda=0.5, rounded moment-matched Gaussian above."""
+    u = _uniform(lam.shape)
+    t = jnp.exp(-lam)
+    cdf = t
+    count = jnp.zeros_like(lam)
+    for k in (1, 2, 3, 4):
+        count = count + (u > cdf)
+        t = t * lam / k
+        cdf = cdf + t
+    # Box-Muller normal from two fresh uniforms.
+    u1 = jnp.maximum(_uniform(lam.shape), 1e-7)
+    u2 = _uniform(lam.shape)
+    normal = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(2.0 * jnp.pi * u2)
+    gauss = jnp.round(lam + jnp.sqrt(lam) * normal)
+    sample = jnp.where(lam < 0.5, count, jnp.maximum(gauss, 0.0))
+    return jnp.minimum(sample, cap)
+
+
+def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
+                 stochastic: bool):
+    ROWS = _state_rows(P, Z, K)
+    NPZ = P * Z * 2  # nodes rows
+
+    def rows(state, name):
+        lo, hi = ROWS[name]
+        return state[lo:hi]
+
+    def kernel(meta_ref, params_ref, actions_ref, exo_ref, out_ref, s_ref):
+        t_idx = pl.program_id(1)
+        b_idx = pl.program_id(0)
+
+        @pl.when(t_idx == 0)
+        def _init():
+            s_ref[:] = jnp.zeros_like(s_ref)
+
+        # Independent stream per grid cell (statistical parity only).
+        # Static gate: deterministic kernels never touch the PRNG (and
+        # plain interpret mode on CPU can then run them).
+        if stochastic:
+            pltpu.prng_seed(meta_ref[0, 2] + b_idx * 131071
+                            + t_idx * 8191)
+
+        p = {n: params_ref[0, i] for n, i in _PI.items()}
+        dt_hr = p["dt_s"] / 3600.0
+        T_total = meta_ref[0, 0]
+
+        state0 = s_ref[:]
+        B = state0.shape[1]
+
+        def tick(i, state):
+            exo = exo_ref[i]                       # [exo_rows, B]
+            tglob = t_idx * T_CHUNK + i
+            valid = (tglob < T_total).astype(jnp.float32)
+
+            is_peak = exo[3 * Z + 2] > 0.5         # [B] bool
+
+            def act(j):
+                """Action coordinate j: per-cluster select of the two
+                constant profiles on is_peak."""
+                return jnp.where(is_peak, actions_ref[1, j],
+                                 actions_ref[0, j])
+
+            zw = [[act(pp * Z + z) for z in range(Z)] for pp in range(P)]
+            ct_allow = [[act(P * Z + pp * 2 + ct) for ct in range(2)]
+                        for pp in range(P)]
+            aggr = [act(P * Z + P * 2 + pp) for pp in range(P)]
+            after = [act(P * Z + P * 2 + P + pp) for pp in range(P)]
+            hpa = [act(P * Z + P * 2 + 2 * P + c) for c in range(2)]
+
+            nodes = rows(state, "nodes")           # [NPZ, B]
+            pipe = rows(state, "pipe")             # [K*NPZ, B]
+            running = rows(state, "running")       # [2, B]
+            timer = rows(state, "timer")           # [P, B]
+
+            # 1. desired pods (HPA lever).
+            demand = exo[3 * Z:3 * Z + 2]                      # [2, B]
+            desired = demand * jnp.stack(hpa)                   # [2, B]
+
+            # 2. provisioning arrivals + pipeline shift.
+            nodes = nodes + pipe[0:NPZ]
+            pipe = jnp.concatenate(
+                [pipe[NPZ:], jnp.zeros((NPZ, B), jnp.float32)], axis=0)
+
+            # 3. spot interruptions.
+            spot = nodes[0:P * Z]
+            lam = spot * p["interrupt_p"]
+            if stochastic:
+                interrupted = _poisson_small_kernel(lam, spot)
+            else:
+                interrupted = lam
+            nodes = jnp.concatenate([spot - interrupted, nodes[P * Z:]],
+                                    axis=0)
+            interrupted_total = interrupted.sum(axis=0)         # [B]
+
+            # 4. scheduling (class c <-> capacity type c).
+            spot_n = nodes[0:P * Z].sum(axis=0)                 # [B]
+            od_n = nodes[P * Z:].sum(axis=0)
+            cap_spot = spot_n * p["ppn"]
+            cap_od = (od_n + p["base_od"]) * p["ppn"]
+            cap_ct = jnp.stack([cap_spot, cap_od])              # [2, B]
+            running = jnp.minimum(desired, cap_ct)
+            pending = desired - running                         # [2, B]
+
+            # 5. provisioning split.
+            inc_spot = sum(pipe[k * NPZ:k * NPZ + P * Z].sum(axis=0)
+                           for k in range(K))
+            inc_od = sum(pipe[k * NPZ + P * Z:(k + 1) * NPZ].sum(axis=0)
+                         for k in range(K))
+            incoming = jnp.stack([inc_spot, inc_od])
+            need_ct = jnp.maximum(pending / p["ppn"] - incoming, 0.0)
+
+            price = [exo[0:Z],                                   # ct=0 [Z,B]
+                     exo[Z:2 * Z]]                               # ct=1
+            price_mean = (price[0].sum(axis=0) + price[1].sum(axis=0)) \
+                / (2.0 * Z)
+            tau = 0.1 * price_mean + _EPS
+            cheap = []
+            for ct in range(2):
+                e = jnp.exp(-price[ct] / tau)
+                cheap.append(e / (e.sum(axis=0) + _EPS) * 1.0)
+            # NOTE: dynamics' softmax normalizes over zones per ct — same.
+
+            w_rows = []
+            for ct in range(2):
+                for pp in range(P):
+                    allow = ct_allow[pp][ct] * p[f"sa{pp}{ct}"]
+                    for z in range(Z):
+                        w_rows.append(zw[pp][z] * allow * cheap[ct][z])
+            w = jnp.stack(w_rows)                               # [NPZ, B]
+            wsum = [w[0:P * Z].sum(axis=0), w[P * Z:].sum(axis=0)]
+            frac_rows = []
+            for ct in range(2):
+                s = wsum[ct]
+                blk = w[ct * P * Z:(ct + 1) * P * Z]
+                frac_rows.append(jnp.where(s > _EPS, blk / (s + _EPS), 0.0)
+                                 * need_ct[ct])
+            new_nodes = jnp.concatenate(frac_rows, axis=0)      # [NPZ, B]
+
+            # Per-pool cap.
+            def pool_rows(arr, pp):  # rows of pool pp across cts, [2Z, B]
+                return jnp.concatenate(
+                    [arr[pp * Z:(pp + 1) * Z],
+                     arr[P * Z + pp * Z:P * Z + (pp + 1) * Z]], axis=0)
+
+            scale = []
+            for pp in range(P):
+                pool_now = pool_rows(nodes, pp).sum(axis=0)
+                for k in range(K):
+                    pool_now = pool_now + pool_rows(
+                        pipe[k * NPZ:(k + 1) * NPZ], pp).sum(axis=0)
+                pool_new = pool_rows(new_nodes, pp).sum(axis=0)
+                headroom = jnp.maximum(p[f"maxn{pp}"] - pool_now, 0.0)
+                scale.append(jnp.where(
+                    pool_new > _EPS,
+                    jnp.minimum(headroom / (pool_new + _EPS), 1.0), 1.0))
+            scaled_rows = []
+            for ct in range(2):
+                for pp in range(P):
+                    blk = new_nodes[ct * P * Z + pp * Z:
+                                    ct * P * Z + (pp + 1) * Z]
+                    scaled_rows.append(blk * scale[pp])
+            new_nodes = jnp.concatenate(scaled_rows, axis=0)
+            pipe = jnp.concatenate(
+                [pipe[0:(K - 1) * NPZ], pipe[(K - 1) * NPZ:] + new_nodes],
+                axis=0)
+
+            # 6. consolidation.
+            used_ct = running                                   # [2, B]
+            used_karp_od = jnp.maximum(
+                used_ct[1] - p["base_od"] * p["ppn"], 0.0)
+            used_karp = jnp.stack([used_ct[0], used_karp_od])
+            repack = used_karp / p["ppn"]
+            nodes_ct = jnp.stack([spot_n, od_n])                # [2, B]
+            slack = jnp.maximum(nodes_ct - repack, 0.0)
+            empty = jnp.maximum(nodes_ct - repack * (1.0 + p["frag"]), 0.0)
+            util = used_karp / (nodes_ct * p["ppn"] + _EPS)
+            under_gate = jax.nn.sigmoid((p["underutil"] - util) / 0.05)
+            evict_budget = (1.0 - p["pdb"]) * used_karp
+            aggr_ct = jnp.minimum(
+                slack, empty + under_gate * evict_budget / p["ppn"])
+
+            removable_rows = []
+            for ct in range(2):
+                denom = nodes_ct[ct] + _EPS
+                for pp in range(P):
+                    blk = nodes[ct * P * Z + pp * Z:
+                                ct * P * Z + (pp + 1) * Z]
+                    share = blk / denom
+                    removable_rows.append(
+                        share * (empty[ct] * (1.0 - aggr[pp])
+                                 + aggr_ct[ct] * aggr[pp]))
+            removable = jnp.concatenate(removable_rows, axis=0)  # [NPZ, B]
+
+            gate = []
+            new_timer_rows = []
+            for pp in range(P):
+                removable_p = pool_rows(removable, pp).sum(axis=0)
+                has_slack = removable_p > 1e-3
+                t_new = jnp.where(has_slack, timer[pp] + p["dt_s"], 0.0)
+                g = jax.nn.sigmoid((t_new - after[pp]) / p["tau_s"])
+                gate.append(g)
+                new_timer_rows.append(jnp.where(g > 0.5, 0.0, t_new))
+            timer = jnp.stack(new_timer_rows)
+
+            removed_rows = []
+            for ct in range(2):
+                for pp in range(P):
+                    blk = removable[ct * P * Z + pp * Z:
+                                    ct * P * Z + (pp + 1) * Z]
+                    removed_rows.append(blk * gate[pp])
+            removed = jnp.concatenate(removed_rows, axis=0)
+            nodes = jnp.maximum(nodes - removed, 0.0)
+            removed_ct = jnp.stack([removed[0:P * Z].sum(axis=0),
+                                    removed[P * Z:].sum(axis=0)])
+            evicted = jnp.maximum(removed_ct - empty, 0.0).sum(axis=0) \
+                * p["ppn"] * 0.5
+
+            # 7. accounting on the post-step fleet.
+            base_z = p["base_od"] / Z
+            nodes_zc = []   # [ct][z] -> [B]
+            for ct in range(2):
+                per_z = []
+                for z in range(Z):
+                    v = sum(nodes[ct * P * Z + pp * Z + z]
+                            for pp in range(P))
+                    if ct == 1:
+                        v = v + base_z
+                    per_z.append(v)
+                nodes_zc.append(per_z)
+            cost = sum(nodes_zc[ct][z] * price[ct][z]
+                       for ct in range(2) for z in range(Z)) * dt_hr
+
+            total_ct = [sum(nodes_zc[ct][z] for z in range(Z))
+                        for ct in range(2)]
+            carbon_z = exo[2 * Z:3 * Z]
+            carbon = jnp.zeros((B,), jnp.float32)
+            for ct in range(2):
+                t_ct = total_ct[ct]
+                u = jnp.where(t_ct > _EPS,
+                              jnp.minimum(
+                                  used_ct[ct] / (t_ct * p["ppn"] + _EPS),
+                                  1.0), 0.0)
+                watts = p["watts_idle"] + (p["watts_full"]
+                                           - p["watts_idle"]) * u
+                for z in range(Z):
+                    carbon = carbon + (nodes_zc[ct][z] * watts / 1000.0
+                                       * dt_hr) * carbon_z[z]
+
+            effective = jnp.minimum(running, demand)
+            requests = effective.sum(axis=0) * p["rps"] * p["dt_s"]
+
+            load = demand.sum(axis=0) / (cap_ct.sum(axis=0) + _EPS)
+            rho = jnp.clip(load, 0.0, LATENCY_RHO_CLIP)
+            lat = p["lat_base"] * (
+                1.0 + LATENCY_CURVE_COEF * rho * rho / (1.0 - rho))
+            queue = pending.sum(axis=0)
+
+            met = jnp.logical_and(
+                running[0] >= p["slo_frac"] * demand[0] - _EPS,
+                running[1] >= p["slo_frac"] * demand[1] - _EPS)
+            lat_ok = jnp.where(p["lat_slo"] > 0,
+                               (lat <= p["lat_slo"]).astype(jnp.float32),
+                               1.0)
+            slo_ok = met.astype(jnp.float32) * lat_ok
+
+            # 8. accumulators (SummaryAcc + episode totals).
+            nodes_total = total_ct[0] + total_ct[1] - p["base_od"]
+            # total_ct includes base in od; SummaryAcc counts
+            # Karpenter-owned nodes only (metrics.nodes_by_ct).
+            nct_spot_now = total_ct[0]
+            nct_od_now = total_ct[1] - p["base_od"]
+            capacity = (nodes_total + p["base_od"]) * p["ppn"]
+            served = running.sum(axis=0)
+
+            def bump(name, delta):
+                return rows(state, name) + valid * delta[None, :]
+
+            new_state_parts = [
+                nodes, pipe, running, timer,
+                bump("acc_cost", cost),
+                bump("acc_carbon", carbon),
+                bump("acc_requests", requests),
+                bump("acc_slo", slo_ok * p["dt_s"]),
+                bump("acc_evict", evicted),
+                bump("nct_spot", nct_spot_now),
+                bump("nct_od", nct_od_now),
+                bump("served_sum", served),
+                bump("capacity_sum", capacity),
+                bump("waste_sum", jnp.maximum(capacity - served, 0.0)),
+                bump("latency_sum", lat),
+                jnp.maximum(rows(state, "latency_max"),
+                            valid * lat[None, :]),
+                bump("queue_sum", queue),
+                bump("interrupts_sum", interrupted_total),
+            ]
+            pad = state.shape[0] - ROWS["_total"][1]
+            if pad:
+                new_state_parts.append(jnp.zeros((pad, B), jnp.float32))
+            new_state = jnp.concatenate(new_state_parts, axis=0)
+            # Ticks beyond T_total leave the dynamic state untouched too.
+            return jnp.where(valid > 0, new_state, state)
+
+        state = jax.lax.fori_loop(0, T_CHUNK, tick, state0)
+        s_ref[:] = state
+
+        @pl.when(t_idx == n_chunks - 1)
+        def _emit():
+            names = ("acc_cost", "acc_carbon", "acc_requests", "acc_slo",
+                     "acc_evict", "nct_spot", "nct_od", "served_sum",
+                     "capacity_sum", "waste_sum", "latency_sum",
+                     "latency_max", "queue_sum", "interrupts_sum")
+            vals = [state[ROWS[n][0]] for n in names]
+            pad = out_ref.shape[0] - len(vals)
+            out = jnp.stack(vals + [jnp.zeros_like(vals[0])] * pad)
+            out_ref[:] = out
+
+    return kernel, ROWS
+
+
+_OUT_ROWS = 16
+
+# Batch-mean parity tolerances — the ONE table both gates use
+# (`tests/test_megakernel.py` and bench.py's inline gate), so the bench
+# can never admit the kernel under a different standard than the pinned
+# contract. Core KPIs tight; rare-event counters and threshold-gated slo
+# fields looser (chaotic event flips are unbiased but noisy) — all far
+# below scoreboard effect sizes.
+MEAN_PARITY_TOLERANCES = {
+    "interruptions": 0.03, "evictions": 0.05, "queue_depth_mean": 0.05,
+    "slo_hours": 0.01, "slo_attainment": 0.01, "usd_per_slo_hour": 0.01,
+    "latency_p95_ms_max": 0.02,
+}
+DEFAULT_MEAN_PARITY_TOL = 0.005
+
+
+def mean_parity_violations(kernel_summary, lax_summary) -> dict:
+    """{field: batch-mean rel diff} for every field exceeding its
+    tolerance; empty == parity holds."""
+    bad = {}
+    for f in kernel_summary._fields:
+        a = float(np.mean(np.asarray(getattr(kernel_summary, f))))
+        b = float(np.mean(np.asarray(getattr(lax_summary, f))))
+        rel = abs(a - b) / (abs(b) + 1e-9)
+        if rel > MEAN_PARITY_TOLERANCES.get(f, DEFAULT_MEAN_PARITY_TOL):
+            bad[f] = round(rel, 5)
+    return bad
+
+
+def _pack_exo(traces: ExogenousTrace, T_pad: int) -> jnp.ndarray:
+    """[B, T, ...] trace pytree -> [T_pad, exo_rows(Z), B] feature-first
+    stream (row offsets: see the comment above `_exo_rows`)."""
+    def tb(x):  # [B, T, k] -> [T, k, B]
+        return jnp.moveaxis(x, 0, -1)
+
+    T = traces.is_peak.shape[1]
+    Z = traces.spot_price_hr.shape[-1]
+    parts = [
+        tb(traces.spot_price_hr), tb(traces.od_price_hr),
+        tb(traces.carbon_g_kwh), tb(traces.demand_pods),
+        tb(traces.is_peak[:, :, None]),
+    ]
+    packed = jnp.concatenate(parts, axis=1).astype(jnp.float32)
+    rows = packed.shape[1]
+    packed = jnp.pad(packed,
+                     ((0, T_pad - T), (0, _exo_rows(Z) - rows), (0, 0)))
+    return packed
+
+
+@functools.partial(jax.jit, static_argnames=("P", "Z", "K", "stochastic",
+                                             "b_block", "t_chunk",
+                                             "interpret"))
+def _run(params_packed, actions_packed, exo_packed, meta, *, P, Z, K,
+         stochastic, b_block, t_chunk, interpret=False):
+    T_pad, _, B = exo_packed.shape
+    n_b = B // b_block
+    n_t = T_pad // t_chunk
+    kernel, ROWS = _make_kernel(P, Z, K, t_chunk, n_t, stochastic)
+    s_rows = math.ceil(ROWS["_total"][1] / 8) * 8
+
+    out = pl.pallas_call(
+        kernel,
+        interpret=interpret,
+        grid=(n_b, n_t),
+        in_specs=[
+            pl.BlockSpec((1, 3), lambda b, t: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, len(_PARAM_NAMES)), lambda b, t: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((2, _act_rows(P, Z)), lambda b, t: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((t_chunk, _exo_rows(Z), b_block),
+                         lambda b, t: (t, 0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_OUT_ROWS, b_block), lambda b, t: (0, b),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((_OUT_ROWS, B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((s_rows, b_block), jnp.float32)],
+    )(meta, params_packed, actions_packed, exo_packed)
+    return out
+
+
+def megakernel_rollout_summary(params: SimParams,
+                               off_action: Action,
+                               peak_action: Action,
+                               traces: ExogenousTrace,
+                               seed: int | jnp.ndarray = 0,
+                               *,
+                               stochastic: bool = True,
+                               b_block: int = 512,
+                               t_chunk: int = 64,
+                               interpret: bool = False):
+    """EpisodeSummary batch for a fresh-state rule-profile rollout.
+
+    Drop-in for the bench/fleet-scoring path:
+    ``batched_rollout_summary(params, zeros, RulePolicy(cfg).action_fn(),
+    traces, keys, stochastic=...)`` — see module docstring for the parity
+    contract. ``traces`` leading axes are [B, T]; B must be a multiple of
+    ``b_block`` (the bench's power-of-two batches are).
+    """
+    from ccka_tpu.sim.metrics import SummaryAcc, finalize_summary
+
+    B, T = traces.is_peak.shape
+    if B % b_block:
+        raise ValueError(f"megakernel needs B % {b_block} == 0, got {B}")
+    P = int(off_action.zone_weight.shape[0])
+    Z = int(off_action.zone_weight.shape[1])
+    K = int(params.provision_pipeline_k)
+
+    T_pad = math.ceil(T / t_chunk) * t_chunk
+    exo_packed = _pack_exo(traces, T_pad)
+    meta = jnp.asarray([[T, 0, 0]], jnp.int32)
+    meta = meta.at[0, 1].set(int(stochastic))
+    meta = meta.at[0, 2].set(jnp.int32(seed))
+    out = _run(_pack_params(params),
+               jnp.stack([_pack_action(off_action),
+                          _pack_action(peak_action)]),
+               exo_packed, meta, P=P, Z=Z, K=K, stochastic=stochastic,
+               b_block=b_block, t_chunk=t_chunk, interpret=interpret)
+
+    (cost, carbon, requests, slo_s, evict, nct_spot, nct_od, served,
+     capacity, waste, lat_sum, lat_max, queue, interrupts) = out[:14]
+
+    zeros = jnp.zeros((B,), jnp.float32)
+    mk_state = lambda c, g, r, s, e: ClusterState(   # noqa: E731
+        nodes=zeros, pipeline=zeros, running=zeros, consol_timer_s=zeros,
+        time_s=zeros, acc_cost_usd=c, acc_carbon_g=g, acc_requests=r,
+        acc_slo_ok_s=s, acc_evictions=e)
+    acc = SummaryAcc(
+        nodes_ct_sum=jnp.stack([nct_spot, nct_od], axis=-1),
+        served_sum=served, capacity_sum=capacity, waste_sum=waste,
+        latency_sum=lat_sum, latency_max=lat_max, queue_sum=queue,
+        interrupts_sum=interrupts)
+    # finalize per cluster (the lax path finalizes under vmap too) — the
+    # SAME reduction code both ways, so the KPI formulas cannot drift.
+    summary = jax.vmap(
+        lambda init, fin, a: finalize_summary(params, init, fin, a, T)
+    )(mk_state(zeros, zeros, zeros, zeros, zeros),
+      mk_state(cost, carbon, requests, slo_s, evict), acc)
+    return summary

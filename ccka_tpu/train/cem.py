@@ -1,0 +1,215 @@
+"""CEM/ES refinement: direct policy search around a distilled init.
+
+Why this exists (VERDICT r3 #1): four rounds of PPO mechanics (critic
+warmup, KL-anchor, advantage clipping, Lagrangian attainment constraint —
+`train/ppo.py`) kept reproducing the same failure: the moment the policy
+gradient activates, surrogate-objective noise walks the policy off the
+teacher's operating point faster than the scoreboard-relevant ~1% cost
+margin can be found. The scoreboard is a *lexicographic* criterion over
+full-episode KPIs — exactly the thing a per-tick reward scalarization
+distorts — so this module optimizes the episode criterion DIRECTLY:
+
+- population of weight perturbations around the current mean policy
+  (antithetic pairs, shared perturbation scale);
+- fitness = the selection score itself (worse headline ratio vs the
+  bars, plus the attainment-shortfall penalty) measured on FRESH
+  full-day stochastic traces each generation (never the selection or
+  bench seed blocks — same train/select/test separation as PPO);
+- elites update the mean; the scale anneals.
+
+TPU mapping: one generation = ONE jitted dispatch — the entire
+population's full-day rollouts run as `vmap(candidates) x vmap(traces)`
+over `rollout_summary` (O(B) memory), with the policy parameters stacked
+along the population axis. A 32-candidate x 4-trace x 2880-tick
+generation is ~370k policy-net sim steps, batched MXU-shaped.
+
+This is evolution-strategies RL (direct episodic policy search), not
+supervised distillation: the teacher only provides the starting point,
+and fitness pressure is toward BEATING it — any candidate that merely
+imitates scores ~1.0 and is outcompeted by candidates that shave cost
+at held carbon/attainment.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccka_tpu.config import FrameworkConfig
+from ccka_tpu.models import ActorCritic, latent_dim, latent_to_action
+from ccka_tpu.policy import RulePolicy
+from ccka_tpu.policy.base import observe
+from ccka_tpu.sim.rollout import initial_state, rollout_summary
+from ccka_tpu.sim.types import SimParams
+
+
+class CEMConfig(NamedTuple):
+    generations: int = 40
+    popsize: int = 32          # even (antithetic pairs)
+    elite_frac: float = 0.25
+    sigma0: float = 0.02       # initial perturbation scale (weight units)
+    sigma_decay: float = 0.97
+    traces_per_gen: int = 4
+    eval_steps: int = 2880     # full day — shorter windows miss peak hours
+    attain_penalty: float = 25.0
+
+
+def _flatten(params) -> tuple[jnp.ndarray, list]:
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = [l.shape for l in leaves]
+    flat = jnp.concatenate([jnp.ravel(l) for l in leaves])
+    return flat, (treedef, shapes)
+
+
+def _unflatten(flat: jnp.ndarray, spec) -> dict:
+    treedef, shapes = spec
+    leaves, off = [], 0
+    for s in shapes:
+        n = int(np.prod(s)) if s else 1
+        leaves.append(jnp.reshape(flat[off:off + n], s))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def cem_refine(cfg: FrameworkConfig, params0, source, *,
+               cem: CEMConfig | None = None,
+               bars: dict | None = None,
+               seed: int = 0,
+               log=None) -> tuple[dict, list[dict]]:
+    """Refine ``params0`` (ActorCritic pytree) by episodic direct search.
+
+    ``bars``: the KPI levels to beat — ``{"usd": ..., "co2": ...,
+    "attain": ...}`` absolute values (typically min(rule, teacher) per
+    axis from the flagship driver's selection measurement). Fitness is
+    ``max(usd/bars.usd, co2/bars.co2) + penalty*max(0, bars.attain −
+    attain)``, averaged over the generation's fresh traces; < 1.0 means
+    both headline bars beaten at attainment.
+
+    Returns ``(best_params, history, info)``; history records each
+    generation's best/mean fitness and the running-best candidate's
+    ratios; ``info`` carries the returned candidate's provenance
+    (``gen``, ``fitness``) and ``final_sigma`` so chunked callers can
+    continue the annealing schedule instead of resetting it.
+    """
+    cem = cem or CEMConfig()
+    log = log or (lambda s: None)
+    assert cem.popsize % 2 == 0, "popsize must be even (antithetic)"
+    params_sim = SimParams.from_config(cfg)
+    net = ActorCritic(act_dim=latent_dim(cfg.cluster))
+
+    flat0, spec = _flatten(params0)
+    dim = flat0.shape[0]
+    n_elite = max(2, int(cem.popsize * cem.elite_frac))
+
+    rule_fn = RulePolicy(cfg.cluster).action_fn()
+    state0 = initial_state(cfg)
+
+    def policy_rollout(flat_params, trace, key):
+        p = _unflatten(flat_params, spec)
+
+        def action_fn(state, exo, t):
+            obs = observe(params_sim, state, exo).flatten()
+            mean, _, _ = net.apply(p, obs)
+            return latent_to_action(mean, cfg.cluster)
+
+        _, summary = rollout_summary(params_sim, state0, action_fn, trace,
+                                     key, stochastic=True)
+        return summary
+
+    def rule_rollout(trace, key):
+        _, summary = rollout_summary(params_sim, state0, rule_fn, trace,
+                                     key, stochastic=True)
+        return summary
+
+    @jax.jit
+    def generation(mean_flat, sigma, traces, keys, noise):
+        # Candidates: antithetic pairs around the mean, plus the mean
+        # itself injected as candidate 0 (elitism: the incumbent always
+        # competes, so the mean cannot drift to a worse operating point
+        # just because a generation's traces were easy).
+        eps = jnp.concatenate([noise, -noise], axis=0)       # [pop, dim]
+        cand = mean_flat[None, :] + sigma * eps
+        cand = cand.at[0].set(mean_flat)
+
+        summaries = jax.vmap(
+            lambda c: jax.vmap(
+                lambda tr, k: policy_rollout(c, tr, k))(traces, keys)
+        )(cand)                                               # [pop, G, ...]
+        rule_s = jax.vmap(rule_rollout)(traces, keys)         # [G, ...]
+        return cand, summaries, rule_s
+
+    history: list[dict] = []
+    mean_flat = flat0
+    sigma = jnp.float32(cem.sigma0)
+    best = {"fitness": float("inf"), "flat": flat0, "gen": 0,
+            "ratios": None}
+    key = jax.random.key(seed)
+
+    for gen in range(cem.generations):
+        key, k_tr, k_world, k_noise = jax.random.split(key, 4)
+        traces = source.batch_trace_device(
+            cem.eval_steps, k_tr, cem.traces_per_gen)
+        keys = jax.random.split(k_world, cem.traces_per_gen)
+        noise = jax.random.normal(k_noise, (cem.popsize // 2, dim))
+        cand, summaries, rule_s = generation(mean_flat, sigma, traces,
+                                             keys, noise)
+
+        usd = np.asarray(summaries.usd_per_slo_hour)          # [pop, G]
+        co2 = np.asarray(summaries.g_co2_per_kreq)
+        attain = np.asarray(summaries.slo_attainment)
+        if bars:
+            usd_bar = np.float64(bars["usd"])
+            co2_bar = np.float64(bars["co2"])
+            attain_bar = np.float64(bars["attain"])
+        else:
+            usd_bar = np.asarray(rule_s.usd_per_slo_hour).mean()
+            co2_bar = np.asarray(rule_s.g_co2_per_kreq).mean()
+            attain_bar = np.asarray(rule_s.slo_attainment).mean()
+        # Paired per-trace ratios vs the same-generation rule rollout
+        # keep trace-difficulty variance out of the fitness; absolute
+        # bars (when given) anchor the target the flagship must beat.
+        rule_usd = np.asarray(rule_s.usd_per_slo_hour)[None, :]
+        rule_co2 = np.asarray(rule_s.g_co2_per_kreq)[None, :]
+        usd_ratio = (usd / rule_usd).mean(axis=1) * (
+            rule_usd.mean() / usd_bar if bars else 1.0)
+        co2_ratio = (co2 / rule_co2).mean(axis=1) * (
+            rule_co2.mean() / co2_bar if bars else 1.0)
+        shortfall = np.maximum(attain_bar - attain.mean(axis=1), 0.0)
+        fitness = (np.maximum(usd_ratio, co2_ratio)
+                   + cem.attain_penalty * shortfall)          # [pop]
+
+        order = np.argsort(fitness)
+        elites = np.asarray(cand)[order[:n_elite]]
+        mean_flat = jnp.asarray(elites.mean(axis=0))
+        sigma = sigma * cem.sigma_decay
+
+        gi = int(order[0])
+        rec = {
+            "generation": gen,
+            "best_fitness": float(fitness[gi]),
+            "mean_fitness": float(fitness.mean()),
+            "best_usd_ratio": float(usd_ratio[gi]),
+            "best_co2_ratio": float(co2_ratio[gi]),
+            "best_attain": float(attain[gi].mean()),
+            "sigma": float(sigma),
+        }
+        history.append(rec)
+        if fitness[gi] < best["fitness"]:
+            best = {"fitness": float(fitness[gi]),
+                    "flat": jnp.asarray(np.asarray(cand)[gi]),
+                    "gen": gen,
+                    "ratios": (rec["best_usd_ratio"],
+                               rec["best_co2_ratio"],
+                               rec["best_attain"])}
+        log(f"gen {gen:3d}: best {rec['best_fitness']:.4f} "
+            f"(usd x{rec['best_usd_ratio']:.3f} "
+            f"co2 x{rec['best_co2_ratio']:.3f} "
+            f"attain {rec['best_attain']:.4f}) "
+            f"mean {rec['mean_fitness']:.4f} sigma {rec['sigma']:.4f}")
+
+    info = {"gen": best["gen"], "fitness": best["fitness"],
+            "ratios": best["ratios"], "final_sigma": float(sigma)}
+    return _unflatten(best["flat"], spec), history, info

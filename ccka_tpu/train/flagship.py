@@ -88,17 +88,23 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
                    seed: int = 0,
                    init_from: str = "scratch",
                    distill_iterations: int = 2000,
+                   refine: str = "ppo",
                    log: Callable[[str], None] | None = None) -> dict:
     """Train + select. Returns {params, meta, history}; ``meta`` carries the
     selection-trace scoreboard of the returned checkpoint.
 
     ``init_from``: "scratch" (fresh net) or "distill:<teacher>" — behavior-
-    clone the named teacher first (`train/imitate.py`) and PPO-refine from
+    clone the named teacher first (`train/imitate.py`) and refine from
     there. Distillation sidesteps PPO's early overprovision excursion (the
     sharp violation-spike advantages that wreck a near-optimal init before
     the critic calibrates; measured trajectories in `train/imitate.py`'s
     module docstring and ARCHITECTURE.md §5) by starting BOTH the actor
     and critic at the teacher's operating point.
+
+    ``refine``: "ppo" (the clipped-surrogate loop, `train/ppo.py`) or
+    "cem" (episodic direct search on the selection criterion itself,
+    `train/cem.py` — requires a distilled init; ``iterations`` then
+    means CEM generations).
     """
     log = log or (lambda s: print(s, file=sys.stderr))
     cfg = cfg or default_config()
@@ -168,35 +174,24 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
             "res": res0}
     history = []
     t0 = time.time()
-    # Ceil-chunking with an exact final remainder: run precisely
-    # ``iterations`` iterations however eval_every divides them (a floor
-    # would silently over- or under-train and misrecord provenance).
-    n_chunks = max(1, -(-iterations // eval_every))
-    it_total = 0
-    for chunk in range(n_chunks):
-        chunk_iters = min(eval_every, iterations - it_total)
-        if chunk_iters <= 0:
-            break
-        # Fresh trace block per chunk — the policy never sees the same
-        # synthetic day twice, so convergence is to the signal family.
-        windows = trainer.make_windows(src, chunk_iters,
-                                       seed=seed + 1000 + 7919 * chunk)
-        for it in range(chunk_iters):
-            ts, diag = trainer._iteration_fn(
-                ts, windows.slice_steps(it * t_len, t_len + 1))
-        it_total += chunk_iters
-        res = evaluate_backend(cfg, PPOBackend(cfg, ts.params), sel_traces)
+
+    def consider(params, it_total, extra=None):
+        """Evaluate a candidate on the selection traces; record + maybe
+        adopt as best (higher tier, then lower score)."""
+        nonlocal best
+        res = evaluate_backend(cfg, PPOBackend(cfg, params), sel_traces)
         wins, score = score_vs_rule(res, rule_res)
         tier = candidate_tier(res, wins)
         rec = {
             "iteration": it_total,
-            "mean_reward": float(diag.mean_reward),
             "usd_ratio": res["usd_per_slo_hour"] / rule_res["usd_per_slo_hour"],
             "co2_ratio": res["g_co2_per_kreq"] / rule_res["g_co2_per_kreq"],
             "slo_attainment": res["slo_attainment"],
             "wins_both": wins,
             "score": score,
         }
+        if extra:
+            rec.update(extra)
         if teacher_res is not None:
             rec["usd_vs_teacher"] = (res["usd_per_slo_hour"]
                                      / teacher_res["usd_per_slo_hour"])
@@ -209,17 +204,73 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
             f"{'WIN' if wins else '   '}"
             f"{' >TEACHER' if rec.get('beats_teacher') else ''} "
             f"score {score:.3f} ({time.time() - t0:.0f}s)")
-        # Prefer the higher tier (rule win + teacher improvement beats a
-        # bare rule win); among equals, the lower score.
         better = (tier > best["tier"]
                   or (tier == best["tier"] and score < best["score"]))
         if better:
             best = {"score": score, "wins": wins, "tier": tier,
-                    "params": jax.device_get(ts.params),
+                    "params": jax.device_get(params),
                     "iteration": it_total, "res": res}
+
+    if refine == "cem":
+        if teacher_res is None:
+            raise ValueError("refine='cem' requires init_from=distill:<t>")
+        from ccka_tpu.train.cem import CEMConfig, cem_refine
+        # Bars: the tighter of rule/teacher per axis — fitness < 1 means
+        # the candidate clears the FULL tier-2 criterion on its traces.
+        bars = {
+            "usd": min(rule_res["usd_per_slo_hour"],
+                       teacher_res["usd_per_slo_hour"]),
+            "co2": min(rule_res["g_co2_per_kreq"],
+                       teacher_res["g_co2_per_kreq"]),
+            "attain": max(rule_res["slo_attainment"],
+                          teacher_res["slo_attainment"]),
+        }
+        gens_per_eval = max(5, eval_every // 5)
+        done = 0
+        params_cur = ts.params
+        sigma = CEMConfig().sigma0
+        while done < iterations:
+            n = min(gens_per_eval, iterations - done)
+            # sigma0 continues the previous chunk's annealed scale — a
+            # reset would oscillate the search width forever.
+            params_cur, _cem_hist, info = cem_refine(
+                cfg, params_cur, src,
+                cem=CEMConfig(generations=n, sigma0=sigma),
+                bars=bars, seed=seed + 31 * done,
+                log=lambda s: log("  cem " + s))
+            sigma = info["final_sigma"]
+            done += n
+            # Provenance: the fitness of the candidate actually being
+            # evaluated, at the generation it came from.
+            consider(params_cur, done,
+                     extra={"cem_best_gen": done - n + info["gen"],
+                            "cem_best_fitness": info["fitness"]})
+    elif refine == "ppo":
+        # Ceil-chunking with an exact final remainder: run precisely
+        # ``iterations`` iterations however eval_every divides them (a
+        # floor would silently over/under-train, misrecording provenance).
+        n_chunks = max(1, -(-iterations // eval_every))
+        it_total = 0
+        for chunk in range(n_chunks):
+            chunk_iters = min(eval_every, iterations - it_total)
+            if chunk_iters <= 0:
+                break
+            # Fresh trace block per chunk — the policy never sees the same
+            # synthetic day twice, so convergence is to the signal family.
+            windows = trainer.make_windows(src, chunk_iters,
+                                           seed=seed + 1000 + 7919 * chunk)
+            for it in range(chunk_iters):
+                ts, diag = trainer._iteration_fn(
+                    ts, windows.slice_steps(it * t_len, t_len + 1))
+            it_total += chunk_iters
+            consider(ts.params, it_total,
+                     extra={"mean_reward": float(diag.mean_reward)})
+    else:
+        raise ValueError(f"unknown refine {refine!r}")
 
     meta = {
         "iterations_total": iterations,
+        "refine": refine,
         "init_from": init_from,
         "selected_iteration": best["iteration"],
         "wins_both": bool(best["wins"]),
@@ -311,6 +362,10 @@ def main(argv=None) -> int:
     ap.add_argument("--init-from", default="scratch",
                     help='"scratch" or "distill:<teacher>" '
                          '(carbon | rule)')
+    ap.add_argument("--refine", default="ppo", choices=("ppo", "cem"),
+                    help="refinement loop: PPO surrogate or CEM episodic "
+                         "direct search (train/cem.py; needs a distilled "
+                         "init; --iterations counts generations)")
     ap.add_argument("--out", default="",
                     help="checkpoint path (default: the package's "
                          "topology-keyed flagship location, where "
@@ -331,7 +386,7 @@ def main(argv=None) -> int:
                          eval_every=args.eval_every,
                          eval_steps=args.eval_steps,
                          n_eval_traces=args.traces, seed=args.seed,
-                         init_from=args.init_from)
+                         init_from=args.init_from, refine=args.refine)
     out["meta"]["preset"] = args.preset
     # Default to the loader's own path — a CWD-relative default would ship
     # checkpoints to wherever the trainer happened to run while
