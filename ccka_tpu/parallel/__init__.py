@@ -29,4 +29,5 @@ from ccka_tpu.parallel.mesh import (  # noqa: F401
 from ccka_tpu.parallel.sharded import (  # noqa: F401
     shard_ppo_state,
     sharded_batched_rollout,
+    sharded_batched_rollout_summary,
 )

@@ -215,16 +215,10 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
         if teacher_res is None:
             raise ValueError("refine='cem' requires init_from=distill:<t>")
         from ccka_tpu.train.cem import CEMConfig, cem_refine
-        # Bars: the tighter of rule/teacher per axis — fitness < 1 means
-        # the candidate clears the FULL tier-2 criterion on its traces.
-        bars = {
-            "usd": min(rule_res["usd_per_slo_hour"],
-                       teacher_res["usd_per_slo_hour"]),
-            "co2": min(rule_res["g_co2_per_kreq"],
-                       teacher_res["g_co2_per_kreq"]),
-            "attain": max(rule_res["slo_attainment"],
-                          teacher_res["slo_attainment"]),
-        }
+        # Teacher-paired fitness: each generation measures the teacher on
+        # its own traces, so the bars are min(rule, teacher) per axis per
+        # trace — fitness < 1 means the candidate clears the FULL tier-2
+        # criterion on those traces.
         gens_per_eval = max(5, eval_every // 5)
         done = 0
         params_cur = ts.params
@@ -236,7 +230,8 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
             params_cur, _cem_hist, info = cem_refine(
                 cfg, params_cur, src,
                 cem=CEMConfig(generations=n, sigma0=sigma),
-                bars=bars, seed=seed + 31 * done,
+                teacher_fn=teacher_backend.action_fn(),
+                seed=seed + 31 * done,
                 log=lambda s: log("  cem " + s))
             sigma = info["final_sigma"]
             done += n
@@ -310,23 +305,28 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
     return {"params": best["params"], "meta": meta, "history": history}
 
 
-def flagship_checkpoint_path(cfg: FrameworkConfig | None = None) -> str:
+def flagship_checkpoint_path(cfg: FrameworkConfig | None = None, *,
+                             variant: str = "") -> str:
     """Absolute path of the shipped checkpoint (inside the package).
 
     Topology-keyed: a multi-region config loads the multi-region
     checkpoint — the nets' obs/action dims differ with zone count, so the
-    files are not interchangeable."""
+    files are not interchangeable. ``variant="replay"`` names the
+    replay-family checkpoint (`scripts/train_replay_flagship.py`)."""
     import os
 
     import ccka_tpu
-    name = ("ppo_flagship_multiregion.npz"
-            if cfg is not None and cfg.cluster.regions
-            else "ppo_flagship.npz")
+    if variant:
+        name = f"ppo_flagship_{variant}.npz"
+    elif cfg is not None and cfg.cluster.regions:
+        name = "ppo_flagship_multiregion.npz"
+    else:
+        name = "ppo_flagship.npz"
     return os.path.join(os.path.dirname(os.path.abspath(ccka_tpu.__file__)),
                         "checkpoints", name)
 
 
-def load_flagship_backend(cfg: FrameworkConfig):
+def load_flagship_backend(cfg: FrameworkConfig, *, variant: str = ""):
     """(PPOBackend, meta) from the shipped checkpoint, or (None, None) if
     no checkpoint is committed. bench.py and `ccka simulate --backend ppo`
     use this so published quality numbers come from the converged,
@@ -335,7 +335,7 @@ def load_flagship_backend(cfg: FrameworkConfig):
 
     from ccka_tpu.train.checkpoint import load_params_npz
 
-    path = flagship_checkpoint_path(cfg)
+    path = flagship_checkpoint_path(cfg, variant=variant)
     if not os.path.exists(path):
         return None, None
     params, meta = load_params_npz(path)

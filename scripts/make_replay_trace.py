@@ -38,14 +38,23 @@ from ccka_tpu.signals.replay import save_trace  # noqa: E402
 
 SEED = 20260730
 DAYS = 2
-OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "data", "replay_2day.npz")
+_DATA = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "data")
+OUT = os.path.join(_DATA, "replay_2day.npz")
+# Train variant: SAME generative family, different realization (seed) and
+# longer (4 days) — the replay-family fine-tuning data
+# (`scripts/train_replay_flagship.py`), so policies scored on the eval
+# trace never trained on its exact windows, only on its family.
+TRAIN_SEED = 20260731
+TRAIN_DAYS = 6
+OUT_TRAIN = os.path.join(_DATA, "replay_train_6day.npz")
 
 
-def build_trace(cfg) -> tuple[ExogenousTrace, TraceMeta]:
-    rng = np.random.Generator(np.random.PCG64(SEED))
+def build_trace(cfg, *, seed: int = SEED,
+                days: int = DAYS) -> tuple[ExogenousTrace, TraceMeta]:
+    rng = np.random.Generator(np.random.PCG64(seed))
     dt_s = cfg.sim.dt_s
-    steps = int(DAYS * 86400 / dt_s)
+    steps = int(days * 86400 / dt_s)
     z = cfg.cluster.n_zones
     t_hr = (np.arange(steps) * dt_s / 3600.0) % 24.0       # local hour
     day = (np.arange(steps) * dt_s // 86400).astype(int)    # 0, 1
@@ -57,10 +66,10 @@ def build_trace(cfg) -> tuple[ExogenousTrace, TraceMeta]:
     lunch_dip = 1.0 - 0.25 * np.exp(-0.5 * ((t_hr - 13.0) / 1.0) ** 2)
     base_level = 0.35 + 0.85 * np.maximum(peak1, peak2)
     base_level *= lunch_dip
-    base_level *= np.where(day == 1, 0.8, 1.0)               # quieter day 2
+    base_level *= np.where(day % 2 == 1, 0.8, 1.0)           # quieter alt days
     # Flash crowds: ~6 events/day, 10-30 min, 1.3-2x multiplier.
     burst = np.ones(steps)
-    n_events = rng.poisson(6 * DAYS)
+    n_events = rng.poisson(6 * days)
     for _ in range(n_events):
         start = rng.integers(0, steps)
         dur = int(rng.integers(20, 60))                      # 10-30 min
@@ -83,7 +92,7 @@ def build_trace(cfg) -> tuple[ExogenousTrace, TraceMeta]:
         crunch = 1.0 + 0.6 * max(base_level[i] - 1.0, 0.0)   # peak crunch
         spot[i] = mean_z * np.exp(x) * crunch
     # Occasional zone-local spot spikes (capacity reclaim events).
-    for _ in range(rng.poisson(3 * DAYS)):
+    for _ in range(rng.poisson(3 * days)):
         zi = rng.integers(0, z)
         start = rng.integers(0, steps)
         dur = int(rng.integers(10, 40))
@@ -96,7 +105,7 @@ def build_trace(cfg) -> tuple[ExogenousTrace, TraceMeta]:
     # -- carbon: duck curve + cloudy day 2 --------------------------------
     base_c = 420.0
     solar = np.exp(-0.5 * ((t_hr - 12.5) / 2.8) ** 2)        # midday sun
-    dip_depth = np.where(day == 1, 0.22, 0.45)               # clouds day 2
+    dip_depth = np.where(day % 2 == 1, 0.22, 0.45)           # clouds alt days
     evening_ramp = 0.18 * np.exp(-0.5 * ((t_hr - 19.0) / 1.5) ** 2)
     carbon_t = base_c * (1.0 - dip_depth * solar + evening_ramp)
     zone_off = 1.0 + 0.06 * (np.arange(z) / max(z - 1, 1) - 0.5)
@@ -113,19 +122,32 @@ def build_trace(cfg) -> tuple[ExogenousTrace, TraceMeta]:
     meta = TraceMeta(
         source="generated-replay",
         start_unix_s=0.0, dt_s=dt_s, zones=cfg.cluster.zones,
-        description=(f"deterministic 2-day replay trace, seed {SEED} "
+        description=(f"deterministic {days}-day replay trace, seed {seed} "
                      "(scripts/make_replay_trace.py): double-peak weekday "
                      "demand + flash crowds, OU spot walk + crunch "
-                     "spikes, duck-curve carbon with cloudy day 2"))
+                     "spikes, duck-curve carbon with cloudy alt days"))
     return trace, meta
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--variant", default="eval", choices=("eval", "train"),
+                    help="eval: the committed scoring trace (seed "
+                         f"{SEED}, {DAYS}d); train: the fine-tuning "
+                         f"realization (seed {TRAIN_SEED}, {TRAIN_DAYS}d; "
+                         "the replay trainer splits it train/selection)")
+    args = ap.parse_args(argv)
     cfg = default_config()
-    trace, meta = build_trace(cfg)
-    save_trace(OUT, trace, meta)
-    print(f"wrote {OUT}: {trace.steps} steps x {cfg.cluster.n_zones} zones "
-          f"({os.path.getsize(OUT) / 1024:.0f} KiB)")
+    if args.variant == "train":
+        trace, meta = build_trace(cfg, seed=TRAIN_SEED, days=TRAIN_DAYS)
+        out = OUT_TRAIN
+    else:
+        trace, meta = build_trace(cfg)
+        out = OUT
+    save_trace(out, trace, meta)
+    print(f"wrote {out}: {trace.steps} steps x {cfg.cluster.n_zones} zones "
+          f"({os.path.getsize(out) / 1024:.0f} KiB)")
     return 0
 
 

@@ -340,6 +340,68 @@ class TestRefinementMechanics:
         assert float(ts.violation_weight) == pytest.approx(
             cfg.train.slo_violation_weight)
 
+    def test_cem_head_mask_targets_actor_head_only(self, cfg):
+        from ccka_tpu.train.cem import _flatten, _head_mask
+
+        params = PPOTrainer(cfg).init_state().params
+        mask = np.asarray(_head_mask(params))
+        flat, spec = _flatten(params)
+        assert mask.shape == flat.shape
+        # Exactly the actor head's parameter count is perturbable.
+        head = params["params"]["actor_mean"]
+        n_head = head["kernel"].size + head["bias"].size
+        assert int(mask.sum()) == n_head
+        # And the mask is positioned on the actor_mean leaves: zeroing
+        # masked coords changes only actor_mean.
+        from ccka_tpu.train.cem import _unflatten
+        perturbed = _unflatten(flat + 7.0 * jnp.asarray(mask), spec)
+        assert not np.allclose(
+            np.asarray(perturbed["params"]["actor_mean"]["kernel"]),
+            np.asarray(params["params"]["actor_mean"]["kernel"]))
+        np.testing.assert_array_equal(
+            np.asarray(perturbed["params"]["critic"]["kernel"]),
+            np.asarray(params["params"]["critic"]["kernel"]))
+        np.testing.assert_array_equal(
+            np.asarray(perturbed["params"]["Dense_0"]["kernel"]),
+            np.asarray(params["params"]["Dense_0"]["kernel"]))
+
+    def test_cem_refine_runs_and_reports(self, cfg, source):
+        from ccka_tpu.train.cem import CEMConfig, cem_refine
+
+        params0 = PPOTrainer(cfg).init_state().params
+        best, hist, info = cem_refine(
+            cfg, params0, source,
+            cem=CEMConfig(generations=2, popsize=4, traces_per_gen=2,
+                          eval_steps=32), seed=3)
+        assert len(hist) == 2
+        assert {"gen", "fitness", "final_sigma"} <= set(info)
+        for rec in hist:
+            assert np.isfinite(rec["incumbent_fitness"])
+            assert rec["best_fitness"] <= rec["incumbent_fitness"] + 1e-9
+        # The refined pytree has the net's structure.
+        assert "actor_mean" in best["params"]
+
+    def test_cem_accepts_replay_sources(self, cfg, tmp_path):
+        """Replay sources (no batch_trace_device) feed the ES through
+        the coprime-window batch_trace fallback."""
+        from ccka_tpu.signals.base import TraceMeta
+        from ccka_tpu.signals.replay import ReplaySignalSource, save_trace
+        from ccka_tpu.train.cem import CEMConfig, cem_refine
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        path = str(tmp_path / "t.npz")
+        save_trace(path, src.trace(128, seed=0),
+                   TraceMeta(source="test", start_unix_s=0.0,
+                             dt_s=cfg.sim.dt_s, zones=cfg.cluster.zones))
+        replay = ReplaySignalSource.from_file(path)
+        params0 = PPOTrainer(cfg).init_state().params
+        _best, hist, _info = cem_refine(
+            cfg, params0, replay,
+            cem=CEMConfig(generations=1, popsize=4, traces_per_gen=2,
+                          eval_steps=32), seed=0)
+        assert np.isfinite(hist[0]["incumbent_fitness"])
+
     def test_beats_teacher_criterion(self):
         from ccka_tpu.train.flagship import beats_teacher
 
