@@ -129,3 +129,7 @@ def test_flagship_checkpoints_decide_on_chip(accel, preset):
         assert bool(jnp.isfinite(leaf).all())
     if cfg.cluster.regions:
         assert meta["wins_both"] is True
+        # Round-4 contract (VERDICT r3 #1): the shipped multiregion
+        # flagship is a TRAINED winner — refinement moved it off the
+        # distilled init before selection adopted it.
+        assert meta["selected_iteration"] > 0
