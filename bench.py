@@ -77,7 +77,11 @@ def _time_best(fn, repeats: int = 3, *, min_valid_s: float = 2e-3) -> float:
 
 
 def _megakernel_parity_gate(cfg, params, src, *, b: int = 2048,
-                            steps: int = 480) -> dict:
+                            steps: int = 960) -> dict:
+    # steps >= 960: the tolerances are calibrated on windows long enough
+    # for the rare-event counters (interruptions ~1/cluster/day) to
+    # accumulate real counts — at 480 steps the relative error across
+    # PRNG families is dominated by shot noise and the gate false-fires.
     """Inline statistical-parity gate (VERDICT r3 #2): the Pallas
     megakernel may carry the headline ONLY if its batch-mean KPIs match
     the lax path on every EpisodeSummary field, on this machine, in this
@@ -139,11 +143,20 @@ def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int,
 
     results = {}
     parity = None
-    if mega_batch_sizes:
+    if mega_batch_sizes and horizon_steps < 960:
+        # Below the gate's calibration floor (rare-event shot noise
+        # dominates): don't pretend to gate — skip the kernel rows.
+        parity = {"ok": False,
+                  "skipped": f"horizon {horizon_steps} < 960-step gate "
+                             "calibration floor (quick mode)"}
+        print(f"# megakernel gate skipped: {parity['skipped']}",
+              file=sys.stderr)
+        results["megakernel_parity"] = parity
+    elif mega_batch_sizes:
         try:
             parity = _megakernel_parity_gate(
                 cfg, params, src, b=min(2048, max(mega_batch_sizes)),
-                steps=min(480, horizon_steps))
+                steps=min(960, horizon_steps))
         except Exception as e:  # noqa: BLE001 — no kernel rows, bench lives
             print(f"# megakernel parity gate errored: {e!r}",
                   file=sys.stderr)
@@ -587,8 +600,8 @@ def bench_quality_replay(cfg, eval_steps: int = 2880, n_windows: int = 3,
     }
     # The replay-family flagship (trained on a DIFFERENT realization of
     # the replay generative process — scripts/train_replay_flagship.py)
-    # carries the ppo row here when committed; else the synthetic-family
-    # flagship transfers in.
+    # carries the ppo row; with no committed replay checkpoint the row
+    # is OMITTED and the reason recorded (no stand-ins).
     ppo_backend, rmeta = load_flagship_backend(cfg, variant="replay")
     if ppo_backend is not None:
         backends["ppo"] = ppo_backend
