@@ -46,21 +46,23 @@ def _make_src(cfg):
                                  cfg.signals)
 
 
-def _time_best(fn, repeats: int = 3, *, min_valid_s: float = 2e-3) -> float:
+def _time_best(fn, repeats: int = 3,
+               *, min_valid_s: float = 2e-3) -> float | None:
     """Best-of-N wall timing with an implausibility guard: under heavy
     host contention the tunnel-backed block_until_ready has been observed
     returning ~0s for work that takes hundreds of ms — a 0.000s sample
     would publish an absurd headline. Samples below ``min_valid_s`` are
-    discarded (with a note) and retried; if nothing valid remains, the
-    smallest raw sample is returned so the bench still completes."""
-    samples, raw = [], []
+    discarded (with a note) and retried; if NOTHING valid remains the
+    measurement is unusable and ``None`` is returned so the caller drops
+    the row — round 4 observed even max(raw) at ~1ms for a 0.5s
+    workload, so no raw sample is publishable in that state."""
+    samples = []
     attempts = 0
     while len(samples) < repeats and attempts < repeats * 3:
         attempts += 1
         t0 = time.perf_counter()
         fn()
         dt = time.perf_counter() - t0
-        raw.append(dt)
         if dt >= min_valid_s:
             samples.append(dt)
         else:
@@ -68,20 +70,19 @@ def _time_best(fn, repeats: int = 3, *, min_valid_s: float = 2e-3) -> float:
                   "(host contention?)", file=sys.stderr)
     if samples:
         return min(samples)
-    # Every sample implausible: return the LARGEST raw sample — the most
-    # conservative throughput claim — never the near-zero one (min would
-    # publish exactly the absurd headline this guard exists to prevent).
-    print("# WARNING: no plausible timing sample; reporting the most "
-          "conservative one", file=sys.stderr)
-    return max(raw)
+    print("# WARNING: no plausible timing sample; measurement dropped",
+          file=sys.stderr)
+    return None
 
 
-def _megakernel_parity_gate(cfg, params, src, *, b: int = 2048,
-                            steps: int = 960) -> dict:
-    # steps >= 960: the tolerances are calibrated on windows long enough
-    # for the rare-event counters (interruptions ~1/cluster/day) to
-    # accumulate real counts — at 480 steps the relative error across
-    # PRNG families is dominated by shot noise and the gate false-fires.
+def _megakernel_parity_gate(cfg, params, src, *, b: int = 8192,
+                            steps: int = 2880) -> dict:
+    # B=8192 x a full day: big enough that the rare-event counters'
+    # paired shot noise drops to ~0.9% relative se, so the z>4
+    # significance filter still DETECTS biases near the 3% tolerance
+    # (at B=2048 x 960 the se is ~4% and the z-gate would let ~16%
+    # biases pass — the tolerance would be dead letter). The gate runs
+    # in an isolated child process, so the memory cost is contained.
     """Inline statistical-parity gate (VERDICT r3 #2): the Pallas
     megakernel may carry the headline ONLY if its batch-mean KPIs match
     the lax path on every EpisodeSummary field, on this machine, in this
@@ -116,13 +117,21 @@ def _megakernel_parity_gate(cfg, params, src, *, b: int = 2048,
 
 
 def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int,
-                  summary_batch_sizes=(), mega_batch_sizes=()) -> dict:
+                  summary_batch_sizes=(), mega_batch_sizes=(),
+                  mega_gate: str = "subprocess") -> dict:
     """Batched rollout sweep. ``batch_sizes`` use the metric-stacking path
     (per-tick StepMetrics over the horizon); ``summary_batch_sizes`` use
     the O(B)-memory summarize-in-scan path; ``mega_batch_sizes`` use the
-    Pallas megakernel (`sim/megakernel.py`) — gated on an inline
-    statistical-parity check against the lax path, without which its
-    rows are skipped and cannot carry the headline.
+    Pallas megakernel (`sim/megakernel.py`) — gated on a statistical-
+    parity check against the lax path, without which its rows are
+    dropped and cannot carry the headline.
+
+    ``mega_gate``: "subprocess" (default — gate AND kernel timing each
+    run in their own isolated child process: the tunneled backend does
+    not reliably reclaim the kernel path's ~11 GB, so anything sharing
+    its process degrades or RESOURCE_EXHAUSTs), "inline" (gate after
+    the sweep in-process), or "skip" (no gate — ONLY for the timing
+    child, whose parent already gated).
     """
     from ccka_tpu.policy import RulePolicy
     from ccka_tpu.policy.rule import offpeak_action, peak_action
@@ -142,7 +151,7 @@ def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int,
         params, s, action_fn, tr, k, stochastic=True))
 
     results = {}
-    parity = None
+    mega_local = []
     if mega_batch_sizes and horizon_steps < 960:
         # Below the gate's calibration floor (rare-event shot noise
         # dominates): don't pretend to gate — skip the kernel rows.
@@ -152,21 +161,25 @@ def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int,
         print(f"# megakernel gate skipped: {parity['skipped']}",
               file=sys.stderr)
         results["megakernel_parity"] = parity
+    elif mega_batch_sizes and mega_gate == "subprocess":
+        sub = _mega_subprocess(mega_batch_sizes, horizon_steps, repeats)
+        if sub:
+            results.update(sub)
+        else:
+            # The recorded-reason contract holds even when the child
+            # itself died (timeout, OOM-kill, import error).
+            results["megakernel_parity"] = {
+                "ok": False, "error": "mega child process failed"}
     elif mega_batch_sizes:
-        try:
-            parity = _megakernel_parity_gate(
-                cfg, params, src, b=min(2048, max(mega_batch_sizes)),
-                steps=min(960, horizon_steps))
-        except Exception as e:  # noqa: BLE001 — no kernel rows, bench lives
-            print(f"# megakernel parity gate errored: {e!r}",
-                  file=sys.stderr)
-            parity = {"ok": False, "error": repr(e)[:200]}
-        results["megakernel_parity"] = parity
+        # Kernel rows are timed FIRST on the fresh heap; an "inline"
+        # parity gate runs AFTER the sweep (below) — its allocations
+        # degrade the timed path, and gate validity doesn't depend on
+        # heap state. Rows are dropped post-hoc if it fails.
+        mega_local = [(b, "mega") for b in mega_batch_sizes]
 
-    sweep = ([(b, "metrics") for b in batch_sizes]
-             + [(b, "summary") for b in summary_batch_sizes]
-             + ([(b, "mega") for b in mega_batch_sizes]
-                if parity and parity["ok"] else []))
+    sweep = (mega_local
+             + [(b, "metrics") for b in batch_sizes]
+             + [(b, "summary") for b in summary_batch_sizes])
     for b, mode in sweep:
         key = f"{b}:{mode}"
         # Per-row guard: one OOM (e.g. the B=64k packed-exo row on a
@@ -178,19 +191,30 @@ def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int,
             states = jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (b,) + x.shape),
                 initial_state(cfg))
-            keys = jax.random.split(jax.random.key(0), b)
-            states, traces, keys = jax.device_put((states, traces, keys))
+            states, traces = jax.device_put((states, traces))
+            # Every timed call gets a DISTINCT world key: the tunneled
+            # backend has been observed short-circuiting byte-identical
+            # repeat requests to ~0s (the implausible-sample pathology),
+            # so repeats must be genuinely different work.
+            n_calls = 3 * repeats + 2
+            key_variants = [jax.random.split(jax.random.key(1000 + i), b)
+                            for i in range(n_calls)]
+            call_i = [0]
 
             if mode == "mega":
                 def once():
+                    call_i[0] += 1
                     s = megakernel_rollout_summary(
-                        params, off, peak, traces, seed=1, stochastic=True)
+                        params, off, peak, traces, seed=call_i[0],
+                        stochastic=True)
                     jax.block_until_ready(s.cost_usd)
             else:
                 run = run_summary if mode == "summary" else run_metrics
 
                 def once():
-                    final, _ = run(states, traces, keys)
+                    k = key_variants[call_i[0] % n_calls]
+                    call_i[0] += 1
+                    final, _ = run(states, traces, k)
                     jax.block_until_ready(final)
 
             once()  # compile
@@ -198,6 +222,10 @@ def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int,
         except Exception as e:  # noqa: BLE001
             print(f"# rollout B={b} [{mode}] failed (skipped): "
                   f"{repr(e)[:160]}", file=sys.stderr)
+            continue
+        if dt is None:
+            print(f"# rollout B={b} [{mode}]: no plausible timing — "
+                  "row dropped", file=sys.stderr)
             continue
         results[key] = {
             "batch": b,
@@ -209,7 +237,23 @@ def bench_rollout(cfg, batch_sizes, horizon_steps: int, repeats: int,
         print(f"# rollout B={b} [{mode}]: {dt:.3f}s -> "
               f"{results[key]['cluster_days_per_sec']:,.0f} cluster-days/sec",
               file=sys.stderr)
-        del traces, states, keys
+        del traces, states, key_variants
+
+    if mega_local and mega_gate == "inline":
+        try:
+            parity = _megakernel_parity_gate(
+                cfg, params, src, b=min(8192, max(mega_batch_sizes)),
+                steps=min(2880, max(horizon_steps, 960)))
+        except Exception as e:  # noqa: BLE001 — drop rows, bench lives
+            print(f"# megakernel parity gate errored: {e!r}",
+                  file=sys.stderr)
+            parity = {"ok": False, "error": repr(e)[:200]}
+        results["megakernel_parity"] = parity
+        if not parity["ok"]:
+            for b, _mode in mega_local:
+                results.pop(f"{b}:mega", None)
+            print("# megakernel rows DROPPED (gate failed)",
+                  file=sys.stderr)
     return results
 
 
@@ -281,10 +325,11 @@ def bench_mpc(cfg, plans: int, fleet_batch: int = 256) -> dict:
             once()
 
     dt = _time_best(plan_round, repeats=2)  # same contended-sample guard
-    out = {"plans_per_sec": plans / dt,
-           "horizon": h, "iters": cfg.train.mpc_iters}
-    print(f"# mpc: {out['plans_per_sec']:.1f} plans/s "
-          f"(H={h}, {cfg.train.mpc_iters} Adam iters)", file=sys.stderr)
+    out = {"horizon": h, "iters": cfg.train.mpc_iters}
+    if dt is not None:
+        out["plans_per_sec"] = plans / dt
+        print(f"# mpc: {out['plans_per_sec']:.1f} plans/s "
+              f"(H={h}, {cfg.train.mpc_iters} Adam iters)", file=sys.stderr)
 
     # Fleet-scale receding-horizon planning: vmap'd optimize_plan over a
     # cluster batch — the batched analog that closes the single-plan
@@ -311,9 +356,10 @@ def bench_mpc(cfg, plans: int, fleet_batch: int = 256) -> dict:
     # contended sample would publish an absurd fleet-plans/sec).
     dt_b = _time_best(batch_round, repeats=2)
     out["fleet_batch"] = b
-    out["fleet_plans_per_sec"] = b * reps / dt_b
-    print(f"# mpc fleet: {out['fleet_plans_per_sec']:,.0f} plans/s "
-          f"(B={b} vmap'd)", file=sys.stderr)
+    if dt_b is not None:
+        out["fleet_plans_per_sec"] = b * reps / dt_b
+        print(f"# mpc fleet: {out['fleet_plans_per_sec']:,.0f} plans/s "
+              f"(B={b} vmap'd)", file=sys.stderr)
     return out
 
 
@@ -416,6 +462,10 @@ def bench_mesh(cfg, *, batch: int = 8192, steps: int = 480,
 
     once()  # compile
     dt = _time_best(once, repeats)
+    if dt is None:
+        print("# mesh: no plausible timing — stage dropped",
+              file=sys.stderr)
+        return None
     platform = jax.devices()[0].platform
     out = {
         "devices": n_dev,
@@ -646,6 +696,50 @@ def bench_quality_replay(cfg, eval_steps: int = 2880, n_windows: int = 3,
     return out
 
 
+def _run_child(argv, timeout_s=1800, env=None) -> dict | None:
+    """Run a bench child phase; relay its narration; parse its JSON."""
+    try:
+        proc = subprocess.run(argv, env=env or dict(os.environ),
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        for line in proc.stderr.splitlines():
+            if line.startswith("#"):
+                print(line, file=sys.stderr)
+        if proc.returncode != 0:
+            print(f"# bench child failed: {proc.stderr.strip()[-200:]}",
+                  file=sys.stderr)
+            return None
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (OSError, subprocess.TimeoutExpired, json.JSONDecodeError,
+            IndexError) as e:
+        print(f"# bench child errored: {e!r}", file=sys.stderr)
+        return None
+
+
+def _mega_subprocess(mega_sizes, horizon: int, repeats: int) -> dict | None:
+    """Gate, then time, each in its OWN child process: the kernel path's
+    ~11 GB and the gate's lax+kernel buffers each poison whatever shares
+    their process on the tunneled backend (memory is not reliably
+    reclaimed), so every phase gets a clean device session. Timing rows
+    merge back only when the gate passed."""
+    me = os.path.abspath(__file__)
+    parity = _run_child([sys.executable, me, "--mega-phase", "gate"])
+    if parity is None:
+        return None
+    out = {"megakernel_parity": parity}
+    if not parity.get("ok"):
+        print("# megakernel rows skipped (gate failed)", file=sys.stderr)
+        return out
+    rows = _run_child([sys.executable, me, "--mega-phase", "time",
+                       "--mega-sizes",
+                       ",".join(str(b) for b in mega_sizes),
+                       "--mega-horizon", str(horizon),
+                       "--mega-repeats", str(repeats)])
+    if rows:
+        out.update(rows)
+    return out
+
+
 def _mesh_virtual_fallback() -> dict | None:
     """Single-device host: measure the sharded path on an 8-device
     CPU-virtual mesh in a child process (labeled as virtual — validates
@@ -655,19 +749,9 @@ def _mesh_virtual_fallback() -> dict | None:
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count=8").strip()
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--mesh-only"],
-            env=env, capture_output=True, text=True, timeout=1200)
-        if proc.returncode != 0:
-            print(f"# mesh virtual fallback failed: "
-                  f"{proc.stderr.strip()[-200:]}", file=sys.stderr)
-            return None
-        return json.loads(proc.stdout.strip().splitlines()[-1])
-    except (OSError, subprocess.TimeoutExpired, json.JSONDecodeError,
-            IndexError) as e:
-        print(f"# mesh virtual fallback errored: {e!r}", file=sys.stderr)
-        return None
+    return _run_child(
+        [sys.executable, os.path.abspath(__file__), "--mesh-only"],
+        timeout_s=1200, env=env)
 
 
 def main(argv=None) -> int:
@@ -677,6 +761,13 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh-only", action="store_true",
                     help="run ONLY the mesh stage and print its JSON "
                          "(used by the CPU-virtual fallback subprocess)")
+    ap.add_argument("--mega-phase", choices=("gate", "time"),
+                    help="child phases of the isolated megakernel stage "
+                         "(see _mega_subprocess): 'gate' prints the "
+                         "parity JSON, 'time' prints the timing rows")
+    ap.add_argument("--mega-sizes", default="16384,32768")
+    ap.add_argument("--mega-horizon", type=int, default=2880)
+    ap.add_argument("--mega-repeats", type=int, default=3)
     args = ap.parse_args(argv)
 
     if args.mesh_only:
@@ -685,6 +776,29 @@ def main(argv=None) -> int:
                           repeats=2)
         print(json.dumps(mesh))
         return 0 if mesh is not None else 1
+
+    if args.mega_phase == "gate":
+        from ccka_tpu.config import default_config
+        cfg = default_config()
+        from ccka_tpu.sim import SimParams
+        try:
+            parity = _megakernel_parity_gate(
+                cfg, SimParams.from_config(cfg), _make_src(cfg))
+        except Exception as e:  # noqa: BLE001
+            parity = {"ok": False, "error": repr(e)[:200]}
+            print(f"# megakernel parity gate errored: {e!r}",
+                  file=sys.stderr)
+        print(json.dumps(parity))
+        return 0
+
+    if args.mega_phase == "time":
+        from ccka_tpu.config import default_config
+        sizes = [int(s) for s in args.mega_sizes.split(",") if s]
+        rows = bench_rollout(default_config(), [], args.mega_horizon,
+                             args.mega_repeats, mega_batch_sizes=sizes,
+                             mega_gate="skip")
+        print(json.dumps(rows))
+        return 0
 
     from ccka_tpu.config import default_config
 
@@ -701,7 +815,9 @@ def main(argv=None) -> int:
     else:
         batch_sizes, horizon, repeats = [256, 2048, 8192], 2880, 3
         summary_sizes = [16384, 32768]
-        mega_sizes = [32768, 65536]
+        # B=64k is out of reach for the kernel path on a 16 GB part
+        # (9 GB traces + 12 GB packed stream must coexist).
+        mega_sizes = [16384, 32768]
         ppo_iters, plans = 10, 20
         ppo_cfg = default_config()  # config #3: 256 clusters, 64 steps
 
@@ -754,6 +870,13 @@ def main(argv=None) -> int:
 
     rates = {k: v for k, v in rollout.items()
              if isinstance(v, dict) and "cluster_days_per_sec" in v}
+    if not rates:
+        print("# FATAL: every rollout row dropped — no headline",
+              file=sys.stderr)
+        print(json.dumps({"metric": "sim_cluster_days_per_sec_per_chip",
+                          "value": None, "unit": "cluster-days/sec/chip",
+                          "error": "no plausible rollout timing"}))
+        return 1
     best_k = max(rates, key=lambda k: rates[k]["cluster_days_per_sec"])
     headline = rates[best_k]["cluster_days_per_sec"]
     line = {

@@ -508,7 +508,10 @@ def mean_parity_violations(kernel_summary, lax_summary) -> dict:
         rel = abs(d.mean()) / (abs(b) + 1e-9)
         if rel <= MEAN_PARITY_TOLERANCES.get(f, DEFAULT_MEAN_PARITY_TOL):
             continue
-        se = d.std(ddof=1) / math.sqrt(max(d.size, 2))
+        if d.size < 2:
+            bad[f] = round(rel, 5)   # no variance estimate: rel decides
+            continue
+        se = d.std(ddof=1) / math.sqrt(d.size)
         z = abs(d.mean()) / (se + 1e-12)
         if z > 4.0:
             bad[f] = round(rel, 5)
