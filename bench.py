@@ -410,18 +410,42 @@ def bench_fleet(cfg, n_clusters: int, ticks: int) -> dict:
 
 
 def _flag_wins(section: dict, rule_row: dict) -> None:
-    """Stamp `beats_rule_both_headlines` on every learned/hand-coded row
-    of a scoreboard section — ONE criterion for synthetic, multiregion
-    and replay scoreboards alike."""
+    """Stamp the win flags on every learned/hand-coded row of a
+    scoreboard section — ONE criterion for synthetic, multiregion and
+    replay scoreboards alike.
+
+    `beats_rule_both_headlines` is SIGNIFICANCE-GATED (VERDICT r4 weak
+    #2 / next #3): each headline's paired per-trace ratio mean must
+    clear 1.0 by two standard errors (mean + 2·se < 1.0), so an exact
+    tie or a noise-level mean can never publish as a win (which also
+    closes the ADVICE r4 tie-counts-as-beats hole). The raw criterion
+    the flag used through round 4 survives as
+    `matches_or_beats_rule_raw` for continuity."""
     for name in ("ppo", "mpc", "carbon"):
         if name not in section:
             continue
         r = section[name]
-        wins = (r.get("vs_rule_usd_per_slo_hour", 9) <= 1.0
-                and r.get("vs_rule_g_co2_per_kreq", 9) <= 1.0
-                and r["slo_attainment"] >= rule_row["slo_attainment"]
-                - 1e-3)
+        attain_ok = (r["slo_attainment"]
+                     >= rule_row["slo_attainment"] - 1e-3)
+        raw = (r.get("vs_rule_usd_per_slo_hour", 9) <= 1.0
+               and r.get("vs_rule_g_co2_per_kreq", 9) <= 1.0
+               and attain_ok)
+        r["matches_or_beats_rule_raw"] = bool(raw)
+
+        def sig_win(k: str) -> bool:
+            win = r.get(f"vs_rule_{k}_win2se")
+            if win is not None:
+                return win
+            # Single-trace sections carry no spread; fall back to a
+            # strict raw improvement and say so in the flag name below.
+            return r.get(f"vs_rule_{k}", 9) < 1.0
+
+        gated = all(f"vs_rule_{k}_win2se" in r
+                    for k in ("usd_per_slo_hour", "g_co2_per_kreq"))
+        wins = (sig_win("usd_per_slo_hour") and sig_win("g_co2_per_kreq")
+                and attain_ok)
         r["beats_rule_both_headlines"] = bool(wins)
+        r["win_flag_significance_gated"] = bool(gated)
 
 
 def bench_mesh(cfg, *, batch: int = 8192, steps: int = 480,
@@ -486,9 +510,12 @@ def bench_mesh(cfg, *, batch: int = 8192, steps: int = 480,
 
 
 def _paired_ratios(board: dict, name: str) -> dict:
-    """Per-trace paired ratios vs rule for the two headline metrics —
-    mean alone can't distinguish a ±2% 'win' from trace noise, so the
-    spread ships next to it (VERDICT r2 weak #3)."""
+    """Per-trace paired ratios vs rule for the two headline metrics,
+    with the paired-difference statistics the win flag gates on — mean
+    alone can't distinguish a ±2% 'win' from trace noise (VERDICT r2
+    weak #3), and a raw mean comparison can't either (VERDICT r4 weak
+    #2), so the scoreboard now ships mean, se, z and a 2-se CI per
+    headline, mirroring the megakernel gate's paired machinery."""
     out = {}
     rule_pt = board["rule"].get("per_trace", {})
     pt = board[name].get("per_trace", {})
@@ -497,6 +524,19 @@ def _paired_ratios(board: dict, name: str) -> dict:
             r = [a / max(b, 1e-9) for a, b in zip(pt[k], rule_pt[k])]
             out[f"vs_rule_{k}_per_trace"] = [round(x, 4) for x in r]
             out[f"vs_rule_{k}_std"] = round(float(np.std(r)), 4)
+            mean = float(np.mean(r))
+            out[f"vs_rule_{k}_mean"] = round(mean, 4)
+            if len(r) >= 2:
+                se = float(np.std(r, ddof=1)) / len(r) ** 0.5
+                out[f"vs_rule_{k}_se"] = round(se, 5)
+                out[f"vs_rule_{k}_ci2se"] = [round(mean - 2 * se, 4),
+                                             round(mean + 2 * se, 4)]
+                out[f"vs_rule_{k}_z"] = round((1.0 - mean) / max(se, 1e-9),
+                                              2)
+                # The gate decision itself rides UNROUNDED so the flag
+                # can never contradict the z it encodes (a rounded CI
+                # bound of exactly 1.0 would deny a z=2.01 win).
+                out[f"vs_rule_{k}_win2se"] = bool(mean + 2 * se < 1.0)
     return out
 
 

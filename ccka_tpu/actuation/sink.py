@@ -31,6 +31,24 @@ from ccka_tpu.actuation.patches import (
 Runner = Callable[[Sequence[str]], tuple[int, str]]
 
 
+def _accepts_budget(fn) -> bool:
+    """Whether a runner accepts the widened-budget kwargs
+    (``timeout_s``/``deadline_s``). Probed ONCE per runner — probing at
+    call time via catch-TypeError would re-run a side-effecting kubectl
+    command when a custom runner raises TypeError after launching it.
+    Requires BOTH names (or ``**kwargs``): a runner taking only one
+    would TypeError on the paired call."""
+    import inspect
+    try:
+        params = inspect.signature(fn).parameters.values()
+        names = {p.name for p in params}
+        return ({"timeout_s", "deadline_s"} <= names
+                or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                       for p in params))
+    except (TypeError, ValueError):
+        return False
+
+
 @dataclass(frozen=True)
 class PatchCommand:
     """One kubectl-equivalent mutation, recorded for audit/replay."""
@@ -367,6 +385,7 @@ class KubectlSink(ActuationSink):
 
     def __init__(self, runner: Runner | None = None):
         self.runner = runner or _subprocess_runner
+        self._runner_takes_budget = _accepts_budget(self.runner)
 
     def _patch(self, cmd: PatchCommand) -> bool:
         rc, _ = self.runner(cmd.kubectl_argv())
@@ -394,10 +413,10 @@ class KubectlSink(ActuationSink):
             # command's declared timeout (+ slack) when the runner
             # supports it (injected argv-only test runners don't).
             budget = max(cmd.grace_s * 2, 60) + 15.0
-            try:
+            if self._runner_takes_budget:
                 rc, _ = self.runner(cmd.kubectl_argv(), timeout_s=budget,
                                     deadline_s=budget + 10.0)
-            except TypeError:
+            else:
                 rc, _ = self.runner(cmd.kubectl_argv())
             return rc == 0
         rc, _ = self.runner(cmd.kubectl_argv())
@@ -474,12 +493,18 @@ def context_runner(context: str, base: Runner | None = None) -> Runner:
     underlying executor (subprocess by default; injectable for tests).
     """
     inner = base or _subprocess_runner
+    inner_takes_budget = _accepts_budget(inner)
 
-    def run(argv: Sequence[str]) -> tuple[int, str]:
+    def run(argv: Sequence[str], **kw) -> tuple[int, str]:
+        # Forward the widened drain budget (timeout_s/deadline_s) so
+        # context-pinned fleet sinks keep long evictions alive too — but
+        # only when the underlying executor accepts it (injected argv-only
+        # test runners don't; silently dropping the kwargs there matches
+        # KubectlSink's own capability probe).
         argv = list(argv)
         if argv and argv[0] == "kubectl":
             argv = ["kubectl", "--context", context, *argv[1:]]
-        return inner(argv)
+        return inner(argv, **kw) if inner_takes_budget else inner(argv)
     return run
 
 

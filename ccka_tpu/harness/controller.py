@@ -307,27 +307,29 @@ class Controller:
                 self.log_fn(f"# duplicate interruption warning for "
                             f"{w.instance_id} (already drained)")
                 continue
-            hit = by_instance.get(w.instance_id)
-            if hit is None:
-                # The warning was already acked at poll time; losing it
-                # here would waste the 2-minute notice whenever the node
-                # listing transiently failed or the node hasn't
-                # registered yet. Retry for a bounded number of ticks.
+            # Both not-yet-matched and failed-to-drain warnings share ONE
+            # bounded retry buffer: the warning was already acked at poll
+            # time, so the controller is its only memory — losing it
+            # wastes the 2-minute notice (ADVICE r4 medium).
+            def carry(reason: str) -> None:
                 _w, ttl = prev_pending.get(w.instance_id,
                                            (w, _PENDING_WARNING_TTL + 1))
                 if ttl - 1 > 0:
                     next_pending[w.instance_id] = (w, ttl - 1)
-                    self.log_fn(f"# interruption warning for unresolved "
-                                f"instance {w.instance_id} — retrying "
-                                f"{ttl - 1} more tick(s)")
+                    self.log_fn(f"# {reason} — retrying {ttl - 1} more "
+                                f"tick(s)")
                 else:
-                    self.log_fn(f"# interruption warning for "
-                                f"{w.instance_id} never matched a node — "
-                                f"dropped (already gone?)")
+                    self.log_fn(f"# {reason} — dropped (TTL exhausted)")
+
+            hit = by_instance.get(w.instance_id)
+            if hit is None:
+                carry(f"interruption warning for unresolved instance "
+                      f"{w.instance_id}")
                 continue
             node, sink = hit
             name = node.get("metadata", {}).get("name", "")
             if not name or not sink.drain_node(name):
+                carry(f"drain of {name or w.instance_id} failed")
                 continue
             self._remember_drained(w.instance_id)
             drained += 1

@@ -252,7 +252,10 @@ class FleetController:
         actuation results never feed the on-device estimate — the loop is
         open at the sink edge; state estimates chain purely on device).
         Depth 2 fully hides a ~30ms device chain under a ~70ms fan-out on
-        a tunneled chip; deeper only defers reporting."""
+        a tunneled chip; deeper only defers reporting. Peak in-flight is
+        briefly ``depth + 1`` (the new dispatch is issued before the
+        oldest is harvested — harvesting first would serialize
+        ``depth=1`` into the unpipelined tick loop)."""
         from collections import deque
 
         depth = max(1, pipeline_depth)

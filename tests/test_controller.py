@@ -592,6 +592,48 @@ class TestSpotInterruptions:
                   if isinstance(c, ManifestCommand) and c.action == "drain"]
         assert [c.name for c in drains] == ["late-node"]
 
+    def test_drain_failure_retries_next_tick(self):
+        """ADVICE r4 (medium): a matched node whose drain transiently
+        fails must carry the warning into the pending buffer — the
+        2-minute notice survives a kubectl hiccup and the drain is
+        retried (and the estimate decremented) on the next tick."""
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.live import InterruptionWarning
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        cfg = default_config()
+
+        class FlakySink(DryRunSink):
+            def __init__(self):
+                super().__init__()
+                self.drain_calls = 0
+
+            def drain_node(self, name, grace_s=30):
+                self.drain_calls += 1
+                if self.drain_calls == 1:
+                    return False  # transient kubectl failure
+                return super().drain_node(name, grace_s=grace_s)
+
+        sink = FlakySink()
+        sink.objects[("node", "", "n1")] = _spot_node(
+            "n1", "i-0flaky", cfg.cluster.zones[0])
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, sink,
+                          interval_s=0.0, log_fn=lambda _l: None)
+        spot_pool = cfg.cluster.pool_index("spot-preferred")
+        ctrl.state = ctrl.state._replace(
+            nodes=ctrl.state.nodes.at[spot_pool, 0, 0].set(2.0))
+        w = InterruptionWarning("i-0flaky", "terminate", "x")
+        assert ctrl._drain_for_warnings([w]) == 0
+        assert "i-0flaky" in ctrl._pending_warnings  # carried, not lost
+        # Next tick re-offers the carried warning; drain succeeds now.
+        assert ctrl._drain_for_warnings([w]) == 1
+        assert ctrl._pending_warnings == {}
+        assert np.asarray(ctrl.state.nodes)[spot_pool, 0, 0] == 1.0
+
     def test_unresolved_warning_expires_after_ttl(self):
         from ccka_tpu.actuation.sink import DryRunSink
         from ccka_tpu.harness.controller import (_PENDING_WARNING_TTL,
