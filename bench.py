@@ -442,8 +442,12 @@ def _flag_wins(section: dict, rule_row: dict) -> None:
 
         gated = all(f"vs_rule_{k}_win2se" in r
                     for k in ("usd_per_slo_hour", "g_co2_per_kreq"))
+        # The published headline is the ratio of AGGREGATES; the gate
+        # additionally requires it <= 1.0 so the flag can never sit next
+        # to a >1.0x headline (a heavy-trace loss can flip the aggregate
+        # while the per-trace mean still clears the CI).
         wins = (sig_win("usd_per_slo_hour") and sig_win("g_co2_per_kreq")
-                and attain_ok)
+                and raw and attain_ok)
         r["beats_rule_both_headlines"] = bool(wins)
         r["win_flag_significance_gated"] = bool(gated)
 
@@ -531,8 +535,11 @@ def _paired_ratios(board: dict, name: str) -> dict:
                 out[f"vs_rule_{k}_se"] = round(se, 5)
                 out[f"vs_rule_{k}_ci2se"] = [round(mean - 2 * se, 4),
                                              round(mean + 2 * se, 4)]
-                out[f"vs_rule_{k}_z"] = round((1.0 - mean) / max(se, 1e-9),
-                                              2)
+                if se > 1e-8:
+                    out[f"vs_rule_{k}_z"] = round((1.0 - mean) / se, 2)
+                # else: zero spread — a z statistic is undefined, not
+                # astronomically large; the CI (collapsed to a point)
+                # and win2se below still decide.
                 # The gate decision itself rides UNROUNDED so the flag
                 # can never contradict the z it encodes (a rounded CI
                 # bound of exactly 1.0 would deny a z=2.01 win).

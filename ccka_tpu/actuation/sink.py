@@ -385,7 +385,18 @@ class KubectlSink(ActuationSink):
 
     def __init__(self, runner: Runner | None = None):
         self.runner = runner or _subprocess_runner
-        self._runner_takes_budget = _accepts_budget(self.runner)
+
+    @property
+    def runner(self) -> Runner:
+        return self._runner
+
+    @runner.setter
+    def runner(self, fn: Runner) -> None:
+        # Re-probed on assignment (not per call): tests swap .runner
+        # after construction, and a stale capability bit would hand the
+        # new runner kwargs it cannot take.
+        self._runner = fn
+        self._runner_takes_budget = _accepts_budget(fn)
 
     def _patch(self, cmd: PatchCommand) -> bool:
         rc, _ = self.runner(cmd.kubectl_argv())

@@ -39,6 +39,11 @@ import math as _math
 _HPA_BIAS = _math.log((1.0 - _HPA_LO) / (_HPA_HI - 1.0))  # logit of 0.2308
 _EPS = 1e-6
 
+# Public aliases: the Pallas megakernel (`sim/megakernel.py`) fuses this
+# codec in-register and must squash with the SAME constants.
+HPA_LO, HPA_HI, HPA_BIAS = _HPA_LO, _HPA_HI, _HPA_BIAS
+AFTER_MAX_S = _AFTER_MAX_S
+
 
 def latent_dim(cluster: ClusterConfig, n_classes: int = 2) -> int:
     p, z = cluster.n_pools, cluster.n_zones

@@ -29,9 +29,34 @@ from ccka_tpu.config import default_config
 from ccka_tpu.policy import RulePolicy
 from ccka_tpu.policy.rule import offpeak_action, peak_action
 from ccka_tpu.sim import SimParams, initial_state
-from ccka_tpu.sim.megakernel import megakernel_rollout_summary
+from ccka_tpu.sim.megakernel import (carbon_megakernel_rollout_summary,
+                                     kernel_numerics_action_fn,
+                                     mean_parity_violations,
+                                     megakernel_rollout_summary,
+                                     neural_megakernel_rollout_summary)
 from ccka_tpu.sim.rollout import batched_rollout_summary
 from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+
+def _perturbed_net_params(cfg, seed: int = 3, scale: float = 0.3):
+    """ActorCritic params with non-trivial weights (a zero-init head
+    would emit the same action everywhere and mask layout bugs)."""
+    from ccka_tpu.models import ActorCritic, latent_dim
+    from ccka_tpu.sim.megakernel import _obs_dim
+
+    import zlib
+
+    net = ActorCritic(act_dim=latent_dim(cfg.cluster))
+    key = jax.random.key(seed)
+    p0 = net.init(key, jnp.zeros(
+        (_obs_dim(cfg.cluster.n_pools, cfg.cluster.n_zones),)))
+    # crc32, not hash(): PYTHONHASHSEED would make the perturbation —
+    # and thus the parity deltas — vary between pytest runs.
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: x + scale * jax.random.normal(
+            jax.random.fold_in(key, zlib.crc32(str(path).encode())
+                               % (2 ** 31)), x.shape),
+        p0)
 
 
 @pytest.fixture(scope="module")
@@ -144,6 +169,157 @@ class TestInterpretExactParity:
                                        b_block=128, interpret=True)
 
 
+class TestCarbonKernelParity:
+    """`policy="carbon"`: CarbonAwarePolicy fused in-kernel — all-f32
+    formulas, so interpret mode is exact like the rule path."""
+
+    def test_interpret_exact(self, cfg, setup):
+        from ccka_tpu.policy import CarbonAwarePolicy
+
+        params, src, off, peak = setup
+        traces = src.batch_trace_device(96, jax.random.key(7), 128)
+        sk = carbon_megakernel_rollout_summary(
+            params, off, peak, traces, stochastic=False, b_block=128,
+            t_chunk=32, interpret=True)
+        b = 128
+        states = jax.tree.map(lambda x: jnp.broadcast_to(x, (b,) + x.shape),
+                              initial_state(cfg))
+        keys = jax.random.split(jax.random.key(0), b)
+        _, sl = batched_rollout_summary(
+            params, states, CarbonAwarePolicy(cfg.cluster).action_fn(),
+            traces, keys, stochastic=False)
+        rel = _field_rel(sk, sl)
+        bad = {f: r for f, r in rel.items() if r > 2e-3}
+        assert not bad, f"carbon kernel parity broken: {bad}"
+
+    def test_policy_constants_thread_through(self, cfg, setup):
+        """Non-default sharpness/stickiness must change the rollout (the
+        statics actually reach the fused policy)."""
+        params, src, off, peak = setup
+        traces = src.batch_trace_device(64, jax.random.key(9), 128)
+        a = carbon_megakernel_rollout_summary(
+            params, off, peak, traces, stochastic=False, b_block=128,
+            t_chunk=32, interpret=True)
+        b = carbon_megakernel_rollout_summary(
+            params, off, peak, traces, stochastic=False, b_block=128,
+            t_chunk=32, interpret=True, sharpness=40.0, stickiness=0.0)
+        assert float(np.max(np.abs(
+            np.asarray(a.carbon_kg) - np.asarray(b.carbon_kg)))) > 0
+
+
+class TestNeuralKernelParity:
+    """`policy="mlp"`: the deterministic ActorCritic policy fused
+    in-kernel. The MLP forward is bit-identical to the packed-weights
+    lax helper (`kernel_numerics_action_fn`), but a FEEDBACK policy
+    amplifies float-association noise through the state→obs→net loop,
+    so exact parity holds only at short horizons; long horizons get the
+    batch-mean gate (same structure as the on-chip contract)."""
+
+    def test_short_horizon_exact(self, cfg, setup):
+        params, src, _, _ = setup
+        net_params = _perturbed_net_params(cfg)
+        traces = src.batch_trace_device(32, jax.random.key(7), 128)
+        sk = neural_megakernel_rollout_summary(
+            params, cfg.cluster, net_params, traces, stochastic=False,
+            b_block=128, t_chunk=16, interpret=True)
+        b = 128
+        states = jax.tree.map(lambda x: jnp.broadcast_to(x, (b,) + x.shape),
+                              initial_state(cfg))
+        keys = jax.random.split(jax.random.key(0), b)
+        _, sl = batched_rollout_summary(
+            params, states,
+            kernel_numerics_action_fn(net_params, cfg.cluster, params),
+            traces, keys, stochastic=False)
+        rel = _field_rel(sk, sl)
+        # Threshold-gated counters divide by near-zero short-horizon
+        # totals, so association noise reads as percents there; core
+        # fields stay at 1e-3.
+        loose = {"evictions": 2e-2, "queue_depth_mean": 2e-2}
+        bad = {f: r for f, r in rel.items() if r > loose.get(f, 1e-3)}
+        assert not bad, f"neural kernel exact parity broken: {bad}"
+
+    def test_full_day_batch_mean_vs_flax(self, cfg, setup):
+        """Against the REAL flax PPOBackend forward (not the helper):
+        batch-mean parity on every field under the shared tolerance
+        table — the same standard the bench gate applies on-chip."""
+        from ccka_tpu.train.ppo import PPOBackend
+
+        params, src, _, _ = setup
+        net_params = _perturbed_net_params(cfg)
+        traces = src.batch_trace_device(288, jax.random.key(11), 256)
+        sk = neural_megakernel_rollout_summary(
+            params, cfg.cluster, net_params, traces, stochastic=False,
+            b_block=128, t_chunk=32, interpret=True)
+        b = 256
+        states = jax.tree.map(lambda x: jnp.broadcast_to(x, (b,) + x.shape),
+                              initial_state(cfg))
+        keys = jax.random.split(jax.random.key(0), b)
+        backend = PPOBackend(cfg, net_params)
+        _, sl = batched_rollout_summary(
+            params, states, backend.action_fn(), traces, keys,
+            stochastic=False)
+        bad = mean_parity_violations(sk, sl)
+        assert not bad, f"neural batch-mean parity broken: {bad}"
+
+    def test_multiregion_topology(self):
+        """Z=4, latent dim 18 (padded to 24): dims are computed from the
+        topology, not hard-coded for the default."""
+        from ccka_tpu.config import multi_region_config
+
+        mcfg = multi_region_config()
+        params = SimParams.from_config(mcfg)
+        src = SyntheticSignalSource(mcfg.cluster, mcfg.workload, mcfg.sim,
+                                    mcfg.signals)
+        net_params = _perturbed_net_params(mcfg)
+        traces = src.batch_trace_device(32, jax.random.key(2), 128)
+        sk = neural_megakernel_rollout_summary(
+            params, mcfg.cluster, net_params, traces, stochastic=False,
+            b_block=128, t_chunk=16, interpret=True)
+        b = 128
+        states = jax.tree.map(lambda x: jnp.broadcast_to(x, (b,) + x.shape),
+                              initial_state(mcfg))
+        keys = jax.random.split(jax.random.key(0), b)
+        _, sl = batched_rollout_summary(
+            params, states,
+            kernel_numerics_action_fn(net_params, mcfg.cluster, params),
+            traces, keys, stochastic=False)
+        rel = _field_rel(sk, sl)
+        bad = {f: r for f, r in rel.items() if r > 1e-3}
+        assert not bad, f"Z=4 neural parity broken: {bad}"
+
+    def test_population_axis(self, cfg, setup):
+        """Stacked candidates: one launch, [NP, B] fields; member 0
+        equals the single-pytree run (paired worlds) and a genuinely
+        different member produces different KPIs."""
+        params, src, _, _ = setup
+        p0 = _perturbed_net_params(cfg)
+        p1 = jax.tree.map(lambda x: x * 0.5, p0)
+        stacked = jax.tree.map(lambda a, b: jnp.stack([a, b]), p0, p1)
+        traces = src.batch_trace_device(48, jax.random.key(5), 128)
+        pop = neural_megakernel_rollout_summary(
+            params, cfg.cluster, stacked, traces, stochastic=False,
+            b_block=128, t_chunk=16, interpret=True)
+        single = neural_megakernel_rollout_summary(
+            params, cfg.cluster, p0, traces, stochastic=False,
+            b_block=128, t_chunk=16, interpret=True)
+        assert np.asarray(pop.cost_usd).shape[0] == 2
+        np.testing.assert_allclose(np.asarray(pop.cost_usd)[0],
+                                   np.asarray(single.cost_usd), rtol=1e-6)
+        assert float(np.max(np.abs(np.asarray(pop.cost_usd)[1]
+                                   - np.asarray(pop.cost_usd)[0]))) > 0
+
+    def test_rejects_wrong_topology_net(self, cfg, setup):
+        from ccka_tpu.config import multi_region_config
+
+        params, src, _, _ = setup
+        wrong = _perturbed_net_params(multi_region_config())
+        traces = src.batch_trace_device(8, jax.random.key(1), 128)
+        with pytest.raises(ValueError, match="obs dim"):
+            neural_megakernel_rollout_summary(
+                params, cfg.cluster, wrong, traces, b_block=128,
+                interpret=True)
+
+
 @pytest.mark.tpu
 class TestTPUDistributionParity:
     """Mosaic-compiled kernel vs lax path: batch-mean parity on every
@@ -168,3 +344,46 @@ class TestTPUDistributionParity:
         sl = _lax_summary(cfg, params, traces, stochastic=stochastic)
         bad = mean_parity_violations(sk, sl)   # the shared tolerance table
         assert not bad, f"distribution parity broken: {bad}"
+
+    @pytest.mark.parametrize("stochastic", [False, True])
+    def test_neural_batch_mean_parity(self, cfg, setup, accel, stochastic):
+        """Mosaic-compiled mlp kernel vs the real flax PPOBackend on the
+        lax path — the learned-policy variant of the pinned contract
+        (fleet-shape diagnostics get the documented bf16-feedback
+        latitude; every scoreboard field stays on the shared table)."""
+        from ccka_tpu.sim.megakernel import NEURAL_MEAN_PARITY_TOLERANCES
+        from ccka_tpu.train.ppo import PPOBackend
+
+        params, src, _, _ = setup
+        net_params = _perturbed_net_params(cfg)
+        traces = src.batch_trace_device(960, jax.random.key(13), 2048)
+        sk = neural_megakernel_rollout_summary(
+            params, cfg.cluster, net_params, traces, seed=5,
+            stochastic=stochastic)
+        b = 2048
+        states = jax.tree.map(lambda x: jnp.broadcast_to(x, (b,) + x.shape),
+                              initial_state(cfg))
+        keys = jax.random.split(jax.random.key(0), b)
+        _, sl = batched_rollout_summary(
+            params, states, PPOBackend(cfg, net_params).action_fn(),
+            traces, keys, stochastic=stochastic)
+        bad = mean_parity_violations(sk, sl,
+                                     NEURAL_MEAN_PARITY_TOLERANCES)
+        assert not bad, f"neural distribution parity broken: {bad}"
+
+    def test_carbon_batch_mean_parity(self, cfg, setup, accel):
+        from ccka_tpu.policy import CarbonAwarePolicy
+
+        params, src, off, peak = setup
+        traces = src.batch_trace_device(960, jax.random.key(17), 2048)
+        sk = carbon_megakernel_rollout_summary(
+            params, off, peak, traces, seed=5, stochastic=True)
+        b = 2048
+        states = jax.tree.map(lambda x: jnp.broadcast_to(x, (b,) + x.shape),
+                              initial_state(cfg))
+        keys = jax.random.split(jax.random.key(0), b)
+        _, sl = batched_rollout_summary(
+            params, states, CarbonAwarePolicy(cfg.cluster).action_fn(),
+            traces, keys, stochastic=True)
+        bad = mean_parity_violations(sk, sl)
+        assert not bad, f"carbon distribution parity broken: {bad}"
