@@ -89,6 +89,7 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
                    init_from: str = "scratch",
                    distill_iterations: int = 2000,
                    refine: str = "ppo",
+                   cem_engine: str = "auto",
                    log: Callable[[str], None] | None = None) -> dict:
     """Train + select. Returns {params, meta, history}; ``meta`` carries the
     selection-trace scoreboard of the returned checkpoint.
@@ -214,11 +215,33 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
     if refine == "cem":
         if teacher_res is None:
             raise ValueError("refine='cem' requires init_from=distill:<t>")
+        from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy
         from ccka_tpu.train.cem import CEMConfig, cem_refine
         # Teacher-paired fitness: each generation measures the teacher on
         # its own traces, so the bars are min(rule, teacher) per axis per
         # trace — fitness < 1 means the candidate clears the FULL tier-2
         # criterion on those traces.
+        #
+        # Engine: the Pallas population kernel when the topology allows
+        # (device-synthesized traces + a rule/carbon teacher — both true
+        # for every flagship run to date). ~100x cheaper rollouts buy
+        # 64x more traces per generation: fitness se drops ~8x, so a
+        # real sub-percent edge stops drowning in generation noise
+        # (VERDICT r4 next #1/#2).
+        if cem_engine not in ("auto", "mega", "lax"):
+            raise ValueError(f"unknown cem_engine {cem_engine!r}")
+        use_mega = (cem_engine != "lax"
+                    and jax.default_backend() == "tpu"
+                    and hasattr(src, "batch_trace_device")
+                    and isinstance(teacher_backend,
+                                   (CarbonAwarePolicy, RulePolicy)))
+        if cem_engine == "mega" and not use_mega:
+            raise ValueError("cem_engine='mega' needs a TPU backend, a "
+                             "device-trace source and a rule/carbon "
+                             "teacher")
+        traces_per_gen = 256 if use_mega else CEMConfig().traces_per_gen
+        log(f"cem engine: {'mega' if use_mega else 'lax'} "
+            f"({traces_per_gen} traces/gen)")
         gens_per_eval = max(5, eval_every // 5)
         done = 0
         params_cur = ts.params
@@ -229,8 +252,12 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
             # reset would oscillate the search width forever.
             params_cur, _cem_hist, info = cem_refine(
                 cfg, params_cur, src,
-                cem=CEMConfig(generations=n, sigma0=sigma),
-                teacher_fn=teacher_backend.action_fn(),
+                cem=CEMConfig(generations=n, sigma0=sigma,
+                              traces_per_gen=traces_per_gen),
+                engine="mega" if use_mega else "lax",
+                teacher_policy=teacher_backend if use_mega else None,
+                teacher_fn=(None if use_mega
+                            else teacher_backend.action_fn()),
                 seed=seed + 31 * done,
                 log=lambda s: log("  cem " + s))
             sigma = info["final_sigma"]
@@ -266,6 +293,8 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
     meta = {
         "iterations_total": iterations,
         "refine": refine,
+        "cem_engine": (("mega" if use_mega else "lax")
+                       if refine == "cem" else None),
         "init_from": init_from,
         "selected_iteration": best["iteration"],
         "wins_both": bool(best["wins"]),
@@ -366,6 +395,11 @@ def main(argv=None) -> int:
                     help="refinement loop: PPO surrogate or CEM episodic "
                          "direct search (train/cem.py; needs a distilled "
                          "init; --iterations counts generations)")
+    ap.add_argument("--cem-engine", default="auto",
+                    choices=("auto", "mega", "lax"),
+                    help="CEM rollout engine: the Pallas population "
+                         "megakernel (256 traces/gen) or the round-4 lax "
+                         "path; auto picks mega when supported")
     ap.add_argument("--out", default="",
                     help="checkpoint path (default: the package's "
                          "topology-keyed flagship location, where "
@@ -386,7 +420,8 @@ def main(argv=None) -> int:
                          eval_every=args.eval_every,
                          eval_steps=args.eval_steps,
                          n_eval_traces=args.traces, seed=args.seed,
-                         init_from=args.init_from, refine=args.refine)
+                         init_from=args.init_from, refine=args.refine,
+                         cem_engine=args.cem_engine)
     out["meta"]["preset"] = args.preset
     # Default to the loader's own path — a CWD-relative default would ship
     # checkpoints to wherever the trainer happened to run while

@@ -381,6 +381,35 @@ class TestRefinementMechanics:
         # The refined pytree has the net's structure.
         assert "actor_mean" in best["params"]
 
+    @pytest.mark.slow
+    def test_cem_mega_engine_matches_contract(self, cfg, source):
+        """The kernel-backed generation: candidates, rule and the carbon
+        teacher all scored by the megakernel in one paired launch
+        (interpret mode on the CPU lane). Verifies the engine runs,
+        reports the same history schema, and rejects misuse."""
+        from ccka_tpu.policy import CarbonAwarePolicy
+        from ccka_tpu.train.cem import CEMConfig, cem_refine
+
+        params0 = PPOTrainer(cfg).init_state().params
+        best, hist, info = cem_refine(
+            cfg, params0, source,
+            cem=CEMConfig(generations=1, popsize=3, traces_per_gen=128,
+                          eval_steps=16),
+            engine="mega", mega_interpret=True,
+            teacher_policy=CarbonAwarePolicy(cfg.cluster), seed=3)
+        assert len(hist) == 1
+        assert np.isfinite(hist[0]["incumbent_fitness"])
+        assert "actor_mean" in best["params"]
+
+        with pytest.raises(ValueError, match="teacher_policy"):
+            cem_refine(cfg, params0, source, engine="mega",
+                       teacher_fn=lambda s, e, t: None)
+        with pytest.raises(ValueError, match="multiple of 128"):
+            cem_refine(cfg, params0, source,
+                       cem=CEMConfig(generations=1, traces_per_gen=4,
+                                     eval_steps=16),
+                       engine="mega", mega_interpret=True)
+
     def test_cem_accepts_replay_sources(self, cfg, tmp_path):
         """Replay sources (no batch_trace_device) feed the ES through
         the coprime-window batch_trace fallback."""
