@@ -22,6 +22,33 @@ from ccka_tpu.actuation.sink import ActuationSink, ApplyResult
 
 EXCLUDED_NAMESPACES = ("karpenter", "kyverno", "kube-system")  # 04:66-69
 
+# The hardened pod/container conventions every workload this framework
+# renders must satisfy — its OWN guardrails above plus the reference's
+# non-root discipline (`06_opencost.sh:227-236`). ONE definition shared
+# by the dashboard and metrics-pipeline renderers so a future tightening
+# (e.g. readOnlyRootFilesystem) cannot drift between stacks.
+HARDENED_CONTAINER_SECURITY_CONTEXT = {
+    "allowPrivilegeEscalation": False,
+    "capabilities": {"drop": ["ALL"]},
+}
+
+
+def hardened_pod_security_context(uid: int = 65534,
+                                  gid: int | None = None,
+                                  fs_group: int | None = None) -> dict:
+    """Non-root pod securityContext (uid defaults to nobody; images with
+    a baked-in user — Grafana's 472 — pass theirs)."""
+    ctx: dict = {
+        "runAsNonRoot": True,
+        "runAsUser": uid,
+        "seccompProfile": {"type": "RuntimeDefault"},
+    }
+    if gid is not None:
+        ctx["runAsGroup"] = gid
+    if fs_group is not None:
+        ctx["fsGroup"] = fs_group
+    return ctx
+
 
 def render_require_requests_limits() -> dict:
     """`require-requests-limits` (`04_kyverno.sh:24-42`): all containers

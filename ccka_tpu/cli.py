@@ -232,6 +232,30 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="render only the ConfigMaps (for a Grafana that "
                          "already exists, e.g. kube-prometheus-stack's)")
 
+    sm = sub.add_parser(
+        "pipeline", help="render/apply the metrics-pipeline deploy stage "
+                         "(06_opencost.sh:204-387 analog): collector "
+                         "RBAC/ConfigMap/Deployment scraping the "
+                         "controller's ccka_* exposition + KSM into a "
+                         "Prometheus remote-write endpoint, optional "
+                         "SigV4 auth + query proxy")
+    sm.add_argument("--remote-write-url", default="",
+                    help="prometheusremotewrite endpoint (default: "
+                         "derived from signals.prometheus_url + "
+                         "/api/v1/write)")
+    sm.add_argument("--region", default="",
+                    help="enable SigV4 auth for this AWS region (AMP)")
+    sm.add_argument("--writer-role-arn", default="",
+                    help="IRSA role annotation for the collector SA")
+    sm.add_argument("--query-role-arn", default="",
+                    help="IRSA role annotation for the query-proxy SA")
+    sm.add_argument("--proxy", action="store_true",
+                    help="also render the SigV4 query proxy "
+                         "Deployment/Service (requires --region)")
+    sm.add_argument("--live", action="store_true")
+    sm.add_argument("--json", action="store_true",
+                    help="print the manifests instead of applying")
+
     sub.add_parser("show-config", help="print the resolved config")
     return p
 
@@ -771,6 +795,31 @@ def main(argv: list[str] | None = None) -> int:
                       file=sys.stderr)
             return _apply_docs(docs, args.live, "dashboard stack",
                                sink=sink)
+        if args.command == "pipeline":
+            from ccka_tpu.harness.pipeline import render_metrics_pipeline
+            if args.query_role_arn and not args.proxy:
+                # The query role only lands on the proxy's SA — silently
+                # dropping it would leave the operator believing
+                # query-side IRSA was deployed.
+                raise SystemExit("ccka: --query-role-arn has no effect "
+                                 "without --proxy")
+            rw_url = args.remote_write_url or (
+                cfg.signals.prometheus_url.rstrip("/")
+                # AMP serves remote-write at /api/v1/remote_write; plain
+                # Prometheus at /api/v1/write.
+                + ("/api/v1/remote_write" if args.region
+                   else "/api/v1/write"))
+            try:
+                docs = render_metrics_pipeline(
+                    rw_url, cfg.workload.namespace, region=args.region,
+                    writer_role_arn=args.writer_role_arn,
+                    query_role_arn=args.query_role_arn, proxy=args.proxy)
+            except ValueError as e:
+                raise SystemExit(f"ccka: {e}")
+            if args.json:
+                print(json.dumps(docs, indent=2))
+                return 0
+            return _apply_docs(docs, args.live, "metrics pipeline")
         if args.command == "report":
             from ccka_tpu.harness.telemetry import (read_telemetry,
                                                     summarize_telemetry)

@@ -23,6 +23,11 @@ from __future__ import annotations
 
 import json
 
+from ccka_tpu.actuation.guardrails import (
+    HARDENED_CONTAINER_SECURITY_CONTEXT,
+    hardened_pod_security_context,
+)
+
 _PANEL_DEFS = (
     # (title, expr, unit) — expr uses the controller's exported series
     # names, served by `harness.promexport` (`ccka run --metrics-port` /
@@ -169,11 +174,10 @@ def render_grafana_deployment(namespace: str = "nov-22") -> list[dict]:
             "template": {
                 "metadata": {"labels": {"app": "ccka-grafana"}},
                 "spec": {
-                    "securityContext": {
-                        "runAsNonRoot": True,
-                        "runAsUser": 472,  # grafana image uid
-                        "seccompProfile": {"type": "RuntimeDefault"},
-                    },
+                    # Shared hardening (actuation/guardrails.py) with the
+                    # grafana image's baked-in uid.
+                    "securityContext": hardened_pod_security_context(
+                        uid=472),
                     "containers": [{
                         "name": "grafana",
                         "image": GRAFANA_IMAGE,
@@ -187,10 +191,8 @@ def render_grafana_deployment(namespace: str = "nov-22") -> list[dict]:
                             "requests": {"cpu": "100m", "memory": "128Mi"},
                             "limits": {"cpu": "500m", "memory": "256Mi"},
                         },
-                        "securityContext": {
-                            "allowPrivilegeEscalation": False,
-                            "capabilities": {"drop": ["ALL"]},
-                        },
+                        "securityContext": dict(
+                            HARDENED_CONTAINER_SECURITY_CONTEXT),
                         "readinessProbe": {
                             "httpGet": {"path": "/login", "port": 3000},
                             "initialDelaySeconds": 5, "periodSeconds": 5},

@@ -215,7 +215,7 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
     if refine == "cem":
         if teacher_res is None:
             raise ValueError("refine='cem' requires init_from=distill:<t>")
-        from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy
+        from ccka_tpu.policy import CarbonAwarePolicy
         from ccka_tpu.train.cem import CEMConfig, cem_refine
         # Teacher-paired fitness: each generation measures the teacher on
         # its own traces, so the bars are min(rule, teacher) per axis per

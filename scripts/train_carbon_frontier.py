@@ -1,0 +1,203 @@
+"""Claim the carbon-frontier point (VERDICT r4 next #4).
+
+Round 4's multiregion flagship beats the rule on both headlines but not
+its own carbon teacher's carbon (0.787x vs 0.759x): the tier-2 fitness
+(`max` over min(rule, teacher) bars) settles where the WORSE ratio is
+best, which parks candidates at the cost edge of the frontier. This
+driver applies direct frontier pressure instead — CEM fitness with
+asymmetric bars (`CEMConfig.usd_bar="rule"`, `co2_bar="teacher"`,
+`attain_bar="rule"`): fitness < 1 means carbon STRICTLY below the
+carbon-greedy teacher at rule-level cost and attainment, i.e. a point
+the round-4 run left unclaimed (`ARCHITECTURE.md` §5 residual).
+
+Selection is carbon-first lexicographic on held-out selection traces
+(seed block 20k, disjoint from training and bench): among candidates
+with cost <= rule and attainment >= rule - eps, minimize carbon. The
+checkpoint ships to `ccka_tpu/checkpoints/ppo_flagship_multiregion_
+frontier.npz` ONLY if the selected candidate's carbon beats the
+teacher's on the selection traces; otherwise the result lands in runs/
+with the shortfall recorded — no stand-ins under flagship names
+(round-3 rule).
+
+Run: ``python scripts/train_carbon_frontier.py --generations 400``
+(TPU required — the CEM mega engine carries the search).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from ccka_tpu.config import multi_region_config  # noqa: E402
+from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy  # noqa: E402
+from ccka_tpu.signals.synthetic import SyntheticSignalSource  # noqa: E402
+from ccka_tpu.train.cem import CEMConfig, cem_refine  # noqa: E402
+from ccka_tpu.train.checkpoint import save_params_npz  # noqa: E402
+from ccka_tpu.train.evaluate import evaluate_backend, heldout_traces  # noqa: E402
+from ccka_tpu.train.flagship import (_ATTAIN_EPS,  # noqa: E402
+                                     _SELECTION_SEED0,
+                                     flagship_checkpoint_path)
+from ccka_tpu.train.imitate import distill_teacher  # noqa: E402
+from ccka_tpu.train.ppo import PPOBackend  # noqa: E402
+
+
+def log(s: str) -> None:
+    print(s, file=sys.stderr, flush=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--generations", type=int, default=400)
+    ap.add_argument("--eval-every", type=int, default=40)
+    ap.add_argument("--traces-per-gen", type=int, default=256)
+    ap.add_argument("--eval-steps", type=int, default=2880)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--distill-iterations", type=int, default=2000)
+    ap.add_argument("--out", default="",
+                    help="override output path (default: ship to the "
+                         "frontier variant location iff the frontier "
+                         "bar is met, else runs/)")
+    args = ap.parse_args(argv)
+
+    cfg = multi_region_config()
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    teacher = CarbonAwarePolicy(cfg.cluster)
+
+    sel_traces = heldout_traces(src, steps=args.eval_steps, n=5,
+                                seed0=_SELECTION_SEED0)
+    rule_res = evaluate_backend(cfg, RulePolicy(cfg.cluster), sel_traces)
+    teacher_res = evaluate_backend(cfg, teacher, sel_traces)
+    log(f"rule:    usd {rule_res['usd_per_slo_hour']:.4f} "
+        f"co2 {rule_res['g_co2_per_kreq']:.4f} "
+        f"attain {rule_res['slo_attainment']:.4f}")
+    log(f"teacher: usd x"
+        f"{teacher_res['usd_per_slo_hour'] / rule_res['usd_per_slo_hour']:.3f}"
+        f" co2 x"
+        f"{teacher_res['g_co2_per_kreq'] / rule_res['g_co2_per_kreq']:.3f}"
+        f" attain {teacher_res['slo_attainment']:.4f}")
+
+    log(f"distilling carbon teacher ({args.distill_iterations} iters)...")
+    params_cur, hist = distill_teacher(cfg, "carbon", seed=args.seed,
+                                       iterations=args.distill_iterations)
+    log(f"distilled: actor_mse {hist[-1]['actor_mse']:.4f}")
+
+    def frontier_eval(params):
+        res = evaluate_backend(cfg, PPOBackend(cfg, params), sel_traces)
+        usd = res["usd_per_slo_hour"] / rule_res["usd_per_slo_hour"]
+        co2 = res["g_co2_per_kreq"] / rule_res["g_co2_per_kreq"]
+        co2_vs_teacher = (res["g_co2_per_kreq"]
+                          / teacher_res["g_co2_per_kreq"])
+        feasible = (usd <= 1.0
+                    and res["slo_attainment"]
+                    >= rule_res["slo_attainment"] - _ATTAIN_EPS)
+        return res, {"usd_ratio": usd, "co2_ratio": co2,
+                     "co2_vs_teacher": co2_vs_teacher,
+                     "slo_attainment": res["slo_attainment"],
+                     "feasible": feasible}
+
+    res0, m0 = frontier_eval(params_cur)
+    log(f"init: usd x{m0['usd_ratio']:.3f} co2 x{m0['co2_ratio']:.3f} "
+        f"(vs teacher x{m0['co2_vs_teacher']:.3f}) "
+        f"attain {m0['slo_attainment']:.4f}")
+    # Carbon-first lexicographic: feasible beats infeasible; then lower
+    # carbon wins (cost only matters through feasibility).
+    best = {"params": jax.device_get(params_cur), "metrics": m0,
+            "res": res0, "generation": 0}
+
+    def better(m, b):
+        if m["feasible"] != b["metrics"]["feasible"]:
+            return m["feasible"]
+        return m["co2_ratio"] < b["metrics"]["co2_ratio"]
+
+    history = [dict(m0, generation=0)]
+    sigma = CEMConfig().sigma0
+    done = 0
+    t0 = time.time()
+    while done < args.generations:
+        n = min(args.eval_every, args.generations - done)
+        params_cur, _h, info = cem_refine(
+            cfg, params_cur, src,
+            cem=CEMConfig(generations=n, sigma0=sigma,
+                          traces_per_gen=args.traces_per_gen,
+                          usd_bar="rule", co2_bar="teacher",
+                          attain_bar="rule"),
+            engine="mega", teacher_policy=teacher,
+            seed=args.seed + 31 * done,
+            log=lambda s: log("  cem " + s))
+        sigma = info["final_sigma"]
+        done += n
+        res, m = frontier_eval(params_cur)
+        m["generation"] = done
+        m["cem_fitness"] = info["fitness"]
+        history.append(m)
+        log(f"gen {done:4d}: usd x{m['usd_ratio']:.3f} "
+            f"co2 x{m['co2_ratio']:.3f} "
+            f"(vs teacher x{m['co2_vs_teacher']:.3f}) "
+            f"attain {m['slo_attainment']:.4f} "
+            f"{'FEASIBLE' if m['feasible'] else 'infeasible'} "
+            f"({time.time() - t0:.0f}s)")
+        if better(m, best):
+            best = {"params": jax.device_get(params_cur), "metrics": m,
+                    "res": res, "generation": done}
+            log("  ^ new best")
+
+    bm = best["metrics"]
+    claimed = bool(bm["feasible"] and bm["co2_vs_teacher"] < 1.0)
+    meta = {
+        "family": "multiregion_frontier",
+        "fitness": {"usd_bar": "rule", "co2_bar": "teacher",
+                    "attain_bar": "rule"},
+        "cem_engine": "mega",
+        "generations_total": args.generations,
+        "traces_per_gen": args.traces_per_gen,
+        "selected_iteration": best["generation"],
+        "init_from": "distill:carbon",
+        "refine": "cem",
+        "seed": args.seed,
+        "selection_seed0": _SELECTION_SEED0,
+        "frontier_claimed": claimed,
+        # The full eval-chunk trajectory — the evidence record for an
+        # unclaimed run (and provenance for a claimed one).
+        "history": history,
+        "wins_both": bool(bm["usd_ratio"] <= 1.0
+                          and bm["co2_ratio"] <= 1.0
+                          and bm["feasible"]),
+        "selection_scoreboard": {
+            "rule": {k: float(rule_res[k]) for k in
+                     ("usd_per_slo_hour", "g_co2_per_kreq",
+                      "slo_attainment")},
+            "teacher": {k: float(teacher_res[k]) for k in
+                        ("usd_per_slo_hour", "g_co2_per_kreq",
+                         "slo_attainment")},
+            "ppo": {k: float(best["res"][k]) for k in
+                    ("usd_per_slo_hour", "g_co2_per_kreq",
+                     "slo_attainment")},
+        },
+    }
+    if args.out:
+        out_path = args.out
+    elif claimed:
+        out_path = flagship_checkpoint_path(
+            cfg, variant="multiregion_frontier")
+    else:
+        out_path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "runs",
+            "mr_frontier_unclaimed.npz")
+        log("frontier NOT claimed — checkpoint goes to runs/, not the "
+            "package (no stand-ins under flagship names)")
+    path = save_params_npz(out_path, best["params"], meta=meta)
+    print(json.dumps({"checkpoint": path, **meta}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

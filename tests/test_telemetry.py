@@ -323,6 +323,105 @@ class TestDashboard:
         results = DryRunSink().apply_manifests(docs)
         assert all(r.ok for r in results)
 
+    def test_metrics_pipeline_golden(self):
+        """06_opencost.sh:277-387 analog: collector RBAC + OTel pipeline
+        ConfigMap + hardened Deployment, with the controller's own
+        ccka_* exposition in the scrape pool (the reference never
+        scraped its decision loop)."""
+        from ccka_tpu.actuation import DryRunSink
+        from ccka_tpu.harness.pipeline import render_metrics_pipeline
+
+        docs = render_metrics_pipeline(
+            "https://aps.example/workspaces/ws-1/api/v1/remote_write",
+            "nov-22", region="us-east-2",
+            writer_role_arn="arn:aws:iam::1:role/writer")
+        kinds = [d["kind"] for d in docs]
+        assert kinds == ["ClusterRole", "ClusterRoleBinding",
+                         "ServiceAccount", "ConfigMap", "Deployment"]
+        role = docs[0]
+        assert role["rules"][0]["verbs"] == ["get", "list", "watch"]
+        sa = docs[2]
+        assert sa["metadata"]["annotations"][
+            "eks.amazonaws.com/role-arn"] == "arn:aws:iam::1:role/writer"
+        conf = json.loads(docs[3]["data"]["collector.yaml"])
+        # The OTel pipeline: prometheus receiver → sigv4auth →
+        # prometheusremotewrite (06_opencost.sh:316-341).
+        assert conf["service"]["pipelines"]["metrics"] == {
+            "receivers": ["prometheus"],
+            "exporters": ["prometheusremotewrite"]}
+        assert conf["service"]["extensions"] == ["sigv4auth"]
+        assert conf["extensions"]["sigv4auth"]["region"] == "us-east-2"
+        assert conf["exporters"]["prometheusremotewrite"]["auth"] == {
+            "authenticator": "sigv4auth"}
+        jobs = {s["job_name"]
+                for s in conf["receivers"]["prometheus"]["config"][
+                    "scrape_configs"]}
+        assert jobs == {"ccka-controller", "ksm-static"}
+        assert conf["receivers"]["prometheus"]["config"]["global"][
+            "scrape_interval"] == "30s"
+        # Hardened pod: passes the framework's own Kyverno guardrail.
+        pod = docs[4]["spec"]["template"]["spec"]
+        c = pod["containers"][0]
+        assert c["resources"]["requests"] and c["resources"]["limits"]
+        assert pod["securityContext"]["runAsNonRoot"] is True
+        assert c["securityContext"]["capabilities"] == {"drop": ["ALL"]}
+        assert pod["volumes"][0]["configMap"]["name"] == (
+            "ccka-collector-config")
+        results = DryRunSink().apply_manifests(docs)
+        assert all(r.ok for r in results)
+
+    def test_metrics_pipeline_plain_prometheus(self):
+        """Without a region the same pipeline lands on any Prometheus-
+        compatible endpoint: no sigv4 extension, no auth block."""
+        from ccka_tpu.harness.pipeline import render_metrics_pipeline
+
+        docs = render_metrics_pipeline("http://prom:9090/api/v1/write",
+                                       "nov-22")
+        conf = json.loads(
+            [d for d in docs if d["kind"] == "ConfigMap"][0]
+            ["data"]["collector.yaml"])
+        assert "extensions" not in conf
+        assert "auth" not in conf["exporters"]["prometheusremotewrite"]
+        sa = [d for d in docs if d["kind"] == "ServiceAccount"][0]
+        assert "annotations" not in sa["metadata"]
+
+    def test_query_proxy_golden(self):
+        """06_opencost.sh:204-264 analog: SigV4 proxy SA + Deployment +
+        Service with the reference's args shape."""
+        from ccka_tpu.harness.pipeline import render_metrics_pipeline
+
+        docs = render_metrics_pipeline(
+            "https://aps.example/api/v1/remote_write", "nov-22",
+            region="us-east-2", proxy=True,
+            query_role_arn="arn:aws:iam::1:role/query")
+        proxy_docs = [d for d in docs
+                      if d["metadata"]["name"] == "ccka-query-proxy"]
+        assert [d["kind"] for d in proxy_docs] == [
+            "ServiceAccount", "Deployment", "Service"]
+        dep = proxy_docs[1]
+        args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--name=aps" in args and "--region=us-east-2" in args
+        assert "--host=aps-workspaces.us-east-2.amazonaws.com" in args
+        svc = proxy_docs[2]
+        assert svc["spec"]["ports"][0]["port"] == 8005
+        # Proxy without a region is a config error, not a silent render.
+        with pytest.raises(ValueError, match="region"):
+            render_metrics_pipeline("http://prom/api/v1/write", "nov-22",
+                                    proxy=True)
+
+    def test_cli_pipeline_json(self, capsys):
+        from ccka_tpu.cli import main
+
+        assert main(["pipeline", "--json"]) == 0
+        docs = json.loads(capsys.readouterr().out)
+        assert [d["kind"] for d in docs] == [
+            "ClusterRole", "ClusterRoleBinding", "ServiceAccount",
+            "ConfigMap", "Deployment"]
+        conf = json.loads(docs[3]["data"]["collector.yaml"])
+        # Default remote-write derives from the configured Prometheus.
+        assert conf["exporters"]["prometheusremotewrite"][
+            "endpoint"].endswith("/api/v1/write")
+
     def test_random_admin_password_generated(self):
         from ccka_tpu.harness.dashboard import render_grafana_admin_secret
 
