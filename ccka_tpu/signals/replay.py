@@ -133,6 +133,37 @@ class ReplaySignalSource(SignalSource):
                    for s in seeds]
         return jax.tree.map(lambda *xs: jnp.stack(xs), *windows)
 
+    def batch_trace_device(self, steps: int, key, n: int) -> ExogenousTrace:
+        """[n, T, ...] window batch sampled ON DEVICE: offsets uniform
+        over the stored length, fresh per ``key`` (the mega ES engine's
+        fresh-traces-per-generation contract — `train/cem.py`), windows
+        gathered from the device-resident periodic extension under vmap.
+        Windows may overlap (the store is finite); for ES fitness that
+        is sampling with replacement over the window population, not a
+        collapse — paired candidates still see identical batches."""
+        import jax
+        import jax.numpy as jnp
+
+        stored = self._trace.steps
+        if getattr(self, "_ext_steps", None) != steps:
+            # Tile once per window length; reused across generations.
+            self._ext_dev = jax.tree.map(
+                jnp.asarray, self._trace_at(0, stored + steps))
+            self._ext_steps = steps
+        ext = self._ext_dev
+        offs = (self.offset_steps
+                + jax.random.randint(key, (n,), 0, stored)) % stored
+
+        def window(o):
+            def sl(a):
+                if a.ndim == 2:                              # [T, k]
+                    return jax.lax.dynamic_slice(
+                        a, (o, 0), (steps, a.shape[1]))
+                return jax.lax.dynamic_slice(a, (o,), (steps,))
+            return jax.tree.map(sl, ext)
+
+        return jax.vmap(window)(offs)
+
 
 def trace_from_arrays(arrays: Mapping[str, np.ndarray], dt_s: float,
                       zones: tuple[str, ...]) -> tuple[ExogenousTrace, TraceMeta]:

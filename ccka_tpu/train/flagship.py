@@ -250,10 +250,15 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
             n = min(gens_per_eval, iterations - done)
             # sigma0 continues the previous chunk's annealed scale — a
             # reset would oscillate the search width forever.
+            # Mega engine affords a 2x population (~4s/gen) and a higher
+            # sigma floor: with precise (256-trace) fitness the 1/5-rule
+            # otherwise anneals into a frozen search (round-5 measured).
+            extra = ({"popsize": 64, "sigma_min": 1e-3} if use_mega
+                     else {})
             params_cur, _cem_hist, info = cem_refine(
                 cfg, params_cur, src,
                 cem=CEMConfig(generations=n, sigma0=sigma,
-                              traces_per_gen=traces_per_gen),
+                              traces_per_gen=traces_per_gen, **extra),
                 engine="mega" if use_mega else "lax",
                 teacher_policy=teacher_backend if use_mega else None,
                 teacher_fn=(None if use_mega

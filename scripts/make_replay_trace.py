@@ -48,6 +48,17 @@ OUT = os.path.join(_DATA, "replay_2day.npz")
 TRAIN_SEED = 20260731
 TRAIN_DAYS = 6
 OUT_TRAIN = os.path.join(_DATA, "replay_train_6day.npz")
+# Round-5 long variants (VERDICT r4 next #2): a 5-day eval trace so the
+# replay scoreboard gets >=5 day-scale windows (3 windows of the 2-day
+# trace carried too little power to significance-gate a ~1% effect),
+# and a 9-day training realization (6 train + 3 holdout days -> 5
+# half-day-staggered selection windows).
+EVAL5_SEED = 20260801
+EVAL5_DAYS = 5
+OUT_EVAL5 = os.path.join(_DATA, "replay_5day.npz")
+TRAIN9_SEED = 20260802
+TRAIN9_DAYS = 9
+OUT_TRAIN9 = os.path.join(_DATA, "replay_train_9day.npz")
 
 
 def build_trace(cfg, *, seed: int = SEED,
@@ -132,19 +143,25 @@ def build_trace(cfg, *, seed: int = SEED,
 def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--variant", default="eval", choices=("eval", "train"),
-                    help="eval: the committed scoring trace (seed "
-                         f"{SEED}, {DAYS}d); train: the fine-tuning "
-                         f"realization (seed {TRAIN_SEED}, {TRAIN_DAYS}d; "
-                         "the replay trainer splits it train/selection)")
+    ap.add_argument("--variant", default="eval",
+                    choices=("eval", "train", "eval5", "train9"),
+                    help="eval: the round-4 scoring trace (seed "
+                         f"{SEED}, {DAYS}d); train: the round-4 "
+                         f"fine-tuning realization (seed {TRAIN_SEED}, "
+                         f"{TRAIN_DAYS}d); eval5/train9: the round-5 "
+                         "long variants (distinct seeds — a different "
+                         "day count reshuffles the whole event stream, "
+                         "so these are new realizations, not extensions)")
     args = ap.parse_args(argv)
     cfg = default_config()
-    if args.variant == "train":
-        trace, meta = build_trace(cfg, seed=TRAIN_SEED, days=TRAIN_DAYS)
-        out = OUT_TRAIN
-    else:
-        trace, meta = build_trace(cfg)
-        out = OUT
+    variants = {
+        "eval": (SEED, DAYS, OUT),
+        "train": (TRAIN_SEED, TRAIN_DAYS, OUT_TRAIN),
+        "eval5": (EVAL5_SEED, EVAL5_DAYS, OUT_EVAL5),
+        "train9": (TRAIN9_SEED, TRAIN9_DAYS, OUT_TRAIN9),
+    }
+    seed, days, out = variants[args.variant]
+    trace, meta = build_trace(cfg, seed=seed, days=days)
     save_trace(out, trace, meta)
     print(f"wrote {out}: {trace.steps} steps x {cfg.cluster.n_zones} zones "
           f"({os.path.getsize(out) / 1024:.0f} KiB)")

@@ -556,6 +556,43 @@ class TestReplayBatchWindows:
             np.asarray(batch.carbon_g_kwh[0]),
             np.asarray(src.trace(16).carbon_g_kwh))
 
+    def test_batch_trace_device_windows(self):
+        """On-device window sampling (the mega ES engine's trace feed):
+        [n, T] shapes, every window a contiguous slice of the periodic
+        extension, deterministic per key, fresh per key."""
+        import jax
+        import numpy as np
+
+        src = self._source(steps=256)
+        stored_c = np.asarray(src._trace.carbon_g_kwh)
+        ext = np.concatenate([stored_c, stored_c], axis=0)
+        batch = src.batch_trace_device(32, jax.random.key(3), 8)
+        carbon = np.asarray(batch.carbon_g_kwh)
+        assert carbon.shape[:2] == (8, 32)
+        for w in carbon:
+            # Each window matches the stored trace at SOME offset.
+            assert any(np.array_equal(w, ext[o:o + 32])
+                       for o in range(256)), "window not a stored slice"
+        again = np.asarray(
+            src.batch_trace_device(32, jax.random.key(3), 8).carbon_g_kwh)
+        np.testing.assert_array_equal(carbon, again)
+        other = np.asarray(
+            src.batch_trace_device(32, jax.random.key(4), 8).carbon_g_kwh)
+        assert not np.array_equal(carbon, other)
+
+    def test_batch_trace_device_respects_offset(self):
+        import jax
+        import numpy as np
+
+        src = self._source(steps=64)
+        src.offset_steps = 7
+        b0 = np.asarray(src.batch_trace_device(
+            16, jax.random.key(0), 4).carbon_g_kwh)
+        src.offset_steps = 0
+        b1 = np.asarray(src.batch_trace_device(
+            16, jax.random.key(0), 4).carbon_g_kwh)
+        assert not np.array_equal(b0, b1)
+
     def test_ppo_trains_on_replayed_traces(self):
         """Config #3 end to end: PPO over a replayed-trace batch runs and
         produces finite diagnostics (device_traces is ignored — replay
