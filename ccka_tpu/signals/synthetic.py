@@ -207,6 +207,104 @@ class SyntheticSignalSource(SignalSource):
             self._device_fns[(steps, batch, sharding)] = fn
         return fn(key)
 
+    def packed_trace_device(self, steps: int, key, batch: int,
+                            *, t_chunk: int = 64):
+        """[T_pad, exo_rows(Z), B] feature-first exo stream synthesized
+        DIRECTLY in the megakernel's packed layout (ARCHITECTURE §6
+        lever): no [B, T, ...] trace ever materializes and no transpose
+        runs — the AR(1) scans generate time-major [T, Z, B] and the
+        diurnal assembly broadcasts in place, so the only HBM traffic is
+        one write of the stream the kernel will read. Same generative
+        family and parameters as :meth:`batch_trace_device` (a different
+        RNG stream — statistically identical, not bitwise; use one or
+        the other within an experiment). Feed the result to
+        `sim.megakernel.megakernel_summary_from_packed`.
+        """
+        import jax
+
+        cache_key = ("packed", steps, batch, t_chunk)
+        fn = self._device_fns.get(cache_key)
+        if fn is None:
+            import math as _math
+
+            z = self.cluster.n_zones
+            t_pad = _math.ceil(steps / t_chunk) * t_chunk
+
+            def generate(k):
+                ks, kc, kd = jax.random.split(k, 3)
+                noise = (
+                    _ar1_device(ks, (steps, z, batch), rho=0.97,
+                                sigma=0.04, axis=0),
+                    _ar1_device(kc, (steps, z, batch), rho=0.95,
+                                sigma=0.03, axis=0),
+                    _ar1_device(kd, (steps, batch), rho=0.9, sigma=0.5,
+                                axis=0),
+                )
+                return self._assemble_packed(steps, t_pad, noise)
+
+            fn = jax.jit(generate)
+            self._device_fns[cache_key] = fn
+        return fn(key)
+
+    def _assemble_packed(self, steps: int, t_pad: int, noise: tuple):
+        """The `_assemble` formulas in time-major packed form: noise
+        [T, Z, B]/[T, B] → [T_pad, exo_rows(Z), B] with the row order
+        `sim.megakernel._pack_exo` defines (spot, od, carbon, demand,
+        is_peak; zero padding). `tests/test_megakernel.py` pins this
+        against `_assemble` on identical noise so the two layouts cannot
+        drift."""
+        import jax.numpy as jnp
+
+        xp = jnp
+        spot_noise, carbon_noise, demand_noise = noise
+        B = demand_noise.shape[-1]
+        dt = self.sim.dt_s
+        t = self.start_unix_s + np.arange(steps) * dt           # [T]
+        tod = xp.asarray((t % _DAY_S) / _DAY_S, dtype=xp.float32)
+        tod_zb = tod[:, None, None]                              # [T,1,1]
+        nt = self.cluster.node_type
+        zp = {k: xp.asarray(v)[None, :, None] for k, v in self._zp.items()}
+
+        diurnal = 1.0 + 0.35 * xp.sin(
+            2 * np.pi * (tod_zb - 0.25 + zp["spot_phase"]))      # [T,Z,1]
+        spot = (nt.spot_price_hr_mean * zp["spot_scale"] * diurnal
+                * (1.0 + spot_noise))                            # [T,Z,B]
+        od_z = xp.float32(nt.od_price_hr) * zp["od_scale"]       # [1,Z,1]
+        spot = xp.clip(spot, 0.2 * od_z, 0.95 * od_z)
+        od = xp.broadcast_to(od_z, spot.shape)
+
+        base = zp["carbon_base"]
+        solar = zp["solar_frac"] * base * _bump(
+            tod_zb + zp["solar_phase"], center=13.5 / 24,
+            width=3.5 / 24, xp=xp)
+        evening = 0.25 * base * _bump(
+            tod_zb + zp["evening_phase"], center=19.5 / 24,
+            width=2.0 / 24, xp=xp)
+        carbon = (base - solar + evening) * zp["carbon_scale"]
+        carbon = xp.clip(carbon * (1.0 + carbon_noise), 20.0, None)
+
+        total = float(self.workload.total_pods)
+        level = total * (0.4 + 0.6 * _bump(tod, center=14.0 / 24,
+                                           width=5.0 / 24, xp=xp))[:, None]
+        level = xp.clip(level * (1.0 + 0.15 * demand_noise),
+                        0.0, 2.0 * total)                        # [T,B]
+        demand = xp.stack([xp.ceil(level / 2.0),
+                           xp.floor(level / 2.0)], axis=1)       # [T,2,B]
+
+        is_peak = ((tod >= 9 / 24) & (tod < 21 / 24)).astype(xp.float32)
+        peak_row = xp.broadcast_to(is_peak[:, None, None],
+                                   (steps, 1, B))
+
+        packed = xp.concatenate(
+            [spot, od, carbon, demand, peak_row], axis=1
+        ).astype(xp.float32)                           # [T, 3Z+3, B]
+        # The kernel's own row-count helper, so a layout change there
+        # cannot silently desynchronize this generator.
+        from ccka_tpu.sim.megakernel import _exo_rows
+        rows_pad = _exo_rows(self.cluster.n_zones)
+        return xp.pad(packed, ((0, t_pad - steps),
+                               (0, rows_pad - packed.shape[1]), (0, 0)))
+
     def _assemble(self, steps: int, noise: tuple, xp=np) -> ExogenousTrace:
         """Deterministic diurnal structure + noise → trace.
 
@@ -312,9 +410,10 @@ def _bump(x, center: float, width: float, xp=np):
     return xp.exp(-0.5 * (d / (width / 2.0)) ** 2)
 
 
-def _ar1_device(key, shape, rho: float, sigma: float):
-    """Stationary AR(1) along the time axis (axis -2 of [..., T, Z] or
-    axis -1 of [..., T]), on device via log-depth `associative_scan`.
+def _ar1_device(key, shape, rho: float, sigma: float, axis: int | None = None):
+    """Stationary AR(1) along the time axis (default: axis -2 of
+    [..., T, Z] or axis -1 of [..., T]; the packed layout passes
+    ``axis=0`` for [T, ...]), on device via log-depth `associative_scan`.
 
     Same recurrence as :func:`_ar1`: ``x_0 ~ N(0,σ)`` then
     ``x_t = ρ·x_{t-1} + √(1-ρ²)·N(0,σ)`` — expressed as the linear map
@@ -325,7 +424,8 @@ def _ar1_device(key, shape, rho: float, sigma: float):
     import jax
     import jax.numpy as jnp
 
-    axis = len(shape) - 2 if len(shape) >= 3 else len(shape) - 1
+    if axis is None:
+        axis = len(shape) - 2 if len(shape) >= 3 else len(shape) - 1
     k0, k1 = jax.random.split(key)
     scale = np.float32(np.sqrt(1.0 - rho * rho))
     x0_shape = shape[:axis] + (1,) + shape[axis + 1:]

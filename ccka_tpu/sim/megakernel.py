@@ -757,18 +757,11 @@ def _obs_dim(P: int, Z: int) -> int:
     return 2 * P * Z + 3 * Z + 8
 
 
-def _pack_mlp_weights(net_params, *, P: int, Z: int, b_block: int):
-    """ActorCritic params pytree (single, or stacked along a leading
-    population axis) → the kernel's weight tensors.
-
-    Returns ``(tensors, dims, NP, was_single)`` where tensors =
-    (w1 [NP,F_pad,H] bf16, b1 [NP,H,b_block] bf16, w2 [NP,H,H] bf16,
-    b2 [NP,H,b_block] bf16, w3 [NP,H,A_pad] f32, b3 [NP,A_pad,b_block]
-    f32). Weights keep flax's natural [in, out] layout — the kernel
-    contracts on dim 0 (W^T @ x) so no transposes are materialized.
-    Biases are replicated across lanes (cheap host-side, once per
-    generation) so the in-kernel add is a plain elementwise op.
-    """
+def _mlp_dims(net_params, *, P: int, Z: int):
+    """Validate an ActorCritic params pytree against the topology and
+    return ``(dims, was_single)`` with dims = (F, F_pad, H, A). Shape
+    reads only — no device work (the tensor build is jitted,
+    `_pack_mlp_tensors`)."""
     pp = net_params["params"]
     extra = sorted(k for k in pp
                    if k.startswith("Dense_") and k not in ("Dense_0",
@@ -778,14 +771,10 @@ def _pack_mlp_weights(net_params, *, P: int, Z: int, b_block: int):
         # policy than the lax PPOBackend runs.
         raise ValueError(f"kernel supports exactly two torso layers; net "
                          f"has extra {extra}")
-    w1 = jnp.asarray(pp["Dense_0"]["kernel"])
+    w1 = pp["Dense_0"]["kernel"]
     was_single = w1.ndim == 2
-    g = (lambda x: jnp.asarray(x)[None]) if was_single else jnp.asarray
-    w1, b1 = g(pp["Dense_0"]["kernel"]), g(pp["Dense_0"]["bias"])
-    w2, b2 = g(pp["Dense_1"]["kernel"]), g(pp["Dense_1"]["bias"])
-    w3, b3 = g(pp["actor_mean"]["kernel"]), g(pp["actor_mean"]["bias"])
-    NP, F, H = w1.shape
-    A = w3.shape[-1]
+    F, H = w1.shape[-2:]
+    A = pp["actor_mean"]["kernel"].shape[-1]
     if F != _obs_dim(P, Z):
         raise ValueError(f"net expects obs dim {F}, topology gives "
                          f"{_obs_dim(P, Z)}")
@@ -794,12 +783,30 @@ def _pack_mlp_weights(net_params, *, P: int, Z: int, b_block: int):
                          f"{_act_rows(P, Z)}")
     F_pad = math.ceil(F / 16) * 16       # bf16 sublane multiple
     A_pad = math.ceil(A / 8) * 8         # f32 sublane multiple
+    return (F, F_pad, H, A), was_single
+
+
+def _pack_mlp_tensors(net_params, dims, b_block: int):
+    """Stacked ActorCritic params → the kernel's weight tensors:
+    (w1 [NP,F_pad,H] bf16, b1 [NP,H,b_block] bf16, w2 [NP,H,H] bf16,
+    b2 [NP,H,b_block] bf16, w3 [NP,H,A_pad] f32, b3 [NP,A_pad,b_block]
+    f32). Weights keep flax's natural [in, out] layout — the kernel
+    contracts on dim 0 (W^T @ x) so no transposes are materialized;
+    biases replicate across lanes so the in-kernel add is elementwise.
+    Pure jnp (runs inside the fused jit)."""
+    F, F_pad, H, A = dims
+    pp = net_params["params"]
+    w1, b1 = pp["Dense_0"]["kernel"], pp["Dense_0"]["bias"]
+    w2, b2 = pp["Dense_1"]["kernel"], pp["Dense_1"]["bias"]
+    w3, b3 = pp["actor_mean"]["kernel"], pp["actor_mean"]["bias"]
+    NP = w1.shape[0]
+    A_pad = math.ceil(A / 8) * 8
 
     def rep(b, rows, dtype):             # [NP, rows] -> [NP, rows, b_block]
         return jnp.broadcast_to(b.astype(dtype)[:, :, None],
                                 (NP, rows, b_block))
 
-    tensors = (
+    return (
         jnp.pad(w1, ((0, 0), (0, F_pad - F), (0, 0))).astype(jnp.bfloat16),
         rep(b1, H, jnp.bfloat16),
         w2.astype(jnp.bfloat16),
@@ -807,7 +814,6 @@ def _pack_mlp_weights(net_params, *, P: int, Z: int, b_block: int):
         jnp.pad(w3, ((0, 0), (0, 0), (0, A_pad - A))).astype(jnp.float32),
         rep(jnp.pad(b3, ((0, 0), (0, A_pad - A))), A_pad, jnp.float32),
     )
-    return tensors, (F, F_pad, H, A), NP, was_single
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -881,15 +887,29 @@ def megakernel_rollout_summary(params: SimParams,
     Z = int(off_action.zone_weight.shape[1])
     K = int(params.provision_pipeline_k)
 
+    return _fused_profile_summary(
+        params, off_action, peak_action, traces, jnp.int32(seed),
+        T=T, P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
+        t_chunk=t_chunk, interpret=interpret, carbon=None)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "T", "P", "Z", "K", "stochastic", "b_block", "t_chunk", "interpret",
+    "carbon"))
+def _fused_profile_summary(params, off_action, peak_action, traces, seed,
+                           *, T, P, Z, K, stochastic, b_block, t_chunk,
+                           interpret, carbon):
+    """pack → kernel → finalize as ONE jitted program: the eager path
+    paid a tunnel round-trip per pack/finalize op (~17ms of dispatch for
+    a ~11ms kernel at B=32k — measured round 5), which the fusion
+    removes along with the intermediate HBM round trips XLA can now
+    elide. Delegates to the packed-stream path after the exo pack, so
+    the two can never diverge."""
     T_pad = math.ceil(T / t_chunk) * t_chunk
-    exo_packed = _pack_exo(traces, T_pad)
-    meta = _meta(T, stochastic, seed)
-    out = _run(_pack_params(params),
-               jnp.stack([_pack_action(off_action),
-                          _pack_action(peak_action)]),
-               exo_packed, meta, P=P, Z=Z, K=K, stochastic=stochastic,
-               b_block=b_block, t_chunk=t_chunk, interpret=interpret)
-    return _finalize(params, out, T)
+    return _fused_packed_summary(
+        params, off_action, peak_action, _pack_exo(traces, T_pad), seed,
+        T=T, P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
+        t_chunk=t_chunk, interpret=interpret, carbon=carbon)
 
 
 def _meta(T: int, stochastic: bool, seed) -> jnp.ndarray:
@@ -947,16 +967,11 @@ def carbon_megakernel_rollout_summary(params: SimParams,
     P = int(off_action.zone_weight.shape[0])
     Z = int(off_action.zone_weight.shape[1])
     K = int(params.provision_pipeline_k)
-    T_pad = math.ceil(T / t_chunk) * t_chunk
-    out = _run(_pack_params(params),
-               jnp.stack([_pack_action(off_action),
-                          _pack_action(peak_action)]),
-               _pack_exo(traces, T_pad), _meta(T, stochastic, seed),
-               P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
-               t_chunk=t_chunk, interpret=interpret,
-               carbon=(float(sharpness), float(min_weight),
-                       float(stickiness)))
-    return _finalize(params, out, T)
+    return _fused_profile_summary(
+        params, off_action, peak_action, traces, jnp.int32(seed),
+        T=T, P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
+        t_chunk=t_chunk, interpret=interpret,
+        carbon=(float(sharpness), float(min_weight), float(stickiness)))
 
 
 def neural_megakernel_rollout_summary(params: SimParams,
@@ -996,18 +1011,104 @@ def neural_megakernel_rollout_summary(params: SimParams,
         raise ValueError(f"megakernel needs B % {b_block} == 0, got {B}")
     P, Z = cluster.n_pools, cluster.n_zones
     K = int(params.provision_pipeline_k)
-    weights, dims, NP, was_single = _pack_mlp_weights(
-        net_params, P=P, Z=Z, b_block=b_block)
+    dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
+    if was_single:
+        net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                  net_params)
     slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
+    summary = _fused_neural_summary(
+        params, net_params, traces, jnp.int32(seed), T=T, P=P, Z=Z, K=K,
+        stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+        slo_mask=slo, mlp_dims=dims, interpret=interpret)
+    if was_single:
+        summary = jax.tree.map(lambda x: x[0], summary)
+    return summary
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "T", "P", "Z", "K", "stochastic", "b_block", "t_chunk", "interpret",
+    "slo_mask", "mlp_dims"))
+def _fused_neural_summary(params, net_params, traces, seed, *, T, P, Z,
+                          K, stochastic, b_block, t_chunk, slo_mask,
+                          mlp_dims, interpret):
+    """Weight pack → exo pack → population kernel → finalize, one jitted
+    program (same dispatch-fusion rationale as
+    `_fused_profile_summary`)."""
+    weights = _pack_mlp_tensors(net_params, mlp_dims, b_block)
     T_pad = math.ceil(T / t_chunk) * t_chunk
     out = _run_mlp(_pack_params(params), weights, _pack_exo(traces, T_pad),
                    _meta(T, stochastic, seed), P=P, Z=Z, K=K,
                    stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
-                   slo_mask=slo, mlp_dims=dims, interpret=interpret)
-    summary = jax.vmap(lambda o: _finalize(params, o, T))(out)
-    if was_single:
-        summary = jax.tree.map(lambda x: x[0], summary)
-    return summary
+                   slo_mask=slo_mask, mlp_dims=mlp_dims,
+                   interpret=interpret)
+    return jax.vmap(lambda o: _finalize(params, o, T))(out)
+
+
+def megakernel_summary_from_packed(params: SimParams,
+                                   off_action: Action,
+                                   peak_action: Action,
+                                   exo_packed: jnp.ndarray,
+                                   T: int,
+                                   seed: int | jnp.ndarray = 0,
+                                   *,
+                                   stochastic: bool = True,
+                                   b_block: int = 512,
+                                   t_chunk: int = 64,
+                                   interpret: bool = False):
+    """Rule-profile EpisodeSummary from an ALREADY-PACKED
+    ``[T_pad, exo_rows, B]`` stream
+    (`signals.synthetic.packed_trace_device`): the exo pack — the
+    transpose that is most of the kernel's non-essential HBM traffic
+    (ARCHITECTURE §6) — never runs, because the stream was generated in
+    this layout. ``T`` is the true horizon (rows beyond it are padding).
+    """
+    T_pad, _rows, B = exo_packed.shape
+    if B % b_block:
+        raise ValueError(f"megakernel needs B % {b_block} == 0, got {B}")
+    if T_pad % t_chunk or T > T_pad:
+        raise ValueError(f"packed stream T_pad={T_pad} must be a "
+                         f"t_chunk={t_chunk} multiple covering T={T} — "
+                         "generate with the same t_chunk")
+    P = int(off_action.zone_weight.shape[0])
+    Z = int(off_action.zone_weight.shape[1])
+    return _fused_packed_summary(
+        params, off_action, peak_action, exo_packed, jnp.int32(seed),
+        T=T, P=P, Z=Z, K=int(params.provision_pipeline_k),
+        stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+        interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "T", "P", "Z", "K", "stochastic", "b_block", "t_chunk", "interpret",
+    "carbon"))
+def _fused_packed_summary(params, off_action, peak_action, exo_packed,
+                          seed, *, T, P, Z, K, stochastic, b_block,
+                          t_chunk, interpret, carbon=None):
+    out = _run(_pack_params(params),
+               jnp.stack([_pack_action(off_action),
+                          _pack_action(peak_action)]),
+               exo_packed, _meta(T, stochastic, seed),
+               P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
+               t_chunk=t_chunk, interpret=interpret, carbon=carbon)
+    return _finalize(params, out, T)
+
+
+def unpack_exo(exo_packed: jnp.ndarray, T: int, Z: int) -> ExogenousTrace:
+    """Inverse of `_pack_exo` — [T_pad, rows, B] → [B, T, ...] traces.
+    Gate/test plumbing only: it pays exactly the transpose the packed
+    path exists to skip, so the hot paths never call it."""
+    x = exo_packed[:T]
+
+    def bt(a):  # [T, k, B] -> [B, T, k]
+        return jnp.transpose(a, (2, 0, 1))
+
+    return ExogenousTrace(
+        spot_price_hr=bt(x[:, 0:Z]),
+        od_price_hr=bt(x[:, Z:2 * Z]),
+        carbon_g_kwh=bt(x[:, 2 * Z:3 * Z]),
+        demand_pods=bt(x[:, 3 * Z:3 * Z + 2]),
+        is_peak=jnp.transpose(x[:, 3 * Z + 2], (1, 0)),
+    )
 
 
 def kernel_numerics_action_fn(net_params, cluster, params_sim: SimParams):

@@ -81,6 +81,13 @@ class CEMConfig(NamedTuple):
     usd_bar: str = "min"
     co2_bar: str = "min"
     attain_bar: str = "max"
+    # Added to the attainment bar: the fitness gives nothing for
+    # attainment ABOVE the bar, so candidates park exactly on it and a
+    # held-out realization can land below (measured on the replay
+    # family: train-window-parked candidates gave back ~1pp of holdout
+    # attainment). A small margin keeps the selected operating point
+    # clear of the bar on fresh data.
+    attain_margin: float = 0.0
     # Anisotropic trust region: scale on the hpa latent coordinates'
     # perturbation (the last C columns of actor_mean). Measured (round
     # 5): the serve-demand operating point hpa=1.0 sits 1% above the
@@ -390,7 +397,8 @@ def cem_refine(cfg: FrameworkConfig, params0, source, *,
                     np.asarray(rule_s.slo_attainment).mean())
             usd_ratio = (usd / rule_usd).mean(axis=1) * usd_scale
             co2_ratio = (co2 / rule_co2).mean(axis=1) * co2_scale
-        shortfall = np.maximum(attain_bar - attain.mean(axis=1), 0.0)
+        shortfall = np.maximum(attain_bar + cem.attain_margin
+                               - attain.mean(axis=1), 0.0)
         fitness = (np.maximum(usd_ratio, co2_ratio)
                    + cem.attain_penalty * shortfall)          # [pop]
 

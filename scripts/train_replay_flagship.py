@@ -96,6 +96,14 @@ def main(argv=None) -> int:
                     help="training windows per ES generation")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--engine", default="mega", choices=("mega", "lax"))
+    ap.add_argument("--attain-margin", type=float, default=0.0,
+                    help="CEMConfig.attain_margin: keep the ES operating "
+                         "point this far ABOVE the attainment bar so "
+                         "holdout realizations don't land below it")
+    ap.add_argument("--usd-bar", default="min",
+                    choices=("min", "rule", "teacher"))
+    ap.add_argument("--co2-bar", default="min",
+                    choices=("min", "rule", "teacher"))
     ap.add_argument("--out", default=OUT)
     args = ap.parse_args(argv)
 
@@ -170,7 +178,10 @@ def main(argv=None) -> int:
                 cem=CEMConfig(generations=n, sigma0=sigma,
                               popsize=args.popsize,
                               traces_per_gen=args.traces,
-                              eval_steps=steps_per_day, **extra),
+                              eval_steps=steps_per_day,
+                              attain_margin=args.attain_margin,
+                              usd_bar=args.usd_bar, co2_bar=args.co2_bar,
+                              **extra),
                 engine=args.engine,
                 teacher_policy=(teacher if args.engine == "mega"
                                 else None),

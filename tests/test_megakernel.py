@@ -320,6 +320,83 @@ class TestNeuralKernelParity:
                 interpret=True)
 
 
+class TestPackedLayoutGeneration:
+    """Traces generated DIRECTLY in the kernel's [T, rows, B] layout
+    (`packed_trace_device`) — no [B, T] materialization, no transpose
+    (ARCHITECTURE §6 lever)."""
+
+    def test_packed_assembly_matches_pack_of_assemble(self, cfg):
+        """Same noise through `_assemble_packed` and through
+        `_assemble` + `_pack_exo` must agree exactly — the two layouts
+        share their formulas by this pin, not by code."""
+        from ccka_tpu.sim.megakernel import _pack_exo
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        T, B, Z = 96, 8, cfg.cluster.n_zones
+        rng = np.random.default_rng(3)
+        # [T, Z, B] noise for the packed path; transposed for the
+        # batch-major assembler.
+        n_spot = rng.standard_normal((T, Z, B)).astype(np.float32) * 0.04
+        n_carb = rng.standard_normal((T, Z, B)).astype(np.float32) * 0.03
+        n_dem = rng.standard_normal((T, B)).astype(np.float32) * 0.5
+        packed = np.asarray(src._assemble_packed(
+            T, 96, (jnp.asarray(n_spot), jnp.asarray(n_carb),
+                    jnp.asarray(n_dem))))
+        trace = src._assemble(
+            T, (np.transpose(n_spot, (2, 0, 1)),
+                np.transpose(n_carb, (2, 0, 1)),
+                np.transpose(n_dem, (1, 0))), xp=np)
+        via_pack = np.asarray(_pack_exo(
+            jax.tree.map(jnp.asarray, trace), 96))
+        np.testing.assert_allclose(packed, via_pack, rtol=1e-6, atol=1e-5)
+
+    def test_packed_kernel_path_matches_unpacked(self, cfg, setup):
+        """`megakernel_summary_from_packed` on a packed stream equals
+        the standard wrapper on its unpacked traces (deterministic,
+        interpret mode)."""
+        from ccka_tpu.sim.megakernel import (megakernel_summary_from_packed,
+                                             unpack_exo)
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        params, _, off, peak = setup
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        T = 64
+        packed = src.packed_trace_device(T, jax.random.key(9), 128,
+                                         t_chunk=32)
+        sk = megakernel_summary_from_packed(
+            params, off, peak, packed, T, stochastic=False, b_block=128,
+            t_chunk=32, interpret=True)
+        traces = unpack_exo(packed, T, cfg.cluster.n_zones)
+        ref = megakernel_rollout_summary(
+            params, off, peak, traces, stochastic=False, b_block=128,
+            t_chunk=32, interpret=True)
+        rel = _field_rel(sk, ref)
+        bad = {f: r for f, r in rel.items() if r > 1e-5}
+        assert not bad, f"packed path diverged: {bad}"
+        # And the unpacked traces drive the lax path to the same place.
+        sl = _lax_summary(cfg, params, traces, stochastic=False)
+        rel = _field_rel(sk, sl)
+        bad = {f: r for f, r in rel.items() if r > 2e-3}
+        assert not bad, f"packed-generated world diverged from lax: {bad}"
+
+    def test_packed_rejects_mismatched_chunking(self, cfg, setup):
+        from ccka_tpu.sim.megakernel import megakernel_summary_from_packed
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        params, _, off, peak = setup
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        packed = src.packed_trace_device(64, jax.random.key(1), 128,
+                                         t_chunk=32)
+        with pytest.raises(ValueError, match="t_chunk"):
+            megakernel_summary_from_packed(params, off, peak, packed, 64,
+                                           b_block=128, t_chunk=48,
+                                           interpret=True)
+
+
 @pytest.mark.tpu
 class TestTPUDistributionParity:
     """Mosaic-compiled kernel vs lax path: batch-mean parity on every
