@@ -1,0 +1,33 @@
+"""Forecasting subsystem: predicted exogenous windows for non-oracle MPC.
+
+See `forecast/base.py` for the protocol, `forecast/backends.py` for the
+persistence / seasonal-naive / ridge-AR backends, and
+`forecast/metrics.py` for horizon-resolved MAPE/RMSE. The oracle
+(perfect-foresight) reference path is spelled ``forecaster=None``
+everywhere a forecaster is accepted.
+"""
+
+from ccka_tpu.forecast.backends import (PersistenceForecaster,
+                                        RidgeARForecaster,
+                                        SeasonalNaiveForecaster,
+                                        fit_ar_coeffs)
+from ccka_tpu.forecast.base import (Forecaster, make_forecaster,
+                                    matrix_to_trace, planning_window,
+                                    trace_to_matrix)
+from ccka_tpu.forecast.metrics import (evaluate_forecaster, forecast_errors,
+                                       gather_windows)
+
+__all__ = [
+    "Forecaster",
+    "PersistenceForecaster",
+    "RidgeARForecaster",
+    "SeasonalNaiveForecaster",
+    "evaluate_forecaster",
+    "fit_ar_coeffs",
+    "forecast_errors",
+    "gather_windows",
+    "make_forecaster",
+    "matrix_to_trace",
+    "planning_window",
+    "trace_to_matrix",
+]

@@ -1,0 +1,147 @@
+"""Forecaster protocol: predicted exogenous windows for non-oracle control.
+
+Every controller-quality number before this subsystem was computed against
+*oracle* futures: ``SignalSource.forecast`` defaults to the true trace slice
+and the receding-horizon planner gathered its windows straight from the
+trace. A deployed autoscaler only ever sees *predictions* of carbon
+intensity, spot price and demand (the ElectricityMaps/OpenCost scrape loop
+measures the present; the future is a model). This module defines the
+seam between the two worlds:
+
+    Forecaster.predict(history, horizon)        -> ExogenousTrace [H, ...]
+    Forecaster.predict_batch(histories, horizon) -> [B, H, ...]
+
+Both forms are pure jnp on static shapes, so a forecaster can live INSIDE
+the jitted receding-horizon loop (`train/mpc.py`): thousands of cluster
+histories forecast in one dispatch, exactly like the rollout batch they
+feed. The oracle path remains available as ``forecaster=None``.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import jax
+import jax.numpy as jnp
+
+from ccka_tpu.signals.base import ExogenousTrace
+
+
+def trace_to_matrix(trace: ExogenousTrace) -> jnp.ndarray:
+    """Flatten a time-major trace into one [T, D] channel matrix.
+
+    Column order: spot (Z), od (Z), carbon (Z), demand (C), is_peak (1).
+    Forecasters model each column independently, so one vmapped fit
+    covers every signal family at once.
+    """
+    return jnp.concatenate([
+        trace.spot_price_hr, trace.od_price_hr, trace.carbon_g_kwh,
+        trace.demand_pods, trace.is_peak[..., None]], axis=-1)
+
+
+def matrix_to_trace(m: jnp.ndarray, n_zones: int, n_classes: int
+                    ) -> ExogenousTrace:
+    """Inverse of :func:`trace_to_matrix` for a [H, D] prediction matrix.
+
+    Physicality clamps applied here, once, for every backend: prices,
+    carbon and demand are non-negative; is_peak lives in [0, 1] (an AR
+    extrapolation of a binary signal is a probability, and the dynamics
+    threshold it at 0.5 anyway).
+    """
+    z, c = n_zones, n_classes
+    m = jnp.maximum(m, 0.0)
+    return ExogenousTrace(
+        spot_price_hr=m[..., :z],
+        od_price_hr=m[..., z:2 * z],
+        carbon_g_kwh=m[..., 2 * z:3 * z],
+        demand_pods=m[..., 3 * z:3 * z + c],
+        is_peak=jnp.minimum(m[..., 3 * z + c], 1.0),
+    )
+
+
+class Forecaster(abc.ABC):
+    """Maps an observed history window to a predicted forward window.
+
+    Implementations are stateless pure-jnp transforms (fit, if any,
+    happens in closed form inside ``predict``), which makes them safe as
+    static arguments to jitted planners: the instance is the cache key,
+    the arrays flow through the trace. Shapes are static per call site
+    (T_hist and H fixed), matching the one-dispatch planning economics
+    of `train/mpc.py`.
+    """
+
+    name: str = "forecaster"
+
+    @abc.abstractmethod
+    def predict(self, history: ExogenousTrace,
+                horizon: int) -> ExogenousTrace:
+        """[T_hist, ...] observed history -> [H, ...] predicted window."""
+
+    def predict_batch(self, histories: ExogenousTrace,
+                      horizon: int) -> ExogenousTrace:
+        """[B, T_hist, ...] -> [B, H, ...]; one dispatch for the fleet."""
+        return jax.vmap(lambda h: self.predict(h, horizon))(histories)
+
+    def wanted_history(self, horizon: int) -> int:
+        """How many trailing observed ticks ``predict`` wants. Callers
+        gather (left-clamped) exactly this many; backends needing
+        seasonal context override."""
+        return max(horizon, 8)
+
+
+def planning_window(forecaster: "Forecaster", history: ExogenousTrace,
+                    horizon: int) -> ExogenousTrace:
+    """The window a receding-horizon planner actually optimizes against:
+    tick 0 is the *observed* current tick (``history``'s last entry — the
+    scrape happens before the decide), ticks 1..H−1 are the forecaster's
+    predictions. Keeps the planner's time base aligned with execution
+    (``window[h]`` IS tick ``now+h``) without ever touching the future:
+    backends predict ticks ``anchor+1..anchor+H−1`` from ticks
+    ``<= anchor`` by construction.
+
+    Pure jnp over static shapes — `jax.vmap` this over a segment batch
+    inside the jitted loop (`train/mpc.py`) or call it directly in the
+    host loop (`harness/controller.py`).
+    """
+    t_hist = history.steps
+    current = history.slice_steps(t_hist - 1, 1)
+    if horizon == 1:
+        return current
+    pred = forecaster.predict(history, horizon - 1)
+
+    def cat(c, p, taxis):
+        return jnp.concatenate([c, p], axis=taxis)
+
+    return ExogenousTrace(
+        spot_price_hr=cat(current.spot_price_hr, pred.spot_price_hr, -2),
+        od_price_hr=cat(current.od_price_hr, pred.od_price_hr, -2),
+        carbon_g_kwh=cat(current.carbon_g_kwh, pred.carbon_g_kwh, -2),
+        demand_pods=cat(current.demand_pods, pred.demand_pods, -2),
+        is_peak=cat(current.is_peak, pred.is_peak, -1),
+    )
+
+
+def make_forecaster(name: str, *, dt_s: float = 30.0,
+                    period_s: float = 86400.0) -> "Forecaster | None":
+    """Factory keyed on the CLI/bench spelling of each backend.
+
+    ``oracle`` (or empty) returns None — the perfect-foresight reference
+    path, kept explicit so scoreboards can sweep it alongside the real
+    forecasters.
+    """
+    from ccka_tpu.forecast.backends import (PersistenceForecaster,
+                                            RidgeARForecaster,
+                                            SeasonalNaiveForecaster)
+
+    key = (name or "oracle").lower().replace("-", "_")
+    if key in ("oracle", "none"):
+        return None
+    if key == "persistence":
+        return PersistenceForecaster()
+    if key in ("seasonal", "seasonal_naive"):
+        return SeasonalNaiveForecaster(
+            period_steps=max(1, int(round(period_s / dt_s))))
+    if key in ("ridge", "ridge_ar", "learned"):
+        return RidgeARForecaster()
+    raise ValueError(f"unknown forecaster {name!r} (expected oracle, "
+                     "persistence, seasonal-naive, or ridge)")

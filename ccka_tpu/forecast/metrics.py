@@ -1,0 +1,119 @@
+"""Forecast-error metrics: per-channel MAPE/RMSE, horizon-resolved.
+
+The oracle-gap story needs two measurements: how wrong each forecaster is
+(this module) and how much controller value that wrongness destroys
+(`bench.py` forecast stage). Errors are resolved along the horizon axis —
+a forecaster that is sharp at h=1 and useless at h=32 is a different
+planning input than one uniformly mediocre, and `mpc_horizon` selection
+should be able to see that.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccka_tpu.forecast.base import Forecaster
+from ccka_tpu.signals.base import ExogenousTrace
+
+_EPS = 1e-6
+
+
+def _nhk(x: jnp.ndarray) -> jnp.ndarray:
+    """[..., H, K] view of a field ([N, H] is_peak gains a K=1 axis)."""
+    return x[..., None] if x.ndim == 2 else x
+
+
+def forecast_errors(pred: ExogenousTrace,
+                    actual: ExogenousTrace) -> dict:
+    """Horizon-resolved error profile over a window batch.
+
+    Inputs are [N, H, ...] trace bundles (N forecast windows). Returns
+    ``{field: {"rmse": [H], "mape": [H]}}`` with both averaged over
+    windows and channel columns — plus horizon-mean scalars under
+    ``overall`` for scoreboard one-liners.
+    """
+    out: dict = {}
+    for field in ExogenousTrace._fields:
+        p = _nhk(jnp.asarray(getattr(pred, field)))
+        a = _nhk(jnp.asarray(getattr(actual, field)))
+        err = p - a
+        rmse = jnp.sqrt(jnp.mean(err ** 2, axis=(0, 2)))          # [H]
+        mape = jnp.mean(jnp.abs(err) / (jnp.abs(a) + _EPS),
+                        axis=(0, 2))                              # [H]
+        out[field] = {"rmse": np.asarray(rmse).tolist(),
+                      "mape": np.asarray(mape).tolist()}
+    out["overall"] = {
+        "mape_mean": float(np.mean([np.mean(v["mape"])
+                                    for k, v in out.items()
+                                    if k != "overall"])),
+        "rmse_mean": float(np.mean([np.mean(v["rmse"])
+                                    for k, v in out.items()
+                                    if k != "overall"])),
+    }
+    return out
+
+
+def gather_windows(trace: ExogenousTrace, anchors, history_steps: int,
+                   horizon: int) -> tuple[ExogenousTrace, ExogenousTrace]:
+    """(histories [N, T_hist, ...], futures [N, H, ...]) at ``anchors``.
+
+    Anchor ``t`` means: history covers ticks [t−T_hist+1, t] (the last
+    observed tick inclusive — the same convention as the planner's
+    history gathers), the future covers [t+1, t+H]. Every anchor must
+    leave both windows fully inside the trace; no clamping here, so the
+    error metrics never score padded data.
+    """
+    anchors = jnp.asarray(anchors, dtype=jnp.int32)
+    steps = trace.steps
+    lo = int(jnp.min(anchors)) if anchors.size else history_steps - 1
+    hi = int(jnp.max(anchors)) if anchors.size else 0
+    if lo < history_steps - 1 or hi + horizon >= steps:
+        raise ValueError(
+            f"anchors must lie in [{history_steps - 1}, "
+            f"{steps - horizon - 1}] for history={history_steps} "
+            f"horizon={horizon} on a {steps}-step trace")
+    hist_idx = anchors[:, None] + jnp.arange(
+        1 - history_steps, 1)[None, :]                    # [N, T_hist]
+    fut_idx = anchors[:, None] + 1 + jnp.arange(horizon)[None, :]
+
+    def gather(idx):
+        return ExogenousTrace(
+            spot_price_hr=trace.spot_price_hr[idx],
+            od_price_hr=trace.od_price_hr[idx],
+            carbon_g_kwh=trace.carbon_g_kwh[idx],
+            demand_pods=trace.demand_pods[idx],
+            is_peak=trace.is_peak[idx],
+        )
+
+    return gather(hist_idx), gather(fut_idx)
+
+
+def evaluate_forecaster(forecaster: Forecaster, trace: ExogenousTrace,
+                        *, horizon: int, history_steps: int | None = None,
+                        stride: int = 32) -> dict:
+    """Sweep a forecaster over every valid window of ``trace``.
+
+    One batched predict per forecaster (``predict_batch`` under jit) —
+    the window sweep costs one dispatch, not one per anchor. Returns the
+    :func:`forecast_errors` profile plus the sweep geometry.
+    """
+    hist = (forecaster.wanted_history(horizon)
+            if history_steps is None else history_steps)
+    first, last = hist - 1, trace.steps - horizon - 1
+    if last < first:
+        raise ValueError(
+            f"trace of {trace.steps} steps too short for "
+            f"history={hist} + horizon={horizon}")
+    anchors = np.arange(first, last + 1, max(stride, 1))
+    histories, futures = gather_windows(trace, anchors, hist, horizon)
+    preds = jax.jit(
+        lambda h: forecaster.predict_batch(h, horizon))(histories)
+    out = forecast_errors(preds, futures)
+    out["forecaster"] = forecaster.name
+    out["horizon"] = int(horizon)
+    out["history_steps"] = int(hist)
+    out["n_windows"] = int(anchors.size)
+    out["stride"] = int(stride)
+    return out

@@ -262,9 +262,20 @@ class Controller:
         self._step = jax.jit(
             lambda s, a, e, k: sim_step(self.params, s, a, e, k,
                                         stochastic=False))
-        # MPC-style backends replan against a forecast window.
+        # MPC-style backends replan against a forecast window. The window
+        # provider is the SAME protocol the jitted evaluation loop uses
+        # (`forecast.Forecaster`): a backend carrying a forecaster plans
+        # against predictions from observed history; without one it falls
+        # back to the source's own forecast (exact future for synthetic/
+        # replay — the oracle reference — persistence-of-anomaly for live).
         self._replan_every = getattr(backend, "replan_every", 0)
         self._horizon = getattr(backend, "horizon", 0)
+        self._forecaster = getattr(backend, "forecaster", None)
+        self._hist_steps = 0
+        if self._forecaster is not None:
+            self._hist_steps = (getattr(backend, "history_steps", 0)
+                                or self._forecaster.wanted_history(
+                                    self._horizon))
 
     # -- spot interruption response -----------------------------------------
 
@@ -400,8 +411,15 @@ class Controller:
         #    synthetic/replay, persistence forecast for live).
         with timer.stage("decide"):
             if self._replan_every and t % self._replan_every == 0:
-                window = self.source.forecast(t, self._horizon,
-                                              seed=self.seed)
+                if self._forecaster is not None:
+                    from ccka_tpu.forecast.base import planning_window
+                    hist = self.source.history(t, self._hist_steps,
+                                               seed=self.seed)
+                    window = planning_window(self._forecaster, hist,
+                                             self._horizon)
+                else:
+                    window = self.source.forecast(t, self._horizon,
+                                                  seed=self.seed)
                 self.backend.replan(self.state, window)
             action = self.backend.decide(self.state, exo, jnp.int32(t))
 

@@ -121,6 +121,45 @@ class SignalSource(abc.ABC):
         return self.trace(t_index + steps, seed=seed).slice_steps(
             t_index, steps)
 
+    def history(self, t_index: int, steps: int, *,
+                seed: int = 0) -> ExogenousTrace:
+        """The trailing ``steps`` *observed* ticks ending at ``t_index``
+        inclusive — the forecaster input window (`ccka_tpu.forecast`).
+
+        Only ticks <= ``t_index`` are ever touched (the current tick is
+        scraped before the decide, so it is observable); early histories
+        left-pad by repeating the first tick, keeping the returned shape
+        static for jitted consumers. Live sources override: their
+        trace() IS backfilled history.
+        """
+        avail = min(steps, t_index + 1)
+        tr = self.trace(t_index + 1, seed=seed).slice_steps(
+            t_index + 1 - avail, avail)
+        pad = steps - avail
+        if not pad:
+            return tr
+
+        def lead(x, taxis):
+            first = jnp.repeat(jnp.take(x, jnp.array([0]), axis=taxis),
+                               pad, axis=taxis)
+            return jnp.concatenate([first, x], axis=taxis)
+
+        return ExogenousTrace(
+            spot_price_hr=lead(as_f32(tr.spot_price_hr), -2),
+            od_price_hr=lead(as_f32(tr.od_price_hr), -2),
+            carbon_g_kwh=lead(as_f32(tr.carbon_g_kwh), -2),
+            demand_pods=lead(as_f32(tr.demand_pods), -2),
+            is_peak=lead(as_f32(tr.is_peak), -1),
+        )
+
+    # Capability flag for on-device trace synthesis (the `--device-traces`
+    # fleet path). True only for sources whose batch_trace_device
+    # *generates* traces on device under an arbitrary sharding (synthetic);
+    # replay's same-named method samples windows from a finite store and
+    # cannot honor sharding — a duck-typed hasattr check conflated the two
+    # (the round-5 tier-1 regression).
+    supports_device_traces = False
+
     def batch_trace(self, steps: int, seeds) -> ExogenousTrace:
         """[B, T, ...] traces for a batch of seeds (default: stack
         per-seed :meth:`trace` calls; synthetic overrides vectorized)."""

@@ -647,6 +647,24 @@ class LiveSignalSource(SignalSource):
             is_peak=base.is_peak,
         )
 
+    def history(self, t_index: int, steps: int, *,
+                seed: int = 0) -> ExogenousTrace:
+        """Forecaster input window (`ccka_tpu.forecast`): ``trace()``
+        already backfills the most recent ``steps`` ticks of measured
+        history, so the base default's slice-of-trace indexing (built for
+        tick-anchored synthetic/replay worlds) is skipped entirely."""
+        del t_index  # live history always ends "now"
+        return self.trace(steps, seed=seed)
+
+    # The live planning default stays in the persistence family
+    # (forecast.PersistenceForecaster is its zero-prior form): od price
+    # below is exactly a last-value hold, demand/carbon hold the measured
+    # *anomaly* against the diurnal prior. Controllers that want the
+    # seasonal-naive or learned backends attach one to the MPC backend
+    # (`MPCBackend(forecaster=...)`) — the controller then routes replans
+    # through it instead of this method.
+    default_forecaster = "persistence"
+
     def forecast(self, t_index: int, steps: int, *,
                  seed: int = 0) -> ExogenousTrace:
         """Forward window for receding-horizon planning: the synthetic

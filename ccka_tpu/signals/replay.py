@@ -133,14 +133,28 @@ class ReplaySignalSource(SignalSource):
                    for s in seeds]
         return jax.tree.map(lambda *xs: jnp.stack(xs), *windows)
 
-    def batch_trace_device(self, steps: int, key, n: int) -> ExogenousTrace:
+    def batch_trace_device(self, steps: int, key, n: int,
+                           *, sharding=None) -> ExogenousTrace:
         """[n, T, ...] window batch sampled ON DEVICE: offsets uniform
         over the stored length, fresh per ``key`` (the mega ES engine's
         fresh-traces-per-generation contract — `train/cem.py`), windows
         gathered from the device-resident periodic extension under vmap.
         Windows may overlap (the store is finite); for ES fitness that
         is sampling with replacement over the window population, not a
-        collapse — paired candidates still see identical batches."""
+        collapse — paired candidates still see identical batches.
+
+        Signature-aligned with the synthetic backend so batch-path
+        callers can pass ``sharding=None`` uniformly; actually honoring
+        a sharding would require resharding a host-resident store, which
+        this backend does not do (``supports_device_traces`` stays
+        False — the `--device-traces` CLI path refuses replay up front).
+        """
+        if sharding is not None:
+            raise SystemExit(
+                "ccka: replay traces are sampled from a host-resident "
+                "store and cannot be synthesized into a device sharding; "
+                "use the synthetic signals backend for sharded "
+                "--device-traces fleets")
         import jax
         import jax.numpy as jnp
 

@@ -166,6 +166,10 @@ class SyntheticSignalSource(SignalSource):
                  rho=0.9, sigma=0.5),
         )
 
+    # Real on-device generation incl. arbitrary output shardings — the
+    # `--device-traces` capability (see SignalSource.supports_device_traces).
+    supports_device_traces = True
+
     def batch_trace_device(self, steps: int, key, batch: int,
                            *, sharding=None) -> ExogenousTrace:
         """[B, T, ...] trace batch synthesized entirely on device.

@@ -112,7 +112,8 @@ def optimize_plan_batch(params: SimParams,
 
 
 @partial(jax.jit, static_argnames=("cluster", "tcfg", "horizon",
-                                   "replan_every", "iters", "stochastic"))
+                                   "replan_every", "iters", "stochastic",
+                                   "forecaster", "history_steps"))
 def receding_horizon_rollout(params: SimParams,
                              cluster: ClusterConfig,
                              tcfg: TrainConfig,
@@ -124,20 +125,30 @@ def receding_horizon_rollout(params: SimParams,
                              horizon: int,
                              replan_every: int,
                              iters: int,
-                             stochastic: bool = True):
+                             stochastic: bool = True,
+                             forecaster=None,
+                             history_steps: int = 0):
     """Closed-loop receding-horizon MPC over a whole trace, in ONE jit.
 
     Outer `lax.scan` over plan segments; each segment re-optimizes the plan
     (the `optimize_plan` fori_loop, warm-started from the carried plan)
-    against an H-step forecast window gathered from the trace, then executes
-    the first ``replan_every`` actions through stochastic dynamics. Replaces
-    the round-1 per-tick host loop (unusable at day-long horizons): the
-    whole evaluation is device-resident, so day-long traces cost one
-    dispatch.
+    against an H-step forecast window, then executes the first
+    ``replan_every`` actions through stochastic dynamics ALWAYS against the
+    true trace. Replaces the round-1 per-tick host loop (unusable at
+    day-long horizons): the whole evaluation is device-resident, so
+    day-long traces cost one dispatch.
 
-    ``trace.steps`` must be a multiple of ``replan_every``. Forecast windows
-    that overrun the trace are clamped to the final tick (persistence
-    forecast at the edge).
+    ``forecaster=None`` is the ORACLE reference: planning windows are the
+    true future slices of the trace (windows overrunning the trace clamp
+    to the final tick — persistence at the edge). With a
+    `forecast.Forecaster`, each segment's planning window is instead
+    *predicted* from the ``history_steps`` ticks observed up to the
+    segment start (left-clamped at tick 0, so no future ever leaks into a
+    prediction) — every segment's forecast runs in one batched
+    ``predict_batch`` dispatch before the scan. Plans are made against
+    beliefs; dynamics bill against reality.
+
+    ``trace.steps`` must be a multiple of ``replan_every``.
     """
     t_steps = trace.steps
     if t_steps % replan_every:
@@ -146,10 +157,31 @@ def receding_horizon_rollout(params: SimParams,
     n_seg = t_steps // replan_every
 
     starts = jnp.arange(n_seg) * replan_every
-    idx = jnp.minimum(starts[:, None] + jnp.arange(horizon)[None, :],
-                      t_steps - 1)                       # [n_seg, H]
-    # Trace leaves are time-leading ([T, Z]/[T, C]/[T]); gather axis 0.
-    windows = jax.tree.map(lambda x: x[idx], exo_steps(trace))  # [n_seg,H,..]
+    if forecaster is None:
+        idx = jnp.minimum(starts[:, None] + jnp.arange(horizon)[None, :],
+                          t_steps - 1)                   # [n_seg, H]
+        # Trace leaves are time-leading ([T,Z]/[T,C]/[T]); gather axis 0.
+        windows = jax.tree.map(lambda x: x[idx],
+                               exo_steps(trace))         # [n_seg, H, ...]
+    else:
+        from ccka_tpu.forecast.base import planning_window
+
+        h_steps = history_steps or forecaster.wanted_history(horizon)
+        # History ends at the segment's first tick (its signals are
+        # scraped before the decide — same observation surface as the
+        # live loop); indices clamp at 0, repeating the first tick
+        # backwards, never forwards.
+        hist_idx = jnp.maximum(
+            starts[:, None] + jnp.arange(1 - h_steps, 1)[None, :],
+            0)                                           # [n_seg, T_hist]
+        hists = ExogenousTrace(*jax.tree.map(
+            lambda x: x[hist_idx], exo_steps(trace)))
+        # window[0] = the observed segment-start tick, window[1:] =
+        # predictions of the H-1 ticks after it — planner and executor
+        # share one time base, still nothing future-dated.
+        predicted = jax.vmap(
+            lambda h: planning_window(forecaster, h, horizon))(hists)
+        windows = exo_steps(predicted)                   # [n_seg, H, ...]
     segs = jax.tree.map(
         lambda x: x.reshape((n_seg, replan_every) + x.shape[1:]),
         exo_steps(trace))                                 # [n_seg, R, ...]
@@ -186,10 +218,20 @@ class MPCBackend(PolicyBackend):
     :meth:`replan` refreshes the plan from the latest state + forecast
     window; :meth:`evaluate` runs the fully-jitted closed loop
     (:func:`receding_horizon_rollout`).
+
+    ``forecaster`` selects what the planner believes about the future:
+    None is the oracle reference (true trace slices — the number every
+    pre-forecast BASELINE row was computed with); a
+    `forecast.Forecaster` makes every planning window a prediction from
+    observed history while execution still bills against the true
+    trace. The live controller reads the same attribute and routes its
+    replan window through the identical protocol
+    (`harness/controller.py`).
     """
 
     def __init__(self, cfg: FrameworkConfig, *, horizon: int | None = None,
-                 iters: int | None = None, replan_every: int = 8):
+                 iters: int | None = None, replan_every: int = 8,
+                 forecaster=None, history_steps: int | None = None):
         self.cfg = cfg
         self.cluster = cfg.cluster
         self.params = SimParams.from_config(cfg)
@@ -197,6 +239,11 @@ class MPCBackend(PolicyBackend):
         self.horizon = horizon or cfg.train.mpc_horizon
         self.iters = iters or cfg.train.mpc_iters
         self.replan_every = replan_every
+        self.forecaster = forecaster
+        self.history_steps = (
+            history_steps if history_steps is not None
+            else (forecaster.wanted_history(self.horizon)
+                  if forecaster is not None else 0))
         # Warm start at the codec ZERO point, not action_to_latent(neutral):
         # the neutral profile has zone_weight/ct_allow exactly 1.0, whose
         # clipped logits (±9.2) saturate the sigmoid — gradients through
@@ -270,7 +317,8 @@ class MPCBackend(PolicyBackend):
         final, metrics = receding_horizon_rollout(
             self.params, self.cluster, self.tcfg, state0, trace, init, key,
             horizon=self.horizon, replan_every=r,
-            iters=self.iters, stochastic=stochastic)
+            iters=self.iters, stochastic=stochastic,
+            forecaster=self.forecaster, history_steps=self.history_steps)
         if pad:
             metrics = jax.tree.map(lambda m: m[:t], metrics)
         return final, metrics

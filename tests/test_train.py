@@ -216,7 +216,13 @@ def test_imitation_distills_teacher(cfg, source):
                                np.asarray(a_t.hpa_scale), atol=0.25)
 
 
+@pytest.mark.slow
 def test_flagship_init_from_distill(cfg):
+    # Slow lane (57s, worst tier-1 offender measured round 6): this is a
+    # composition smoke — distillation itself is pinned by
+    # test_imitation_distills_teacher and checkpoint selection by
+    # test_flagship_checkpoint_path_is_topology_keyed, both in the fast
+    # lane.
     from ccka_tpu.train.flagship import train_flagship
 
     out = train_flagship(cfg, iterations=2, eval_every=2, eval_steps=64,
@@ -319,9 +325,19 @@ class TestRefinementMechanics:
         # Target nobody meets → multiplier grows.
         w_hi, hist_hi = run(0.999)
         assert w_hi > w0
-        # Trivial target → multiplier decays toward the floor.
-        w_lo, _ = run(0.05)
-        assert w_lo < w0
+        # Modest target: the ENDPOINT depends on where the untrained
+        # policy's attainment starts on a given host (it can sit under
+        # even 5% for the first iterations), so pin the mechanism
+        # per-iteration instead — above-target iterations must shrink
+        # the multiplier, below-target ones must grow it.
+        w_lo, hist_lo = run(0.05)
+        ws = [h["violation_weight"] for h in hist_lo] + [w_lo]
+        assert len(ws) == len(hist_lo) + 1
+        for h, w_used, w_next in zip(hist_lo, ws, ws[1:]):
+            if h["attainment"] > 0.05:
+                assert w_next < w_used, h
+            else:
+                assert w_next > w_used, h
         # Diagnostics expose the adaptation.
         assert all("attainment" in h and "violation_weight" in h
                    for h in hist_hi)
@@ -410,9 +426,15 @@ class TestRefinementMechanics:
                                      eval_steps=16),
                        engine="mega", mega_interpret=True)
 
+    @pytest.mark.slow
     def test_cem_accepts_replay_sources(self, cfg, tmp_path):
         """Replay sources (no batch_trace_device) feed the ES through
-        the coprime-window batch_trace fallback."""
+        the coprime-window batch_trace fallback.
+
+        Slow lane (48s measured round 6): the coprime-window sampling is
+        pinned fast in test_signals, the ES loop by
+        test_cem_refine_runs_and_reports — this adds only their
+        composition."""
         from ccka_tpu.signals.base import TraceMeta
         from ccka_tpu.signals.replay import ReplaySignalSource, save_trace
         from ccka_tpu.train.cem import CEMConfig, cem_refine
