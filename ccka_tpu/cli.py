@@ -13,6 +13,8 @@ become thin callers of PolicyBackend.decide()". Subcommand ↔ script map:
               test substrate the reference lacked, SURVEY.md §4)
   forecast-eval — horizon-resolved forecast-quality scoreboard for the
               non-oracle planning backends (ccka_tpu/forecast)
+  obs       — tail/summarize structured training run logs
+              (ccka_tpu/obs/runlog; `ccka obs summarize runs/flagship.jsonl`)
   show-config — resolved FrameworkConfig (replaces `demo_00_env.sh` output)
 
 All mutating commands default to --dry-run (printing kubectl-equivalent
@@ -97,6 +99,10 @@ def _build_parser() -> argparse.ArgumentParser:
     sr.add_argument("--metrics-textfile", default="",
                     help="also write the gauges to this .prom file each "
                          "tick (node-exporter textfile collector)")
+    sr.add_argument("--trace-out", default="",
+                    help="write the session's per-phase tick spans as "
+                         "Chrome trace-event JSON on exit (load in "
+                         "ui.perfetto.dev)")
 
     sp = sub.add_parser("preroll", help="environment assertions (demo_18)")
     sp.add_argument("--live", action="store_true")
@@ -178,6 +184,9 @@ def _build_parser() -> argparse.ArgumentParser:
     st.add_argument("--checkpoint-dir", required=True)
     st.add_argument("--seed", type=int, default=None)
     st.add_argument("--log-every", type=int, default=10)
+    st.add_argument("--runlog", default="",
+                    help="structured JSONL run log (obs/runlog; inspect "
+                         "with `ccka obs tail|summarize`)")
 
     se = sub.add_parser(
         "evaluate", help="scoreboard: backends on held-out traces, with "
@@ -261,6 +270,16 @@ def _build_parser() -> argparse.ArgumentParser:
                        "machine-readable)")
     sp.add_argument("--telemetry", required=True,
                     help="JSONL file written by `ccka run --telemetry`")
+
+    sob = sub.add_parser(
+        "obs", help="inspect structured run logs (obs/runlog JSONL from "
+                    "the training drivers): tail the latest records of a "
+                    "live or finished run, or summarize it")
+    sob.add_argument("action", choices=("tail", "summarize"))
+    sob.add_argument("path", help="run-log JSONL (RunLog output, e.g. "
+                                  "runs/flagship.jsonl)")
+    sob.add_argument("-n", "--lines", type=int, default=10,
+                     help="tail: records to show (default 10)")
 
     sd = sub.add_parser(
         "dashboard", help="render/apply the demo_40 observability stage: "
@@ -456,12 +475,21 @@ def _cmd_run(cfg: FrameworkConfig, backend_name: str, checkpoint: str,
              ticks: int, interval: float | None, live: bool,
              seed: int, hpa: bool = False, keda: bool = False,
              telemetry: str = "", metrics_port: int = -1,
-             metrics_textfile: str = "", forecaster: str = "") -> int:
+             metrics_textfile: str = "", forecaster: str = "",
+             trace_out: str = "") -> int:
     from ccka_tpu.harness.controller import controller_from_config
 
     backend = make_backend(cfg, backend_name, checkpoint,
                            forecaster=forecaster)
     from ccka_tpu.harness.controller import ControllerLockHeld
+    tracer = None
+    if trace_out:
+        from ccka_tpu.obs.trace import SpanTracer
+        # Retention-bounded like the fleet's default: an unbounded
+        # `ccka run --live --trace-out` daemon would leak spans for
+        # weeks before the exit-time export. 100k spans ≈ 4+ days of
+        # 30s ticks — any bounded session exports completely.
+        tracer = SpanTracer(max_spans=100_000)
     exporter = None
     if metrics_port >= 0 or metrics_textfile:
         from ccka_tpu.harness.promexport import MetricsExporter
@@ -478,7 +506,7 @@ def _cmd_run(cfg: FrameworkConfig, backend_name: str, checkpoint: str,
                                       interval_s=interval, seed=seed,
                                       apply_hpa=hpa, apply_keda=keda,
                                       lock=live, telemetry_path=telemetry,
-                                      exporter=exporter)
+                                      exporter=exporter, tracer=tracer)
     except ValueError as e:  # e.g. --keda without the SQS config
         if exporter is not None:
             exporter.close()
@@ -493,6 +521,10 @@ def _cmd_run(cfg: FrameworkConfig, backend_name: str, checkpoint: str,
         ctrl.close()
         if exporter is not None:
             exporter.close()
+        if tracer is not None:
+            print(f"[ok] chrome trace -> "
+                  f"{tracer.write_chrome_trace(trace_out)} "
+                  "(load in ui.perfetto.dev)", file=sys.stderr)
     ok = all(r.applied and r.verified for r in reports) if reports else True
     print(f"[{'ok' if ok else 'err'}] controller ran "
           f"{len(reports)} tick(s)", file=sys.stderr)
@@ -703,20 +735,24 @@ def _cmd_capture(cfg: FrameworkConfig, out: str, steps: int,
 
 def _cmd_train(cfg: FrameworkConfig, backend_name: str, iterations: int,
                checkpoint_dir: str, seed: int | None,
-               log_every: int) -> int:
+               log_every: int, runlog_path: str = "") -> int:
+    from ccka_tpu.obs.runlog import RunLog
     from ccka_tpu.signals.live import make_signal_source
     from ccka_tpu.train.checkpoint import save_state
 
     src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    rl = RunLog(runlog_path or None, kind=f"{backend_name}-train",
+                meta={"iterations": iterations, "seed": seed})
     if backend_name == "ppo":
         from ccka_tpu.train.ppo import PPOTrainer
         trainer = PPOTrainer(cfg)
         ts, history = trainer.train(src, iterations, seed=seed,
-                                    log_every=log_every or 1)
+                                    log_every=log_every or 1, runlog=rl)
         for rec in history:
             print(json.dumps(rec))
         path = save_state(checkpoint_dir, ts.params,
                           step=int(ts.iteration))
+        rl.close(checkpoint=path)
         print(f"[ok] ppo params -> {path}", file=sys.stderr)
         return 0
     # MPC has no trained parameters; its "training" artifact is a warm-
@@ -740,6 +776,9 @@ def _cmd_train(cfg: FrameworkConfig, backend_name: str, iterations: int,
     # Dict-wrapped: orbax PyTree handlers reject bare-array items.
     path = save_state(checkpoint_dir, {"plan": result.plan_latent},
                       step=iterations)
+    rl.event("mpc_plan", first_objective=float(result.losses[0]),
+             final_objective=float(result.losses[-1]), iters=iterations)
+    rl.close(checkpoint=path)
     print(f"[ok] mpc warm-start plan -> {path}", file=sys.stderr)
     return 0
 
@@ -902,7 +941,8 @@ def main(argv: list[str] | None = None) -> int:
             return _cmd_run(cfg, args.backend, args.checkpoint, args.ticks,
                             args.interval, args.live, args.seed, args.hpa,
                             args.keda, args.telemetry, args.metrics_port,
-                            args.metrics_textfile, args.forecaster)
+                            args.metrics_textfile, args.forecaster,
+                            args.trace_out)
         if args.command == "dashboard":
             from ccka_tpu.actuation import DryRunSink, KubectlSink
             from ccka_tpu.harness.dashboard import (
@@ -970,9 +1010,24 @@ def main(argv: list[str] | None = None) -> int:
                                  f"{args.telemetry}: {e}")
             print(json.dumps(summarize_telemetry(records), indent=2))
             return 0
+        if args.command == "obs":
+            from ccka_tpu.obs.runlog import read_runlog, summarize_runlog
+            try:
+                # Non-strict read: a LIVE run's last line may be
+                # mid-write; tail/summarize must still work on it.
+                records = read_runlog(args.path)
+            except OSError as e:
+                raise SystemExit(f"ccka: cannot read run log: {e}")
+            if args.action == "tail":
+                for rec in records[-max(args.lines, 1):]:
+                    print(json.dumps(rec, sort_keys=True))
+                return 0
+            print(json.dumps(summarize_runlog(records), indent=2))
+            return 0
         if args.command == "train":
             return _cmd_train(cfg, args.backend, args.iterations,
-                              args.checkpoint_dir, args.seed, args.log_every)
+                              args.checkpoint_dir, args.seed,
+                              args.log_every, args.runlog)
         if args.command == "evaluate":
             return _cmd_evaluate(cfg, args.backends, args.checkpoint,
                                  args.days, args.traces, args.seed,

@@ -189,6 +189,7 @@ class Controller:
                  lock_dir: str | None = None,
                  telemetry_path: str = "",
                  exporter=None,
+                 tracer=None,
                  interruption_feed=None,
                  log_fn: Callable[[str], None] | None = None,
                  sleep_fn: Callable[[float], None] = time.sleep):
@@ -209,6 +210,11 @@ class Controller:
         # Prometheus exposition of the tick KPIs (harness.promexport);
         # None disables. Updated after every tick.
         self.exporter = exporter
+        # Shared span tracer (obs/trace.py): when given, every tick's
+        # phase spans accumulate here and the owner can export one
+        # Perfetto-loadable Chrome trace for the whole session (`ccka run
+        # --trace-out`). None keeps per-tick private timers (old shape).
+        self.tracer = tracer
         self.backend = backend
         self.source = source
         # Multi-region fleets (BASELINE config #4) run one Karpenter per
@@ -259,9 +265,14 @@ class Controller:
         if telemetry_path:
             from ccka_tpu.harness.telemetry import TelemetryWriter
             self.telemetry = TelemetryWriter(telemetry_path)
-        self._step = jax.jit(
-            lambda s, a, e, k: sim_step(self.params, s, a, e, k,
-                                        stochastic=False))
+        # Watched jit (obs/compile.py): the state-estimate step is the
+        # controller's hot device path — after the warmup compile, a
+        # recompile mid-run means a static-arg leak and gets warned.
+        from ccka_tpu.obs.compile import watch_jit
+        self._step = watch_jit(
+            jax.jit(lambda s, a, e, k: sim_step(self.params, s, a, e, k,
+                                                stochastic=False)),
+            "controller.step", hot=True)
         # MPC-style backends replan against a forecast window. The window
         # provider is the SAME protocol the jitted evaluation loop uses
         # (`forecast.Forecaster`): a backend carrying a forecaster plans
@@ -377,7 +388,7 @@ class Controller:
     def tick(self, t: int) -> TickReport:
         from ccka_tpu.harness.telemetry import StageTimer
 
-        timer = StageTimer()
+        timer = StageTimer(self.tracer)
         # 1. scrape the latest signals (the 30s AMP pipeline analog).
         with timer.stage("scrape"):
             tick_trace = self.source.tick(t, seed=self.seed)
@@ -409,7 +420,7 @@ class Controller:
         # 2. decide. Receding-horizon backends periodically re-optimize
         #    against the source's forward-looking window (exact future for
         #    synthetic/replay, persistence forecast for live).
-        with timer.stage("decide"):
+        with timer.stage("decide") as sp_decide:
             if self._replan_every and t % self._replan_every == 0:
                 if self._forecaster is not None:
                     from ccka_tpu.forecast.base import planning_window
@@ -422,6 +433,9 @@ class Controller:
                                                   seed=self.seed)
                 self.backend.replan(self.state, window)
             action = self.backend.decide(self.state, exo, jnp.int32(t))
+            # Device fence: without it the stage times the dispatch, not
+            # the decide (the VERDICT r5 weak-#2 footgun).
+            sp_decide.fence(action)
 
         # 3. render: op mirrors the reference's profile split — peak uses
         #    op:add (demo_21:65), off-peak op:replace (demo_20:69). The
@@ -467,9 +481,13 @@ class Controller:
                 for ps in patches)
 
         # 6. advance the model-based state estimate (expectation dynamics).
-        with timer.stage("estimate"):
+        with timer.stage("estimate") as sp_est:
             self.key, sub = jax.random.split(self.key)
             self.state, metrics = self._step(self.state, action, exo, sub)
+            # Fence on the step outputs: the report pulls these to host
+            # floats below anyway, so the estimate stage must carry the
+            # device time, not leak it into whatever blocks first.
+            sp_est.fence((self.state, metrics))
 
         # 7. measured app-level SLO metrics, when the source scrapes them
         #    (live Prometheus p95/RPS/queue depth; {} for sources without

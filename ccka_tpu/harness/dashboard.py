@@ -43,6 +43,12 @@ _PANEL_DEFS = (
      "ccka_nodes_od, 1)", "percentunit"),
     ("p95 latency", "ccka_latency_p95_ms", "ms"),
     ("Pending pods", "ccka_pending_pods", "short"),
+    # Controller self-observation (the obs subsystem): per-stage tick
+    # timing from the span tracer, so a slow scrape endpoint or a
+    # recompiling decide shows up on the SAME board as the KPIs it skews.
+    ("Tick time by stage", "ccka_tick_scrape_ms + ccka_tick_decide_ms + "
+     "ccka_tick_act_ms", "ms"),
+    ("Tick total", "ccka_tick_total_ms", "ms"),
 )
 
 

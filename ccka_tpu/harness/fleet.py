@@ -36,7 +36,6 @@ device→host pull per aggregate metric). Now one tick is:
 from __future__ import annotations
 
 import dataclasses
-import time
 from concurrent.futures import ThreadPoolExecutor
 from functools import partial
 from typing import Callable, Sequence
@@ -97,8 +96,9 @@ class FleetController:
     def __init__(self, cfg: FrameworkConfig, backend: PolicyBackend,
                  source: SignalSource, sinks: Sequence[ActuationSink],
                  *, horizon_ticks: int = 2880, seed: int = 0,
-                 fanout_workers: int = 8,
+                 fanout_workers: int = 8, tracer=None,
                  log_fn: Callable[[str], None] | None = None):
+        from ccka_tpu.obs.trace import SpanTracer
         if not hasattr(source, "batch_trace_device"):
             raise ValueError(
                 "FleetController needs a device-batched signal source "
@@ -110,6 +110,13 @@ class FleetController:
         self.n = len(self.sinks)
         self.params = SimParams.from_config(cfg)
         self.log_fn = log_fn or (lambda s: None)
+        # Shared span tracer (obs/trace.py): dispatch/harvest/fanout spans
+        # per tick, exportable as one Chrome trace. The default is
+        # retention-bounded: a fleet daemon ticks forever and its owner
+        # may never export, so unbounded span accumulation on the hot
+        # loop would be a slow leak; pass an unbounded tracer to keep a
+        # full-session trace.
+        self.tracer = tracer or SpanTracer(max_spans=4096)
         n = self.n
 
         self._traces = source.batch_trace_device(
@@ -162,23 +169,32 @@ class FleetController:
             ])
             return packed, new_states, agg
 
-        self._fleet_tick = fleet_tick
+        # Watched jit (obs/compile.py): the batched decide is THE fleet
+        # hot path — one warmup compile is expected; any recompile after
+        # it (a leaked static-arg rebind) warns loudly.
+        from ccka_tpu.obs.compile import watch_jit
+        self._fleet_tick = watch_jit(fleet_tick, "fleet.tick", hot=True)
 
     # -- device side --------------------------------------------------------
 
     def _dispatch(self, t: int) -> _Dispatched:
         """Queue tick t's device work; start its host copy; don't block."""
-        t0 = time.perf_counter()
-        packed, new_states, agg = self._fleet_tick(
-            self.states, jnp.int32(t), self.key)
-        self.states = new_states
-        # Start the device→host copy immediately so it overlaps the
-        # previous tick's fan-out (harvest then finds it already local).
-        for arr in (packed, agg):
-            if hasattr(arr, "copy_to_host_async"):
-                arr.copy_to_host_async()
+        # Deliberately UNFENCED span: this measures host time to *queue*
+        # the tick (the pipelining design point), never device execution
+        # — the device chain is timed as its own fenced region by
+        # bench_fleet. A fence here would serialize the pipeline.
+        with self.tracer.span("fleet.dispatch", t=t) as sp:
+            packed, new_states, agg = self._fleet_tick(
+                self.states, jnp.int32(t), self.key)
+            self.states = new_states
+            # Start the device→host copy immediately so it overlaps the
+            # previous tick's fan-out (harvest then finds it already
+            # local).
+            for arr in (packed, agg):
+                if hasattr(arr, "copy_to_host_async"):
+                    arr.copy_to_host_async()
         return _Dispatched(t=t, packed=packed, agg=agg,
-                           dispatch_ms=(time.perf_counter() - t0) * 1e3)
+                           dispatch_ms=sp.dur_ms)
 
     # -- host side ----------------------------------------------------------
 
@@ -214,12 +230,14 @@ class FleetController:
         return sum(f.result() for f in futures)
 
     def _harvest_and_fanout(self, disp: _Dispatched) -> FleetTickReport:
-        t0 = time.perf_counter()
-        packed = np.asarray(disp.packed)   # no-op if async copy landed
-        agg = np.asarray(disp.agg)
-        t1 = time.perf_counter()
-        applied = self._fanout(packed)
-        t2 = time.perf_counter()
+        # The harvest span DOES block (np.asarray pulls the device
+        # arrays), so decide_ms = dispatch + harvest is host time blocked
+        # on device work — near zero when pipelining hides the chain.
+        with self.tracer.span("fleet.harvest", t=disp.t) as sp_h:
+            packed = np.asarray(disp.packed)  # no-op if async copy landed
+            agg = np.asarray(disp.agg)
+        with self.tracer.span("fleet.fanout", t=disp.t) as sp_f:
+            applied = self._fanout(packed)
 
         dt_hr = float(self.params.dt_s) / 3600.0
         report = FleetTickReport(
@@ -230,8 +248,8 @@ class FleetController:
             cost_usd_hr=float(agg[1]) / dt_hr,
             carbon_g_hr=float(agg[2]) / dt_hr,
             pending_pods=float(agg[3]),
-            decide_ms=round(disp.dispatch_ms + (t1 - t0) * 1e3, 3),
-            fanout_ms=round((t2 - t1) * 1e3, 3),
+            decide_ms=round(disp.dispatch_ms + sp_h.dur_ms, 3),
+            fanout_ms=round(sp_f.dur_ms, 3),
         )
         self.log_fn(
             f"fleet t={report.t}: {report.applied}/{self.n} applied, "

@@ -33,7 +33,10 @@ from dataclasses import asdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Mapping
 
-# series name -> (TickReport field, help text). Booleans export as 0/1.
+# series name -> (TickReport field spec, help text). Booleans export as
+# 0/1. A spec may be dotted into the per-phase timing dict the span
+# tracer fills (`TickReport.timings_ms`): "timings_ms.a+b" sums the
+# named phases, "timings_ms.*" sums them all — see `resolve_field`.
 SERIES: dict[str, tuple[str, str]] = {
     "ccka_cost_usd_hr": ("cost_usd_hr", "Estimated fleet spend rate, $/hr"),
     "ccka_carbon_g_hr": ("carbon_g_hr", "Estimated emission rate, gCO2e/hr"),
@@ -57,6 +60,20 @@ SERIES: dict[str, tuple[str, str]] = {
     "ccka_applied": ("applied", "1 if every patch applied this tick"),
     "ccka_verified": ("verified", "1 if read-back matched intent"),
     "ccka_tick": ("t", "Controller tick counter"),
+    # Per-stage tick timing, sourced from the span tracer's fenced phase
+    # spans (obs/trace.py via StageTimer): the scrape→decide→act loop's
+    # structured timing, now on the wire and not only in JSONL.
+    "ccka_tick_scrape_ms": (
+        "timings_ms.scrape+slo_scrape",
+        "Signal + SLO scrape time this tick, milliseconds"),
+    "ccka_tick_decide_ms": (
+        "timings_ms.decide",
+        "Policy decide time this tick (device-fenced), milliseconds"),
+    "ccka_tick_act_ms": (
+        "timings_ms.render+apply+verify",
+        "Render + apply + verify time this tick, milliseconds"),
+    "ccka_tick_total_ms": (
+        "timings_ms.*", "Total instrumented tick time, milliseconds"),
 }
 
 _LABEL = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
@@ -69,6 +86,23 @@ def exported_series_names() -> set[str]:
 def referenced_series(expr: str) -> set[str]:
     """The `ccka_*` tokens a PromQL expression reads (for parity tests)."""
     return {tok for tok in _LABEL.findall(expr) if tok.startswith("ccka_")}
+
+
+def resolve_field(rec: Mapping, spec: str):
+    """A SERIES field spec against one tick record: a plain TickReport
+    field, or a dotted reach into a sub-dict — "timings_ms.a+b" sums the
+    named phases (absent phases count 0), "timings_ms.*" sums all. An
+    absent/empty sub-dict resolves to None so the series is skipped, not
+    exported as a fake 0."""
+    if "." not in spec:
+        return rec.get(spec)
+    base, _, sub = spec.partition(".")
+    d = rec.get(base)
+    if not isinstance(d, Mapping) or not d:
+        return None
+    if sub == "*":
+        return sum(float(v) for v in d.values())
+    return sum(float(d.get(k, 0.0)) for k in sub.split("+"))
 
 
 def _escape_label_value(value: str) -> str:
@@ -86,7 +120,7 @@ def render_exposition(report, *, cluster: str = "") -> str:
              if cluster else "")
     lines = []
     for name, (field, help_text) in SERIES.items():
-        value = rec.get(field)
+        value = resolve_field(rec, field)
         if value is None:
             continue
         lines.append(f"# HELP {name} {help_text}")

@@ -8,7 +8,11 @@ colored log lines (`00_common.sh:12-14`). SURVEY §5 calls for the new
 build to carry "JAX profiler traces of the simulator/policy step +
 structured timing of the scrape→decide→act loop". This module is that:
 
-- :class:`StageTimer` — named-phase wall timing for one control tick;
+- :class:`StageTimer` — named-phase wall timing for one control tick (as
+  of the obs subsystem, a re-export of `ccka_tpu.obs.trace.StageTimer`:
+  every stage is now a span, so controller phases land in the same trace
+  model — and the same Chrome trace files — as bench stages and training
+  generations; the round-2 API is unchanged);
 - :class:`TelemetryWriter` — append-only JSONL export of tick reports (the
   remote-write analog: durable, machine-parseable, replayable);
 - :func:`profile_trace` — gated `jax.profiler` capture around any block
@@ -20,41 +24,9 @@ from __future__ import annotations
 import contextlib
 import json
 import os
-import time
 from typing import Iterator, Mapping
 
-
-class StageTimer:
-    """Wall-clock timing for the named phases of one control tick.
-
-    Usage::
-
-        timer = StageTimer()
-        with timer.stage("scrape"):
-            ...
-        report["timings_ms"] = timer.timings_ms()
-
-    Re-entering a stage accumulates (for per-pool apply loops).
-    """
-
-    def __init__(self):
-        self._acc: dict[str, float] = {}
-
-    @contextlib.contextmanager
-    def stage(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self._acc[name] = self._acc.get(name, 0.0) + (
-                time.perf_counter() - t0)
-
-    def timings_ms(self) -> dict[str, float]:
-        return {k: round(v * 1000.0, 3) for k, v in self._acc.items()}
-
-    @property
-    def total_ms(self) -> float:
-        return round(sum(self._acc.values()) * 1000.0, 3)
+from ccka_tpu.obs.trace import StageTimer  # noqa: F401  (re-export)
 
 
 class TelemetryWriter:

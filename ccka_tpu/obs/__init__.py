@@ -1,0 +1,31 @@
+"""ccka_tpu.obs — unified run-trace observability.
+
+One subsystem spanning host and device (the instrumentation the reference
+configured a metrics fabric for but never applied to itself):
+
+- `obs.trace` — nested span tracer with device fences; Chrome trace-event
+  (Perfetto) + JSONL export; the span-backed StageTimer.
+- `obs.compile` — dispatch/recompile counters for jitted entry points
+  (megakernel launches, MPC replans, fleet decides), with hot-path
+  recompile warnings.
+- `obs.runlog` — structured JSONL run logs for the training drivers and
+  the `ccka obs tail|summarize` CLI.
+"""
+
+from ccka_tpu.obs.compile import (  # noqa: F401
+    CompileStats,
+    compile_report,
+    stats_for,
+    watch_jit,
+)
+from ccka_tpu.obs.runlog import (  # noqa: F401
+    RunLog,
+    read_runlog,
+    summarize_runlog,
+)
+from ccka_tpu.obs.trace import (  # noqa: F401
+    Span,
+    SpanTracer,
+    StageTimer,
+    validate_chrome_trace,
+)

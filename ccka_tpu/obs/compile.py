@@ -1,0 +1,144 @@
+"""Dispatch/recompile accounting for jitted entry points.
+
+XLA recompiles are the repo's quietest performance hazard: forecaster
+*instances* are compile-cache keys on the MPC replan path (ARCHITECTURE
+§8 — two `make_forecaster("ridge")` calls produce equal configs but
+distinct static-arg hashes, so each new instance silently recompiles the
+whole receding-horizon program), and nothing counted them. This module
+wraps a jitted callable and watches its compile cache:
+
+    optimize_plan = watch_jit(optimize_plan, "mpc.optimize_plan", hot=True)
+
+Per wrapped function, :class:`CompileStats` records calls, compiles,
+cache hits, and the wall time split between compiling calls and
+cache-hit calls. When a ``hot=True`` path compiles *beyond its warmup
+budget*, the wrapper warns (stderr by default) — a fleet decide or a
+megakernel launch that recompiles mid-run is a bug, not a cost.
+
+Honesty notes:
+
+- Compile detection reads the jitted function's tracing-cache size
+  (``fn._cache_size()``) around each call; a growth means this call
+  traced+compiled. On JAX builds without that accessor the wrapper
+  degrades to pure call counting (``compiles`` stays 0, never lies).
+- ``compile_s`` is the wall time of calls that compiled — it INCLUDES
+  that call's first execution (separating further needs AOT lowering,
+  which the hot paths' static-argname signatures make invasive).
+- ``execute_s`` on an async backend measures host time in the call
+  (dispatch), not device time — device durations belong to fenced spans
+  (`obs/trace.py`). The two are complementary, not interchangeable.
+- Calls made while tracing (a watched function invoked inside another
+  jit) pass straight through: they are inlining, not dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+import time
+from typing import Callable
+
+_REGISTRY: dict[str, "CompileStats"] = {}
+_LOCK = threading.Lock()
+
+
+@dataclasses.dataclass
+class CompileStats:
+    """Counters for one watched jitted entry point."""
+
+    name: str
+    calls: int = 0
+    compiles: int = 0
+    cache_hits: int = 0
+    compile_s: float = 0.0     # wall of compiling calls (incl. their exec)
+    execute_s: float = 0.0     # wall of cache-hit calls (host dispatch)
+    last_compile_call: int = 0  # 1-based call index of the latest compile
+
+    def to_dict(self) -> dict:
+        return {k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in dataclasses.asdict(self).items()}
+
+
+def _trace_clean() -> bool:
+    """True outside any jit trace (when a call is a real dispatch)."""
+    try:
+        import jax
+
+        return jax.core.trace_state_clean()
+    except Exception:  # noqa: BLE001 — missing API: assume real dispatch
+        return True
+
+
+class WatchedJit:
+    """Callable wrapper around a jitted function; see module docstring.
+
+    Unknown attributes delegate to the wrapped function, so ``.lower``/
+    ``.clear_cache`` keep working on the original.
+    """
+
+    def __init__(self, fn: Callable, name: str, *, hot: bool = False,
+                 warmup_compiles: int = 1,
+                 warn: Callable[[str], None] | None = None):
+        self._fn = fn
+        self.hot = hot
+        self.warmup_compiles = warmup_compiles
+        self._warn = warn or (lambda msg: print(msg, file=sys.stderr))
+        self.stats = CompileStats(name)
+        with _LOCK:
+            _REGISTRY[name] = self.stats
+
+    def _cache_size(self) -> int | None:
+        try:
+            return self._fn._cache_size()
+        except (AttributeError, TypeError):
+            return None
+
+    def __call__(self, *args, **kwargs):
+        if not _trace_clean():
+            return self._fn(*args, **kwargs)
+        before = self._cache_size()
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        after = self._cache_size()
+        s = self.stats
+        s.calls += 1
+        if before is not None and after is not None and after > before:
+            s.compiles += 1
+            s.compile_s += dt
+            s.last_compile_call = s.calls
+            if self.hot and s.compiles > self.warmup_compiles:
+                self._warn(
+                    f"# [obs] hot path {s.name!r} RECOMPILED at call "
+                    f"{s.calls} (compile #{s.compiles}, {dt:.2f}s): a new "
+                    "static-arg value — e.g. a fresh forecaster/policy "
+                    "instance — is re-keying the compile cache mid-run")
+        else:
+            s.cache_hits += 1
+            s.execute_s += dt
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def watch_jit(fn: Callable, name: str, *, hot: bool = False,
+              warmup_compiles: int = 1,
+              warn: Callable[[str], None] | None = None) -> WatchedJit:
+    """Wrap an already-jitted callable with compile/dispatch counters,
+    registered under ``name`` (re-registration replaces the entry — each
+    construction watches its own function object)."""
+    return WatchedJit(fn, name, hot=hot, warmup_compiles=warmup_compiles,
+                      warn=warn)
+
+
+def stats_for(name: str) -> CompileStats | None:
+    with _LOCK:
+        return _REGISTRY.get(name)
+
+
+def compile_report() -> dict[str, dict]:
+    """Snapshot of every watched entry point's counters (bench/CLI)."""
+    with _LOCK:
+        return {name: s.to_dict() for name, s in sorted(_REGISTRY.items())}

@@ -1,0 +1,163 @@
+"""Structured run logs for training/eval drivers.
+
+The training loops logged through ad-hoc ``print``/``lambda s:
+print(s, file=sys.stderr)`` callables (`train/flagship.py`,
+`scripts/train_replay_flagship.py`): a crashed 8-hour run left NO
+machine-parseable record of the generations it completed. :class:`RunLog`
+replaces them with the telemetry discipline the controller already has —
+one JSON object per line, flushed per write, append-only — plus the human
+stderr line the operator still wants:
+
+    rl = RunLog("runs/flagship.jsonl", kind="flagship", meta={...})
+    rl.note("rule baseline: ...")                      # echoed + recorded
+    rl.event("eval", _echo="it 100: ...", **record)    # structured record
+    rl.close()                                         # "end" event
+
+Schema: line 0 is ``{"event": "start", "kind": ..., "time_unix": ...,
+"meta": {...}}``; every later line carries ``event`` plus ``elapsed_s``
+since start; a clean exit appends ``{"event": "end", "status": ...}`` —
+its ABSENCE is how ``ccka obs summarize`` flags a crashed/live run.
+
+A RunLog is also a plain callable (``rl("msg")`` == ``rl.note``), so it
+drops into every ``log=`` callback the trainers already take.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Callable, Mapping
+
+# Events that are bookkeeping, not training progress — excluded from the
+# per-field numeric summary in summarize_runlog.
+_META_EVENTS = ("start", "end", "note")
+
+
+class RunLog:
+    """Append-only JSONL run record + optional human echo.
+
+    ``path`` empty/None keeps it echo-only (tests, dry drivers) — every
+    method still works, nothing is written. ``echo`` is the stderr-line
+    sink (None = stderr print; pass the driver's existing ``log``
+    callable to preserve its capture hooks).
+    """
+
+    def __init__(self, path: str | None = None, *, kind: str = "run",
+                 echo: Callable[[str], None] | None = None,
+                 meta: Mapping | None = None):
+        self.path = path or ""
+        self.kind = kind
+        self._echo = echo or (lambda s: print(s, file=sys.stderr,
+                                              flush=True))
+        self._fh = None
+        self._closed = False
+        self._t0 = time.perf_counter()
+        if self.path:
+            parent = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(parent, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        self._write({"event": "start", "kind": kind,
+                     "time_unix": round(time.time(), 3),
+                     **({"meta": dict(meta)} if meta else {})})
+
+    def _write(self, rec: Mapping) -> None:
+        if self._fh is not None:
+            self._fh.write(json.dumps(dict(rec), sort_keys=True,
+                                      default=str) + "\n")
+            self._fh.flush()
+
+    def event(self, event: str, _echo: str | None = None, **fields) -> dict:
+        """Record one structured event; ``_echo`` additionally prints a
+        human line (it is NOT written — the fields are the record)."""
+        rec = {"event": event,
+               "elapsed_s": round(time.perf_counter() - self._t0, 3),
+               **fields}
+        self._write(rec)
+        if _echo is not None:
+            self._echo(_echo)
+        return rec
+
+    def note(self, msg: str) -> None:
+        """Free-text progress line: echoed AND recorded (as `note`)."""
+        self.event("note", _echo=msg, msg=msg)
+
+    def __call__(self, msg: str) -> None:  # drop-in for log= callbacks
+        self.note(str(msg))
+
+    def close(self, status: str = "ok", **fields) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.event("end", status=status, **fields)
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "RunLog":
+        return self
+
+    def __exit__(self, exc_type, exc, _tb) -> None:
+        if exc_type is None:
+            self.close()
+        else:
+            self.close(status="error", error=repr(exc)[:200])
+
+
+def read_runlog(path: str, *, strict: bool = False) -> list[dict]:
+    """Load a run log. Non-strict (default) skips malformed lines — a
+    LIVE run's last line may be mid-write, and `ccka obs tail` must work
+    on it; strict raises like telemetry's reader."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                if strict:
+                    raise
+    return out
+
+
+def summarize_runlog(records: list[dict]) -> dict:
+    """Reduce a run log to a scoreboard: event counts, completion status
+    (a missing "end" event means crashed-or-live), and first/last/min/max
+    per numeric field over the progress events."""
+    if not records:
+        return {"events": 0}
+    start = next((r for r in records if r.get("event") == "start"), {})
+    end = next((r for r in reversed(records)
+                if r.get("event") == "end"), None)
+    counts: dict[str, int] = {}
+    fields: dict[str, dict] = {}
+    for r in records:
+        ev = str(r.get("event", "?"))
+        counts[ev] = counts.get(ev, 0) + 1
+        if ev in _META_EVENTS:
+            continue
+        for k, v in r.items():
+            if k in ("event", "elapsed_s") or isinstance(v, bool) \
+                    or not isinstance(v, (int, float)):
+                continue
+            f = fields.setdefault(k, {"first": v, "last": v,
+                                      "min": v, "max": v, "n": 0})
+            f["last"] = v
+            f["min"] = min(f["min"], v)
+            f["max"] = max(f["max"], v)
+            f["n"] += 1
+    return {
+        "kind": start.get("kind"),
+        "events": len(records),
+        "counts": dict(sorted(counts.items())),
+        "completed": end is not None,
+        "status": (end.get("status") if end
+                   else "unterminated (crashed or still running)"),
+        "elapsed_s": records[-1].get("elapsed_s"),
+        "fields": {k: {kk: (round(vv, 6) if isinstance(vv, float) else vv)
+                       for kk, vv in v.items()}
+                   for k, v in sorted(fields.items())},
+    }

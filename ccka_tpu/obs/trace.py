@@ -1,0 +1,253 @@
+"""Nested span tracer — ONE trace model for ticks, benches and training.
+
+The device stack outran the repo's ability to watch it (VERDICT r5: perf
+levers shipped without gated wall-clock numbers; an async-dispatch timing
+pathology was only caught by a human re-deriving roofline bytes). This
+module is the timing *primitive* everything else builds on:
+
+- :class:`SpanTracer` — nested wall-clock spans with an optional *device
+  fence*: a span that measured device work attaches the result pytree via
+  ``span.fence(x)`` and the tracer calls ``jax.block_until_ready`` at span
+  exit, so the recorded duration covers the work, not the dispatch (the
+  exact footgun ``tests/test_timing_guard.py`` now rejects elsewhere).
+- Chrome trace-event export (:meth:`SpanTracer.chrome_trace` /
+  :meth:`SpanTracer.write_chrome_trace`) — load the file in Perfetto
+  (https://ui.perfetto.dev) or ``chrome://tracing``; spans nest by
+  timestamp within a thread track.
+- JSONL streaming (``jsonl_path=``) — one record per completed span, the
+  same durable-append discipline as `harness/telemetry.TelemetryWriter`.
+- :class:`StageTimer` — the controller's named-phase accumulator,
+  re-implemented on spans so controller ticks, bench stages and training
+  generations share one trace vocabulary (`harness/telemetry.py`
+  re-exports it; the public API is unchanged).
+
+This file is the ONLY place in ``ccka_tpu/`` allowed to time with a bare
+``time.perf_counter()`` next to device references — everywhere else the
+guard test requires a fence or a span in scope.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Iterator, Mapping
+
+
+class Span:
+    """One completed (or in-flight) span. ``fence(x)`` marks it a device
+    span: the attached pytree is blocked on at exit, so ``dur_s`` covers
+    execution rather than async dispatch."""
+
+    __slots__ = ("name", "cat", "t0_s", "dur_s", "depth", "tid", "args",
+                 "_fence")
+
+    def __init__(self, name: str, cat: str, t0_s: float, depth: int,
+                 tid: int, args: dict):
+        self.name = name
+        self.cat = cat
+        self.t0_s = t0_s          # seconds since the tracer's epoch
+        self.dur_s = 0.0
+        self.depth = depth
+        self.tid = tid
+        self.args = args
+        self._fence = None
+
+    def fence(self, pytree) -> None:
+        """Attach device work to block on at span exit (marks the span
+        category "device"). Call with the span's result arrays."""
+        self._fence = pytree
+        self.cat = "device"
+
+    @property
+    def dur_ms(self) -> float:
+        return self.dur_s * 1e3
+
+    def to_record(self) -> dict:
+        return {"name": self.name, "cat": self.cat,
+                "ts_us": round(self.t0_s * 1e6, 1),
+                "dur_us": round(self.dur_s * 1e6, 1),
+                "depth": self.depth, **({"args": self.args}
+                                        if self.args else {})}
+
+
+class SpanTracer:
+    """Collects nested spans; exports Chrome trace JSON and/or JSONL.
+
+    Thread-safe: each thread keeps its own nesting stack (depth/track),
+    completed spans append under a lock. ``jsonl_path`` streams every
+    completed span as it closes (durable under crashes, like telemetry).
+    ``max_spans`` bounds in-memory retention (oldest dropped — for
+    always-on loops like the fleet controller whose owner may never
+    export); None keeps everything.
+    """
+
+    def __init__(self, jsonl_path: str = "", *,
+                 max_spans: int | None = None):
+        self._epoch = time.perf_counter()
+        self._spans: "collections.deque[Span]" = collections.deque(
+            maxlen=max_spans)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._fh = None
+        if jsonl_path:
+            parent = os.path.dirname(os.path.abspath(jsonl_path))
+            os.makedirs(parent, exist_ok=True)
+            self._fh = open(jsonl_path, "a", encoding="utf-8")
+
+    def _stack(self) -> list:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, cat: str = "host",
+             **args) -> Iterator[Span]:
+        """Time a block as a nested span. The yielded :class:`Span` takes
+        ``.fence(pytree)`` to make it a device-fenced span; extra kwargs
+        land in the Chrome trace ``args`` payload."""
+        stack = self._stack()
+        sp = Span(name, cat, time.perf_counter() - self._epoch,
+                  depth=len(stack), tid=threading.get_ident(),
+                  args={k: v for k, v in args.items()})
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            try:
+                if sp._fence is not None:
+                    import jax
+
+                    jax.block_until_ready(sp._fence)
+            finally:
+                # Bookkeeping must survive a fence that raises (XLA
+                # runtime error at block time): the duration, the
+                # nesting stack and the record all still close — a
+                # corrupted stack would mis-nest every later span on
+                # this thread.
+                sp._fence = None
+                sp.dur_s = (time.perf_counter() - self._epoch) - sp.t0_s
+                stack.pop()
+                with self._lock:
+                    self._spans.append(sp)
+                    if self._fh is not None:
+                        self._fh.write(json.dumps(sp.to_record(),
+                                                  sort_keys=True) + "\n")
+                        self._fh.flush()
+
+    @contextlib.contextmanager
+    def device_span(self, name: str, **args) -> Iterator[Span]:
+        """A span that MUST fence: exit raises if no pytree was attached,
+        so "device span" in the code can never silently time a dispatch."""
+        with self.span(name, cat="device", **args) as sp:
+            yield sp
+            if sp._fence is None:
+                raise RuntimeError(
+                    f"device_span {name!r} closed without a fence — call "
+                    "span.fence(result) with the device arrays, or use "
+                    "span() for host-only timing")
+
+    # -- export -------------------------------------------------------------
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (Perfetto-loadable): one complete
+        ("ph": "X") event per span, microsecond timestamps from the
+        tracer's epoch, one track per originating thread."""
+        pid = os.getpid()
+        events = []
+        for sp in self.spans():
+            events.append({
+                "name": sp.name, "cat": sp.cat, "ph": "X",
+                "ts": round(sp.t0_s * 1e6, 1),
+                "dur": round(sp.dur_s * 1e6, 1),
+                "pid": pid, "tid": sp.tid,
+                "args": dict(sp.args, depth=sp.depth),
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(self, path: str) -> str:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self.chrome_trace(), fh)
+        return path
+
+    def timings_by_name(self) -> dict[str, float]:
+        """Total seconds per span name (re-entry accumulates)."""
+        acc: dict[str, float] = {}
+        for sp in self.spans():
+            acc[sp.name] = acc.get(sp.name, 0.0) + sp.dur_s
+        return acc
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "SpanTracer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class StageTimer:
+    """Named-phase wall timing for one control tick, built on spans.
+
+    The round-2 API is unchanged (``stage``/``timings_ms``/``total_ms``;
+    re-entering a stage accumulates), but each stage is now a span: pass a
+    shared ``tracer`` to land controller phases in the same Chrome trace
+    as bench stages, and call ``span.fence(result)`` inside a stage whose
+    work is device-dispatched — otherwise the recorded time is dispatch,
+    not execution.
+    """
+
+    def __init__(self, tracer: SpanTracer | None = None, *,
+                 prefix: str = ""):
+        self.tracer = tracer or SpanTracer()
+        self.prefix = prefix
+        self._acc: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[Span]:
+        try:
+            with self.tracer.span(self.prefix + name) as sp:
+                yield sp
+        finally:
+            # Record even when the stage body raised (the span's exit has
+            # already closed its duration by the time we get here).
+            self._acc[name] = self._acc.get(name, 0.0) + sp.dur_s
+
+    def timings_ms(self) -> dict[str, float]:
+        return {k: round(v * 1000.0, 3) for k, v in self._acc.items()}
+
+    @property
+    def total_ms(self) -> float:
+        return round(sum(self._acc.values()) * 1000.0, 3)
+
+
+def validate_chrome_trace(doc: Mapping) -> list[str]:
+    """Schema check for a Chrome trace-event document (what the tests —
+    and a skeptical operator — run before pointing Perfetto at a file).
+    Returns a list of problems; empty means loadable."""
+    problems = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    for i, ev in enumerate(events):
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            if key not in ev:
+                problems.append(f"event {i} missing {key!r}")
+        if ev.get("ph") == "X" and "dur" not in ev:
+            problems.append(f"complete event {i} missing 'dur'")
+        for key in ("ts", "dur"):
+            if key in ev and not isinstance(ev[key], (int, float)):
+                problems.append(f"event {i} {key!r} not numeric")
+    return problems

@@ -1093,6 +1093,26 @@ def _fused_packed_summary(params, off_action, peak_action, exo_packed,
     return _finalize(params, out, T)
 
 
+# Dispatch/recompile watch (obs/compile.py) on the fused jit entry
+# points — the only places a megakernel launch actually dispatches
+# (`_run`/`_run_mlp` live inside these traces). A sweep legitimately
+# compiles one program per (B, T, mode) combination, so the warmup
+# budget is wider than the controller's; anything beyond it means a
+# param-shape or static-arg leak is recompiling ~10s Mosaic programs
+# mid-run.
+from ccka_tpu.obs.compile import watch_jit  # noqa: E402
+
+_fused_profile_summary = watch_jit(
+    _fused_profile_summary, "megakernel.profile_summary", hot=True,
+    warmup_compiles=6)
+_fused_neural_summary = watch_jit(
+    _fused_neural_summary, "megakernel.neural_summary", hot=True,
+    warmup_compiles=6)
+_fused_packed_summary = watch_jit(
+    _fused_packed_summary, "megakernel.packed_summary", hot=True,
+    warmup_compiles=6)
+
+
 def unpack_exo(exo_packed: jnp.ndarray, T: int, Z: int) -> ExogenousTrace:
     """Inverse of `_pack_exo` — [T_pad, rows, B] → [B, T, ...] traces.
     Gate/test plumbing only: it pays exactly the transpose the packed

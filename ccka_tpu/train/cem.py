@@ -149,7 +149,7 @@ def cem_refine(cfg: FrameworkConfig, params0, source, *,
                engine: str = "lax",
                mega_interpret: bool = False,
                seed: int = 0,
-               log=None) -> tuple[dict, list[dict], dict]:
+               log=None, runlog=None) -> tuple[dict, list[dict], dict]:
     """Refine ``params0`` (ActorCritic pytree) by (1+λ) episodic search.
 
     ``bars``: the KPI levels to beat — ``{"usd": ..., "co2": ...,
@@ -178,6 +178,10 @@ def cem_refine(cfg: FrameworkConfig, params0, source, *,
     rule/carbon teacher given as ``teacher_policy`` (a PolicyBackend,
     NOT an action_fn — the engine must recognize the policy family to
     fuse it).
+
+    ``runlog``: an `obs.runlog.RunLog`; every generation's history record
+    is additionally written as a structured "gen" event (so a crashed
+    refinement leaves its completed generations machine-parseable).
 
     Returns ``(best_params, history, info)``; ``info`` carries the
     returned candidate's provenance (``gen``: the last generation that
@@ -425,6 +429,8 @@ def cem_refine(cfg: FrameworkConfig, params0, source, *,
             "sigma": sigma,
         }
         history.append(rec)
+        if runlog is not None:
+            runlog.event("gen", **rec)
         log(f"gen {gen:3d}: incumbent {rec['incumbent_fitness']:.4f} "
             f"best {rec['best_fitness']:.4f} "
             f"(usd x{rec['best_usd_ratio']:.3f} "

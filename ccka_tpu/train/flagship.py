@@ -26,7 +26,6 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
 from typing import Callable
 
 import jax
@@ -90,9 +89,17 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
                    distill_iterations: int = 2000,
                    refine: str = "ppo",
                    cem_engine: str = "auto",
-                   log: Callable[[str], None] | None = None) -> dict:
+                   log: Callable[[str], None] | None = None,
+                   runlog=None) -> dict:
     """Train + select. Returns {params, meta, history}; ``meta`` carries the
     selection-trace scoreboard of the returned checkpoint.
+
+    ``runlog``: an `obs.runlog.RunLog`, a JSONL path, or None. Every
+    progress line and every selection evaluation is recorded as a
+    structured event (the old print-only logging left a crashed run with
+    NO machine-parseable record of its completed generations); a crash
+    shows up as a run log without an "end" event — `ccka obs summarize`
+    flags it. ``log`` remains the human echo sink.
 
     ``init_from``: "scratch" (fresh net) or "distill:<teacher>" — behavior-
     clone the named teacher first (`train/imitate.py`) and refine from
@@ -107,7 +114,14 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
     `train/cem.py` — requires a distilled init; ``iterations`` then
     means CEM generations).
     """
+    from ccka_tpu.obs.runlog import RunLog
     log = log or (lambda s: print(s, file=sys.stderr))
+    own_runlog = not isinstance(runlog, RunLog)
+    rl = runlog if isinstance(runlog, RunLog) else RunLog(
+        runlog or None, kind="flagship", echo=log,
+        meta={"iterations": iterations, "refine": refine,
+              "init_from": init_from, "cem_engine": cem_engine,
+              "seed": seed, "eval_steps": eval_steps})
     cfg = cfg or default_config()
     trainer = PPOTrainer(cfg)
     from ccka_tpu.signals.synthetic import SyntheticSignalSource
@@ -117,9 +131,9 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
     sel_traces = heldout_traces(src, steps=eval_steps, n=n_eval_traces,
                                 seed0=_SELECTION_SEED0)
     rule_res = evaluate_backend(cfg, RulePolicy(cfg.cluster), sel_traces)
-    log(f"rule baseline: $/slo-hr={rule_res['usd_per_slo_hour']:.4f} "
-        f"gCO2/kreq={rule_res['g_co2_per_kreq']:.4f} "
-        f"attain={rule_res['slo_attainment']:.4f}")
+    rl.note(f"rule baseline: $/slo-hr={rule_res['usd_per_slo_hour']:.4f} "
+            f"gCO2/kreq={rule_res['g_co2_per_kreq']:.4f} "
+            f"attain={rule_res['slo_attainment']:.4f}")
 
     teacher_res = None
     if init_from.startswith("distill:"):
@@ -128,11 +142,15 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
         # Resolve the teacher BEFORE the expensive distillation so an
         # unknown name fails fast instead of after 2000 iterations.
         teacher_backend = build_teacher(cfg, teacher)
-        log(f"distilling teacher {teacher!r} into the policy net...")
+        rl.note(f"distilling teacher {teacher!r} into the policy net...")
         params0, hist = distill_teacher(cfg, teacher, seed=seed,
                                         iterations=distill_iterations)
-        log(f"distilled: actor_mse {hist[-1]['actor_mse']:.4f} "
-            f"critic_mse {hist[-1]['critic_mse']:.4f}")
+        rl.event("distill", _echo=(
+            f"distilled: actor_mse {hist[-1]['actor_mse']:.4f} "
+            f"critic_mse {hist[-1]['critic_mse']:.4f}"),
+            teacher=teacher, iterations=distill_iterations,
+            actor_mse=float(hist[-1]["actor_mse"]),
+            critic_mse=float(hist[-1]["critic_mse"]))
         if cfg.train.anchor_coef > 0:
             # Rebuild the trainer with the distilled init as the KL
             # anchor: refinement explores around the teacher, not away.
@@ -142,10 +160,10 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
         # The teacher itself on the selection traces — the bar a refined
         # candidate must clear for training to have earned its keep.
         teacher_res = evaluate_backend(cfg, teacher_backend, sel_traces)
-        log(f"teacher {teacher!r}: "
-            f"usd x{teacher_res['usd_per_slo_hour'] / rule_res['usd_per_slo_hour']:.3f} "
-            f"co2 x{teacher_res['g_co2_per_kreq'] / rule_res['g_co2_per_kreq']:.3f} "
-            f"attain {teacher_res['slo_attainment']:.4f}")
+        rl.note(f"teacher {teacher!r}: "
+                f"usd x{teacher_res['usd_per_slo_hour'] / rule_res['usd_per_slo_hour']:.3f} "
+                f"co2 x{teacher_res['g_co2_per_kreq'] / rule_res['g_co2_per_kreq']:.3f} "
+                f"attain {teacher_res['slo_attainment']:.4f}")
     elif init_from == "scratch":
         ts = trainer.init_state(seed)
     else:
@@ -165,16 +183,21 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
 
     res0 = evaluate_backend(cfg, PPOBackend(cfg, ts.params), sel_traces)
     wins0, score0 = score_vs_rule(res0, rule_res)
-    log(f"it     0: usd x{res0['usd_per_slo_hour'] / rule_res['usd_per_slo_hour']:.3f} "
+    rl.event("eval", _echo=(
+        f"it     0: usd x{res0['usd_per_slo_hour'] / rule_res['usd_per_slo_hour']:.3f} "
         f"co2 x{res0['g_co2_per_kreq'] / rule_res['g_co2_per_kreq']:.3f} "
         f"attain {res0['slo_attainment']:.4f} "
-        f"{'WIN' if wins0 else '   '} score {score0:.3f}")
+        f"{'WIN' if wins0 else '   '} score {score0:.3f}"),
+        iteration=0,
+        usd_ratio=res0["usd_per_slo_hour"] / rule_res["usd_per_slo_hour"],
+        co2_ratio=res0["g_co2_per_kreq"] / rule_res["g_co2_per_kreq"],
+        slo_attainment=res0["slo_attainment"], wins_both=wins0,
+        score=score0)
     best = {"score": score0, "wins": wins0,
             "tier": candidate_tier(res0, wins0),
             "params": jax.device_get(ts.params), "iteration": 0,
             "res": res0}
     history = []
-    t0 = time.time()
 
     def consider(params, it_total, extra=None):
         """Evaluate a candidate on the selection traces; record + maybe
@@ -200,11 +223,12 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
                                      / teacher_res["g_co2_per_kreq"])
             rec["beats_teacher"] = beats_teacher(res, teacher_res)
         history.append(rec)
+        ev = rl.event("eval", **rec)
         log(f"it {it_total:5d}: usd x{rec['usd_ratio']:.3f} "
             f"co2 x{rec['co2_ratio']:.3f} attain {rec['slo_attainment']:.4f} "
             f"{'WIN' if wins else '   '}"
             f"{' >TEACHER' if rec.get('beats_teacher') else ''} "
-            f"score {score:.3f} ({time.time() - t0:.0f}s)")
+            f"score {score:.3f} ({ev['elapsed_s']:.0f}s)")
         better = (tier > best["tier"]
                   or (tier == best["tier"] and score < best["score"]))
         if better:
@@ -240,8 +264,8 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
                              "device-trace source and a rule/carbon "
                              "teacher")
         traces_per_gen = 256 if use_mega else CEMConfig().traces_per_gen
-        log(f"cem engine: {'mega' if use_mega else 'lax'} "
-            f"({traces_per_gen} traces/gen)")
+        rl.note(f"cem engine: {'mega' if use_mega else 'lax'} "
+                f"({traces_per_gen} traces/gen)")
         gens_per_eval = max(5, eval_every // 5)
         done = 0
         params_cur = ts.params
@@ -264,7 +288,7 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
                 teacher_fn=(None if use_mega
                             else teacher_backend.action_fn()),
                 seed=seed + 31 * done,
-                log=lambda s: log("  cem " + s))
+                log=lambda s: log("  cem " + s), runlog=rl)
             sigma = info["final_sigma"]
             done += n
             # Provenance: the fitness of the candidate actually being
@@ -336,6 +360,12 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
                      "slo_attainment")} if best["res"] else None,
         },
     }
+    # Close only on success (and only a RunLog this call created): a
+    # crashed run keeps its log "unterminated", which is the signal
+    # `ccka obs summarize` uses to flag it.
+    if own_runlog:
+        rl.close(selected_iteration=int(best["iteration"]),
+                 wins_both=bool(best["wins"]))
     return {"params": best["params"], "meta": meta, "history": history}
 
 
@@ -409,6 +439,9 @@ def main(argv=None) -> int:
                     help="checkpoint path (default: the package's "
                          "topology-keyed flagship location, where "
                          "load_flagship_backend and bench.py look)")
+    ap.add_argument("--runlog", default="runs/flagship.jsonl",
+                    help="structured JSONL run log (obs/runlog; inspect "
+                         "with `ccka obs tail|summarize`); '' disables")
     ap.add_argument("--override", action="append", default=[],
                     help="dotted config override, e.g. train.slo_weight=0.002")
     args = ap.parse_args(argv)
@@ -426,7 +459,7 @@ def main(argv=None) -> int:
                          eval_steps=args.eval_steps,
                          n_eval_traces=args.traces, seed=args.seed,
                          init_from=args.init_from, refine=args.refine,
-                         cem_engine=args.cem_engine)
+                         cem_engine=args.cem_engine, runlog=args.runlog)
     out["meta"]["preset"] = args.preset
     # Default to the loader's own path — a CWD-relative default would ship
     # checkpoints to wherever the trainer happened to run while

@@ -211,6 +211,29 @@ def receding_horizon_rollout(params: SimParams,
     return final, metrics
 
 
+# Dispatch/recompile watch (obs/compile.py) on the planning hot paths.
+# The receding-horizon program keys its compile cache on the forecaster
+# INSTANCE (a static argname): two `make_forecaster("ridge")` calls with
+# identical config hash differently, so constructing forecasters per
+# replan silently recompiles the entire closed loop — the ARCHITECTURE
+# §8 hazard these counters exist to surface. The warmup budget is one
+# compile per distinct (topology, forecaster, horizon) combination a
+# normal process legitimately holds — bench_forecast alone sweeps four
+# forecaster backends — so the warning fires only on the pathological
+# shape (a fresh instance per replan compiling without bound), not on a
+# sweep.
+from ccka_tpu.obs.compile import watch_jit  # noqa: E402
+
+optimize_plan = watch_jit(optimize_plan, "mpc.optimize_plan", hot=True,
+                          warmup_compiles=8)
+optimize_plan_batch = watch_jit(optimize_plan_batch,
+                                "mpc.optimize_plan_batch", hot=True,
+                                warmup_compiles=8)
+receding_horizon_rollout = watch_jit(
+    receding_horizon_rollout, "mpc.receding_horizon_rollout", hot=True,
+    warmup_compiles=8)
+
+
 class MPCBackend(PolicyBackend):
     """Receding-horizon diff-MPC controller.
 

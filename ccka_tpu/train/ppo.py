@@ -356,7 +356,11 @@ class PPOTrainer:
         return source.batch_trace(total, range(seed, seed + b))
 
     def train(self, source, iterations: int, *, seed: int | None = None,
-              log_every: int = 0) -> tuple[PPOTrainState, list[dict]]:
+              log_every: int = 0,
+              runlog=None) -> tuple[PPOTrainState, list[dict]]:
+        """``runlog``: an `obs.runlog.RunLog` — each history record is
+        also written as a structured "iter" event, so an interrupted run
+        keeps a machine-parseable record of its completed iterations."""
         ts = self.init_state(seed)
         seed = self.tcfg.seed if seed is None else seed
         all_traces = self.make_windows(source, iterations, seed=seed + 1000)
@@ -369,6 +373,8 @@ class PPOTrainer:
                 rec = {k: float(v) for k, v in diag._asdict().items()}
                 rec["iteration"] = it
                 history.append(rec)
+                if runlog is not None:
+                    runlog.event("iter", **rec)
         return ts, history
 
 

@@ -43,6 +43,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
 
 from ccka_tpu.config import default_config  # noqa: E402
+from ccka_tpu.obs.runlog import RunLog  # noqa: E402
 from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy  # noqa: E402
 from ccka_tpu.signals.replay import ReplaySignalSource  # noqa: E402
 from ccka_tpu.train.cem import CEMConfig, cem_refine  # noqa: E402
@@ -105,6 +106,9 @@ def main(argv=None) -> int:
     ap.add_argument("--co2-bar", default="min",
                     choices=("min", "rule", "teacher"))
     ap.add_argument("--out", default=OUT)
+    ap.add_argument("--runlog", default="runs/replay_flagship.jsonl",
+                    help="structured JSONL run log (obs/runlog; inspect "
+                         "with `ccka obs tail|summarize`); '' disables")
     args = ap.parse_args(argv)
 
     train_path = (TRAIN_TRACE if os.path.exists(TRAIN_TRACE)
@@ -116,7 +120,15 @@ def main(argv=None) -> int:
     steps_per_day = int(86400 / cfg.sim.dt_s)
     train_src, sel = split_sources(train_path, steps_per_day)
 
-    log = lambda s: print(s, file=sys.stderr, flush=True)  # noqa: E731
+    # Structured run log (obs/runlog): the old stderr-only logging left a
+    # crashed multi-hour ES run with no machine-parseable record of its
+    # completed generations. `log` stays the human echo; every candidate
+    # evaluation and ES generation is now also a JSONL event.
+    rl = RunLog(args.runlog or None, kind="replay-flagship",
+                meta={"generations": args.generations,
+                      "es_seeds": args.es_seeds, "engine": args.engine,
+                      "popsize": args.popsize, "seed": args.seed})
+    log = rl
     rule_res = evaluate_backend(cfg, RulePolicy(cfg.cluster), sel)
     teacher = CarbonAwarePolicy(cfg.cluster)
     teacher_res = evaluate_backend(cfg, teacher, sel)
@@ -148,11 +160,18 @@ def main(argv=None) -> int:
         all_windows = (max(pw_usd) < 1.0 and max(pw_co2) < 1.0
                        and res["slo_attainment"]
                        >= rule_res["slo_attainment"] - _ATTAIN_EPS)
-        log(f"{name:>14}: usd x{res['usd_per_slo_hour'] / rule_res['usd_per_slo_hour']:.4f} "
+        rl.event("eval", _echo=(
+            f"{name:>14}: usd x{res['usd_per_slo_hour'] / rule_res['usd_per_slo_hour']:.4f} "
             f"co2 x{res['g_co2_per_kreq'] / rule_res['g_co2_per_kreq']:.4f} "
             f"attain {res['slo_attainment']:.4f} "
             f"worst-window usd x{max(pw_usd):.4f} co2 x{max(pw_co2):.4f} "
-            f"{'ALL-WINDOWS-WIN' if all_windows else ('WIN' if wins_mean else '')}")
+            f"{'ALL-WINDOWS-WIN' if all_windows else ('WIN' if wins_mean else '')}"),
+            name=name, gen=gen,
+            usd_ratio=res["usd_per_slo_hour"] / rule_res["usd_per_slo_hour"],
+            co2_ratio=res["g_co2_per_kreq"] / rule_res["g_co2_per_kreq"],
+            slo_attainment=res["slo_attainment"], wins_mean=wins_mean,
+            all_windows_win=all_windows, score=score,
+            worst_window_usd=max(pw_usd), worst_window_co2=max(pw_co2))
         return {"name": name, "params": jax.device_get(params),
                 "gen": gen, "res": res, "wins": wins_mean,
                 "all_windows_win": all_windows, "score": score,
@@ -188,7 +207,11 @@ def main(argv=None) -> int:
                 teacher_fn=(None if args.engine == "mega"
                             else teacher.action_fn()),
                 seed=args.seed + 1000 * es_seed + 17 * done,
-                log=lambda s: log(f"  cem[s{es_seed}] " + s))
+                # Echo-only here: the structured record comes from
+                # runlog's per-generation "gen" event (no double lines).
+                log=lambda s: print(f"  cem[s{es_seed}] " + s,
+                                    file=sys.stderr, flush=True),
+                runlog=rl)
             sigma = info["final_sigma"]
             done += n
             cand = consider(f"seed{es_seed}@gen{done}", params_cur, done)
@@ -228,6 +251,7 @@ def main(argv=None) -> int:
         },
     }
     path = save_params_npz(args.out, best["params"], meta=meta)
+    rl.close(selected=best["name"], checkpoint=path)
     print(json.dumps({"checkpoint": path, **{k: v for k, v in meta.items()
                                              if k != "params"}}, indent=2))
     return 0

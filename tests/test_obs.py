@@ -1,0 +1,459 @@
+"""Tests for the obs subsystem: span tracer + Chrome trace export,
+dispatch/recompile counters (incl. the forecaster-instance-keyed MPC
+recompile and the steady-state controller loop), structured run logs and
+the `ccka obs` CLI, and bench provenance stamping.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import default_config
+from ccka_tpu.obs import (
+    RunLog,
+    SpanTracer,
+    read_runlog,
+    stats_for,
+    summarize_runlog,
+    validate_chrome_trace,
+    watch_jit,
+)
+
+
+class TestSpanTracer:
+    def test_nesting_and_chrome_schema(self, tmp_path):
+        jsonl = str(tmp_path / "spans.jsonl")
+        tr = SpanTracer(jsonl_path=jsonl)
+        with tr.span("outer", stage="demo"):
+            with tr.span("inner"):
+                pass
+        with tr.span("outer"):  # re-entry: second event, same name
+            pass
+        tr.close()
+
+        doc = tr.chrome_trace()
+        assert validate_chrome_trace(doc) == []
+        by_name = {}
+        for ev in doc["traceEvents"]:
+            assert ev["ph"] == "X"
+            by_name.setdefault(ev["name"], []).append(ev)
+        assert len(by_name["outer"]) == 2 and len(by_name["inner"]) == 1
+        # Nesting: the child's interval lies inside its parent's.
+        inner, outer = by_name["inner"][0], by_name["outer"][0]
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] \
+            + 1.0  # 1us rounding slack
+        assert inner["args"]["depth"] == 1
+        assert outer["args"]["depth"] == 0
+
+        # The JSONL stream carries the same spans, durably.
+        records = [json.loads(l) for l in open(jsonl) if l.strip()]
+        assert [r["name"] for r in records] == ["inner", "outer", "outer"]
+        assert all(r["dur_us"] >= 0 for r in records)
+
+    def test_device_fence_marks_and_blocks(self):
+        tr = SpanTracer()
+        with tr.span("matmul") as sp:
+            y = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+            sp.fence(y)
+        (span,) = tr.spans()
+        assert span.cat == "device"
+        assert span.dur_s >= 0.0
+
+    def test_raising_fence_keeps_bookkeeping_intact(self):
+        """A fence that raises at block time (XLA runtime error, device
+        failure) must not corrupt the nesting stack or drop the span —
+        later spans on the thread would otherwise mis-nest forever."""
+        class FailingArr:
+            def block_until_ready(self):
+                raise RuntimeError("xla runtime error")
+
+        tr = SpanTracer()
+        with pytest.raises(RuntimeError, match="xla runtime error"):
+            with tr.span("outer"):
+                with tr.span("bad") as sp:
+                    sp.fence(FailingArr())
+        with tr.span("after"):
+            pass
+        spans = {(s.name, s.depth) for s in tr.spans()}
+        assert ("bad", 1) in spans       # recorded despite the raise
+        assert ("outer", 0) in spans
+        assert ("after", 0) in spans     # stack recovered: depth 0
+
+    def test_bounded_retention_drops_oldest(self):
+        tr = SpanTracer(max_spans=3)
+        for i in range(6):
+            with tr.span(f"s{i}"):
+                pass
+        assert [s.name for s in tr.spans()] == ["s3", "s4", "s5"]
+
+    def test_device_span_requires_fence(self):
+        tr = SpanTracer()
+        with pytest.raises(RuntimeError, match="without a fence"):
+            with tr.device_span("oops"):
+                pass
+        # The fenced form passes.
+        with tr.device_span("ok") as sp:
+            sp.fence(jnp.ones(4))
+
+    def test_write_chrome_trace_is_loadable_json(self, tmp_path):
+        tr = SpanTracer()
+        with tr.span("a"):
+            pass
+        path = tr.write_chrome_trace(str(tmp_path / "sub" / "trace.json"))
+        doc = json.load(open(path))
+        assert validate_chrome_trace(doc) == []
+        assert doc["traceEvents"][0]["name"] == "a"
+
+    def test_validator_rejects_malformed(self):
+        assert validate_chrome_trace({}) == [
+            "traceEvents missing or not a list"]
+        bad = {"traceEvents": [{"name": "x", "ph": "X", "ts": "zero",
+                                "pid": 1, "tid": 1, "dur": 1}]}
+        assert any("not numeric" in p for p in validate_chrome_trace(bad))
+
+
+class TestWatchJit:
+    def test_forced_recompile_on_changed_static_arg(self):
+        f = watch_jit(jax.jit(lambda x, n: x * n, static_argnums=1),
+                      "obs_test.static", warn=lambda m: None)
+        x = jnp.ones(8)
+        f(x, 2)
+        f(x, 2)
+        f(x, 3)  # new static value -> recompile
+        assert f.stats.compiles == 2
+        assert f.stats.cache_hits == 1
+        assert f.stats.calls == 3
+        assert f.stats.compile_s > 0.0
+        # Registry carries the same object.
+        assert stats_for("obs_test.static") is f.stats
+
+    def test_steady_loop_zero_recompiles_after_warmup(self):
+        warns = []
+        f = watch_jit(jax.jit(lambda x: x + 1), "obs_test.steady",
+                      hot=True, warn=warns.append)
+        x = jnp.zeros(4)
+        for _ in range(5):
+            x = f(x)
+        assert f.stats.compiles == 1  # the warmup compile only
+        assert f.stats.cache_hits == 4
+        assert not warns
+
+    def test_hot_path_recompile_warns(self):
+        warns = []
+        f = watch_jit(jax.jit(lambda x, n: x * n, static_argnums=1),
+                      "obs_test.hot", hot=True, warn=warns.append)
+        x = jnp.ones(4)
+        f(x, 1)
+        f(x, 2)
+        assert len(warns) == 1 and "RECOMPILED" in warns[0]
+
+    def test_traced_calls_pass_through_uncounted(self):
+        inner = watch_jit(jax.jit(lambda x: x * 2), "obs_test.inner")
+        outer = jax.jit(lambda x: inner(x) + 1)
+        assert float(outer(jnp.float32(3.0))) == 7.0
+        # The inlined trace-time call must not count as a dispatch.
+        assert inner.stats.calls == 0
+
+    def test_attribute_passthrough(self):
+        jitted = jax.jit(lambda x: x)
+        f = watch_jit(jitted, "obs_test.attrs")
+        assert f.lower is jitted.lower  # delegation, not a copy
+
+
+class TestMPCRecompileDetection:
+    def test_forecaster_instance_rekeys_the_replan_path(self, cfg):
+        """Acceptance: the recompile counter detects a forecaster-
+        INSTANCE-keyed recompile on the MPC replan path (ARCHITECTURE §8
+        hazard) — same config, fresh instance, silent full recompile."""
+        from ccka_tpu.forecast import make_forecaster
+        from ccka_tpu.sim import initial_state
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+        from ccka_tpu.train.mpc import MPCBackend
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        trace = src.trace(8, seed=0)
+        s0 = initial_state(cfg)
+
+        def evaluate(fc):
+            b = MPCBackend(cfg, horizon=4, iters=1, replan_every=4,
+                           forecaster=fc)
+            b.evaluate(s0, trace, jax.random.key(0), stochastic=False)
+
+        stats = stats_for("mpc.receding_horizon_rollout")
+        f1 = make_forecaster("persistence", dt_s=cfg.sim.dt_s)
+        evaluate(f1)
+        after_first = stats.compiles
+        evaluate(f1)  # same instance: cache hit, no recompile
+        assert stats.compiles == after_first
+        f2 = make_forecaster("persistence", dt_s=cfg.sim.dt_s)
+        evaluate(f2)  # equal config, fresh instance: silent recompile
+        assert stats.compiles == after_first + 1
+        assert stats.last_compile_call == stats.calls
+
+
+class TestControllerSteadyState:
+    def test_zero_recompiles_after_warmup(self, cfg):
+        """Acceptance: the steady-state controller loop compiles its
+        estimate step exactly once; every later tick is a cache hit."""
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, DryRunSink(),
+                          interval_s=0.0, log_fn=lambda _l: None)
+        ctrl.run(ticks=4)
+        s = ctrl._step.stats
+        assert s.calls == 4
+        assert s.compiles == 1
+        assert s.cache_hits == 3
+        ctrl.close()
+
+    def test_tick_spans_share_a_tracer(self, cfg):
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        tracer = SpanTracer()
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, DryRunSink(),
+                          interval_s=0.0, tracer=tracer,
+                          log_fn=lambda _l: None)
+        ctrl.run(ticks=2)
+        ctrl.close()
+        names = [s.name for s in tracer.spans()]
+        # Two ticks x the seven phases, in one Perfetto-exportable trace.
+        assert names.count("decide") == 2
+        assert names.count("estimate") == 2
+        # The device stages fenced (decide on the action, estimate on
+        # the step outputs) — category says so.
+        cats = {s.name: s.cat for s in tracer.spans()}
+        assert cats["decide"] == "device"
+        assert cats["estimate"] == "device"
+        assert validate_chrome_trace(tracer.chrome_trace()) == []
+
+
+class TestRunLog:
+    def test_events_echo_and_schema(self, tmp_path, capsys):
+        path = str(tmp_path / "run.jsonl")
+        with RunLog(path, kind="demo", meta={"seed": 3}) as rl:
+            rl.note("hello operator")
+            rl.event("gen", generation=0, fitness=1.5)
+            rl.event("gen", _echo="gen 1 done", generation=1, fitness=0.8)
+        records = read_runlog(path)
+        assert [r["event"] for r in records] == [
+            "start", "note", "gen", "gen", "end"]
+        assert records[0]["kind"] == "demo"
+        assert records[0]["meta"] == {"seed": 3}
+        assert all("elapsed_s" in r for r in records[1:])
+        err = capsys.readouterr().err
+        assert "hello operator" in err and "gen 1 done" in err
+
+    def test_callable_drops_into_log_callbacks(self, tmp_path):
+        rl = RunLog(str(tmp_path / "r.jsonl"))
+        log = rl  # the trainers' log= parameter shape
+        log("progress line")
+        rl.close()
+        recs = read_runlog(str(tmp_path / "r.jsonl"))
+        assert recs[1] == {"event": "note", "msg": "progress line",
+                           "elapsed_s": recs[1]["elapsed_s"]}
+
+    def test_crashed_run_is_flagged_unterminated(self, tmp_path):
+        path = str(tmp_path / "crash.jsonl")
+        rl = RunLog(path, kind="flagship")
+        rl.event("eval", iteration=40, score=1.01)
+        # ... process dies here: no close(), no "end" event.
+        del rl
+        board = summarize_runlog(read_runlog(path))
+        assert board["completed"] is False
+        assert "unterminated" in board["status"]
+        # The completed generations ARE machine-parseable (the bugfix).
+        assert board["fields"]["iteration"]["last"] == 40
+
+    def test_tolerates_midwrite_partial_line(self, tmp_path):
+        path = str(tmp_path / "live.jsonl")
+        with open(path, "w") as fh:
+            fh.write('{"event": "start", "kind": "x"}\n')
+            fh.write('{"event": "gen", "fitn')  # killed mid-write
+        records = read_runlog(path)
+        assert len(records) == 1
+        with pytest.raises(json.JSONDecodeError):
+            read_runlog(path, strict=True)
+
+    def test_error_exit_records_status(self, tmp_path):
+        path = str(tmp_path / "err.jsonl")
+        with pytest.raises(RuntimeError):
+            with RunLog(path) as rl:
+                rl.event("gen", generation=0)
+                raise RuntimeError("boom")
+        end = read_runlog(path)[-1]
+        assert end["event"] == "end" and end["status"] == "error"
+        assert "boom" in end["error"]
+
+
+class TestObsCLI:
+    def _write_runlog(self, path):
+        with RunLog(path, kind="t", echo=lambda s: None) as rl:
+            for g in range(5):
+                rl.event("gen", generation=g, fitness=1.0 - 0.1 * g)
+
+    def test_tail(self, tmp_path, capsys):
+        from ccka_tpu.cli import main
+
+        path = str(tmp_path / "r.jsonl")
+        self._write_runlog(path)
+        assert main(["obs", "tail", path, "-n", "3"]) == 0
+        lines = [json.loads(l) for l in
+                 capsys.readouterr().out.strip().splitlines()]
+        assert len(lines) == 3
+        assert lines[-1]["event"] == "end"
+        assert lines[0]["generation"] == 3
+
+    def test_summarize(self, tmp_path, capsys):
+        from ccka_tpu.cli import main
+
+        path = str(tmp_path / "r.jsonl")
+        self._write_runlog(path)
+        assert main(["obs", "summarize", path]) == 0
+        board = json.loads(capsys.readouterr().out)
+        assert board["completed"] is True
+        assert board["counts"]["gen"] == 5
+        assert board["fields"]["fitness"]["min"] == pytest.approx(0.6)
+
+    def test_missing_file_is_a_clean_error(self):
+        from ccka_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="cannot read run log"):
+            main(["obs", "summarize", "/nonexistent/run.jsonl"])
+
+    def test_summarize_roundtrips_a_cem_refine_run(self, tmp_path,
+                                                   capsys, cfg):
+        """Acceptance: `ccka obs summarize` on a RunLog written by a
+        short cem_refine run."""
+        from ccka_tpu.cli import main
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+        from ccka_tpu.train.cem import CEMConfig, cem_refine
+        from ccka_tpu.train.ppo import PPOTrainer
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        params0 = PPOTrainer(cfg).init_state().params
+        path = str(tmp_path / "cem.jsonl")
+        with RunLog(path, kind="cem", echo=lambda s: None) as rl:
+            cem_refine(cfg, params0, src,
+                       cem=CEMConfig(generations=2, popsize=4,
+                                     traces_per_gen=2, eval_steps=32),
+                       seed=3, runlog=rl)
+        assert main(["obs", "summarize", path]) == 0
+        board = json.loads(capsys.readouterr().out)
+        assert board["kind"] == "cem"
+        assert board["counts"]["gen"] == 2
+        assert board["completed"] is True
+        assert np.isfinite(board["fields"]["incumbent_fitness"]["last"])
+
+
+class TestBenchProvenance:
+    def test_provenance_fields_present(self):
+        """Acceptance: the BENCH record's provenance block pins device
+        kind, jax/jaxlib versions, timing mode, and the roofline floor
+        basis — on CPU too."""
+        import bench
+
+        prov = bench.bench_provenance()
+        for key in ("device_kind", "platform", "n_devices", "jax_version",
+                    "jaxlib_version", "timing_mode", "roofline_floor"):
+            assert key in prov, key
+        assert prov["jax_version"] == jax.__version__
+        assert prov["timing_mode"] == bench.TIMING_MODE
+        assert "basis" in prov["roofline_floor"]
+        assert "measured_bw_bytes_per_s" in prov["roofline_floor"]
+
+    def test_time_best_emits_spans_for_the_trace(self, tmp_path):
+        """Every timed bench sample is a span — the Perfetto trace the
+        bench writes shows exactly what was measured."""
+        import bench
+
+        before = len(bench._TRACER.spans())
+        dt = bench._time_best(lambda: None, repeats=2, min_valid_s=0.0,
+                              label="obs_test")
+        assert dt is not None and dt >= 0.0
+        spans = [s for s in bench._TRACER.spans()[before:]
+                 if s.name == "bench.obs_test"]
+        assert len(spans) == 2
+        path = bench._TRACER.write_chrome_trace(
+            str(tmp_path / "bench_trace.json"))
+        doc = json.load(open(path))
+        assert validate_chrome_trace(doc) == []
+        assert any(ev["name"] == "bench.obs_test"
+                   for ev in doc["traceEvents"])
+
+    def test_mega_time_phase_emits_provenance_and_trace(self, tmp_path,
+                                                        capsys):
+        """The CPU-path equivalent of `python bench.py --mega-phase
+        time`: the phase's JSON record carries provenance and writes a
+        Perfetto-loadable trace file even where the Mosaic kernel cannot
+        run (its rows are skipped, the record contract holds)."""
+        import bench
+
+        trace_out = str(tmp_path / "mega_trace.json")
+        rc = bench.main(["--mega-phase", "time", "--mega-sizes", "64",
+                         "--mega-horizon", "16", "--mega-repeats", "1",
+                         "--trace-out", trace_out])
+        assert rc == 0
+        rows = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        prov = rows["provenance"]
+        for key in ("device_kind", "jax_version", "jaxlib_version",
+                    "timing_mode", "roofline_floor"):
+            assert key in prov, key
+        assert rows["trace_file"] == trace_out
+        doc = json.load(open(trace_out))
+        assert validate_chrome_trace(doc) == []
+        assert any(ev["name"] == "bench.mega_time_phase"
+                   for ev in doc["traceEvents"])
+
+
+class TestFlagshipRunLog:
+    @pytest.mark.slow
+    def test_train_flagship_writes_structured_evals(self, tmp_path, cfg):
+        """The satellite bugfix end-to-end: a flagship run leaves a
+        machine-parseable record of every selection evaluation (rides the
+        slow lane with the other flagship composition smoke)."""
+        from ccka_tpu.train.flagship import train_flagship
+
+        path = str(tmp_path / "flagship.jsonl")
+        train_flagship(cfg, iterations=2, eval_every=2, eval_steps=64,
+                       n_eval_traces=1, log=lambda s: None, runlog=path)
+        records = read_runlog(path)
+        evals = [r for r in records if r["event"] == "eval"]
+        assert len(evals) >= 2  # it-0 + the trained candidate
+        assert all("usd_ratio" in r and "score" in r for r in evals)
+        assert records[-1]["event"] == "end"
+        assert records[0]["meta"]["refine"] == "ppo"
+
+
+def test_fleet_spans_and_watch(cfg):
+    """Fleet ticks emit dispatch/harvest/fanout spans and the batched
+    decide is compile-watched (one warmup compile, then cache hits)."""
+    from ccka_tpu.harness.fleet import fleet_controller_from_config
+    from ccka_tpu.policy import RulePolicy
+
+    ctrl = fleet_controller_from_config(cfg, RulePolicy(cfg.cluster), 3,
+                                        horizon_ticks=8)
+    reports = ctrl.run(3)
+    names = [s.name for s in ctrl.tracer.spans()]
+    assert names.count("fleet.dispatch") == 3
+    assert names.count("fleet.fanout") == 3
+    assert ctrl._fleet_tick.stats.compiles == 1
+    assert ctrl._fleet_tick.stats.cache_hits == 2
+    assert all(r.decide_ms >= 0 and r.fanout_ms >= 0 for r in reports)
+    ctrl.close()

@@ -472,9 +472,38 @@ class TestPromExport:
             missing = refs - exported
             assert not missing, (f"panel queries unexported series "
                                  f"{missing}: {expr}")
-        # And every exported series maps to a real TickReport field.
+        # And every exported series maps to a real TickReport field —
+        # dotted specs (the span-sourced tick timing gauges) resolve
+        # against their base field.
         for name, (field, _help) in SERIES.items():
-            assert field in fields, f"{name} maps to unknown field {field}"
+            base = field.split(".", 1)[0]
+            assert base in fields, f"{name} maps to unknown field {field}"
+
+    def test_tick_timing_gauges_cover_the_span_phases(self):
+        """The per-stage gauges (satellite of the obs PR) must stay in
+        SERIES, resolve from a real tick's timings dict, and appear in a
+        dashboard panel — both directions of the parity contract."""
+        from ccka_tpu.harness.dashboard import _PANEL_DEFS
+        from ccka_tpu.harness.promexport import (SERIES, referenced_series,
+                                                 resolve_field)
+
+        gauges = {"ccka_tick_scrape_ms", "ccka_tick_decide_ms",
+                  "ccka_tick_act_ms", "ccka_tick_total_ms"}
+        assert gauges <= set(SERIES)
+        paneled = set()
+        for _t, expr, _u in _PANEL_DEFS:
+            paneled |= referenced_series(expr)
+        assert gauges <= paneled, "tick gauges missing from the dashboard"
+
+        rec = {"timings_ms": {"scrape": 1.0, "decide": 2.0, "render": 0.5,
+                              "apply": 0.25, "verify": 0.25,
+                              "estimate": 3.0, "slo_scrape": 0.5}}
+        assert resolve_field(rec, SERIES["ccka_tick_scrape_ms"][0]) == 1.5
+        assert resolve_field(rec, SERIES["ccka_tick_decide_ms"][0]) == 2.0
+        assert resolve_field(rec, SERIES["ccka_tick_act_ms"][0]) == 1.0
+        assert resolve_field(rec, SERIES["ccka_tick_total_ms"][0]) == 7.5
+        # No timings yet (e.g. a hand-built record): skipped, not 0.
+        assert resolve_field({}, SERIES["ccka_tick_total_ms"][0]) is None
 
     def test_live_scrape_serves_all_panel_series(self):
         """Drive two controller ticks with an exporter on a real socket

@@ -1,0 +1,150 @@
+"""Tier-1 static guard: no unfenced wall-clock timing around device work.
+
+The async-dispatch footgun (VERDICT r5 weak #2): `t0 = perf_counter();
+jitted(x); dt = perf_counter() - t0` times the *dispatch*, not the work —
+on an async backend the published number can be 100x off, and round 5
+only caught it because a human re-derived the roofline bytes. This test
+enforces the fix mechanically over `ccka_tpu/` and `bench.py`:
+
+    any function that (a) calls `time.perf_counter()` or `time.time()`
+    AND (b) touches device code (a `jax.`/`jnp.` reference in scope)
+    MUST also have a fence or span wrapper in scope — a
+    `block_until_ready` call, a `.span(`/`device_span` context, or a
+    `StageTimer` stage (whose spans fence).
+
+Host-only timing (wall-clock timestamps, subprocess timing) passes
+untouched because it references no device code. The obs tracer itself
+(`ccka_tpu/obs/trace.py`) is the one exempt file: it IS the primitive
+the rule points everyone else at.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_TARGETS = (os.path.join(ROOT, "ccka_tpu"),
+                os.path.join(ROOT, "bench.py"))
+# The timing primitive: spans fence *for* their callers, so this file
+# legitimately holds bare perf_counter next to jax references.
+EXEMPT = {os.path.join(ROOT, "ccka_tpu", "obs", "trace.py")}
+
+_TIMING_FNS = {("time", "perf_counter"), ("time", "time")}
+_FENCE_MARKERS = ("block_until_ready", ".span(", "device_span(",
+                  "StageTimer")
+_DEVICE_MARKERS = ("jax.", "jnp.")
+
+
+def _python_files():
+    for target in SCAN_TARGETS:
+        if os.path.isfile(target):
+            yield target
+            continue
+        for dirpath, _dirs, files in os.walk(target):
+            if "__pycache__" in dirpath:
+                continue
+            for f in files:
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def _timing_calls(tree: ast.AST) -> list[ast.Call]:
+    """Call nodes that are time.perf_counter() / time.time()."""
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and (node.func.value.id,
+                     node.func.attr) in _TIMING_FNS):
+            out.append(node)
+    return out
+
+
+def _enclosing_function(tree: ast.AST, call: ast.Call):
+    """The innermost FunctionDef containing ``call`` (None = module)."""
+    best = None
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if (node.lineno <= call.lineno <= (node.end_lineno or node.lineno)
+                and (best is None or node.lineno > best.lineno)):
+            best = node
+    return best
+
+
+def _segment(src_lines: list[str], node) -> str:
+    if node is None:
+        return "".join(src_lines)  # module scope
+    return "".join(src_lines[node.lineno - 1:node.end_lineno])
+
+
+def test_no_unfenced_device_timing():
+    violations = []
+    for path in _python_files():
+        if path in EXEMPT:
+            continue
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        tree = ast.parse(src)
+        src_lines = src.splitlines(keepends=True)
+        seen_scopes = set()
+        for call in _timing_calls(tree):
+            fn = _enclosing_function(tree, call)
+            scope_key = (path, fn.lineno if fn else 0)
+            if scope_key in seen_scopes:
+                continue
+            seen_scopes.add(scope_key)
+            seg = _segment(src_lines, fn)
+            touches_device = any(m in seg for m in _DEVICE_MARKERS)
+            fenced = any(m in seg for m in _FENCE_MARKERS)
+            if touches_device and not fenced:
+                name = fn.name if fn else "<module>"
+                violations.append(
+                    f"{os.path.relpath(path, ROOT)}:{call.lineno} "
+                    f"in {name}()")
+    assert not violations, (
+        "unfenced wall-clock timing next to device code (time the work "
+        "through a span with a fence, or block_until_ready before "
+        "reading the clock):\n  " + "\n  ".join(violations))
+
+
+def test_guard_scans_a_nontrivial_tree():
+    """The guard is only worth its pass if it actually scanned the files
+    it claims to police (a refactor that breaks the walk must not turn
+    this into a vacuous green)."""
+    files = list(_python_files())
+    assert len(files) > 40
+    assert any(p.endswith("bench.py") for p in files)
+    assert any(os.path.join("harness", "fleet.py") in p for p in files)
+
+
+def test_guard_catches_the_footgun_pattern(tmp_path):
+    """Self-test on a synthetic violation: the exact VERDICT weak-#2
+    pattern must be flagged, and its fenced fix must pass."""
+    bad = (
+        "import time\n"
+        "import jax.numpy as jnp\n"
+        "def bench(f, x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = f(jnp.asarray(x))\n"
+        "    return time.perf_counter() - t0\n")
+    good = bad.replace("    return time.perf_counter() - t0\n",
+                       "    jax.block_until_ready(y)\n"
+                       "    return time.perf_counter() - t0\n")
+
+    def violations_of(src):
+        tree = ast.parse(src)
+        lines = src.splitlines(keepends=True)
+        out = []
+        for call in _timing_calls(tree):
+            fn = _enclosing_function(tree, call)
+            seg = _segment(lines, fn)
+            if (any(m in seg for m in _DEVICE_MARKERS)
+                    and not any(m in seg for m in _FENCE_MARKERS)):
+                out.append(call.lineno)
+        return out
+
+    assert violations_of(bad), "guard missed the canonical footgun"
+    assert not violations_of(good), "guard flagged the fenced fix"
