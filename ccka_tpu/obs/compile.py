@@ -79,14 +79,28 @@ class WatchedJit:
 
     def __init__(self, fn: Callable, name: str, *, hot: bool = False,
                  warmup_compiles: int = 1,
-                 warn: Callable[[str], None] | None = None):
+                 warn: Callable[[str], None] | None = None,
+                 shared_stats: bool = False):
         self._fn = fn
         self.hot = hot
         self.warmup_compiles = warmup_compiles
         self._warn = warn or (lambda msg: print(msg, file=sys.stderr))
-        self.stats = CompileStats(name)
         with _LOCK:
-            _REGISTRY[name] = self.stats
+            if shared_stats and name in _REGISTRY:
+                # Accumulate into the existing entry: callers that build
+                # one watched function PER GEOMETRY (e.g. the sharded
+                # kernel's per-mesh lru cache) would otherwise reset the
+                # name's counters on every new shape and leave earlier
+                # stats_for() handles pointing at a dead object.
+                self.stats = _REGISTRY[name]
+            else:
+                self.stats = CompileStats(name)
+                _REGISTRY[name] = self.stats
+        # Warn threshold is per WATCHED FUNCTION, not per shared name:
+        # with shared_stats, the accumulated count crossing the budget
+        # is legitimate geometry growth, while THIS function object
+        # recompiling past its own warmup is the mid-run hazard.
+        self._own_compiles = 0
 
     def _cache_size(self) -> int | None:
         try:
@@ -108,7 +122,8 @@ class WatchedJit:
             s.compiles += 1
             s.compile_s += dt
             s.last_compile_call = s.calls
-            if self.hot and s.compiles > self.warmup_compiles:
+            self._own_compiles += 1
+            if self.hot and self._own_compiles > self.warmup_compiles:
                 self._warn(
                     f"# [obs] hot path {s.name!r} RECOMPILED at call "
                     f"{s.calls} (compile #{s.compiles}, {dt:.2f}s): a new "
@@ -125,12 +140,16 @@ class WatchedJit:
 
 def watch_jit(fn: Callable, name: str, *, hot: bool = False,
               warmup_compiles: int = 1,
-              warn: Callable[[str], None] | None = None) -> WatchedJit:
+              warn: Callable[[str], None] | None = None,
+              shared_stats: bool = False) -> WatchedJit:
     """Wrap an already-jitted callable with compile/dispatch counters,
-    registered under ``name`` (re-registration replaces the entry — each
-    construction watches its own function object)."""
+    registered under ``name``. By default re-registration replaces the
+    entry (each construction watches its own function object);
+    ``shared_stats=True`` instead accumulates into the name's existing
+    counters — for entry points constructed once per geometry that are
+    still ONE hot path to the reader."""
     return WatchedJit(fn, name, hot=hot, warmup_compiles=warmup_compiles,
-                      warn=warn)
+                      warn=warn, shared_stats=shared_stats)
 
 
 def stats_for(name: str) -> CompileStats | None:

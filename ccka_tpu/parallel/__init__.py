@@ -31,3 +31,13 @@ from ccka_tpu.parallel.sharded import (  # noqa: F401
     sharded_batched_rollout,
     sharded_batched_rollout_summary,
 )
+from ccka_tpu.parallel.sharded_kernel import (  # noqa: F401
+    shard_seed,
+    sharded_carbon_megakernel_rollout_summary,
+    sharded_carbon_summary_from_packed,
+    sharded_megakernel_rollout_summary,
+    sharded_megakernel_summary_from_packed,
+    sharded_neural_megakernel_rollout_summary,
+    sharded_neural_summary_from_packed,
+    sharded_packed_trace,
+)

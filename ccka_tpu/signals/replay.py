@@ -178,6 +178,38 @@ class ReplaySignalSource(SignalSource):
 
         return jax.vmap(window)(offs)
 
+    def packed_trace_device(self, steps: int, key, n: int,
+                            *, t_chunk: int = 64, recycle=None):
+        """``[T_pad, exo_rows(Z), n]`` kernel-layout stream of
+        device-sampled replay windows: the window batch of
+        :meth:`batch_trace_device` (SAME offsets for the same key)
+        followed by the megakernel's pack. A replay store is batch-major
+        at rest, so the pack transpose is paid here — but the stream
+        then feeds the packed kernel entries and their donated-buffer
+        chain uniformly with the synthetic backend (`train/cem.py` mega
+        engine). ``recycle``: donate a dead same-shape stream buffer so
+        the fresh pack reuses its memory (see the synthetic backend's
+        docstring)."""
+        import jax
+
+        from ccka_tpu.sim.megakernel import _pack_exo
+
+        t_pad = math.ceil(steps / t_chunk) * t_chunk
+        recycled = recycle is not None
+        if not hasattr(self, "_packed_fns"):
+            self._packed_fns = {}
+        ckey = (steps, n, t_chunk, recycled)
+        fn = self._packed_fns.get(ckey)
+        if fn is None:
+            if recycled:
+                fn = jax.jit(lambda tr, buf: _pack_exo(tr, t_pad),
+                             donate_argnums=(1,), keep_unused=True)
+            else:
+                fn = jax.jit(lambda tr: _pack_exo(tr, t_pad))
+            self._packed_fns[ckey] = fn
+        trace = self.batch_trace_device(steps, key, n)
+        return fn(trace, recycle) if recycled else fn(trace)
+
 
 def trace_from_arrays(arrays: Mapping[str, np.ndarray], dt_s: float,
                       zones: tuple[str, ...]) -> tuple[ExogenousTrace, TraceMeta]:

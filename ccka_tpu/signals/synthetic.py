@@ -211,8 +211,36 @@ class SyntheticSignalSource(SignalSource):
             self._device_fns[(steps, batch, sharding)] = fn
         return fn(key)
 
+    def packed_generate_fn(self, steps: int, batch: int,
+                           *, t_chunk: int = 64):
+        """Un-jitted ``key -> [T_pad, exo_rows(Z), B]`` packed-stream
+        synthesis — the traceable core shared by
+        :meth:`packed_trace_device` (which jits it) and the multi-chip
+        wrapper (`parallel.sharded_kernel.sharded_packed_trace`, which
+        runs it PER SHARD inside a `shard_map` body so each chip's exo
+        block is born local and never crosses ICI)."""
+        import jax
+        import math as _math
+
+        z = self.cluster.n_zones
+        t_pad = _math.ceil(steps / t_chunk) * t_chunk
+
+        def generate(k):
+            ks, kc, kd = jax.random.split(k, 3)
+            noise = (
+                _ar1_device(ks, (steps, z, batch), rho=0.97,
+                            sigma=0.04, axis=0),
+                _ar1_device(kc, (steps, z, batch), rho=0.95,
+                            sigma=0.03, axis=0),
+                _ar1_device(kd, (steps, batch), rho=0.9, sigma=0.5,
+                            axis=0),
+            )
+            return self._assemble_packed(steps, t_pad, noise)
+
+        return generate
+
     def packed_trace_device(self, steps: int, key, batch: int,
-                            *, t_chunk: int = 64):
+                            *, t_chunk: int = 64, recycle=None):
         """[T_pad, exo_rows(Z), B] feature-first exo stream synthesized
         DIRECTLY in the megakernel's packed layout (ARCHITECTURE §6
         lever): no [B, T, ...] trace ever materializes and no transpose
@@ -223,32 +251,31 @@ class SyntheticSignalSource(SignalSource):
         RNG stream — statistically identical, not bitwise; use one or
         the other within an experiment). Feed the result to
         `sim.megakernel.megakernel_summary_from_packed`.
+
+        ``recycle``: a dead stream buffer of the SAME shape (the second
+        element of a ``donate_stream=True`` kernel return) — it is
+        DONATED and the fresh stream is written into its memory, so a
+        generate→rollout→generate loop holds one stream in HBM instead
+        of allocating a second before freeing the first.
         """
         import jax
 
-        cache_key = ("packed", steps, batch, t_chunk)
+        recycled = recycle is not None
+        cache_key = ("packed", steps, batch, t_chunk, recycled)
         fn = self._device_fns.get(cache_key)
         if fn is None:
-            import math as _math
-
-            z = self.cluster.n_zones
-            t_pad = _math.ceil(steps / t_chunk) * t_chunk
-
-            def generate(k):
-                ks, kc, kd = jax.random.split(k, 3)
-                noise = (
-                    _ar1_device(ks, (steps, z, batch), rho=0.97,
-                                sigma=0.04, axis=0),
-                    _ar1_device(kc, (steps, z, batch), rho=0.95,
-                                sigma=0.03, axis=0),
-                    _ar1_device(kd, (steps, batch), rho=0.9, sigma=0.5,
-                                axis=0),
-                )
-                return self._assemble_packed(steps, t_pad, noise)
-
-            fn = jax.jit(generate)
+            generate = self.packed_generate_fn(steps, batch,
+                                               t_chunk=t_chunk)
+            if recycled:
+                # The buffer's VALUES are dead — only its memory is
+                # reused, via donation aliased to the same-shaped output
+                # (keep_unused: a pruned arg cannot donate).
+                fn = jax.jit(lambda k, buf: generate(k),
+                             donate_argnums=(1,), keep_unused=True)
+            else:
+                fn = jax.jit(generate)
             self._device_fns[cache_key] = fn
-        return fn(key)
+        return fn(key, recycle) if recycled else fn(key)
 
     def _assemble_packed(self, steps: int, t_pad: int, noise: tuple):
         """The `_assemble` formulas in time-major packed form: noise

@@ -81,6 +81,16 @@ from ccka_tpu.signals.base import ExogenousTrace
 # compile: P/Z/CT/C/K enter as static python ints).
 _EPS = 1e-6
 
+# pltpu PRNG stream spacing: the per-grid-cell seed is
+# ``seed + b_idx * SEED_BLOCK_STRIDE + t_idx * SEED_CHUNK_STRIDE``.
+# Exported constants (not inline literals) because the multi-chip wrapper
+# (`parallel/sharded_kernel.py`) must reproduce the SAME per-(global
+# block, chunk) streams by offsetting each shard's seed — the paired-
+# comparison invariant only survives sharding if both sides agree on the
+# stride arithmetic.
+SEED_BLOCK_STRIDE = 131071
+SEED_CHUNK_STRIDE = 8191
+
 # Latent→Action codec constants — imported from the single source of
 # truth so the fused squash can never drift from `latent_to_action`.
 from ccka_tpu.models.nets import (  # noqa: E402
@@ -238,8 +248,8 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
         # touch the PRNG (and plain interpret mode on CPU can then run
         # them).
         if stochastic:
-            pltpu.prng_seed(meta_ref[0, 2] + b_idx * 131071
-                            + t_idx * 8191)
+            pltpu.prng_seed(meta_ref[0, 2] + b_idx * SEED_BLOCK_STRIDE
+                            + t_idx * SEED_CHUNK_STRIDE)
 
         p = {n: params_ref[0, i] for n, i in _PI.items()}
         dt_hr = p["dt_s"] / 3600.0
@@ -1025,6 +1035,20 @@ def neural_megakernel_rollout_summary(params: SimParams,
     return summary
 
 
+def _neural_packed_impl(params, net_params, exo_packed, seed, *, T, P, Z,
+                        K, stochastic, b_block, t_chunk, slo_mask,
+                        mlp_dims, interpret):
+    """Weight pack → population kernel → finalize on an ALREADY-PACKED
+    exo stream — the shared body of both neural fused entries."""
+    weights = _pack_mlp_tensors(net_params, mlp_dims, b_block)
+    out = _run_mlp(_pack_params(params), weights, exo_packed,
+                   _meta(T, stochastic, seed), P=P, Z=Z, K=K,
+                   stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+                   slo_mask=slo_mask, mlp_dims=mlp_dims,
+                   interpret=interpret)
+    return jax.vmap(lambda o: _finalize(params, o, T))(out)
+
+
 @functools.partial(jax.jit, static_argnames=(
     "T", "P", "Z", "K", "stochastic", "b_block", "t_chunk", "interpret",
     "slo_mask", "mlp_dims"))
@@ -1033,15 +1057,59 @@ def _fused_neural_summary(params, net_params, traces, seed, *, T, P, Z,
                           mlp_dims, interpret):
     """Weight pack → exo pack → population kernel → finalize, one jitted
     program (same dispatch-fusion rationale as
-    `_fused_profile_summary`)."""
-    weights = _pack_mlp_tensors(net_params, mlp_dims, b_block)
+    `_fused_profile_summary`). Delegates to the packed-stream body after
+    the exo pack, so the two can never diverge."""
     T_pad = math.ceil(T / t_chunk) * t_chunk
-    out = _run_mlp(_pack_params(params), weights, _pack_exo(traces, T_pad),
-                   _meta(T, stochastic, seed), P=P, Z=Z, K=K,
-                   stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
-                   slo_mask=slo_mask, mlp_dims=mlp_dims,
-                   interpret=interpret)
-    return jax.vmap(lambda o: _finalize(params, o, T))(out)
+    return _neural_packed_impl(
+        params, net_params, _pack_exo(traces, T_pad), seed, T=T, P=P, Z=Z,
+        K=K, stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+        slo_mask=slo_mask, mlp_dims=mlp_dims, interpret=interpret)
+
+
+_NEURAL_PACKED_STATICS = ("T", "P", "Z", "K", "stochastic", "b_block",
+                          "t_chunk", "interpret", "slo_mask", "mlp_dims")
+
+_fused_neural_packed_summary = functools.partial(
+    jax.jit, static_argnames=_NEURAL_PACKED_STATICS)(_neural_packed_impl)
+
+
+def _neural_packed_donate_impl(params, net_params, exo_packed, seed, *,
+                               T, P, Z, K, stochastic, b_block, t_chunk,
+                               slo_mask, mlp_dims, interpret):
+    """Donating variant: consumes the packed exo stream and weights
+    buffers and returns them aliased (ping-pong), so back-to-back ES
+    generations hold ONE stream in HBM instead of two — the caller
+    threads the returned stream into the next generation's synthesis
+    (`SyntheticSignalSource.packed_trace_device(recycle=...)`). The
+    identity returns are what make the donation USABLE (warning-free):
+    jax donation is input→output aliasing, and a donated buffer with no
+    same-shaped output is ignored with a warning."""
+    s = _neural_packed_impl(
+        params, net_params, exo_packed, seed, T=T, P=P, Z=Z, K=K,
+        stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+        slo_mask=slo_mask, mlp_dims=mlp_dims, interpret=interpret)
+    return s, exo_packed, net_params
+
+
+_fused_neural_packed_donate = functools.partial(
+    jax.jit, static_argnames=_NEURAL_PACKED_STATICS,
+    donate_argnums=(1, 2))(_neural_packed_donate_impl)
+
+
+def _check_chunking(T_pad: int, T: int, t_chunk: int) -> None:
+    """Shared by the single-chip and sharded packed entries (one copy of
+    the contract and its message)."""
+    if T_pad % t_chunk or T > T_pad:
+        raise ValueError(f"packed stream T_pad={T_pad} must be a "
+                         f"t_chunk={t_chunk} multiple covering T={T} — "
+                         "generate with the same t_chunk")
+
+
+def _check_packed(exo_packed, T: int, b_block: int, t_chunk: int) -> None:
+    T_pad, _rows, B = exo_packed.shape
+    if B % b_block:
+        raise ValueError(f"megakernel needs B % {b_block} == 0, got {B}")
+    _check_chunking(T_pad, T, t_chunk)
 
 
 def megakernel_summary_from_packed(params: SimParams,
@@ -1054,36 +1122,109 @@ def megakernel_summary_from_packed(params: SimParams,
                                    stochastic: bool = True,
                                    b_block: int = 512,
                                    t_chunk: int = 64,
-                                   interpret: bool = False):
+                                   interpret: bool = False,
+                                   carbon: tuple | None = None,
+                                   donate_stream: bool = False):
     """Rule-profile EpisodeSummary from an ALREADY-PACKED
     ``[T_pad, exo_rows, B]`` stream
     (`signals.synthetic.packed_trace_device`): the exo pack — the
     transpose that is most of the kernel's non-essential HBM traffic
     (ARCHITECTURE §6) — never runs, because the stream was generated in
     this layout. ``T`` is the true horizon (rows beyond it are padding).
+
+    ``carbon``: optional (sharpness, min_weight, stickiness) statics —
+    the CarbonAwarePolicy kernel on the same stream (see
+    `carbon_megakernel_summary_from_packed` for keyword defaults).
+    ``donate_stream``: donate the stream buffer into the launch and
+    return ``(summary, stream)`` with the stream ALIASED in place —
+    thread it into the next generation's synthesis
+    (``packed_trace_device(recycle=...)``) so back-to-back generations
+    never hold two streams in HBM.
     """
-    T_pad, _rows, B = exo_packed.shape
-    if B % b_block:
-        raise ValueError(f"megakernel needs B % {b_block} == 0, got {B}")
-    if T_pad % t_chunk or T > T_pad:
-        raise ValueError(f"packed stream T_pad={T_pad} must be a "
-                         f"t_chunk={t_chunk} multiple covering T={T} — "
-                         "generate with the same t_chunk")
+    _check_packed(exo_packed, T, b_block, t_chunk)
     P = int(off_action.zone_weight.shape[0])
     Z = int(off_action.zone_weight.shape[1])
-    return _fused_packed_summary(
+    fn = _fused_packed_donate if donate_stream else _fused_packed_summary
+    return fn(
         params, off_action, peak_action, exo_packed, jnp.int32(seed),
         T=T, P=P, Z=Z, K=int(params.provision_pipeline_k),
         stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
-        interpret=interpret)
+        interpret=interpret, carbon=carbon)
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "T", "P", "Z", "K", "stochastic", "b_block", "t_chunk", "interpret",
-    "carbon"))
-def _fused_packed_summary(params, off_action, peak_action, exo_packed,
-                          seed, *, T, P, Z, K, stochastic, b_block,
-                          t_chunk, interpret, carbon=None):
+def carbon_megakernel_summary_from_packed(params: SimParams,
+                                          off_action: Action,
+                                          peak_action: Action,
+                                          exo_packed: jnp.ndarray,
+                                          T: int,
+                                          seed: int | jnp.ndarray = 0,
+                                          *,
+                                          sharpness: float = 10.0,
+                                          min_weight: float = 0.05,
+                                          stickiness: float = 1.0,
+                                          stochastic: bool = True,
+                                          b_block: int = 512,
+                                          t_chunk: int = 64,
+                                          interpret: bool = False,
+                                          donate_stream: bool = False):
+    """CarbonAwarePolicy EpisodeSummary from a packed stream — the
+    packed-layout analog of `carbon_megakernel_rollout_summary` (keyword
+    defaults mirror CarbonAwarePolicy's). Same-seed/-stream runs are
+    PAIRED with the other packed entry points."""
+    return megakernel_summary_from_packed(
+        params, off_action, peak_action, exo_packed, T, seed,
+        stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+        interpret=interpret, donate_stream=donate_stream,
+        carbon=(float(sharpness), float(min_weight), float(stickiness)))
+
+
+def neural_megakernel_summary_from_packed(params: SimParams,
+                                          cluster,
+                                          net_params,
+                                          exo_packed: jnp.ndarray,
+                                          T: int,
+                                          seed: int | jnp.ndarray = 0,
+                                          *,
+                                          stochastic: bool = True,
+                                          b_block: int = 256,
+                                          t_chunk: int = 64,
+                                          interpret: bool = False,
+                                          donate_stream: bool = False):
+    """Population-MLP EpisodeSummary from a packed stream — the
+    packed-layout analog of `neural_megakernel_rollout_summary` (same
+    population-axis and pairing contract; same b_block=256 default and
+    caveat). ``donate_stream=True`` donates BOTH the stream and the
+    stacked weights pytree and returns ``(summary, stream)`` — the ES
+    mega engine's per-generation tensors are single-use, so the launch
+    reclaims them instead of double-peaking HBM."""
+    from ccka_tpu.policy.constraints import slo_pool_mask
+
+    _check_packed(exo_packed, T, b_block, t_chunk)
+    P, Z = cluster.n_pools, cluster.n_zones
+    K = int(params.provision_pipeline_k)
+    dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
+    if was_single:
+        net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                  net_params)
+    slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
+    kw = dict(T=T, P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
+              t_chunk=t_chunk, slo_mask=slo, mlp_dims=dims,
+              interpret=interpret)
+    if donate_stream:
+        summary, stream, _weights = _fused_neural_packed_donate(
+            params, net_params, exo_packed, jnp.int32(seed), **kw)
+    else:
+        summary = _fused_neural_packed_summary(
+            params, net_params, exo_packed, jnp.int32(seed), **kw)
+        stream = exo_packed
+    if was_single:
+        summary = jax.tree.map(lambda x: x[0], summary)
+    return (summary, stream) if donate_stream else summary
+
+
+def _packed_summary_impl(params, off_action, peak_action, exo_packed,
+                         seed, *, T, P, Z, K, stochastic, b_block,
+                         t_chunk, interpret, carbon=None):
     out = _run(_pack_params(params),
                jnp.stack([_pack_action(off_action),
                           _pack_action(peak_action)]),
@@ -1091,6 +1232,32 @@ def _fused_packed_summary(params, off_action, peak_action, exo_packed,
                P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
                t_chunk=t_chunk, interpret=interpret, carbon=carbon)
     return _finalize(params, out, T)
+
+
+_PACKED_STATICS = ("T", "P", "Z", "K", "stochastic", "b_block", "t_chunk",
+                   "interpret", "carbon")
+
+_fused_packed_summary = functools.partial(
+    jax.jit, static_argnames=_PACKED_STATICS)(_packed_summary_impl)
+
+
+def _packed_summary_donate_impl(params, off_action, peak_action,
+                                exo_packed, seed, *, T, P, Z, K,
+                                stochastic, b_block, t_chunk, interpret,
+                                carbon=None):
+    """Donating variant of the packed entry: the stream buffer is
+    consumed and returned aliased (see `_neural_packed_donate_impl` for
+    why the identity return is load-bearing)."""
+    s = _packed_summary_impl(
+        params, off_action, peak_action, exo_packed, seed, T=T, P=P, Z=Z,
+        K=K, stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+        interpret=interpret, carbon=carbon)
+    return s, exo_packed
+
+
+_fused_packed_donate = functools.partial(
+    jax.jit, static_argnames=_PACKED_STATICS,
+    donate_argnums=(3,))(_packed_summary_donate_impl)
 
 
 # Dispatch/recompile watch (obs/compile.py) on the fused jit entry
@@ -1111,6 +1278,15 @@ _fused_neural_summary = watch_jit(
 _fused_packed_summary = watch_jit(
     _fused_packed_summary, "megakernel.packed_summary", hot=True,
     warmup_compiles=6)
+_fused_packed_donate = watch_jit(
+    _fused_packed_donate, "megakernel.packed_summary_donate", hot=True,
+    warmup_compiles=6)
+_fused_neural_packed_summary = watch_jit(
+    _fused_neural_packed_summary, "megakernel.neural_packed_summary",
+    hot=True, warmup_compiles=6)
+_fused_neural_packed_donate = watch_jit(
+    _fused_neural_packed_donate, "megakernel.neural_packed_summary_donate",
+    hot=True, warmup_compiles=6)
 
 
 def unpack_exo(exo_packed: jnp.ndarray, T: int, Z: int) -> ExogenousTrace:

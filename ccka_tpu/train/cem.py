@@ -147,6 +147,7 @@ def cem_refine(cfg: FrameworkConfig, params0, source, *,
                teacher_fn=None,
                teacher_policy=None,
                engine: str = "lax",
+               mesh=None,
                mega_interpret: bool = False,
                seed: int = 0,
                log=None, runlog=None) -> tuple[dict, list[dict], dict]:
@@ -177,7 +178,21 @@ def cem_refine(cfg: FrameworkConfig, params0, source, *,
     1/√G) instead of 4. It requires a device-synthesizing source and a
     rule/carbon teacher given as ``teacher_policy`` (a PolicyBackend,
     NOT an action_fn — the engine must recognize the policy family to
-    fuse it).
+    fuse it). Each generation synthesizes its traces DIRECTLY in the
+    kernel's packed layout and donates the stream buffer through the
+    launch chain, so back-to-back generations hold one stream in HBM.
+
+    ``mesh``: a `jax.sharding.Mesh` takes the mega engine multi-chip
+    (`parallel/sharded_kernel.py`): the generation's candidates ×
+    traces fan out across the mesh's ``data`` axis, trace synthesis runs
+    shard-locally, and the kernel PRNG streams are keyed by global
+    (seed, shard, block) — so the paired-comparison invariant is
+    preserved exactly across shards. A mesh run additionally reproduces
+    a single-chip mega run of the same ``traces_per_gen`` bitwise when
+    both derive the same lane block (traces_per_gen/shards still a 256
+    multiple — block geometry is part of the stream key).
+    ``traces_per_gen`` must divide by the data-axis size (and by
+    128 × shards outside interpret mode). Ignored for the lax engine.
 
     ``runlog``: an `obs.runlog.RunLog`; every generation's history record
     is additionally written as a structured "gen" event (so a crashed
@@ -213,9 +228,10 @@ def cem_refine(cfg: FrameworkConfig, params0, source, *,
             raise ValueError("engine='mega' takes teacher_policy, not "
                              "teacher_fn (the kernel must recognize the "
                              "policy family)")
-        if not hasattr(source, "batch_trace_device"):
+        if not hasattr(source, "packed_trace_device"):
             raise ValueError("engine='mega' needs a device-synthesizing "
-                             "source (batch_trace_device)")
+                             "source (packed_trace_device / "
+                             "batch_trace_device)")
     elif teacher_policy is not None:
         teacher_fn = teacher_policy.action_fn()
     has_teacher = n_teachers > 0
@@ -287,14 +303,52 @@ def cem_refine(cfg: FrameworkConfig, params0, source, *,
         from ccka_tpu.policy import CarbonAwarePolicy
         from ccka_tpu.policy.rule import offpeak_action, peak_action
         from ccka_tpu.sim.megakernel import (
-            carbon_megakernel_rollout_summary, megakernel_rollout_summary,
-            neural_megakernel_rollout_summary)
+            carbon_megakernel_summary_from_packed,
+            megakernel_summary_from_packed,
+            neural_megakernel_summary_from_packed)
 
         G = cem.traces_per_gen
-        b_block = 256 if G % 256 == 0 else 128
-        if G % b_block:
+        n_shards = 1
+        if mesh is not None:
+            from ccka_tpu.parallel.sharded_kernel import (
+                data_shards, sharded_carbon_summary_from_packed,
+                sharded_megakernel_summary_from_packed,
+                sharded_neural_summary_from_packed, sharded_packed_trace)
+
+            n_shards = data_shards(mesh)
+            if G % n_shards:
+                raise ValueError(f"mega engine on a {n_shards}-shard mesh "
+                                 f"needs traces_per_gen divisible by the "
+                                 f"data-axis size, got {G}")
+            if not hasattr(source, "packed_generate_fn"):
+                raise ValueError(
+                    "mesh mega engine needs a shard-locally synthesizing "
+                    "source (packed_generate_fn) — replay stores are "
+                    "host-resident and cannot generate per shard")
+        G_loc = G // n_shards
+        if mesh is None and G % 128:
             raise ValueError("mega engine needs traces_per_gen to be a "
                              f"multiple of 128, got {G}")
+        if mesh is not None and G_loc % 128 and not mega_interpret:
+            # A per-shard batch below the 128-lane block only exists for
+            # interpret-mode tests/dryruns; on real chips it would hand
+            # Mosaic a non-lane-aligned block the single-chip path
+            # deliberately forbids.
+            raise ValueError(
+                f"mega engine on a {n_shards}-shard mesh needs "
+                f"traces_per_gen/shard to be a multiple of 128, got "
+                f"{G_loc} (= {G}/{n_shards})")
+        # Largest natural lane block that tiles the PER-SHARD batch
+        # (single-chip: the whole batch; keeps the measured-fastest 256
+        # when it divides). NOTE the pairing scope: within a run,
+        # candidates/rule/teacher always share one (stream, seed,
+        # b_block) and stay exactly paired; a mesh run additionally
+        # reproduces a single-chip run of the same G bitwise only when
+        # both derive the same block here (e.g. G/shards still a 256
+        # multiple) — block geometry is part of the stream key.
+        b_block = (256 if G_loc % 256 == 0
+                   else 128 if G_loc % 128 == 0 else G_loc)
+        t_chunk = 64
         if teacher_policy is not None and not isinstance(
                 teacher_policy, (CarbonAwarePolicy, RulePolicy)):
             raise ValueError("mega engine fuses rule/carbon teachers "
@@ -302,41 +356,65 @@ def cem_refine(cfg: FrameworkConfig, params0, source, *,
         off_a = offpeak_action(cfg.cluster)
         peak_a = peak_action(cfg.cluster)
 
-        def mega_generation(incumbent, sigma, key_tr, gseed, noise):
+        def mega_generation(incumbent, sigma, key_tr, gseed, noise,
+                            recycle):
             """One generation, every rollout on the kernel. One shared
-            (seed, b_block, t_chunk) across the three calls keeps the
-            interruption randomness IDENTICAL per (trace, tick) for
-            candidates, rule and teacher — the kernel-side analog of
-            the lax path's shared world keys."""
+            (stream, seed, b_block, t_chunk) across the three calls
+            keeps both the worlds AND the interruption randomness
+            IDENTICAL per (trace, tick) for candidates, rule and teacher
+            — the kernel-side analog of the lax path's shared world
+            keys; on a mesh the sharded wrappers key the PRNG by global
+            (seed, shard, block), preserving the same invariant. The
+            neural launch goes LAST and donates the stream (plus the
+            stacked candidate weights); the returned buffer is recycled
+            into the next generation's synthesis, so back-to-back
+            generations never hold two streams.
+
+            mega_interpret: pallas interpret mode for CPU-lane tests of
+            this engine (no Mosaic on the CPU backend) — necessarily
+            deterministic, since the pltpu PRNG primitives only lower
+            on real TPUs."""
             cand = candidates(incumbent, sigma, noise)
             stacked = jax.vmap(lambda f: _unflatten(f, spec))(cand)
-            traces = source.batch_trace_device(cem.eval_steps, key_tr, G)
-            # mega_interpret: pallas interpret mode for CPU-lane tests of
-            # this engine (no Mosaic on the CPU backend) — necessarily
-            # deterministic, since the pltpu PRNG primitives only lower
-            # on real TPUs.
-            kw = dict(seed=gseed, stochastic=not mega_interpret,
-                      b_block=b_block, interpret=mega_interpret)
-            summaries = neural_megakernel_rollout_summary(
-                params_sim, cfg.cluster, stacked, traces, **kw)
-            rule_s = megakernel_rollout_summary(
-                params_sim, off_a, peak_a, traces, **kw)
-            if isinstance(teacher_policy, CarbonAwarePolicy):
-                teach_s = carbon_megakernel_rollout_summary(
-                    params_sim, off_a, peak_a, traces,
-                    sharpness=teacher_policy.sharpness,
-                    min_weight=teacher_policy.min_weight,
-                    stickiness=teacher_policy.stickiness, **kw)
+            kw = dict(stochastic=not mega_interpret, b_block=b_block,
+                      t_chunk=t_chunk, interpret=mega_interpret)
+            tkw = dict(sharpness=teacher_policy.sharpness,
+                       min_weight=teacher_policy.min_weight,
+                       stickiness=teacher_policy.stickiness) \
+                if isinstance(teacher_policy, CarbonAwarePolicy) else None
+            T = cem.eval_steps
+            if mesh is None:
+                stream = source.packed_trace_device(
+                    T, key_tr, G, t_chunk=t_chunk, recycle=recycle)
+                rule_s = megakernel_summary_from_packed(
+                    params_sim, off_a, peak_a, stream, T, gseed, **kw)
+                teach_s = carbon_megakernel_summary_from_packed(
+                    params_sim, off_a, peak_a, stream, T, gseed,
+                    **tkw, **kw) if tkw else rule_s
+                summaries, stream = neural_megakernel_summary_from_packed(
+                    params_sim, cfg.cluster, stacked, stream, T, gseed,
+                    donate_stream=True, **kw)
             else:
-                # Rule teacher (or none): the rule run IS the teacher.
-                teach_s = rule_s
-            return cand, summaries, rule_s, teach_s
+                stream = sharded_packed_trace(
+                    mesh, source, T, key_tr, G, t_chunk=t_chunk,
+                    recycle=recycle)
+                rule_s = sharded_megakernel_summary_from_packed(
+                    mesh, params_sim, off_a, peak_a, stream, T, gseed,
+                    **kw)
+                teach_s = sharded_carbon_summary_from_packed(
+                    mesh, params_sim, off_a, peak_a, stream, T, gseed,
+                    **tkw, **kw) if tkw else rule_s
+                summaries, stream = sharded_neural_summary_from_packed(
+                    mesh, params_sim, cfg.cluster, stacked, stream, T,
+                    gseed, donate_stream=True, **kw)
+            return cand, summaries, rule_s, teach_s, stream
 
     history: list[dict] = []
     incumbent = flat0
     sigma = float(cem.sigma0)
     info = {"gen": 0, "fitness": float("inf")}
     key = jax.random.key(seed)
+    stream_recycle = None  # mega engine's donated-stream ping-pong
 
     def gen_traces(k, n):
         """Fresh trace batch: device synthesis when the source supports
@@ -352,8 +430,9 @@ def cem_refine(cfg: FrameworkConfig, params0, source, *,
         noise = jax.random.normal(k_noise, (n_pert, dim))
         if engine == "mega":
             gseed = int(jax.random.randint(k_world, (), 0, 2 ** 30))
-            cand, summaries, rule_s, teach_s = mega_generation(
-                incumbent, jnp.float32(sigma), k_tr, gseed, noise)
+            cand, summaries, rule_s, teach_s, stream_recycle = \
+                mega_generation(incumbent, jnp.float32(sigma), k_tr,
+                                gseed, noise, stream_recycle)
         else:
             traces = gen_traces(k_tr, cem.traces_per_gen)
             keys = jax.random.split(k_world, cem.traces_per_gen)

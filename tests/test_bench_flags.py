@@ -114,3 +114,21 @@ def test_roofline_floor_rejects_impossible_samples(monkeypatch):
     dt = bench._time_best(lambda: _time.sleep(0.001), repeats=1,
                           bytes_touched=1e3)
     assert dt is not None and dt >= 0.001
+
+
+def test_provenance_mesh_stamp():
+    """Multi-chip records are self-describing (ISSUE 3 satellite): a
+    mesh-stamped provenance block carries the mesh shape + axis sizes;
+    without a mesh the field still records the visible device count."""
+    import jax
+
+    from ccka_tpu.parallel import make_mesh
+
+    mesh = make_mesh(devices=jax.devices()[:8])
+    p = bench.bench_provenance(mesh=mesh)
+    assert p["mesh"]["shape"] == {"data": 8, "model": 1}
+    assert p["mesh"]["axis_names"] == ["data", "model"]
+    assert p["mesh"]["n_devices"] == 8
+    p0 = bench.bench_provenance()
+    assert p0["mesh"]["shape"] is None
+    assert p0["mesh"]["n_devices"] >= 1

@@ -1,0 +1,146 @@
+"""Claim-drift guard (ISSUE 3 satellite; VERDICT r5 weak #5): the
+numbers README's results section and PARITY's performance section state
+must match BASELINE.json — the round that made this test necessary had
+README still quoting the round-3 flagship (gen 20, ~0.98×/~0.79×, +4 pp)
+two rounds after round 5 superseded every one of those numbers.
+
+Quick lane (pure text + json parsing). The regexes pin the CLAIM
+PHRASES, deliberately: if a doc rewrite changes how a number is stated,
+this test must be updated in the same commit — that is the sync working,
+not a false positive. Tolerances are rounding-width only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(name: str) -> str:
+    with open(os.path.join(_ROOT, name), encoding="utf-8") as fh:
+        return fh.read()
+
+
+@pytest.fixture(scope="module")
+def baseline() -> dict:
+    return json.loads(_read("BASELINE.json"))
+
+
+@pytest.fixture(scope="module")
+def readme() -> str:
+    return _read("README.md")
+
+
+@pytest.fixture(scope="module")
+def parity() -> str:
+    return _read("PARITY.md")
+
+
+def _flagship_row(baseline) -> dict:
+    return (baseline["published"]["round5"]
+            ["north_star_quality_selection_5_fullday_traces"]
+            ["multiregion_ppo_flagship"])
+
+
+class TestFlagshipClaims:
+    """The multiregion flagship's headline ratios, attainments and
+    selection generation — one source of truth (BASELINE round5)."""
+
+    def test_readme_multiregion_bullet(self, readme, baseline):
+        row = _flagship_row(baseline)
+        m = re.search(
+            r"([\d.]+)×\s+\$/SLO-hour,\s+([\d.]+)×\s+gCO₂/kreq\s+—\s+at"
+            r"\s+\+([\d.]+)\s+pp\s+SLO\s+attainment\s*\(([\d.]+)\s+vs"
+            r"\s+rule\s+([\d.]+)\)", readme)
+        assert m, ("README's multiregion bullet no longer states the "
+                   "flagship ratios in the pinned form — update the "
+                   "claim AND this regex together")
+        usd, co2, pp, attain, rule_attain = map(float, m.groups())
+        assert abs(usd - row["vs_rule_usd_per_slo_hour"]) < 5e-3
+        assert abs(co2 - row["vs_rule_g_co2_per_kreq"]) < 5e-3
+        assert abs(attain - row["slo_attainment"]) < 5e-3
+        assert abs(rule_attain - row["rule_attainment"]) < 5e-3
+        assert abs(pp - 100 * (row["slo_attainment"]
+                               - row["rule_attainment"])) < 0.15
+
+    def test_readme_selection_generation(self, readme, baseline):
+        row = _flagship_row(baseline)
+        m = re.search(r"selected at generation (\d+)", readme)
+        assert m, "README no longer states the selection generation"
+        assert f"selected_iteration={m.group(1)}" in row["provenance"], (
+            f"README says generation {m.group(1)}; BASELINE round5 "
+            f"provenance says {row['provenance']!r}")
+
+    def test_parity_quality_bullet(self, parity, baseline):
+        row = _flagship_row(baseline)
+        m = re.search(
+            r"([\d.]+)×\s+\$/SLO-hour,\s+([\d.]+)×\s+gCO₂/kreq\s+at"
+            r"\s+attainment\s+([\d.]+)\s+vs\s+rule\s+([\d.]+)\s+"
+            r"\(teacher:\s+([\d.]+)×,\s+([\d.]+)×", parity)
+        assert m, ("PARITY's performance section no longer states the "
+                   "flagship numbers in the pinned form")
+        usd, co2, attain, rule_attain, _t_usd, t_co2 = map(float,
+                                                           m.groups())
+        assert abs(usd - row["vs_rule_usd_per_slo_hour"]) < 5e-3
+        assert abs(co2 - row["vs_rule_g_co2_per_kreq"]) < 5e-3
+        assert abs(attain - row["slo_attainment"]) < 5e-3
+        assert abs(rule_attain - row["rule_attainment"]) < 5e-3
+        assert abs(t_co2 - row["teacher_vs_rule_g_co2_per_kreq"]) < 5e-3
+
+
+class TestThroughputClaims:
+    """The kernel headline (round-4 measured 1,847,836 cluster-days/sec
+    at B=32768) — README states a range, PARITY a point value."""
+
+    def test_readme_range_contains_measured(self, readme, baseline):
+        measured = baseline["published"]["round4"][
+            "sim_cluster_days_per_sec_per_chip"] / 1e6
+        m = re.search(r"~([\d.]+)–([\d.]+)\s?M\s+simulated\s+"
+                      r"cluster-days/sec", readme)
+        assert m, "README no longer states the throughput range"
+        lo, hi = float(m.group(1)), float(m.group(2))
+        assert lo <= measured <= hi, (
+            f"README range {lo}–{hi}M excludes the measured "
+            f"{measured:.2f}M")
+
+    def test_parity_point_value(self, parity, baseline):
+        measured = baseline["published"]["round4"][
+            "sim_cluster_days_per_sec_per_chip"] / 1e6
+        m = re.search(r"~([\d.]+)M\s+simulated\s+cluster-days/sec/chip",
+                      parity)
+        assert m, "PARITY no longer states the throughput point value"
+        assert abs(float(m.group(1)) - measured) < 0.01
+
+
+class TestMultichipClaims:
+    """Round 8's multi-chip kernel record: the PARITY bullet must quote
+    BASELINE round8's 8-shard aggregate and keep the virtual-mesh label
+    next to it (a virtual-CPU number published as a chip number would be
+    the worst possible drift)."""
+
+    def test_round8_record_is_self_describing(self, baseline):
+        r8 = baseline["published"]["round8"]
+        sec = r8["multichip_virtual_mesh"]
+        assert sec["virtual_cpu_mesh"] is True
+        assert sec["mesh"]["shape"]["data"] == 8
+        assert sec["donation"]["ok"] is True
+        assert "8dev" in sec["weak_scaling"]
+
+    def test_parity_multichip_bullet(self, parity, baseline):
+        sec = (baseline["published"]["round8"]
+               ["multichip_virtual_mesh"])
+        agg = sec["weak_scaling"]["8dev"]["cluster_days_per_sec_aggregate"]
+        m = re.search(r"\*\*Multi-chip kernel\*\*.*?([\d,.]+)\s+"
+                      r"cluster-days/sec\s+aggregate", parity, re.S)
+        assert m, "PARITY no longer carries the multi-chip bullet"
+        quoted = float(m.group(1).replace(",", ""))
+        assert abs(quoted - agg) <= 1.0, (
+            f"PARITY quotes {quoted}, BASELINE round8 says {agg}")
+        bullet = parity[m.start():m.start() + 600]
+        assert re.search(r"virtual", bullet, re.I), (
+            "the multi-chip bullet lost its virtual-mesh label")

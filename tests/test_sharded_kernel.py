@@ -1,0 +1,310 @@
+"""8-device CPU-mesh parity gate for the multi-chip megakernel
+(`parallel/sharded_kernel.py`).
+
+The sharded wrappers earn trust the same way the single-chip kernel did
+(`tests/test_megakernel.py`): interpret mode on the virtual 8-device CPU
+mesh (conftest forces ``--xla_force_host_platform_device_count=8``),
+deterministic, against the single-device kernel — distribution-level on
+every EpisodeSummary field via the ONE shared tolerance table
+(`mean_parity_violations`), with the decomposition additionally exact by
+construction (same per-block kernel math, same shard-locally generated
+worlds). Stochastic-mode equivalence cannot execute on CPU (the pltpu
+PRNG only lowers on real TPUs), so the PAIRED-PRNG invariant — each
+shard's seed offset makes its block streams equal the single-chip
+kernel's GLOBAL block streams — is pinned at the seed-arithmetic level
+against the kernel's exported stride constants.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import ConfigError, default_config
+from ccka_tpu.parallel import (
+    make_mesh,
+    shard_seed,
+    sharded_carbon_summary_from_packed,
+    sharded_megakernel_rollout_summary,
+    sharded_megakernel_summary_from_packed,
+    sharded_neural_summary_from_packed,
+    sharded_packed_trace,
+)
+from ccka_tpu.policy.rule import offpeak_action, peak_action
+from ccka_tpu.sim import SimParams
+from ccka_tpu.sim.megakernel import (
+    SEED_BLOCK_STRIDE,
+    carbon_megakernel_summary_from_packed,
+    mean_parity_violations,
+    megakernel_rollout_summary,
+    megakernel_summary_from_packed,
+    neural_megakernel_summary_from_packed,
+)
+from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+# One shared geometry for the whole module: every test reuses the same
+# lru-cached sharded callables (and the single compile they cost), which
+# is what keeps this in the fast lane.
+B, T, T_CHUNK, B_BLOCK = 128, 64, 32, 16
+N_SHARDS = 8
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config()
+
+
+@pytest.fixture(scope="module")
+def setup(cfg):
+    params = SimParams.from_config(cfg)
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals)
+    return params, src, offpeak_action(cfg.cluster), peak_action(cfg.cluster)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(devices=jax.devices()[:N_SHARDS])
+
+
+@pytest.fixture(scope="module")
+def streams(mesh, setup):
+    """(sharded stream, bitwise-identical single-device stream): the
+    sharded one generated SHARD-LOCALLY on the mesh, the reference by
+    concatenating each shard's block generated with the same folded key
+    on one device."""
+    _params, src, _off, _peak = setup
+    key = jax.random.key(3)
+    stream = sharded_packed_trace(mesh, src, T, key, B, t_chunk=T_CHUNK)
+    ref = jnp.concatenate(
+        [src.packed_trace_device(T, jax.random.fold_in(key, s),
+                                 B // N_SHARDS, t_chunk=T_CHUNK)
+         for s in range(N_SHARDS)], axis=-1)
+    return stream, ref
+
+
+def _assert_parity(sk, ref, what, *, exact_tol=1e-5):
+    """BOTH gates: the shared tolerance table (the pinned contract) and
+    the deterministic decomposition's near-exactness."""
+    bad = mean_parity_violations(sk, ref)
+    assert not bad, f"{what}: shared-table parity broken: {bad}"
+    for f in sk._fields:
+        a = np.asarray(getattr(sk, f)).astype(np.float64)
+        b = np.asarray(getattr(ref, f)).astype(np.float64)
+        rel = float(np.max(np.abs(a - b) / (np.abs(b) + 1e-6)))
+        assert rel <= exact_tol, f"{what}: field {f} diverged ({rel})"
+
+
+def test_shard_local_generation_matches_per_shard_reference(streams):
+    """The exo stream is born shard-local (fold_in(key, shard)) and is
+    bitwise what each shard would generate alone — no ICI, no drift."""
+    stream, ref = streams
+    assert len(stream.addressable_shards) == N_SHARDS
+    np.testing.assert_allclose(np.asarray(stream), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_profile_entry_sharded_parity(mesh, setup, streams):
+    """Sharded `_fused_packed_summary` (rule profiles) == single-device
+    kernel on the identical worlds, all EpisodeSummary fields."""
+    params, _src, off, peak = setup
+    stream, ref_stream = streams
+    kw = dict(stochastic=False, b_block=B_BLOCK, t_chunk=T_CHUNK,
+              interpret=True)
+    sk = sharded_megakernel_summary_from_packed(
+        mesh, params, off, peak, stream, T, **kw)
+    assert len(sk.cost_usd.addressable_shards) == N_SHARDS
+    ref = megakernel_summary_from_packed(params, off, peak, ref_stream, T,
+                                         **kw)
+    _assert_parity(sk, ref, "profile")
+
+
+def test_carbon_entry_sharded_parity(mesh, setup, streams):
+    params, _src, off, peak = setup
+    stream, ref_stream = streams
+    kw = dict(stochastic=False, b_block=B_BLOCK, t_chunk=T_CHUNK,
+              interpret=True)
+    sk = sharded_carbon_summary_from_packed(
+        mesh, params, off, peak, stream, T, **kw)
+    ref = carbon_megakernel_summary_from_packed(
+        params, off, peak, ref_stream, T, **kw)
+    _assert_parity(sk, ref, "carbon")
+
+
+def _two_candidates(cfg):
+    from ccka_tpu.models import ActorCritic, latent_dim
+    from ccka_tpu.sim.megakernel import _obs_dim
+
+    net = ActorCritic(act_dim=latent_dim(cfg.cluster))
+    p0 = net.init(jax.random.key(5), jnp.zeros(
+        (_obs_dim(cfg.cluster.n_pools, cfg.cluster.n_zones),)))
+    p0 = jax.tree.map(
+        lambda x: x + 0.3 * jax.random.normal(jax.random.key(7), x.shape),
+        p0)
+    p1 = jax.tree.map(lambda x: x * 0.5, p0)
+    return jax.tree.map(lambda a, b: jnp.stack([a, b]), p0, p1)
+
+
+def test_neural_entry_sharded_parity(mesh, cfg, setup, streams):
+    """Sharded population-MLP entry: candidates replicated, batch split —
+    [NP, B] fields match the single-device population launch."""
+    params, _src, _off, _peak = setup
+    stream, ref_stream = streams
+    stacked = _two_candidates(cfg)
+    kw = dict(stochastic=False, b_block=B_BLOCK, t_chunk=T_CHUNK,
+              interpret=True)
+    sk = sharded_neural_summary_from_packed(
+        mesh, params, cfg.cluster, stacked, stream, T, **kw)
+    assert np.asarray(sk.cost_usd).shape == (2, B)
+    ref = neural_megakernel_summary_from_packed(
+        params, cfg.cluster, stacked, ref_stream, T, **kw)
+    _assert_parity(sk, ref, "neural")
+    # The two candidates genuinely differ (a zero-diff would mean the
+    # replicated weights never reached the per-shard kernels).
+    assert float(np.max(np.abs(np.asarray(sk.cost_usd)[1]
+                               - np.asarray(sk.cost_usd)[0]))) > 0
+
+
+def test_paired_prng_seed_invariant():
+    """The invariant that keeps sharded stochastic runs PAIRED with the
+    single-chip kernel (and candidates/rule/teacher with each other):
+    local block b of shard s must seed its pltpu stream exactly like
+    GLOBAL block s*nb + b on one chip. Pinned against the kernel's
+    exported stride so a refactor of either side trips this."""
+    seed = 1234
+    for n_shards, blocks_per_shard in ((8, 1), (4, 4), (2, 16)):
+        for s in range(n_shards):
+            for b_loc in range(blocks_per_shard):
+                local = shard_seed(seed, s, blocks_per_shard) \
+                    + b_loc * SEED_BLOCK_STRIDE
+                global_block = s * blocks_per_shard + b_loc
+                assert local == seed + global_block * SEED_BLOCK_STRIDE
+    # And the kernel actually consumes the exported constants (not stale
+    # literals) — the stride arithmetic above is only meaningful then.
+    import inspect
+
+    from ccka_tpu.sim import megakernel as mk
+
+    src = inspect.getsource(mk._make_kernel)
+    assert "SEED_BLOCK_STRIDE" in src and "SEED_CHUNK_STRIDE" in src
+
+
+def test_donation_chain_recycles_single_stream(mesh, setup, streams):
+    """donate_stream=True: same results, the input buffer genuinely
+    freed (CPU supports donation), the returned alias recyclable into
+    the next generation's synthesis — and no 'donated buffers were not
+    usable' warning anywhere in the chain."""
+    import warnings
+
+    params, src, off, peak = setup
+    _stream, ref_stream = streams
+    kw = dict(stochastic=False, b_block=B_BLOCK, t_chunk=T_CHUNK,
+              interpret=True)
+    ref = megakernel_summary_from_packed(params, off, peak, ref_stream, T,
+                                         **kw)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        stream = sharded_packed_trace(mesh, src, T, jax.random.key(3), B,
+                                      t_chunk=T_CHUNK)
+        sk, stream2 = sharded_megakernel_summary_from_packed(
+            mesh, params, off, peak, stream, T, donate_stream=True, **kw)
+        jax.block_until_ready(sk.cost_usd)
+        assert stream.is_deleted()
+        recycled = sharded_packed_trace(mesh, src, T, jax.random.key(9),
+                                        B, t_chunk=T_CHUNK,
+                                        recycle=stream2)
+        jax.block_until_ready(recycled)
+        assert stream2.is_deleted()
+    donation_msgs = [str(m.message) for m in w
+                     if "donated" in str(m.message).lower()]
+    assert not donation_msgs, donation_msgs
+    _assert_parity(sk, ref, "donated profile")
+    # The recycled buffer carries the NEW key's worlds, not the old ones.
+    fresh = src.packed_trace_device(T, jax.random.fold_in(
+        jax.random.key(9), 0), B // N_SHARDS, t_chunk=T_CHUNK)
+    np.testing.assert_allclose(
+        np.asarray(recycled)[..., :B // N_SHARDS], np.asarray(fresh),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_rejects_indivisible_batches(mesh, setup, streams):
+    params, src, off, peak = setup
+    stream, _ = streams
+    with pytest.raises(ConfigError, match="data shards"):
+        sharded_packed_trace(mesh, src, T, jax.random.key(0), 12)
+    with pytest.raises(ConfigError, match="b_block"):
+        sharded_megakernel_summary_from_packed(
+            mesh, params, off, peak, stream, T, b_block=12,
+            t_chunk=T_CHUNK, interpret=True)
+
+
+def test_compile_watch_no_recompile_on_repeat(mesh, setup, streams):
+    """The sharded entries are compile-watched (obs/compile.py): a
+    repeat call with identical shapes must be a cache hit — a recompile
+    here would mean the mesh/static plumbing re-keys the cache per call."""
+    from ccka_tpu.obs.compile import stats_for
+
+    params, _src, off, peak = setup
+    stream, _ = streams
+    kw = dict(stochastic=False, b_block=B_BLOCK, t_chunk=T_CHUNK,
+              interpret=True)
+    s = sharded_megakernel_summary_from_packed(
+        mesh, params, off, peak, stream, T, **kw)
+    jax.block_until_ready(s.cost_usd)
+    st = stats_for("sharded_kernel.packed_summary")
+    compiles_before, calls_before = st.compiles, st.calls
+    s = sharded_megakernel_summary_from_packed(
+        mesh, params, off, peak, stream, T, **kw)
+    jax.block_until_ready(s.cost_usd)
+    assert st.calls == calls_before + 1
+    assert st.compiles == compiles_before, "sharded entry recompiled"
+
+
+@pytest.mark.slow
+def test_trace_taking_wrappers_match_single_device(mesh, cfg, setup):
+    """The [B, T]-trace wrappers (pack runs per shard, inside the fused
+    jit): parity vs the single-device trace-taking kernel on the SAME
+    pre-generated batch. Slow lane: duplicates the packed entries'
+    fast-lane parity coverage through one extra layout path."""
+    params, src, off, peak = setup
+    traces = src.batch_trace_device(T, jax.random.key(11), B)
+    kw = dict(stochastic=False, b_block=B_BLOCK, t_chunk=T_CHUNK,
+              interpret=True)
+    sk = sharded_megakernel_rollout_summary(
+        mesh, params, off, peak, traces, **kw)
+    ref = megakernel_rollout_summary(params, off, peak, traces, **kw)
+    _assert_parity(sk, ref, "trace-taking profile")
+
+
+@pytest.mark.slow
+def test_cem_mega_engine_on_mesh(mesh, cfg):
+    """One (1+λ)-ES generation with engine='mega', mesh=: candidates ×
+    traces fan out over the 8 shards, worlds synthesized shard-locally.
+    Slow lane: `__graft_entry__.dryrun_multichip` runs this same step as
+    the driver contract, and the sharded entries' parity is pinned
+    above — this adds only their composition."""
+    from ccka_tpu.policy import CarbonAwarePolicy
+    from ccka_tpu.train.cem import CEMConfig, cem_refine
+    from ccka_tpu.train.ppo import PPOTrainer
+
+    params0 = PPOTrainer(cfg).init_state().params
+    best, hist, info = cem_refine(
+        cfg, params0, SyntheticSignalSource(cfg.cluster, cfg.workload,
+                                            cfg.sim, cfg.signals),
+        cem=CEMConfig(generations=1, popsize=3, traces_per_gen=B,
+                      eval_steps=16),
+        engine="mega", mesh=mesh, mega_interpret=True,
+        teacher_policy=CarbonAwarePolicy(cfg.cluster), seed=3)
+    assert len(hist) == 1
+    assert np.isfinite(hist[0]["incumbent_fitness"])
+    assert "actor_mean" in best["params"]
+
+    with pytest.raises(ValueError, match="divisible by the data-axis"):
+        cem_refine(cfg, params0,
+                   SyntheticSignalSource(cfg.cluster, cfg.workload,
+                                         cfg.sim, cfg.signals),
+                   cem=CEMConfig(generations=1, traces_per_gen=12,
+                                 eval_steps=16),
+                   engine="mega", mesh=mesh, mega_interpret=True)
