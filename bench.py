@@ -230,9 +230,10 @@ def _megakernel_parity_gate(cfg, params, src, *, b: int = 8192,
                                          mean_parity_violations)
 
     traces = src.batch_trace_device(steps, jax.random.key(23), b)
-    sk = megakernel_rollout_summary(
-        params, offpeak_action(cfg.cluster), peak_action(cfg.cluster),
-        traces, seed=9, stochastic=True)
+    off = offpeak_action(cfg.cluster)
+    peak = peak_action(cfg.cluster)
+    sk = megakernel_rollout_summary(params, off, peak, traces, seed=9,
+                                    stochastic=True)
     states = jax.tree.map(lambda x: jnp.broadcast_to(x, (b,) + x.shape),
                           initial_state(cfg))
     keys = jax.random.split(jax.random.key(0), b)
@@ -240,13 +241,31 @@ def _megakernel_parity_gate(cfg, params, src, *, b: int = 8192,
         params, states, RulePolicy(cfg.cluster).action_fn(), traces, keys,
         stochastic=True)
     bad = mean_parity_violations(sk, sl)
-    out = {"ok": not bad, "b": b, "steps": steps}
-    if bad:
-        out["failed_fields"] = bad
-        print(f"# megakernel parity gate FAILED: {bad} — kernel excluded "
-              "from the headline", file=sys.stderr)
+    # The plan-playback entry rides the SAME gate (ISSUE 4): a
+    # per-cluster plan replaying the rule profile selection per
+    # (cluster, tick) must match the lax rule rollout under the one
+    # shared tolerance table, same seed → paired with the profile
+    # kernel's draws.
+    from ccka_tpu.sim.megakernel import plan_megakernel_rollout_summary
+
+    is_peak = traces.is_peak > 0.5                       # [b, steps]
+    rule_plan = jax.tree.map(
+        lambda o, p: jnp.where(
+            is_peak.reshape(is_peak.shape + (1,) * o.ndim), p, o),
+        off, peak)
+    sp = plan_megakernel_rollout_summary(params, rule_plan, traces,
+                                         seed=9, stochastic=True)
+    bad_plan = mean_parity_violations(sp, sl)
+    out = {"ok": not bad and not bad_plan, "b": b, "steps": steps,
+           "plan_playback_ok": not bad_plan}
+    if bad or bad_plan:
+        out["failed_fields"] = dict(bad, **{f"plan:{k}": v
+                                            for k, v in bad_plan.items()})
+        print(f"# megakernel parity gate FAILED: {out['failed_fields']} — "
+              "kernel excluded from the headline", file=sys.stderr)
     else:
-        print("# megakernel parity gate ok", file=sys.stderr)
+        print("# megakernel parity gate ok (profile + plan playback)",
+              file=sys.stderr)
     return out
 
 
@@ -525,7 +544,94 @@ def bench_mpc(cfg, plans: int, fleet_batch: int = 256) -> dict:
         out["fleet_plans_per_sec"] = b * reps / dt_b
         print(f"# mpc fleet: {out['fleet_plans_per_sec']:,.0f} plans/s "
               f"(B={b} vmap'd)", file=sys.stderr)
+    try:
+        out["playback"] = _bench_mpc_playback(cfg, params, src, latent0)
+    except Exception as e:  # noqa: BLE001 — kernel stage must not kill
+        print(f"# mpc playback stage failed (omitted): {e!r}",
+              file=sys.stderr)
     return out
+
+
+def _bench_mpc_playback(cfg, params, src, latent0) -> dict:
+    """MPC execution on the plan-playback megakernel (ISSUE 4): the
+    quick planner's receding-horizon plan, tiled into a PER-CLUSTER
+    packed plan stream and executed/scored by the fused kernel —
+    kernel-scored cluster-days/sec with the same roofline-floor gating
+    as the rollout rows (plan + exo stream traffic both counted). On a
+    TPU host this is the Mosaic kernel in stochastic mode; elsewhere it
+    runs interpret-mode deterministic at CI sizes (labeled — validates
+    the path and records an honest small number, not a headline)."""
+    import math as _math
+
+    from ccka_tpu.models import latent_to_action
+    from ccka_tpu.sim import initial_state
+    from ccka_tpu.sim.megakernel import (
+        _plan_rows, pack_plan, plan_megakernel_summary_from_packed)
+    from ccka_tpu.train.mpc import receding_horizon_plan
+
+    on_tpu = jax.default_backend() == "tpu"
+    steps = 2880 if on_tpu else 96
+    b = 16384 if on_tpu else 256
+    t_chunk = 64 if on_tpu else 32
+    b_block = min(512, b)
+    days = steps * cfg.sim.dt_s / 86400.0
+    T_pad = _math.ceil(steps / t_chunk) * t_chunk
+
+    # Plan on the lax path: the quick planner (the flag-carrying
+    # scoreboard's settings) over one representative trace.
+    quick = dict(horizon=8, replan_every=8, iters=2)
+    lat_seq = receding_horizon_plan(
+        params, cfg.cluster, cfg.train, initial_state(cfg),
+        src.trace(steps, seed=11), latent0[:quick["horizon"]], **quick)
+    actions = jax.vmap(lambda u: latent_to_action(u, cfg.cluster))(lat_seq)
+    plan2d = pack_plan(actions, T_pad)                  # [T_pad, rows]
+    pr = _plan_rows(cfg.cluster.n_pools, cfg.cluster.n_zones)
+    # Per-cluster layout (the scoreboard's real traffic shape), tiled on
+    # device — playback throughput does not depend on plan CONTENT, and
+    # the stream the kernel reads is a genuine [T_pad, rows, B] buffer.
+    plan_stream = jax.jit(
+        lambda q: jnp.broadcast_to(q[:, :, None], (T_pad, pr, b)))(plan2d)
+    jax.block_until_ready(plan_stream)
+
+    kw = dict(stochastic=on_tpu, b_block=b_block, t_chunk=t_chunk,
+              interpret=not on_tpu)
+    state = {"stream": src.packed_trace_device(steps, jax.random.key(29),
+                                               b, t_chunk=t_chunk),
+             "seed": 0}
+
+    def once():
+        # Donation ping-pong on the EXO stream (the plan is reused —
+        # one plan scored against fresh worlds every repeat).
+        state["seed"] += 1
+        s, dead = plan_megakernel_summary_from_packed(
+            params, cfg.cluster, plan_stream, state["stream"], steps,
+            seed=state["seed"], donate_stream=True, **kw)
+        jax.block_until_ready(s.cost_usd)
+        state["stream"] = src.packed_trace_device(
+            steps, jax.random.key(200 + state["seed"]), b,
+            t_chunk=t_chunk, recycle=dead)
+
+    once()  # compile
+    row_bytes = float(b) * steps * (_trace_row_bytes(cfg) + 4 * pr)
+    dt = _time_best(once, repeats=2, bytes_touched=row_bytes,
+                    label="mpc.playback")
+    row = {
+        "engine": "plan_playback_megakernel(packed per-cluster plan, "
+                  "donated exo stream)",
+        "planner": dict(quick, mode="lax_quick_plan"),
+        "batch": b, "steps": steps, "b_block": b_block,
+        "t_chunk": t_chunk,
+        "stochastic": on_tpu, "interpret": not on_tpu,
+    }
+    if dt is not None:
+        row["seconds"] = round(dt, 4)
+        row["cluster_days_per_sec"] = round(b * days / dt, 1)
+        row["roofline_floor_ms"] = round(
+            _roofline_floor_s(row_bytes) * 1e3, 3)
+        print(f"# mpc playback: {row['cluster_days_per_sec']:,.0f} "
+              f"kernel-scored cluster-days/s (B={b}, T={steps}"
+              f"{', INTERPRET' if not on_tpu else ''})", file=sys.stderr)
+    return row
 
 
 def bench_fleet(cfg, n_clusters: int, ticks: int) -> dict:
@@ -619,6 +725,31 @@ def _flag_wins(section: dict, rule_row: dict) -> None:
                 and raw and attain_ok)
         r["beats_rule_both_headlines"] = bool(wins)
         r["win_flag_significance_gated"] = bool(gated)
+
+
+# The MPC evidence standard (ISSUE 4): every PUBLISHED MPC
+# `beats_rule_both_headlines` flag rests on win2se at >= this many
+# KERNEL-paired traces (bench_quality_mega's plan-playback row). The
+# lax stages keep their raw ratios and paired statistics but defer the
+# flag — at their trace counts the 2-se machinery has no power against
+# the ~1% effects the flag claims.
+MPC_FLAG_MIN_TRACES = 256
+
+
+def _defer_mpc_flags(section: dict) -> None:
+    """Null the headline win flag on every lax-stage MPC row, recording
+    where the flag now lives. `matches_or_beats_rule_raw` and the
+    paired-ratio statistics stay — they are evidence, just not the
+    flag."""
+    note = (f"deferred: MPC flags publish only from the kernel-paired "
+            f"n>={MPC_FLAG_MIN_TRACES} plan-playback stage "
+            "(quality_mega.mpc)")
+    for name, r in section.items():
+        if not isinstance(r, dict) or "beats_rule_both_headlines" not in r:
+            continue
+        if name == "mpc" or name.startswith("mpc_"):
+            r["beats_rule_both_headlines"] = None
+            r["headline_flag"] = note
 
 
 def bench_mesh(cfg, *, batch: int = 8192, steps: int = 480,
@@ -813,6 +944,17 @@ def bench_multichip(cfg, *, steps: int | None = None,
                 print(f"# multichip warning: {m.category.__name__}: "
                       f"{str(m.message)[:200]}", file=sys.stderr)
 
+    playback = None
+    if mesh8 is not None:
+        try:
+            playback = _multichip_plan_playback(
+                cfg, params, src, mesh8, steps=steps,
+                per_device_batch=per_device_batch, b_block=b_block,
+                t_chunk=t_chunk, repeats=repeats, virtual=virtual)
+        except Exception as e:  # noqa: BLE001 — row guard
+            print(f"# multichip plan-playback failed (skipped): "
+                  f"{repr(e)[:160]}", file=sys.stderr)
+
     if not rows:
         print("# multichip: no row survived — stage dropped",
               file=sys.stderr)
@@ -848,6 +990,8 @@ def bench_multichip(cfg, *, steps: int | None = None,
                      "warnings": donation_msgs[:3]},
         "provenance": provenance,
     }
+    if playback is not None:
+        out["plan_playback"] = playback
     if donation_msgs:
         print("# WARNING: donation warnings in the multichip stage: "
               f"{donation_msgs[0][:120]}", file=sys.stderr)
@@ -857,6 +1001,96 @@ def bench_multichip(cfg, *, steps: int | None = None,
                        "not absolute speed; real-chip rows come from a "
                        "multi-TPU host")
     return out
+
+
+def _multichip_plan_playback(cfg, params, src, mesh, *, steps: int,
+                             per_device_batch: int, b_block: int,
+                             t_chunk: int, repeats: int,
+                             virtual: bool) -> dict | None:
+    """Sharded PLAN-PLAYBACK row (ISSUE 4): the quick planner's plan,
+    tiled into a per-cluster packed stream SPLIT over the mesh lanes,
+    executed by `sharded_plan_summary_from_packed` on the largest mesh
+    the weak-scaling sweep measured. Roofline floor counts BOTH streams
+    each shard reads (exo + plan rows) — the playback kernel's
+    irreducible traffic is ~2x the profile kernel's."""
+    import math as _math
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ccka_tpu.models import action_to_latent, latent_to_action
+    from ccka_tpu.parallel import (sharded_packed_trace,
+                                   sharded_plan_summary_from_packed)
+    from ccka_tpu.policy.rule import neutral_action
+    from ccka_tpu.sim import initial_state
+    from ccka_tpu.sim.megakernel import _plan_rows, pack_plan
+    from ccka_tpu.train.mpc import optimize_plan
+
+    n = int(mesh.shape[mesh.axis_names[0]])
+    B = per_device_batch * n
+    T_pad = _math.ceil(steps / t_chunk) * t_chunk
+    pr = _plan_rows(cfg.cluster.n_pools, cfg.cluster.n_zones)
+    days = steps * cfg.sim.dt_s / 86400.0
+
+    # A real (quick) plan, tiled across the horizon — playback
+    # throughput is content-independent, the stream layout is not.
+    h = 8
+    base = jnp.zeros_like(action_to_latent(neutral_action(cfg.cluster),
+                                           cfg.cluster))
+    lat = optimize_plan(params, cfg.cluster, cfg.train,
+                        initial_state(cfg), src.trace(h, seed=13),
+                        jnp.broadcast_to(base, (h,) + base.shape),
+                        iters=2).plan_latent
+    lat_t = jnp.tile(lat, (T_pad // h + 1, 1))[:T_pad]
+    actions = jax.vmap(lambda u: latent_to_action(u, cfg.cluster))(lat_t)
+    plan2d = pack_plan(actions, T_pad)                   # [T_pad, pr]
+    spec = NamedSharding(mesh, PartitionSpec(None, None,
+                                             mesh.axis_names[0]))
+    # Tiled ON the mesh: each shard materializes only its lane block.
+    plan_stream = jax.jit(
+        lambda q: jnp.broadcast_to(q[:, :, None], (T_pad, pr, B)),
+        out_shardings=spec)(plan2d)
+    jax.block_until_ready(plan_stream)
+
+    kw = dict(stochastic=not virtual, b_block=b_block, t_chunk=t_chunk,
+              interpret=virtual)
+    state = {"stream": sharded_packed_trace(mesh, src, steps,
+                                            jax.random.key(17), B,
+                                            t_chunk=t_chunk),
+             "seed": 0}
+
+    def once():
+        state["seed"] += 1
+        s, dead = sharded_plan_summary_from_packed(
+            mesh, params, cfg.cluster, plan_stream, state["stream"],
+            steps, seed=state["seed"], donate_stream=True, **kw)
+        jax.block_until_ready(s.cost_usd)
+        state["stream"] = sharded_packed_trace(
+            mesh, src, steps, jax.random.key(300 + state["seed"]), B,
+            t_chunk=t_chunk, recycle=dead)
+
+    once()  # compile
+    shard_bytes = float(per_device_batch) * steps \
+        * (_trace_row_bytes(cfg) + 4 * pr)
+    dt = _time_best(once, repeats, bytes_touched=shard_bytes,
+                    label=f"multichip.plan_playback.{n}dev")
+    if dt is None:
+        return None
+    row = {
+        "engine": "sharded_plan_playback_megakernel(per-cluster plan, "
+                  "donated exo stream)",
+        "devices": n, "batch": B, "per_device_batch": per_device_batch,
+        "steps": steps, "plan_rows": pr,
+        "seconds": round(dt, 4),
+        "cluster_days_per_sec_aggregate": round(B * days / dt, 1),
+        "cluster_days_per_sec_per_device": round(B * days / dt / n, 1),
+        "roofline_floor_ms_per_shard": round(
+            _roofline_floor_s(shard_bytes) * 1e3, 3),
+    }
+    print(f"# multichip plan-playback {n}dev: "
+          f"{row['cluster_days_per_sec_aggregate']:,.0f} cluster-days/s "
+          f"aggregate{' (VIRTUAL+INTERPRET)' if virtual else ''}",
+          file=sys.stderr)
+    return row
 
 
 def _multichip_virtual_fallback() -> dict | None:
@@ -1037,6 +1271,8 @@ def bench_quality(cfg, eval_steps: int = 2880,
 
     _flag_wins(out, out["rule"])
     _flag_wins(out["multiregion"], out["multiregion"]["rule"])
+    _defer_mpc_flags(out)
+    _defer_mpc_flags(out["multiregion"])
     for label, section in (("", out), ("multiregion.", out["multiregion"])):
         for name in ("ppo", "mpc"):
             if name not in section:
@@ -1129,6 +1365,7 @@ def bench_quality_replay(cfg, eval_steps: int = 2880, n_windows: int = 0,
     # drift between the two), so a replay-family shortfall can't hide
     # behind raw ratios.
     _flag_wins(out, out["rule"])
+    _defer_mpc_flags(out)
     learned = [n for n in ("mpc", "ppo") if n in out]
     for name in learned:
         print(f"# quality_replay[{name}]: usd x"
@@ -1206,6 +1443,7 @@ def bench_forecast(cfg, eval_steps: int = 2880, n_windows: int = 2,
         if name != "rule":
             out[name].update(_paired_ratios(board, name))
     _flag_wins(out, out["rule"])
+    _defer_mpc_flags(out)
 
     # Oracle → forecast degradation, the stage's headline: how much of
     # the perfect-foresight ratio each real forecaster gives back.
@@ -1262,31 +1500,49 @@ def bench_forecast(cfg, eval_steps: int = 2880, n_windows: int = 2,
 def bench_quality_mega(n_traces: int = 256, eval_steps: int = 2880,
                        *, seed: int = 31) -> dict | None:
     """High-power kernel scoreboard (VERDICT r4 next #1 + #3): rule,
-    carbon and the learned flagships scored on ``n_traces`` PAIRED
-    full-day traces via the Pallas megakernels — ~50x the lax quality
-    stage's trace count, so the 2-se significance gate resolves
+    carbon, the learned flagships AND diff-MPC scored on ``n_traces``
+    PAIRED full-day traces via the Pallas megakernels — ~50x the lax
+    quality stage's trace count, so the 2-se significance gate resolves
     sub-percent effects instead of drowning them. All rows of a section
     share one (seed, b_block, t_chunk): identical per-(trace, tick)
     interruption randomness (`sim/megakernel.py` pairing contract).
-    MPC has no kernel path — its rows stay in the lax `quality` stage,
-    noted here. Mosaic-only: returns None off-TPU (CPU and GPU hosts
-    both skip cleanly)."""
+
+    MPC rides the plan-playback kernel (ISSUE 4; VERDICT r5 Next #5's
+    strong form): the quick planner plans each trace on the LAX path
+    (`receding_horizon_plan_batch` — deterministic expectation
+    dynamics, so the plan depends only on the trace), then the kernel
+    executes those per-cluster plans on the SAME paired stochastic
+    worlds as every other row — MPC's `beats_rule_both_headlines` flag
+    finally rests on the same win2se evidence standard as ppo/carbon.
+    Mosaic-only: returns None off-TPU (CPU and GPU hosts both skip
+    cleanly)."""
     if jax.default_backend() != "tpu":
         print("# quality_mega: no TPU — skipped (Mosaic kernels)",
               file=sys.stderr)
         return None
     from ccka_tpu.config import default_config, multi_region_config
+    from ccka_tpu.models import action_to_latent, latent_to_action
     from ccka_tpu.policy import CarbonAwarePolicy
-    from ccka_tpu.policy.rule import offpeak_action, peak_action
-    from ccka_tpu.sim import SimParams
+    from ccka_tpu.policy.rule import neutral_action, offpeak_action, \
+        peak_action
+    from ccka_tpu.sim import SimParams, initial_state
     from ccka_tpu.sim.megakernel import (
         carbon_megakernel_rollout_summary, megakernel_rollout_summary,
-        neural_megakernel_rollout_summary)
+        neural_megakernel_rollout_summary,
+        plan_megakernel_rollout_summary)
     from ccka_tpu.train.flagship import load_flagship_backend
+    from ccka_tpu.train.mpc import receding_horizon_plan_batch
 
+    quick_planner = dict(horizon=8, iters=2, replan_every=8)
     out: dict = {"n_traces": n_traces, "eval_steps": eval_steps,
                  "engine": "megakernel",
-                 "mpc": "no kernel path — see the lax `quality` stage"}
+                 "mpc_planner": dict(
+                     quick_planner, n_traces=n_traces,
+                     mode="lax_quick_plan->kernel_playback",
+                     note="plans computed per paired trace on the lax "
+                          "path (expectation dynamics), executed/scored "
+                          "by the plan-playback kernel on the shared "
+                          "(seed, stream) — the flag-carrying MPC row")}
     for label, cfg in (("default", default_config()),
                        ("multiregion", multi_region_config())):
         src = _make_src(cfg)
@@ -1304,6 +1560,21 @@ def bench_quality_mega(n_traces: int = 256, eval_steps: int = 2880,
                 params, off, peak, traces, sharpness=cp.sharpness,
                 min_weight=cp.min_weight, stickiness=cp.stickiness, **kw),
         }
+        # MPC: lax planning over every paired trace, kernel execution.
+        base = jnp.zeros_like(action_to_latent(
+            neutral_action(cfg.cluster), cfg.cluster))
+        lat0 = jnp.broadcast_to(
+            base, (n_traces, quick_planner["horizon"]) + base.shape)
+        states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n_traces,) + x.shape),
+            initial_state(cfg))
+        plans = receding_horizon_plan_batch(
+            params, cfg.cluster, cfg.train, states, traces, lat0,
+            **quick_planner)                         # [N, T, A]
+        plan_actions = jax.vmap(jax.vmap(
+            lambda u: latent_to_action(u, cfg.cluster)))(plans)
+        summaries["mpc"] = plan_megakernel_rollout_summary(
+            params, plan_actions, traces, **kw)
         variants = [("ppo", "")]
         if label == "multiregion":
             variants.append(("ppo_frontier", "multiregion_frontier"))
@@ -1340,7 +1611,7 @@ def bench_quality_mega(n_traces: int = 256, eval_steps: int = 2880,
                 row.update(_paired_ratios(board, name))
             section[name] = row
         _flag_wins(section, section["rule"])
-        for name in ("carbon", "ppo", "ppo_frontier"):
+        for name in ("carbon", "mpc", "ppo", "ppo_frontier"):
             r = section.get(name)
             if not r:
                 continue
@@ -1437,6 +1708,10 @@ def main(argv=None) -> int:
                     help="run ONLY the multi-chip megakernel stage and "
                          "print its JSON (used by the CPU-virtual "
                          "fallback subprocess)")
+    ap.add_argument("--mpc-only", action="store_true",
+                    help="run ONLY the MPC stage (plans/s + the kernel "
+                         "plan-playback row) and print its JSON — the "
+                         "BENCH_r09 record path; CI-sized off-TPU")
     ap.add_argument("--mega-phase", choices=("gate", "time"),
                     help="child phases of the isolated megakernel stage "
                          "(see _mega_subprocess): 'gate' prints the "
@@ -1462,6 +1737,16 @@ def main(argv=None) -> int:
         multichip = bench_multichip(default_config())
         print(json.dumps(multichip))
         return 0 if multichip is not None else 1
+
+    if args.mpc_only:
+        from ccka_tpu.config import default_config
+        on_tpu = jax.default_backend() == "tpu"
+        mpc = bench_mpc(default_config(),
+                        plans=20 if on_tpu else 5,
+                        fleet_batch=256 if on_tpu else 64)
+        mpc["provenance"] = bench_provenance()
+        print(json.dumps(mpc))
+        return 0
 
     if args.mega_phase == "gate":
         from ccka_tpu.config import default_config
@@ -1628,7 +1913,11 @@ def main(argv=None) -> int:
                          for k, v in r.items()}
                     for kk, r in rollout.items()},
         "ppo": {k: round(v, 3) for k, v in ppo.items()},
-        "mpc": {k: round(float(v), 3) for k, v in mpc.items()},
+        # mpc carries the nested playback row (already rounded); only
+        # scalars round here.
+        "mpc": {k: (round(float(v), 3) if isinstance(v, (int, float))
+                    else v)
+                for k, v in mpc.items()},
     }
     if fleet is not None:
         line["fleet"] = {k: round(float(v), 3) for k, v in fleet.items()}

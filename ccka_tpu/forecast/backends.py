@@ -70,6 +70,9 @@ class SeasonalNaiveForecaster(Forecaster):
                              f"got {period_steps}")
         self.period_steps = int(period_steps)
 
+    def _config_key(self) -> tuple:
+        return (self.period_steps,)
+
     def predict(self, history: ExogenousTrace,
                 horizon: int) -> ExogenousTrace:
         z, c = _shape_info(history)
@@ -146,6 +149,9 @@ class RidgeARForecaster(Forecaster):
             raise ValueError(f"lags must be >= 1, got {lags}")
         self.lags = int(lags)
         self.ridge = float(ridge)
+
+    def _config_key(self) -> tuple:
+        return (self.lags, self.ridge)
 
     def predict(self, history: ExogenousTrace,
                 horizon: int) -> ExogenousTrace:

@@ -72,6 +72,36 @@ class Forecaster(abc.ABC):
 
     name: str = "forecaster"
 
+    # -- compile-cache identity ------------------------------------------
+    # Forecasters ride as STATIC arguments into the jitted planners
+    # (`train/mpc.py`), so their hash IS the compile-cache key. Default
+    # object identity meant two `make_forecaster("ridge")` calls with
+    # identical config hashed differently — a fresh instance per replan
+    # silently recompiled the entire receding-horizon program (the
+    # ARCHITECTURE §8 hazard `obs/compile.py` was built to surface).
+    # Equality/hash therefore key on (type, config): same-config
+    # instances share one compile, different configs still get their
+    # own. Backends with constructor state SHOULD override
+    # `_config_key`; the default is fail-safe, not permissive — it
+    # derives the key from the instance's attributes, so a future
+    # stateful backend that forgets the override still hashes
+    # differently for different configs (two alphas silently sharing
+    # one traced program would be wrong RESULTS, strictly worse than
+    # the wasted recompile this fix removed). Unhashable attribute
+    # values (e.g. arrays) fail loudly at hash time rather than
+    # silently colliding.
+
+    def _config_key(self) -> tuple:
+        """Hashable constructor config; () for stateless backends."""
+        return tuple(sorted(self.__dict__.items()))
+
+    def __eq__(self, other) -> bool:
+        return (type(self) is type(other)
+                and self._config_key() == other._config_key())
+
+    def __hash__(self) -> int:
+        return hash((type(self), self._config_key()))
+
     @abc.abstractmethod
     def predict(self, history: ExogenousTrace,
                 horizon: int) -> ExogenousTrace:

@@ -1,11 +1,15 @@
 """Dispatch/recompile accounting for jitted entry points.
 
-XLA recompiles are the repo's quietest performance hazard: forecaster
-*instances* are compile-cache keys on the MPC replan path (ARCHITECTURE
-§8 — two `make_forecaster("ridge")` calls produce equal configs but
-distinct static-arg hashes, so each new instance silently recompiles the
-whole receding-horizon program), and nothing counted them. This module
-wraps a jitted callable and watches its compile cache:
+XLA recompiles are the repo's quietest performance hazard: through
+round 8, forecaster *instances* were compile-cache keys on the MPC
+replan path (ARCHITECTURE §8 — two `make_forecaster("ridge")` calls
+produced equal configs but distinct static-arg hashes, so each new
+instance silently recompiled the whole receding-horizon program), and
+nothing counted them. These counters surfaced that hazard; round 9
+fixed the key itself (config-keyed `Forecaster.__hash__`), and the
+watch now guards against any other static-arg value re-keying a hot
+path mid-run. This module wraps a jitted callable and watches its
+compile cache:
 
     optimize_plan = watch_jit(optimize_plan, "mpc.optimize_plan", hot=True)
 

@@ -32,6 +32,7 @@ from ccka_tpu.parallel.sharded import (  # noqa: F401
     sharded_batched_rollout_summary,
 )
 from ccka_tpu.parallel.sharded_kernel import (  # noqa: F401
+    shard_plan_stream,
     shard_seed,
     sharded_carbon_megakernel_rollout_summary,
     sharded_carbon_summary_from_packed,
@@ -40,4 +41,5 @@ from ccka_tpu.parallel.sharded_kernel import (  # noqa: F401
     sharded_neural_megakernel_rollout_summary,
     sharded_neural_summary_from_packed,
     sharded_packed_trace,
+    sharded_plan_summary_from_packed,
 )

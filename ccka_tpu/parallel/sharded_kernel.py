@@ -56,8 +56,10 @@ from ccka_tpu.obs.compile import watch_jit
 from ccka_tpu.sim.megakernel import (
     SEED_BLOCK_STRIDE,
     _check_chunking,
+    _check_plan,
     _fused_neural_packed_summary,
     _fused_packed_summary,
+    _fused_plan_packed_summary,
     _fused_profile_summary,
     _mlp_dims,
 )
@@ -324,6 +326,84 @@ def sharded_neural_summary_from_packed(mesh: Mesh, params: SimParams,
     if was_single:
         summary = jax.tree.map(lambda x: x[0], summary)
     return (summary, stream) if donate_stream else summary
+
+
+# ---- plan playback over the mesh (ISSUE 4) -------------------------------
+
+
+def shard_plan_stream(mesh: Mesh, plan_packed: jnp.ndarray):
+    """Place a packed plan (`sim.megakernel.pack_plan`) on the mesh:
+    per-cluster ``[T_pad, rows, B]`` plans split over the ``data`` axis
+    (lane-aligned with the exo stream they will play against), broadcast
+    ``[T_pad, rows]`` plans replicated."""
+    spec = (PartitionSpec(None, None, mesh.axis_names[0])
+            if plan_packed.ndim == 3 else PartitionSpec())
+    return jax.device_put(plan_packed,
+                          jax.sharding.NamedSharding(mesh, spec))
+
+
+@functools.lru_cache(maxsize=64)
+def _plan_call(mesh: Mesh, T, P, Z, K, stochastic, b_block, t_chunk,
+               interpret, plan_batched, blocks_per_shard, donate):
+    data = mesh.axis_names[0]
+    stream_spec = PartitionSpec(None, None, data)
+    # A broadcast plan replicates; per-cluster plans split on the SAME
+    # lane axis as the exo stream, so each shard plays exactly the plans
+    # of its own trace block.
+    plan_spec = stream_spec if plan_batched else PartitionSpec()
+
+    def body(params, plan, exo, seed):
+        local = shard_seed(seed, jax.lax.axis_index(data),
+                           blocks_per_shard)
+        s = _fused_plan_packed_summary(
+            params, plan, exo, local, T=T, P=P, Z=Z, K=K,
+            stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+            interpret=interpret, plan_batched=plan_batched)
+        return (s, exo) if donate else s
+
+    out_specs = ((PartitionSpec(data), stream_spec) if donate
+                 else PartitionSpec(data))
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(PartitionSpec(), plan_spec, stream_spec,
+                             PartitionSpec()),
+                   out_specs=out_specs, check_rep=False)
+    name = ("sharded_kernel.plan_summary"
+            + ("_batched" if plan_batched else "")
+            + ("_donate" if donate else ""))
+    jfn = jax.jit(fn, donate_argnums=(2,)) if donate else jax.jit(fn)
+    return watch_jit(jfn, name, hot=True, warmup_compiles=_WARMUP_COMPILES,
+                     shared_stats=True)
+
+
+def sharded_plan_summary_from_packed(mesh: Mesh, params: SimParams,
+                                     cluster,
+                                     plan_packed: jnp.ndarray,
+                                     exo_packed: jnp.ndarray, T: int,
+                                     seed: int | jnp.ndarray = 0, *,
+                                     stochastic: bool = True,
+                                     b_block: int = 512,
+                                     t_chunk: int = 64,
+                                     interpret: bool = False,
+                                     donate_stream: bool = False):
+    """Plan-playback EpisodeSummary batch from a mesh-sharded packed exo
+    stream — `plan_megakernel_summary_from_packed` over the ``data``
+    axis. The exo stream (and a per-cluster plan stream, via
+    `shard_plan_stream`) split on the batch lanes; a broadcast plan
+    replicates. Same `shard_seed` offsets as every other sharded entry,
+    so MPC-vs-rule comparisons on one (stream, seed, b_block, t_chunk)
+    survive sharding bit-for-bit. ``donate_stream=True`` donates the exo
+    stream only (``(summary, stream)`` — the plan typically outlives the
+    launch; see the single-chip entry's rationale)."""
+    n = data_shards(mesh)
+    T_pad, _rows, B = exo_packed.shape
+    b_loc = _split_batch(B, n, b_block, "stream")
+    _check_chunking(T_pad, T, t_chunk)
+    P, Z = cluster.n_pools, cluster.n_zones
+    plan_batched = _check_plan(plan_packed, exo_packed, P, Z)
+    fn = _plan_call(mesh, T, P, Z, int(params.provision_pipeline_k),
+                    stochastic, b_block, t_chunk, interpret, plan_batched,
+                    b_loc // b_block, donate_stream)
+    return fn(params, plan_packed, exo_packed, jnp.int32(seed))
 
 
 # ---- trace-taking wrappers (pack runs per shard, inside the fused jit) ---

@@ -48,6 +48,13 @@ Design (the round-3 sketch, realized):
   contract. The general `PolicyBackend` path stays on the lax rollout
   (`sim/rollout.py`), which remains the reference implementation the
   parity suite pins this kernel against.
+- **Plan playback** (round 9, ARCHITECTURE §11): a fourth mode executes
+  a PRECOMPUTED action stream — broadcast ``[T_pad, rows]`` (SMEM
+  scalars) or per-cluster ``[T_pad, rows, B]`` (VMEM, the exo stream's
+  layout) — instead of deciding in-kernel. This is diff-MPC's execution
+  path: plans come from the lax receding-horizon planner
+  (`train/mpc.py`), the kernel scores them on paired stochastic worlds
+  (`plan_megakernel_rollout_summary` / `..._summary_from_packed`).
 
 Semantics contract: identical to
 ``batched_rollout_summary(params, zeros, RulePolicy(...).action_fn(),
@@ -138,6 +145,13 @@ def _act_rows(P: int, Z: int) -> int:
     # zone_weight P*Z + ct_allow 2P + aggr P + after P + hpa 2.
     return P * Z + 2 * P + P + P + 2
 
+
+def _plan_rows(P: int, Z: int) -> int:
+    """Rows of a packed plan stream: the action coordinates padded to a
+    sublane multiple (the per-cluster form is a VMEM-streamed
+    ``[T_pad, rows, B]`` block exactly like the exo stream)."""
+    return math.ceil(_act_rows(P, Z) / 8) * 8
+
 # Packed scalar params (SMEM [1, NP]).
 _PARAM_NAMES = (
     "dt_s", "ppn", "base_od", "maxn0", "maxn1",
@@ -208,12 +222,18 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
                  policy: str = "profiles",
                  carbon: tuple | None = None,
                  slo_mask: tuple | None = None,
-                 mlp_dims: tuple | None = None):
-    """``policy``: "profiles" | "carbon" | "mlp" (module docstring).
+                 mlp_dims: tuple | None = None,
+                 plan_batched: bool = False):
+    """``policy``: "profiles" | "carbon" | "mlp" | "plan" (module
+    docstring; "plan" executes a precomputed per-tick action stream —
+    the diff-MPC playback entry — instead of deciding in-kernel).
 
     ``carbon``: (sharpness, min_weight, stickiness) compile-time floats.
     ``slo_mask``: per-pool SLO flags (mlp feasibility projection rule 3).
     ``mlp_dims``: (F, F_pad, H, A) — obs/hidden/latent dims, static.
+    ``plan_batched``: plan streams are ``[T_pad, rows, B]`` (per-cluster
+    plans, VMEM-streamed like the exo block) rather than ``[T_pad,
+    rows]`` (one broadcast plan, SMEM scalars).
     """
     ROWS = _state_rows(P, Z, K)
     NPZ = P * Z * 2  # nodes rows
@@ -233,6 +253,10 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
             # Grid (pop, batch, time): weights per population member.
             b_idx = pl.program_id(1)
             t_idx = pl.program_id(2)
+        elif policy == "plan":
+            plan_ref, exo_ref, out_ref, s_ref = rest
+            b_idx = pl.program_id(0)
+            t_idx = pl.program_id(1)
         else:
             actions_ref, exo_ref, out_ref, s_ref = rest
             b_idx = pl.program_id(0)
@@ -283,12 +307,26 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
             running = rows(state, "running")       # [2, B]
             timer = rows(state, "timer")           # [P, B]
 
-            if policy in ("profiles", "carbon"):
-                def act(j):
-                    """Action coordinate j: per-cluster select of the
-                    two constant profiles on is_peak."""
-                    return jnp.where(is_peak, actions_ref[1, j],
-                                     actions_ref[0, j])
+            if policy in ("profiles", "carbon", "plan"):
+                if policy == "plan":
+                    if plan_batched:
+                        prow = plan_ref[i]        # [plan_rows, B]
+
+                        def act(j):
+                            """Action coordinate j of this tick's
+                            per-cluster plan row."""
+                            return prow[j]
+                    else:
+                        def act(j):
+                            """Coordinate j of the broadcast plan's tick
+                            row (SMEM scalar → all lanes)."""
+                            return jnp.broadcast_to(plan_ref[i, j], (B,))
+                else:
+                    def act(j):
+                        """Action coordinate j: per-cluster select of the
+                        two constant profiles on is_peak."""
+                        return jnp.where(is_peak, actions_ref[1, j],
+                                         actions_ref[0, j])
 
                 zw = [[act(pp * Z + z) for z in range(Z)]
                       for pp in range(P)]
@@ -1260,6 +1298,228 @@ _fused_packed_donate = functools.partial(
     donate_argnums=(3,))(_packed_summary_donate_impl)
 
 
+# ---- plan playback: execute a precomputed action sequence ---------------
+
+
+def pack_plan(actions: Action, T_pad: int) -> jnp.ndarray:
+    """Action pytree with a leading time axis → packed plan stream.
+
+    ``[T, ...]`` leaves (ONE plan broadcast to every cluster) →
+    ``[T_pad, plan_rows]``; ``[B, T, ...]`` leaves (per-cluster plans —
+    diff-MPC's receding-horizon output, one plan per trace) →
+    ``[T_pad, plan_rows, B]`` in the exo stream's feature-first layout.
+    Coordinate order is `_pack_action`'s (the kernel's action order);
+    rows pad to a sublane multiple and ticks beyond T pad zero (the
+    kernel's ``valid`` gate never executes them). Pure jnp — runs inside
+    the fused jit."""
+    per_cluster = actions.zone_weight.ndim == 4
+    P = int(actions.zone_weight.shape[-2])
+    Z = int(actions.zone_weight.shape[-1])
+    rows, pr = _act_rows(P, Z), _plan_rows(P, Z)
+    if per_cluster:
+        packed = jax.vmap(jax.vmap(_pack_action))(actions)   # [B, T, rows]
+        packed = jnp.moveaxis(packed, 0, -1)                 # [T, rows, B]
+        return jnp.pad(packed, ((0, T_pad - packed.shape[0]),
+                                (0, pr - rows), (0, 0)))
+    packed = jax.vmap(_pack_action)(actions)                 # [T, rows]
+    return jnp.pad(packed, ((0, T_pad - packed.shape[0]), (0, pr - rows)))
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "P", "Z", "K", "stochastic", "b_block", "t_chunk", "interpret",
+    "plan_batched"))
+def _run_plan(params_packed, plan_packed, exo_packed, meta, *, P, Z, K,
+              stochastic, b_block, t_chunk, plan_batched,
+              interpret=False):
+    T_pad, _, B = exo_packed.shape
+    n_b = B // b_block
+    n_t = T_pad // t_chunk
+    kernel, ROWS = _make_kernel(P, Z, K, t_chunk, n_t, stochastic,
+                                policy="plan", plan_batched=plan_batched)
+    s_rows = math.ceil(ROWS["_total"][1] / 8) * 8
+    pr = _plan_rows(P, Z)
+    if plan_batched:
+        # Per-cluster plans stream through VMEM exactly like the exo
+        # block (same chunking, same lane split).
+        plan_spec = pl.BlockSpec((t_chunk, pr, b_block),
+                                 lambda b, t: (t, 0, b),
+                                 memory_space=pltpu.VMEM)
+    else:
+        # One broadcast plan: t_chunk×rows scalars per chunk in SMEM
+        # (~4 KB at the defaults) — no lane traffic at all.
+        plan_spec = pl.BlockSpec((t_chunk, pr), lambda b, t: (t, 0),
+                                 memory_space=pltpu.SMEM)
+
+    out = pl.pallas_call(
+        kernel,
+        interpret=interpret,
+        grid=(n_b, n_t),
+        in_specs=[
+            pl.BlockSpec((1, 3), lambda b, t: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, len(_PARAM_NAMES)), lambda b, t: (0, 0),
+                         memory_space=pltpu.SMEM),
+            plan_spec,
+            pl.BlockSpec((t_chunk, _exo_rows(Z), b_block),
+                         lambda b, t: (t, 0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_OUT_ROWS, b_block), lambda b, t: (0, b),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((_OUT_ROWS, B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((s_rows, b_block), jnp.float32)],
+    )(meta, params_packed, plan_packed, exo_packed)
+    return out
+
+
+def _check_plan(plan_packed, exo_packed, P: int, Z: int) -> bool:
+    """Shape contract of a packed plan vs its exo stream; returns
+    ``plan_batched``."""
+    T_pad, _rows, B = exo_packed.shape
+    pr = _plan_rows(P, Z)
+    if plan_packed.ndim not in (2, 3) or \
+            plan_packed.shape[0] != T_pad or plan_packed.shape[1] != pr:
+        raise ValueError(
+            f"plan stream shape {tuple(plan_packed.shape)} does not "
+            f"match the exo stream's T_pad={T_pad} / plan_rows={pr} for "
+            f"this topology — pack with pack_plan(actions, T_pad)")
+    if plan_packed.ndim == 3 and plan_packed.shape[2] != B:
+        raise ValueError(
+            f"per-cluster plan batch {plan_packed.shape[2]} != stream "
+            f"batch {B}")
+    return plan_packed.ndim == 3
+
+
+def _plan_packed_impl(params, plan_packed, exo_packed, seed, *, T, P, Z,
+                      K, stochastic, b_block, t_chunk, interpret,
+                      plan_batched):
+    out = _run_plan(_pack_params(params), plan_packed, exo_packed,
+                    _meta(T, stochastic, seed), P=P, Z=Z, K=K,
+                    stochastic=stochastic, b_block=b_block,
+                    t_chunk=t_chunk, plan_batched=plan_batched,
+                    interpret=interpret)
+    return _finalize(params, out, T)
+
+
+_PLAN_STATICS = ("T", "P", "Z", "K", "stochastic", "b_block", "t_chunk",
+                 "interpret", "plan_batched")
+
+_fused_plan_packed_summary = functools.partial(
+    jax.jit, static_argnames=_PLAN_STATICS)(_plan_packed_impl)
+
+
+def _plan_packed_donate_impl(params, plan_packed, exo_packed, seed, *, T,
+                             P, Z, K, stochastic, b_block, t_chunk,
+                             interpret, plan_batched):
+    """Donating variant: the EXO stream is consumed and returned aliased
+    (``(summary, stream)`` — recycle via ``packed_trace_device``). The
+    PLAN stream is deliberately NOT donated: a scoreboard scores one
+    plan against many fresh worlds, so the plan buffer outlives the
+    launch by design."""
+    s = _plan_packed_impl(
+        params, plan_packed, exo_packed, seed, T=T, P=P, Z=Z, K=K,
+        stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+        interpret=interpret, plan_batched=plan_batched)
+    return s, exo_packed
+
+
+_fused_plan_packed_donate = functools.partial(
+    jax.jit, static_argnames=_PLAN_STATICS,
+    donate_argnums=(2,))(_plan_packed_donate_impl)
+
+
+@functools.partial(jax.jit, static_argnames=_PLAN_STATICS)
+def _fused_plan_summary(params, plan_actions, traces, seed, *, T, P, Z,
+                        K, stochastic, b_block, t_chunk, interpret,
+                        plan_batched):
+    """Plan pack → exo pack → playback kernel → finalize, one jitted
+    program (same dispatch-fusion rationale as `_fused_profile_summary`).
+    Delegates to the packed-stream body after the packs, so the two can
+    never diverge."""
+    T_pad = math.ceil(T / t_chunk) * t_chunk
+    return _plan_packed_impl(
+        params, pack_plan(plan_actions, T_pad), _pack_exo(traces, T_pad),
+        seed, T=T, P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
+        t_chunk=t_chunk, interpret=interpret, plan_batched=plan_batched)
+
+
+def plan_megakernel_rollout_summary(params: SimParams,
+                                    plan_actions: Action,
+                                    traces: ExogenousTrace,
+                                    seed: int | jnp.ndarray = 0,
+                                    *,
+                                    stochastic: bool = True,
+                                    b_block: int = 512,
+                                    t_chunk: int = 64,
+                                    interpret: bool = False):
+    """EpisodeSummary batch for fresh-state PLAN-PLAYBACK rollouts: a
+    precomputed action sequence executed tick-for-tick instead of a
+    policy — the diff-MPC execution path at kernel speed (ISSUE 4).
+
+    ``plan_actions``: an Action pytree with leading ``[T]`` axes (one
+    plan broadcast to every cluster) or ``[B, T]`` axes (per-cluster
+    plans, e.g. `train.mpc.receding_horizon_plan_batch` output decoded
+    through ``latent_to_action``). Semantics contract: identical to
+    ``rollout_actions(params, zeros, plan, trace, key, stochastic=...)``
+    per cluster — exact (float-tolerance) in deterministic mode,
+    distribution-level in stochastic mode. Same ``seed``/``b_block``/
+    ``t_chunk`` pairs runs with the rule/carbon/mlp kernels (the kernel
+    PRNG is policy-independent — module docstring), which is what lets
+    MPC execution be scored against the rule baseline on IDENTICAL
+    worlds AND identical interruption draws."""
+    B, T = traces.is_peak.shape
+    if B % b_block:
+        raise ValueError(f"megakernel needs B % {b_block} == 0, got {B}")
+    per_cluster = plan_actions.zone_weight.ndim == 4
+    t_axis = plan_actions.zone_weight.shape[1 if per_cluster else 0]
+    if t_axis != T:
+        raise ValueError(f"plan covers {t_axis} ticks, traces cover {T} "
+                         "— plan playback needs one action per tick")
+    if per_cluster and plan_actions.zone_weight.shape[0] != B:
+        raise ValueError(
+            f"per-cluster plan batch {plan_actions.zone_weight.shape[0]} "
+            f"!= trace batch {B}")
+    P = int(plan_actions.zone_weight.shape[-2])
+    Z = int(plan_actions.zone_weight.shape[-1])
+    return _fused_plan_summary(
+        params, plan_actions, traces, jnp.int32(seed), T=T, P=P, Z=Z,
+        K=int(params.provision_pipeline_k), stochastic=stochastic,
+        b_block=b_block, t_chunk=t_chunk, interpret=interpret,
+        plan_batched=per_cluster)
+
+
+def plan_megakernel_summary_from_packed(params: SimParams,
+                                        cluster,
+                                        plan_packed: jnp.ndarray,
+                                        exo_packed: jnp.ndarray,
+                                        T: int,
+                                        seed: int | jnp.ndarray = 0,
+                                        *,
+                                        stochastic: bool = True,
+                                        b_block: int = 512,
+                                        t_chunk: int = 64,
+                                        interpret: bool = False,
+                                        donate_stream: bool = False):
+    """Plan-playback EpisodeSummary from ALREADY-PACKED plan + exo
+    streams (`pack_plan` / `packed_trace_device`) — the packed-layout
+    analog of `plan_megakernel_rollout_summary`, matching the rule/
+    carbon packed entries' contract. ``cluster``: the ClusterConfig
+    (topology — P/Z are not recoverable from padded streams).
+    ``donate_stream=True`` donates the EXO stream and returns
+    ``(summary, stream)`` aliased; the plan stream is never donated
+    (one plan is typically scored against many fresh worlds — see
+    `_plan_packed_donate_impl`)."""
+    _check_packed(exo_packed, T, b_block, t_chunk)
+    P, Z = cluster.n_pools, cluster.n_zones
+    plan_batched = _check_plan(plan_packed, exo_packed, P, Z)
+    fn = (_fused_plan_packed_donate if donate_stream
+          else _fused_plan_packed_summary)
+    return fn(params, plan_packed, exo_packed, jnp.int32(seed), T=T, P=P,
+              Z=Z, K=int(params.provision_pipeline_k),
+              stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+              interpret=interpret, plan_batched=plan_batched)
+
+
 # Dispatch/recompile watch (obs/compile.py) on the fused jit entry
 # points — the only places a megakernel launch actually dispatches
 # (`_run`/`_run_mlp` live inside these traces). A sweep legitimately
@@ -1286,6 +1546,15 @@ _fused_neural_packed_summary = watch_jit(
     hot=True, warmup_compiles=6)
 _fused_neural_packed_donate = watch_jit(
     _fused_neural_packed_donate, "megakernel.neural_packed_summary_donate",
+    hot=True, warmup_compiles=6)
+_fused_plan_summary = watch_jit(
+    _fused_plan_summary, "megakernel.plan_summary", hot=True,
+    warmup_compiles=6)
+_fused_plan_packed_summary = watch_jit(
+    _fused_plan_packed_summary, "megakernel.plan_packed_summary",
+    hot=True, warmup_compiles=6)
+_fused_plan_packed_donate = watch_jit(
+    _fused_plan_packed_donate, "megakernel.plan_packed_summary_donate",
     hot=True, warmup_compiles=6)
 
 

@@ -89,7 +89,56 @@ def optimize_plan(params: SimParams,
     return PlanResult(plan_latent=latent, losses=losses)
 
 
-@partial(jax.jit, static_argnames=("cluster", "tcfg", "iters"))
+def _mesh_fanout(run, mesh):
+    """Batch-planner fan-out, the mirror of `cem_refine(mesh=)`: params
+    replicated, the cluster batch split over the mesh's data axis —
+    each chip plans its own slice of the fleet, no collectives anywhere
+    (plans are per-cluster independent). ``run(params, states, traces,
+    latents)`` is the vmapped single-device body; ONE copy of the
+    shard_map specs serves both batch planners."""
+    if mesh is None:
+        return run
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec
+
+    spec = PartitionSpec(mesh.axis_names[0])
+    return shard_map(run, mesh=mesh,
+                     in_specs=(PartitionSpec(), spec, spec, spec),
+                     out_specs=spec, check_rep=False)
+
+
+def _plan_batch_impl(params, cluster, tcfg, states0, traces, init_latents,
+                     *, iters, mesh):
+    def run(p, s, tr, lat):
+        return jax.vmap(
+            lambda s1, tr1, l1: optimize_plan(p, cluster, tcfg, s1, tr1,
+                                              l1, iters=iters)
+        )(s, tr, lat)
+
+    return _mesh_fanout(run, mesh)(params, states0, traces, init_latents)
+
+
+_PLAN_BATCH_STATICS = ("cluster", "tcfg", "iters", "mesh")
+_plan_batch_jit = partial(
+    jax.jit, static_argnames=_PLAN_BATCH_STATICS)(_plan_batch_impl)
+# Donating variant: the [N, H, A] warm-start buffer is consumed and the
+# returned plan_latent aliases it (same shape/dtype) — a fleet replan
+# loop that threads plans segment-to-segment holds ONE plan buffer
+# instead of double-peaking HBM at fleet scale.
+_plan_batch_donate = partial(
+    jax.jit, static_argnames=_PLAN_BATCH_STATICS,
+    donate_argnums=(5,))(_plan_batch_impl)
+
+
+def _check_mesh_batch(mesh, n: int, what: str) -> None:
+    if mesh is None:
+        return
+    shards = int(mesh.shape[mesh.axis_names[0]])
+    if n % shards:
+        raise ValueError(f"{what}: batch {n} not divisible by the "
+                         f"data-axis size {shards}")
+
+
 def optimize_plan_batch(params: SimParams,
                         cluster: ClusterConfig,
                         tcfg: TrainConfig,
@@ -97,18 +146,175 @@ def optimize_plan_batch(params: SimParams,
                         traces: ExogenousTrace,
                         init_latents: jnp.ndarray,
                         *,
-                        iters: int = 50) -> PlanResult:
+                        iters: int = 50,
+                        mesh=None,
+                        donate_plans: bool = False) -> PlanResult:
     """Fleet-scale planning: `vmap` of :func:`optimize_plan` over a cluster
     batch ([N, ...] states / traces / latent plans → [N, H, A] plans).
 
     One dispatch plans every cluster's receding-horizon window at once —
     the N-cluster analog the round-2 review noted was missing (single-
     cluster MPC at 8.5 plans/sec is two orders short of fleet control;
-    batching rides the same vmap economics as the rollout bench)."""
-    return jax.vmap(
-        lambda s, tr, lat: optimize_plan(params, cluster, tcfg, s, tr, lat,
-                                         iters=iters)
-    )(states0, traces, init_latents)
+    batching rides the same vmap economics as the rollout bench).
+
+    ``mesh``: a `jax.sharding.Mesh` fans the cluster batch out over the
+    mesh's ``data`` axis (mirroring `cem_refine`'s fan-out): params
+    replicated, states/traces/warm-starts split, zero collectives. N
+    must divide by the data-axis size. ``donate_plans=True`` donates the
+    warm-start buffer into the launch — the returned ``plan_latent``
+    aliases it, so a segment-to-segment replan loop holds one plan
+    buffer per chip. Do NOT reuse a donated ``init_latents`` afterwards.
+    """
+    _check_mesh_batch(mesh, init_latents.shape[0], "optimize_plan_batch")
+    fn = _plan_batch_donate if donate_plans else _plan_batch_jit
+    return fn(params, cluster, tcfg, states0, traces, init_latents,
+              iters=iters, mesh=mesh)
+
+
+def _segment_windows(trace: ExogenousTrace, horizon: int,
+                     replan_every: int, forecaster, history_steps: int):
+    """Per-segment planning windows + execution segments — the shared
+    front half of :func:`receding_horizon_rollout` and
+    :func:`receding_horizon_plan` (one copy of the oracle/forecast
+    gather logic, so the two can never diverge). Returns
+    ``(windows, segs, n_seg, t_steps)`` with windows ``[n_seg, H, ...]``
+    and segs ``[n_seg, R, ...]``."""
+    t_steps = trace.steps
+    if t_steps % replan_every:
+        raise ValueError(f"trace length {t_steps} not a multiple of "
+                         f"replan_every={replan_every}")
+    n_seg = t_steps // replan_every
+
+    starts = jnp.arange(n_seg) * replan_every
+    if forecaster is None:
+        idx = jnp.minimum(starts[:, None] + jnp.arange(horizon)[None, :],
+                          t_steps - 1)                   # [n_seg, H]
+        # Trace leaves are time-leading ([T,Z]/[T,C]/[T]); gather axis 0.
+        windows = jax.tree.map(lambda x: x[idx],
+                               exo_steps(trace))         # [n_seg, H, ...]
+    else:
+        from ccka_tpu.forecast.base import planning_window
+
+        h_steps = history_steps or forecaster.wanted_history(horizon)
+        # History ends at the segment's first tick (its signals are
+        # scraped before the decide — same observation surface as the
+        # live loop); indices clamp at 0, repeating the first tick
+        # backwards, never forwards.
+        hist_idx = jnp.maximum(
+            starts[:, None] + jnp.arange(1 - h_steps, 1)[None, :],
+            0)                                           # [n_seg, T_hist]
+        hists = ExogenousTrace(*jax.tree.map(
+            lambda x: x[hist_idx], exo_steps(trace)))
+        # window[0] = the observed segment-start tick, window[1:] =
+        # predictions of the H-1 ticks after it — planner and executor
+        # share one time base, still nothing future-dated.
+        predicted = jax.vmap(
+            lambda h: planning_window(forecaster, h, horizon))(hists)
+        windows = exo_steps(predicted)                   # [n_seg, H, ...]
+    segs = jax.tree.map(
+        lambda x: x.reshape((n_seg, replan_every) + x.shape[1:]),
+        exo_steps(trace))                                 # [n_seg, R, ...]
+    return windows, segs, n_seg, t_steps
+
+
+@partial(jax.jit, static_argnames=("cluster", "tcfg", "horizon",
+                                   "replan_every", "iters",
+                                   "forecaster", "history_steps"))
+def receding_horizon_plan(params: SimParams,
+                          cluster: ClusterConfig,
+                          tcfg: TrainConfig,
+                          state0: ClusterState,
+                          trace: ExogenousTrace,
+                          init_latent: jnp.ndarray,
+                          *,
+                          horizon: int,
+                          replan_every: int,
+                          iters: int,
+                          forecaster=None,
+                          history_steps: int = 0) -> jnp.ndarray:
+    """The receding-horizon loop as a PLANNER: returns the executed
+    ``[T, A]`` latent sequence instead of metrics — the kernel
+    plan-playback input (ISSUE 4: MPC plans on the lax path, executes
+    on the kernel).
+
+    Same segment scan as :func:`receding_horizon_rollout` (shared
+    window gather, same warm-start roll), but execution between replans
+    runs on EXPECTATION dynamics (``stochastic=False``), so the plan
+    depends only on (trace, planner config) — never on an execution
+    noise realization. The playback kernel then scores that plan on
+    stochastic paired worlds; this is open-loop playback of a
+    closed-loop-derived plan, and the trajectory mismatch it introduces
+    is part of what the scoreboard honestly measures.
+    """
+    windows, segs, _n_seg, t_steps = _segment_windows(
+        trace, horizon, replan_every, forecaster, history_steps)
+
+    def body(carry, inp):
+        state, plan = carry
+        window, seg = inp
+        pr = optimize_plan(params, cluster, tcfg, state,
+                           ExogenousTrace(*window), plan, iters=iters)
+        plan = pr.plan_latent
+        exec_lat = plan[:replan_every]                   # [R, A]
+        actions = jax.vmap(lambda u: latent_to_action(u, cluster))(
+            exec_lat)
+        state, _ = rollout_actions(
+            params, state, actions, ExogenousTrace(*seg),
+            jax.random.key(0), stochastic=False)
+        return (state, jnp.roll(plan, -replan_every, axis=0)), exec_lat
+
+    _, latents = jax.lax.scan(body, (state0, init_latent),
+                              (windows, segs))           # [n_seg, R, A]
+    return latents.reshape((t_steps,) + latents.shape[2:])
+
+
+def _plan_rh_batch_impl(params, cluster, tcfg, states0, traces,
+                        init_latents, *, horizon, replan_every, iters,
+                        forecaster, history_steps, mesh):
+    def run(p, s, tr, lat):
+        return jax.vmap(
+            lambda s1, tr1, l1: receding_horizon_plan(
+                p, cluster, tcfg, s1, tr1, l1, horizon=horizon,
+                replan_every=replan_every, iters=iters,
+                forecaster=forecaster, history_steps=history_steps)
+        )(s, tr, lat)
+
+    return _mesh_fanout(run, mesh)(params, states0, traces, init_latents)
+
+
+_plan_rh_batch_jit = partial(
+    jax.jit, static_argnames=("cluster", "tcfg", "horizon",
+                              "replan_every", "iters", "forecaster",
+                              "history_steps", "mesh"))(
+    _plan_rh_batch_impl)
+
+
+def receding_horizon_plan_batch(params: SimParams,
+                                cluster: ClusterConfig,
+                                tcfg: TrainConfig,
+                                states0: ClusterState,
+                                traces: ExogenousTrace,
+                                init_latents: jnp.ndarray,
+                                *,
+                                horizon: int,
+                                replan_every: int,
+                                iters: int,
+                                forecaster=None,
+                                history_steps: int = 0,
+                                mesh=None) -> jnp.ndarray:
+    """`vmap` of :func:`receding_horizon_plan` over a trace batch —
+    ``[N, T, A]`` executed latent plans, one per paired trace, in one
+    dispatch. ``mesh`` fans N out over the mesh's ``data`` axis exactly
+    like :func:`optimize_plan_batch` (params replicated, batch split,
+    no collectives); N must divide by the data-axis size. This is the
+    planning half of the n≥256 kernel MPC scoreboard
+    (`bench.bench_quality_mega`)."""
+    _check_mesh_batch(mesh, init_latents.shape[0],
+                      "receding_horizon_plan_batch")
+    return _plan_rh_batch_jit(
+        params, cluster, tcfg, states0, traces, init_latents,
+        horizon=horizon, replan_every=replan_every, iters=iters,
+        forecaster=forecaster, history_steps=history_steps, mesh=mesh)
 
 
 @partial(jax.jit, static_argnames=("cluster", "tcfg", "horizon",
@@ -150,41 +356,8 @@ def receding_horizon_rollout(params: SimParams,
 
     ``trace.steps`` must be a multiple of ``replan_every``.
     """
-    t_steps = trace.steps
-    if t_steps % replan_every:
-        raise ValueError(f"trace length {t_steps} not a multiple of "
-                         f"replan_every={replan_every}")
-    n_seg = t_steps // replan_every
-
-    starts = jnp.arange(n_seg) * replan_every
-    if forecaster is None:
-        idx = jnp.minimum(starts[:, None] + jnp.arange(horizon)[None, :],
-                          t_steps - 1)                   # [n_seg, H]
-        # Trace leaves are time-leading ([T,Z]/[T,C]/[T]); gather axis 0.
-        windows = jax.tree.map(lambda x: x[idx],
-                               exo_steps(trace))         # [n_seg, H, ...]
-    else:
-        from ccka_tpu.forecast.base import planning_window
-
-        h_steps = history_steps or forecaster.wanted_history(horizon)
-        # History ends at the segment's first tick (its signals are
-        # scraped before the decide — same observation surface as the
-        # live loop); indices clamp at 0, repeating the first tick
-        # backwards, never forwards.
-        hist_idx = jnp.maximum(
-            starts[:, None] + jnp.arange(1 - h_steps, 1)[None, :],
-            0)                                           # [n_seg, T_hist]
-        hists = ExogenousTrace(*jax.tree.map(
-            lambda x: x[hist_idx], exo_steps(trace)))
-        # window[0] = the observed segment-start tick, window[1:] =
-        # predictions of the H-1 ticks after it — planner and executor
-        # share one time base, still nothing future-dated.
-        predicted = jax.vmap(
-            lambda h: planning_window(forecaster, h, horizon))(hists)
-        windows = exo_steps(predicted)                   # [n_seg, H, ...]
-    segs = jax.tree.map(
-        lambda x: x.reshape((n_seg, replan_every) + x.shape[1:]),
-        exo_steps(trace))                                 # [n_seg, R, ...]
+    windows, segs, n_seg, t_steps = _segment_windows(
+        trace, horizon, replan_every, forecaster, history_steps)
 
     def body(carry, inp):
         state, k, plan = carry
@@ -212,25 +385,38 @@ def receding_horizon_rollout(params: SimParams,
 
 
 # Dispatch/recompile watch (obs/compile.py) on the planning hot paths.
-# The receding-horizon program keys its compile cache on the forecaster
-# INSTANCE (a static argname): two `make_forecaster("ridge")` calls with
-# identical config hash differently, so constructing forecasters per
-# replan silently recompiles the entire closed loop — the ARCHITECTURE
-# §8 hazard these counters exist to surface. The warmup budget is one
-# compile per distinct (topology, forecaster, horizon) combination a
-# normal process legitimately holds — bench_forecast alone sweeps four
-# forecaster backends — so the warning fires only on the pathological
-# shape (a fresh instance per replan compiling without bound), not on a
-# sweep.
+# Forecasters are static argnames on the receding-horizon programs;
+# through round 8 their compile-cache key was the forecaster INSTANCE
+# (two `make_forecaster("ridge")` calls with identical config hashed
+# differently), so constructing forecasters per replan silently
+# recompiled the entire closed loop — the ARCHITECTURE §8 hazard these
+# counters surfaced. Round 9 fixed the key itself: `forecast.Forecaster`
+# hashes by (type, config), so same-config instances share one compile
+# (pinned by `tests/test_forecast.py`). The watch stays hot — it now
+# guards against any OTHER static-arg value (a policy object, a mesh, a
+# tweaked TrainConfig) re-keying the cache mid-run. The warmup budget is
+# one compile per distinct (topology, forecaster-config, horizon)
+# combination a normal process legitimately holds — bench_forecast
+# alone sweeps four forecaster backends.
 from ccka_tpu.obs.compile import watch_jit  # noqa: E402
 
 optimize_plan = watch_jit(optimize_plan, "mpc.optimize_plan", hot=True,
                           warmup_compiles=8)
-optimize_plan_batch = watch_jit(optimize_plan_batch,
-                                "mpc.optimize_plan_batch", hot=True,
-                                warmup_compiles=8)
+# The batch planner keeps ONE registry entry across its plain/donating/
+# mesh variants (shared_stats): to the reader it is one hot path.
+_plan_batch_jit = watch_jit(_plan_batch_jit, "mpc.optimize_plan_batch",
+                            hot=True, warmup_compiles=8)
+_plan_batch_donate = watch_jit(
+    _plan_batch_donate, "mpc.optimize_plan_batch", hot=True,
+    warmup_compiles=8, shared_stats=True)
 receding_horizon_rollout = watch_jit(
     receding_horizon_rollout, "mpc.receding_horizon_rollout", hot=True,
+    warmup_compiles=8)
+receding_horizon_plan = watch_jit(
+    receding_horizon_plan, "mpc.receding_horizon_plan", hot=True,
+    warmup_compiles=8)
+_plan_rh_batch_jit = watch_jit(
+    _plan_rh_batch_jit, "mpc.receding_horizon_plan_batch", hot=True,
     warmup_compiles=8)
 
 

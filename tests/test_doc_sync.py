@@ -144,3 +144,49 @@ class TestMultichipClaims:
         bullet = parity[m.start():m.start() + 600]
         assert re.search(r"virtual", bullet, re.I), (
             "the multi-chip bullet lost its virtual-mesh label")
+
+
+class TestMPCKernelClaims:
+    """Round 9's kernel-grade MPC claims: the evidence-standard number
+    (n>=256 kernel-paired traces) and the plan-playback throughput
+    README/PARITY quote must come from BASELINE.json round9 — and the
+    virtual-mesh label must stay welded to the virtual number."""
+
+    def test_round9_record_is_self_describing(self, baseline):
+        r9 = baseline["published"]["round9"]
+        policy = r9["mpc_flag_policy"]
+        assert policy["min_paired_traces"] == 256
+        assert "deferred" in policy["lax_stage_flags"]
+        assert "quality_mega" in policy["flag_source"]
+        pb = r9["multichip_plan_playback"]
+        assert pb["virtual_cpu_mesh"] is True and pb["interpret"] is True
+        assert pb["mesh"]["shape"]["data"] == 8
+        assert pb["donation_ok"] is True
+        # No published sample below its physical floor (the acceptance
+        # criterion, checked against the record itself).
+        for row in (pb, r9["mpc_kernel_playback"]):
+            floor_ms = row.get("roofline_floor_ms",
+                               row.get("roofline_floor_ms_per_shard"))
+            assert row["seconds"] * 1e3 >= floor_ms
+
+    def test_readme_flag_standard(self, readme, baseline):
+        m = re.search(r"n≥(\d+)\s+kernel-paired\s+traces", readme)
+        assert m, ("README no longer states the MPC kernel evidence "
+                   "standard — update the claim AND this regex together")
+        assert int(m.group(1)) == (baseline["published"]["round9"]
+                                   ["mpc_flag_policy"]
+                                   ["min_paired_traces"])
+
+    def test_parity_plan_playback_bullet(self, parity, baseline):
+        pb = (baseline["published"]["round9"]
+              ["multichip_plan_playback"])
+        m = re.search(r"\*\*MPC plan-playback kernel\*\*.*?([\d,.]+)\s+"
+                      r"cluster-days/sec\s+aggregate", parity, re.S)
+        assert m, "PARITY no longer carries the plan-playback bullet"
+        quoted = float(m.group(1).replace(",", ""))
+        assert abs(quoted - pb["cluster_days_per_sec_aggregate"]) <= 1.0
+        bullet = parity[m.start():m.start() + 900]
+        assert re.search(r"virtual", bullet, re.I), (
+            "the plan-playback bullet lost its virtual-mesh label")
+        m2 = re.search(r"n≥(\d+)\s+kernel-paired\s+traces", bullet)
+        assert m2 and int(m2.group(1)) == 256

@@ -372,3 +372,45 @@ def test_cli_forecast_eval_unknown_forecaster():
     with pytest.raises(SystemExit, match="unknown forecaster"):
         main(["forecast-eval", "--trace", "data/replay_2day.npz",
               "--forecasters", "prophet"])
+
+
+def test_forecaster_compile_cache_keys_on_config(cfg, synth):
+    """ISSUE 4 satellite (ARCHITECTURE §8): forecasters hash by
+    (type, config), so a FRESH same-config instance is a compile-cache
+    HIT on the jitted receding-horizon program — two MPCBackend
+    instances share ONE compile instead of silently recompiling the
+    whole closed loop per instance (the hazard `obs/compile.py` was
+    built to detect, now closed at the cache key itself)."""
+    from ccka_tpu.obs.compile import stats_for
+    from ccka_tpu.sim.rollout import initial_state
+    from ccka_tpu.train.mpc import MPCBackend
+
+    # The equality/hash contract itself (host-side).
+    assert make_forecaster("ridge") == make_forecaster("ridge")
+    assert hash(make_forecaster("ridge")) == hash(make_forecaster("ridge"))
+    assert (make_forecaster("seasonal", dt_s=30.0)
+            == make_forecaster("seasonal", dt_s=30.0))
+    assert (make_forecaster("seasonal", dt_s=30.0)
+            != make_forecaster("seasonal", dt_s=60.0))
+    assert make_forecaster("persistence") != make_forecaster("ridge")
+    assert RidgeARForecaster(lags=4) != RidgeARForecaster(lags=8)
+
+    # Same statics as the jitted end-to-end test above, with two FRESH
+    # ridge instances — in the full lane the first run is itself a
+    # cache hit on that test's compile.
+    trace = synth.trace(32, seed=1)
+
+    def run():
+        fc = make_forecaster("ridge", dt_s=cfg.sim.dt_s)
+        backend = MPCBackend(cfg, horizon=8, iters=2, replan_every=8,
+                             forecaster=fc, history_steps=32)
+        backend.evaluate(initial_state(cfg), trace, jax.random.key(0),
+                         stochastic=False)
+
+    run()
+    st = stats_for("mpc.receding_horizon_rollout")
+    before = st.compiles
+    run()
+    assert st.compiles == before, (
+        "a fresh same-config forecaster re-keyed the receding-horizon "
+        "compile cache (instance-identity hashing is back)")

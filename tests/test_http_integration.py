@@ -287,3 +287,70 @@ class TestExternalPrometheus:
         rows = prom.query("up")
         assert isinstance(rows, list)
         assert prom.label_values("__name__")
+
+
+@pytest.mark.slow
+class TestCaptureTrainReplayRoundTrip:
+    """VERDICT r5 Next #6 (ISSUE 4 satellite), opt-in via the slow lane:
+    the full data path LiveSignalSource → ``ccka capture`` → stored .npz
+    → ReplaySignalSource → train + evaluate, over the REAL in-process
+    HTTP backends — asserting end-to-end schema fidelity (the capture
+    is what the replay family actually trains on, so a silent schema
+    drift here would poison every replay scoreboard downstream)."""
+
+    def test_capture_then_train_and_evaluate(self, backend, tmp_path):
+        from ccka_tpu.cli import main
+        from ccka_tpu.policy import RulePolicy
+        from ccka_tpu.signals.replay import ReplaySignalSource, load_trace
+        from ccka_tpu.train.evaluate import compare_backends
+        from ccka_tpu.train.ppo import PPOTrainer
+
+        _, url = backend
+        out = str(tmp_path / "live_capture.npz")
+        steps = 40
+        rc = main(["--set", "signals.backend=live",
+                   "--set", f"signals.prometheus_url={url}",
+                   "--set", f"signals.opencost_url={url}",
+                   "--set", f"signals.carbon_url={url}",
+                   "--set", "signals.carbon_api_key=test-key",
+                   "capture", "--out", out, "--steps", str(steps)])
+        assert rc == 0
+
+        cfg = default_config()
+        trace, meta = load_trace(out)          # validates shapes itself
+        # Schema fidelity: provenance, cadence, topology and the live
+        # backends' actual values all survive the store.
+        assert meta.source == "live"
+        assert meta.dt_s == cfg.sim.dt_s
+        assert tuple(meta.zones) == tuple(cfg.cluster.zones)
+        z = cfg.cluster.n_zones
+        assert np.asarray(trace.spot_price_hr).shape == (steps, z)
+        assert np.asarray(trace.demand_pods).shape == (steps, 2)
+        # The Prometheus range series (values 10, 11, ...) lands per
+        # tick in the stored demand — the live WIRE values survive the
+        # store, not a synthetic stand-in (carbon/prices backfill from
+        # the diurnal prior by design; demand is the scraped channel).
+        demand = np.asarray(trace.demand_pods)
+        assert demand[0].sum() == pytest.approx(2 * 10.0)
+        assert demand[-1].sum() == pytest.approx(2 * (10.0 + steps - 1))
+        assert float(np.asarray(trace.od_price_hr).min()) >= (
+            cfg.cluster.node_type.od_price_hr)  # OpenCost floor held
+        assert np.isfinite(np.asarray(trace.carbon_g_kwh)).all()
+        assert float(np.asarray(trace.carbon_g_kwh).min()) > 0
+
+        # Train on the capture through the replay path (BASELINE #3's
+        # pipeline on a genuinely live-captured store)...
+        tcfg = cfg.with_overrides(**{"train.batch_clusters": 4,
+                                     "train.unroll_steps": 8})
+        src = ReplaySignalSource.from_file(out)
+        trainer = PPOTrainer(tcfg)
+        ts, history = trainer.train(src, iterations=1, log_every=1)
+        assert int(ts.iteration) == 1
+        assert np.isfinite(history[0]["mean_reward"])
+
+        # ...and evaluate on it: the scoreboard machinery accepts the
+        # captured trace end to end.
+        board = compare_backends(tcfg, {"rule": RulePolicy(tcfg.cluster)},
+                                 [src.trace(steps)], stochastic=False)
+        assert np.isfinite(board["rule"]["usd_per_slo_hour"])
+        assert board["rule"]["slo_attainment"] >= 0.0

@@ -470,3 +470,144 @@ class TestTPUDistributionParity:
             traces, keys, stochastic=True)
         bad = mean_parity_violations(sk, sl)
         assert not bad, f"carbon distribution parity broken: {bad}"
+
+
+class TestPlanPlaybackParity:
+    """Plan-playback entry (ISSUE 4): a precomputed [T] / [B, T] action
+    sequence executed instead of a policy — the MPC execution path. The
+    contract is `rollout_actions` per cluster (interpret-exact here;
+    the stochastic tier inherits the profile kernel's distribution gate
+    through the bench's shared parity gate, which replays the rule
+    profiles through this entry)."""
+
+    @staticmethod
+    def _decoded_plan(cfg, key, shape):
+        from ccka_tpu.models import latent_dim, latent_to_action
+
+        lat = 0.3 * jax.random.normal(
+            key, shape + (latent_dim(cfg.cluster),))
+        dec = lambda u: latent_to_action(u, cfg.cluster)  # noqa: E731
+        for _ in shape:
+            dec = jax.vmap(dec)
+        return dec(lat)
+
+    @pytest.mark.slow
+    def test_broadcast_plan_matches_lax(self, cfg, setup):
+        """Slow lane (840s budget): the per-cluster test below anchors
+        the playback dynamics against lax; broadcast differs only in
+        the act() source (SMEM scalars), and its sharded-vs-single
+        consistency is pinned fast in test_sharded_kernel."""
+        from ccka_tpu.sim.megakernel import plan_megakernel_rollout_summary
+
+        params, src, _off, _peak = setup
+        B, T = 128, 32
+        traces = src.batch_trace_device(T, jax.random.key(5), B)
+        acts = self._decoded_plan(cfg, jax.random.key(2), (T,))
+        sk = plan_megakernel_rollout_summary(
+            params, acts, traces, stochastic=False, b_block=128,
+            t_chunk=32, interpret=True)
+        states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (B,) + x.shape),
+            initial_state(cfg))
+        keys = jax.random.split(jax.random.key(0), B)
+        afn = lambda state, exo, t: jax.tree.map(  # noqa: E731
+            lambda a: a[t], acts)
+        _, sl = batched_rollout_summary(params, states, afn, traces, keys,
+                                        stochastic=False)
+        rel = _field_rel(sk, sl)
+        bad = {f: r for f, r in rel.items() if r > 2e-3}
+        assert not bad, f"broadcast plan playback diverged: {bad}"
+
+    def test_per_cluster_plan_matches_lax(self, cfg, setup):
+        from ccka_tpu.sim.rollout import rollout_summary
+        from ccka_tpu.sim.megakernel import plan_megakernel_rollout_summary
+
+        params, src, _off, _peak = setup
+        B, T = 128, 32
+        traces = src.batch_trace_device(T, jax.random.key(7), B)
+        acts = self._decoded_plan(cfg, jax.random.key(3), (B, T))
+        sk = plan_megakernel_rollout_summary(
+            params, acts, traces, stochastic=False, b_block=128,
+            t_chunk=32, interpret=True)
+        states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (B,) + x.shape),
+            initial_state(cfg))
+        keys = jax.random.split(jax.random.key(0), B)
+
+        def run_one(s, a, tr, k):
+            fn = lambda state, exo, t: jax.tree.map(  # noqa: E731
+                lambda x: x[t], a)
+            return rollout_summary(params, s, fn, tr, k,
+                                   stochastic=False)[1]
+
+        sl = jax.vmap(run_one)(states, acts, traces, keys)
+        rel = _field_rel(sk, sl)
+        bad = {f: r for f, r in rel.items() if r > 2e-3}
+        assert not bad, f"per-cluster plan playback diverged: {bad}"
+        # Distinct plans genuinely produce distinct outcomes (a zero
+        # spread would mean the lane split never reached the kernel).
+        assert float(np.std(np.asarray(sk.cost_usd))) > 0
+
+    def test_rule_equivalent_plan_matches_profile_kernel(self, cfg, setup):
+        """A per-cluster plan replaying the rule profile selection per
+        (cluster, tick) is EXACTLY the profile kernel — same dynamics
+        code, different action source; also pins the packed entry and
+        the donation contract (exo consumed, plan NOT donated)."""
+        import math
+
+        from ccka_tpu.sim.megakernel import (
+            _pack_exo, megakernel_summary_from_packed, pack_plan,
+            plan_megakernel_summary_from_packed)
+
+        params, src, off, peak = setup
+        B, T, TC = 128, 32, 32
+        traces = src.batch_trace_device(T, jax.random.key(11), B)
+        is_peak = traces.is_peak > 0.5
+        plan = jax.tree.map(
+            lambda o, p: jnp.where(
+                is_peak.reshape(is_peak.shape + (1,) * o.ndim), p, o),
+            off, peak)
+        T_pad = math.ceil(T / TC) * TC
+        exo = _pack_exo(traces, T_pad)
+        pp = pack_plan(plan, T_pad)
+        kw = dict(stochastic=False, b_block=128, t_chunk=TC,
+                  interpret=True)
+        ref = megakernel_summary_from_packed(params, off, peak, exo, T,
+                                             **kw)
+        sk, stream = plan_megakernel_summary_from_packed(
+            params, cfg.cluster, pp, exo, T, donate_stream=True, **kw)
+        jax.block_until_ready(sk.cost_usd)
+        assert exo.is_deleted(), "donated exo stream not consumed"
+        assert not pp.is_deleted(), "plan stream must survive the launch"
+        rel = _field_rel(sk, ref)
+        bad = {f: r for f, r in rel.items() if r > 1e-6}
+        assert not bad, f"rule-equivalent plan != profile kernel: {bad}"
+        del stream
+
+    def test_rejects_mismatched_plans(self, cfg, setup):
+        import math
+
+        from ccka_tpu.sim.megakernel import (
+            _pack_exo, pack_plan, plan_megakernel_summary_from_packed,
+            plan_megakernel_rollout_summary)
+
+        params, src, _off, _peak = setup
+        B, T, TC = 128, 32, 32
+        traces = src.batch_trace_device(T, jax.random.key(13), B)
+        acts_short = self._decoded_plan(cfg, jax.random.key(4), (T // 2,))
+        with pytest.raises(ValueError, match="one action per tick"):
+            plan_megakernel_rollout_summary(
+                params, acts_short, traces, stochastic=False,
+                b_block=128, t_chunk=TC, interpret=True)
+        T_pad = math.ceil(T / TC) * TC
+        exo = _pack_exo(traces, T_pad)
+        acts = self._decoded_plan(cfg, jax.random.key(5), (B, T))
+        good = pack_plan(acts, T_pad)
+        with pytest.raises(ValueError, match="pack_plan"):
+            plan_megakernel_summary_from_packed(
+                params, cfg.cluster, good[:, :8], exo, T,
+                stochastic=False, b_block=128, t_chunk=TC, interpret=True)
+        with pytest.raises(ValueError, match="plan batch"):
+            plan_megakernel_summary_from_packed(
+                params, cfg.cluster, good[:, :, :64], exo, T,
+                stochastic=False, b_block=128, t_chunk=TC, interpret=True)

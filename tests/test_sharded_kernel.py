@@ -308,3 +308,46 @@ def test_cem_mega_engine_on_mesh(mesh, cfg):
                    cem=CEMConfig(generations=1, traces_per_gen=12,
                                  eval_steps=16),
                    engine="mega", mesh=mesh, mega_interpret=True)
+
+
+def test_plan_playback_entry_sharded_parity(mesh, cfg, setup, streams):
+    """Sharded plan-playback entry (ISSUE 4): per-cluster plans split on
+    the exo stream's lane axis (and a broadcast plan replicated) must
+    match the single-device playback kernel on identical worlds — the
+    MPC-vs-rule pairing survives sharding because every entry shares the
+    same shard_seed offsets."""
+    import math
+
+    from ccka_tpu.models import latent_dim, latent_to_action
+    from ccka_tpu.parallel import (shard_plan_stream,
+                                   sharded_plan_summary_from_packed)
+    from ccka_tpu.sim.megakernel import (
+        pack_plan, plan_megakernel_summary_from_packed)
+
+    params, _src, _off, _peak = setup
+    stream, ref_stream = streams
+    T_pad = math.ceil(T / T_CHUNK) * T_CHUNK
+    kw = dict(stochastic=False, b_block=B_BLOCK, t_chunk=T_CHUNK,
+              interpret=True)
+    lat = 0.3 * jax.random.normal(jax.random.key(19),
+                                  (B, T, latent_dim(cfg.cluster)))
+    acts = jax.vmap(jax.vmap(
+        lambda u: latent_to_action(u, cfg.cluster)))(lat)
+    pp = pack_plan(acts, T_pad)
+    sk = sharded_plan_summary_from_packed(
+        mesh, params, cfg.cluster, shard_plan_stream(mesh, pp), stream,
+        T, **kw)
+    assert len(sk.cost_usd.addressable_shards) == N_SHARDS
+    ref = plan_megakernel_summary_from_packed(
+        params, cfg.cluster, pp, ref_stream, T, **kw)
+    _assert_parity(sk, ref, "plan playback (per-cluster)")
+
+    # Broadcast form: one plan replicated to every shard.
+    acts1 = jax.vmap(lambda u: latent_to_action(u, cfg.cluster))(lat[0])
+    pb = pack_plan(acts1, T_pad)
+    sk1 = sharded_plan_summary_from_packed(
+        mesh, params, cfg.cluster, shard_plan_stream(mesh, pb), stream,
+        T, **kw)
+    ref1 = plan_megakernel_summary_from_packed(
+        params, cfg.cluster, pb, ref_stream, T, **kw)
+    _assert_parity(sk1, ref1, "plan playback (broadcast)")

@@ -481,3 +481,91 @@ def test_flagship_checkpoint_path_is_topology_keyed():
     assert single.endswith("ppo_flagship.npz")
     assert multi.endswith("ppo_flagship_multiregion.npz")
     assert flagship_checkpoint_path() == single
+
+
+class TestMeshShardedPlanning:
+    """ISSUE 4: `optimize_plan_batch`/`receding_horizon_plan_batch` fan
+    the cluster batch over the mesh's data axis (mirroring
+    `cem_refine(mesh=)`), with a donated warm-start buffer; and the
+    receding-horizon PLANNER returns the exact sequence the closed loop
+    would execute — the kernel plan-playback contract."""
+
+    @staticmethod
+    def _batch(cfg, source, n, h):
+        from ccka_tpu.train.mpc import optimize_plan_batch  # noqa: F401
+
+        base = jnp.zeros_like(
+            action_to_latent(neutral_action(cfg.cluster), cfg.cluster))
+        lat = jnp.broadcast_to(base, (n, h) + base.shape)
+        states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n,) + x.shape),
+            initial_state(cfg))
+        traces = source.batch_trace_device(h, jax.random.key(3), n)
+        return states, traces, lat
+
+    @pytest.mark.slow
+    def test_mesh_fanout_matches_single_device(self, cfg, source):
+        """Slow lane: compiles a shard_map'd Adam loop twice — the mesh
+        composition idiom is already pinned by the (slow) cem-mesh test;
+        this adds only the planner instance of it."""
+        from ccka_tpu.parallel import make_mesh
+        from ccka_tpu.train.mpc import optimize_plan_batch
+
+        params = SimParams.from_config(cfg)
+        states, traces, lat = self._batch(cfg, source, 8, 8)
+        r0 = optimize_plan_batch(params, cfg.cluster, cfg.train, states,
+                                 traces, lat, iters=2)
+        mesh = make_mesh(devices=jax.devices()[:8])
+        r1 = optimize_plan_batch(params, cfg.cluster, cfg.train, states,
+                                 traces, lat, iters=2, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(r0.plan_latent),
+                                   np.asarray(r1.plan_latent), atol=1e-5)
+        with pytest.raises(ValueError, match="data-axis"):
+            s6, t6, l6 = self._batch(cfg, source, 6, 8)
+            optimize_plan_batch(params, cfg.cluster, cfg.train, s6, t6,
+                                l6, iters=2, mesh=mesh)
+
+    @pytest.mark.slow
+    def test_donated_warm_start_aliases(self, cfg, source):
+        """Slow lane: an extra donating compile of the batch planner;
+        the donation mechanics themselves are pinned in the fast lane
+        by the sharded-kernel donation-chain test."""
+        from ccka_tpu.train.mpc import optimize_plan_batch
+
+        params = SimParams.from_config(cfg)
+        states, traces, lat = self._batch(cfg, source, 8, 8)
+        donated = jnp.array(lat)
+        r = optimize_plan_batch(params, cfg.cluster, cfg.train, states,
+                                traces, donated, iters=2,
+                                donate_plans=True)
+        jax.block_until_ready(r.plan_latent)
+        assert donated.is_deleted(), "warm-start buffer was not donated"
+        assert r.plan_latent.shape == lat.shape
+
+    def test_receding_horizon_plan_replays_the_closed_loop(self, cfg,
+                                                           source):
+        from ccka_tpu.train.mpc import (receding_horizon_plan,
+                                        receding_horizon_rollout)
+
+        params = SimParams.from_config(cfg)
+        base = jnp.zeros_like(
+            action_to_latent(neutral_action(cfg.cluster), cfg.cluster))
+        lat0 = jnp.broadcast_to(base, (8,) + base.shape)
+        # Same (steps, horizon, replan, iters) statics as
+        # test_mpc_backend_closed_loop, so the closed-loop program is a
+        # compile-cache hit in the full lane.
+        tr = source.trace(12, seed=5)
+        seq = receding_horizon_plan(params, cfg.cluster, cfg.train,
+                                    initial_state(cfg), tr, lat0,
+                                    horizon=8, replan_every=4, iters=5)
+        assert seq.shape == (12, latent_dim(cfg.cluster))
+        acts = jax.vmap(lambda u: latent_to_action(u, cfg.cluster))(seq)
+        _, m_play = rollout_actions(params, initial_state(cfg), acts, tr,
+                                    jax.random.key(0), stochastic=False)
+        _, m_rh = receding_horizon_rollout(
+            params, cfg.cluster, cfg.train, initial_state(cfg), tr, lat0,
+            jax.random.key(0), horizon=8, replan_every=4, iters=5,
+            stochastic=False)
+        for a, b in zip(m_play, m_rh):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)
