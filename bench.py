@@ -1626,6 +1626,26 @@ def bench_quality_mega(n_traces: int = 256, eval_steps: int = 2880,
     return out
 
 
+def bench_faults(n_traces: int = 256, eval_steps: int | None = None,
+                 *, seed: int = 31) -> dict | None:
+    """Robustness scoreboard (ISSUE 5): >=3 fault intensities x
+    {rule, flagship, MPC-playback} on n>=256 PAIRED traces through the
+    kernel path — $/SLO-hr degradation curves + interruption/denial/
+    stale counts, recorded into BASELINE.json round10. Runs on the
+    multiregion preset (the topology with a committed flagship
+    checkpoint, so the learned row is a real trained policy, not a
+    stand-in). On TPU: stochastic Mosaic kernels over full days; off-TPU:
+    deterministic interpret-mode at CI horizons (labeled on the record —
+    the degradation curve's SHAPE is the result)."""
+    from ccka_tpu.config import multi_region_config
+    from ccka_tpu.faults.scoreboard import fault_scoreboard
+
+    board = fault_scoreboard(multi_region_config(), n_traces=n_traces,
+                             eval_steps=eval_steps, seed=seed)
+    board["config"] = "multiregion(flagship checkpoint committed)"
+    return board
+
+
 def _run_child(argv, timeout_s=1800, env=None) -> dict | None:
     """Run a bench child phase; relay its narration; parse its JSON."""
     try:
@@ -1712,6 +1732,11 @@ def main(argv=None) -> int:
                     help="run ONLY the MPC stage (plans/s + the kernel "
                          "plan-playback row) and print its JSON — the "
                          "BENCH_r09 record path; CI-sized off-TPU")
+    ap.add_argument("--faults-only", action="store_true",
+                    help="run ONLY the fault-injection robustness "
+                         "scoreboard (bench_faults) and print its JSON "
+                         "— the BENCH_r10 record path; interpret-mode "
+                         "deterministic off-TPU")
     ap.add_argument("--mega-phase", choices=("gate", "time"),
                     help="child phases of the isolated megakernel stage "
                          "(see _mega_subprocess): 'gate' prints the "
@@ -1747,6 +1772,14 @@ def main(argv=None) -> int:
         mpc["provenance"] = bench_provenance()
         print(json.dumps(mpc))
         return 0
+
+    if args.faults_only:
+        with _TRACER.span("bench.faults_stage"):
+            faults = bench_faults()
+        if faults is not None:
+            faults["provenance"] = bench_provenance()
+        print(json.dumps(faults))
+        return 0 if faults is not None else 1
 
     if args.mega_phase == "gate":
         from ccka_tpu.config import default_config
@@ -1888,6 +1921,15 @@ def main(argv=None) -> int:
         print(f"# quality_mega stage failed (omitted): {e!r}",
               file=sys.stderr)
         quality_mega = None
+    # Robustness scoreboard (ISSUE 5): kernel-paired fault sweep —
+    # guarded like every quality stage, CI-sized under --quick.
+    try:
+        with _TRACER.span("bench.faults_stage"):
+            faults = (bench_faults(n_traces=64, eval_steps=48)
+                      if args.quick else bench_faults())
+    except Exception as e:  # noqa: BLE001
+        print(f"# faults stage failed (omitted): {e!r}", file=sys.stderr)
+        faults = None
 
     rates = {k: v for k, v in rollout.items()
              if isinstance(v, dict) and "cluster_days_per_sec" in v}
@@ -1939,6 +1981,8 @@ def main(argv=None) -> int:
         line["forecast"] = forecast
     if quality_mega is not None:
         line["quality_mega"] = quality_mega
+    if faults is not None:
+        line["faults"] = faults
     # Provenance + the session's span trace: a headline without device/
     # version/timing context cannot be audited (VERDICT r5 weak #3).
     line["provenance"] = bench_provenance()

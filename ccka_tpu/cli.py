@@ -255,6 +255,27 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="include the full per-tick error curves "
                           "(default: summary stats only)")
 
+    sch = sub.add_parser(
+        "chaos-eval", help="fault-injection robustness scoreboard "
+                           "(ccka_tpu/faults): policies x fault "
+                           "intensities on paired kernel traces, with "
+                           "$/SLO-hr degradation curves + interruption/"
+                           "denial/stale counts")
+    sch.add_argument("--intensities", default="off,mild,moderate,severe",
+                     help="comma list of config.FAULT_PRESETS names; "
+                          "must include 'off' (the calm denominator)")
+    sch.add_argument("--policies", default="rule,flagship,mpc",
+                     help="comma list of rule,carbon,flagship,mpc "
+                          "(flagship rows need a committed checkpoint "
+                          "for the chosen preset's topology)")
+    sch.add_argument("--traces", type=int, default=0,
+                     help="paired traces per intensity (0 = platform "
+                          "default: 256)")
+    sch.add_argument("--steps", type=int, default=0,
+                     help="ticks per trace (0 = platform default: one "
+                          "day on TPU, CI-sized interpret off-TPU)")
+    sch.add_argument("--seed", type=int, default=31)
+
     sg = sub.add_parser(
         "capture", help="record exogenous signals from the configured "
                         "source into a replayable .npz trace (the AMP "
@@ -448,7 +469,8 @@ def _cmd_observe(cfg: FrameworkConfig, backend_name: str,
     from ccka_tpu.sim import initial_state
     from ccka_tpu.signals.live import make_signal_source
 
-    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+                             faults=cfg.faults)
     tick = src.tick(0)
     from ccka_tpu.sim.rollout import exo_steps
     exo = jax_tree_first(exo_steps(tick))
@@ -553,7 +575,8 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
 
     params = SimParams.from_config(cfg)
     steps = int(days * 86400.0 / cfg.sim.dt_s)
-    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+                             faults=cfg.faults)
 
     if clusters == 1 and (mesh or device_traces):
         raise SystemExit("ccka: --mesh/--device-traces are batch-path "
@@ -674,7 +697,7 @@ def _cmd_forecast_eval(cfg: FrameworkConfig, args) -> int:
     else:
         from ccka_tpu.signals.live import make_signal_source
         src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim,
-                                 cfg.signals)
+                                 cfg.signals, faults=cfg.faults)
         steps = args.steps or int(2 * 86400.0 / cfg.sim.dt_s)
         dt_s = cfg.sim.dt_s
     trace = src.trace(steps, seed=args.seed)
@@ -724,7 +747,8 @@ def _cmd_capture(cfg: FrameworkConfig, out: str, steps: int,
     from ccka_tpu.signals.live import make_signal_source
     from ccka_tpu.signals.replay import save_trace
 
-    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+                             faults=cfg.faults)
     trace = src.trace(steps, seed=seed)
     save_trace(out, trace, src.meta())
     print(json.dumps({"out": out, "steps": steps,
@@ -740,7 +764,8 @@ def _cmd_train(cfg: FrameworkConfig, backend_name: str, iterations: int,
     from ccka_tpu.signals.live import make_signal_source
     from ccka_tpu.train.checkpoint import save_state
 
-    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+                             faults=cfg.faults)
     rl = RunLog(runlog_path or None, kind=f"{backend_name}-train",
                 meta={"iterations": iterations, "seed": seed})
     if backend_name == "ppo":
@@ -789,7 +814,8 @@ def _cmd_evaluate(cfg: FrameworkConfig, backend_names: str, checkpoint: str,
     from ccka_tpu.signals.live import make_signal_source
     from ccka_tpu.train.evaluate import compare_backends, heldout_traces
 
-    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals)
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+                             faults=cfg.faults)
     steps = max(int(days * 86400.0 / cfg.sim.dt_s), 1)
     traces = heldout_traces(src, steps=steps, n=n_traces,
                             seed0=10_000 + seed)
@@ -1039,6 +1065,24 @@ def main(argv: list[str] | None = None) -> int:
                                  args.device_traces, args.forecaster)
         if args.command == "forecast-eval":
             return _cmd_forecast_eval(cfg, args)
+        if args.command == "chaos-eval":
+            from ccka_tpu.faults.scoreboard import fault_scoreboard
+            try:
+                board = fault_scoreboard(
+                    cfg,
+                    intensities=tuple(
+                        s.strip() for s in args.intensities.split(",")
+                        if s.strip()),
+                    policies=tuple(
+                        s.strip() for s in args.policies.split(",")
+                        if s.strip()),
+                    n_traces=args.traces or 256,
+                    eval_steps=args.steps or None,
+                    seed=args.seed)
+            except ValueError as e:
+                raise SystemExit(f"ccka: {e}")
+            print(json.dumps(board, indent=2))
+            return 0
         if args.command == "capture":
             return _cmd_capture(cfg, args.out, args.steps, args.seed)
         if args.command == "watch":

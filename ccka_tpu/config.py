@@ -389,6 +389,19 @@ class SignalsConfig:
     opencost_url: str = "http://localhost:9090"
     carbon_url: str = "https://api.electricitymap.org/v3"
     request_timeout_s: float = 10.0
+    # Live-fetch retry budget (`signals/live.RetryingFetch`): transport
+    # failures retry up to this many extra attempts with jittered
+    # exponential backoff starting at fetch_backoff_s. The budget is
+    # PER FETCH CALL, not per tick: sleeps and new attempts are bounded
+    # by request_timeout_s, and each in-flight attempt additionally by
+    # the transport's own socket timeout, so one call takes at most
+    # ~2x request_timeout_s under a hanging endpoint — and a tick makes
+    # one call per family (OD, demand, one per carbon zone), so a full
+    # outage can stall the scrape stage for several multiples of
+    # request_timeout_s before degraded mode reacts. Exhaustion marks
+    # the tick's sample stale (degraded-mode input) instead of raising.
+    fetch_retries: int = 2
+    fetch_backoff_s: float = 0.4
 
     def validate(self) -> None:
         if self.backend not in ("synthetic", "replay", "live"):
@@ -401,6 +414,8 @@ class SignalsConfig:
             raise ConfigError("signals: non-positive default carbon intensity")
         if self.scrape_interval_s <= 0:
             raise ConfigError("signals: non-positive scrape interval")
+        if self.fetch_retries < 0 or self.fetch_backoff_s < 0:
+            raise ConfigError("signals: negative fetch retry budget")
 
 
 @dataclass(frozen=True)
@@ -519,6 +534,112 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class FaultsConfig:
+    """Fault-injection disturbance processes (`ccka_tpu/faults`).
+
+    The simulator's only disturbance before this block was the flat
+    per-node spot-interruption hazard (`SimConfig.spot_interruption_rate_hr`)
+    — none of the failure modes real spot fleets exhibit (correlated
+    preemption storms, insufficient-capacity errors, provisioning-delay
+    jitter, signal outages) existed anywhere in the pipeline, even though
+    pool class 0 *is* the spot class and the Off-Peak mode is a bet on
+    spot staying up. All processes are synthesized as extra lanes in the
+    packed exo stream (`signals/synthetic.py` → `faults/process.py`),
+    keyed by the same ``(seed, shard, block)`` PRNG scheme as the exo
+    signals, so a given fault realization is bitwise identical for every
+    policy being compared.
+
+    ``enabled=False`` (the default) is a hard gate: generation emits the
+    exact pre-fault stream (no lanes, no extra key splits) and every
+    consumer takes the exact pre-fault code path — the zero-fault bitwise
+    parity contract `tests/test_faults.py` pins.
+
+    Window-shaped processes (storms, ICE, outages) are thresholded
+    stationary AR(1) latents: ``*_frac`` sets the stationary fraction of
+    time in-window (the Gaussian threshold is computed host-side), and
+    ``*_mean_ticks`` sets persistence via ``rho = exp(-1/mean_ticks)`` —
+    windows come out geometrically distributed with roughly that mean,
+    which doubles as the ICE "cooldown": a denial window decays over
+    ~``ice_mean_ticks`` rather than flickering per tick.
+    """
+
+    enabled: bool = False
+    # -- spot preemption storms: hazard multiplier on the base per-step
+    # interruption probability. In-storm hazard = 1 + preempt_storm_hazard;
+    # out-of-storm hazard = 1 (the calm baseline process is untouched).
+    preempt_storm_hazard: float = 0.0
+    preempt_storm_frac: float = 0.05
+    preempt_storm_mean_ticks: int = 20
+    # Price coupling: hazard is additionally scaled by
+    # ``1 + coupling * max(price_anomaly, 0) / 0.04`` per zone — spot
+    # capacity tightens exactly when the spot price runs above its
+    # diurnal mean (0.04 is the generator's AR(1) sigma, so coupling=1
+    # reads "+1x hazard per +1-sigma price anomaly"). 0 decouples.
+    preempt_price_coupling: float = 0.0
+    # -- insufficient-capacity errors: provisioning requests for SPOT
+    # capacity are denied (fully or partially) during ICE windows. The
+    # on-demand class is never denied — matching the cloud reality that
+    # ICE is a spot-pool phenomenon.
+    ice_frac: float = 0.0
+    ice_deny_frac: float = 1.0
+    ice_mean_ticks: int = 10
+    # -- provisioning-delay jitter: this fraction of each tick's pipeline
+    # ARRIVALS is held back one more tick (re-queued at pipeline stage 0),
+    # modulated by its own AR(1) so delays come in bursts; clipped to 0.9
+    # so provisioning always eventually lands.
+    delay_jitter_frac: float = 0.0
+    # -- signal outage/staleness windows: while active, policies observe
+    # the LAST pre-outage signals (prices/carbon/demand held; is_peak is
+    # clock-derived and stays true). Dynamics/accounting always use true
+    # values — the outage models the metrics-scrape pipeline, not the
+    # cloud provider's biller.
+    outage_frac: float = 0.0
+    outage_mean_ticks: int = 8
+
+    def validate(self) -> None:
+        for name in ("preempt_storm_hazard", "preempt_price_coupling",
+                     "delay_jitter_frac"):
+            if getattr(self, name) < 0:
+                raise ConfigError(f"faults: negative {name}")
+        for name in ("preempt_storm_frac", "ice_frac", "outage_frac"):
+            if not 0.0 <= getattr(self, name) < 1.0:
+                raise ConfigError(f"faults: {name} out of [0, 1)")
+        if not 0.0 <= self.ice_deny_frac <= 1.0:
+            raise ConfigError("faults: ice_deny_frac out of [0, 1]")
+        if self.delay_jitter_frac > 0.9:
+            raise ConfigError("faults: delay_jitter_frac > 0.9 would "
+                              "strand provisioning forever")
+        for name in ("preempt_storm_mean_ticks", "ice_mean_ticks",
+                     "outage_mean_ticks"):
+            if getattr(self, name) < 1:
+                raise ConfigError(f"faults: {name} must be >= 1")
+
+
+# The robustness scoreboard's named intensities (`bench.py bench_faults`,
+# `ccka chaos-eval`): the same storm/ICE/outage latent processes (same
+# key → same storm timing) at rising severities, so the degradation curve
+# is a genuine dose-response over one shared realization family. "off"
+# is the enabled-but-neutral config — the stream widens with lanes that
+# are exactly (hazard=1, deny=0, delay=0, outage=0), which the zero-fault
+# bitwise gate pins against the un-widened pipeline.
+FAULT_PRESETS: dict[str, FaultsConfig] = {
+    "off": FaultsConfig(enabled=True),
+    "mild": FaultsConfig(
+        enabled=True, preempt_storm_hazard=5.0, preempt_storm_frac=0.02,
+        preempt_price_coupling=0.5, ice_frac=0.02, ice_deny_frac=0.7,
+        delay_jitter_frac=0.10, outage_frac=0.02),
+    "moderate": FaultsConfig(
+        enabled=True, preempt_storm_hazard=15.0, preempt_storm_frac=0.05,
+        preempt_price_coupling=1.0, ice_frac=0.05, ice_deny_frac=0.9,
+        delay_jitter_frac=0.25, outage_frac=0.05),
+    "severe": FaultsConfig(
+        enabled=True, preempt_storm_hazard=40.0, preempt_storm_frac=0.10,
+        preempt_price_coupling=2.0, ice_frac=0.12, ice_deny_frac=1.0,
+        delay_jitter_frac=0.40, outage_frac=0.12),
+}
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout for `pjit`/`shard_map`.
 
@@ -552,6 +673,7 @@ class FrameworkConfig:
     signals: SignalsConfig = field(default_factory=SignalsConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    faults: FaultsConfig = field(default_factory=FaultsConfig)
 
     def validate(self) -> "FrameworkConfig":
         self.cluster.validate()
@@ -560,6 +682,7 @@ class FrameworkConfig:
         self.signals.validate()
         self.train.validate()
         self.mesh.validate()
+        self.faults.validate()
         # Cross-section: a live multi-region fleet must name each region's
         # grid zone — silently falling back to the global carbon_zone would
         # price one region's zones by another region's grid, flattening the
@@ -706,6 +829,7 @@ _NESTED_TYPES = {
     "signals": SignalsConfig,
     "train": TrainConfig,
     "mesh": MeshConfig,
+    "faults": FaultsConfig,
 }
 
 

@@ -1,0 +1,41 @@
+"""Fault-injection subsystem: batched disturbance processes + scoreboard.
+
+Three halves (ARCHITECTURE §12):
+
+- **Processes** (`faults/process.py`): spot-preemption storms
+  (optionally price-correlated), insufficient-capacity errors with a
+  cooldown, provisioning-delay jitter, and signal-outage windows — all
+  pure-jnp, synthesized as extra lanes in the packed exo stream and
+  keyed by the same ``(seed, shard, block)`` PRNG scheme as the exo
+  signals, so every policy being compared sees the bitwise-identical
+  fault realization.
+- **Consumption**: `sim/dynamics.step` (``fault=`` kwarg) and the fused
+  Pallas megakernel (fault lanes auto-detected from the packed stream's
+  row count) lose capacity, deny/delay provisioning, and serve stale
+  observations; `harness/controller.py` degrades gracefully on stale
+  signals (hold-last-action → rule-fallback state machine).
+- **Scoreboard** (`faults/scoreboard.py`): paired robustness sweep over
+  the named `config.FAULT_PRESETS` intensities — `bench.py bench_faults`
+  and `ccka chaos-eval` both drive it.
+"""
+
+from ccka_tpu.config import FAULT_PRESETS, FaultsConfig  # noqa: F401
+from ccka_tpu.faults.process import (  # noqa: F401
+    fault_rows,
+    has_fault_lanes,
+    packed_fault_lanes,
+    sample_fault_steps,
+    unpack_fault_lanes,
+)
+from ccka_tpu.faults.types import FaultStep  # noqa: F401
+
+__all__ = [
+    "FAULT_PRESETS",
+    "FaultsConfig",
+    "FaultStep",
+    "fault_rows",
+    "has_fault_lanes",
+    "packed_fault_lanes",
+    "sample_fault_steps",
+    "unpack_fault_lanes",
+]

@@ -1,0 +1,176 @@
+"""Batched disturbance processes, synthesized as packed-stream lanes.
+
+The fault subsystem's generation half: pure-jnp, scan/`associative_scan`-
+compatible processes emitting ``[T_pad, fault_rows(Z), B]`` lane blocks
+that ride the SAME packed exo stream the megakernel reads
+(`sim/megakernel.py` layout table, ARCHITECTURE §12). Because the lanes
+are part of stream synthesis they inherit every pairing property of the
+exo signals for free: shard-local on a mesh (`parallel/sharded_kernel.
+sharded_packed_trace` runs the generator per shard on ``fold_in(key,
+shard)``), and bitwise identical for every policy scored on the stream —
+rule, flagship and MPC-playback see the same preemption storm.
+
+Lane layout, offsets relative to the fault block base ``_exo_rows(Z)``:
+
+    row 0..Z-1   preempt_hazard[z]  multiplier on interrupt_p (1 = calm)
+    row Z        deny_frac          spot provisioning denied this tick
+    row Z+1      delay_frac         pipeline arrivals held back one tick
+    row Z+2      signal_stale       {0,1} outage indicator
+    rows pad to a sublane multiple of 8 (zeros)
+
+Window processes (storms / ICE / outages) are thresholded stationary
+AR(1) latents: the threshold for a stationary in-window fraction ``f``
+is the Gaussian quantile ``Phi^-1(1-f)`` (computed HOST-side from the
+static config — ``f=0`` maps to +inf, so a zero-rate process is exactly
+never active), and persistence ``rho = exp(-1/mean_ticks)`` gives
+geometric-ish windows with roughly that mean — the ICE "cooldown" and
+outage-window length fall out of the same two-parameter family.
+
+The neutral contract: with every intensity at 0 the emitted lanes are
+EXACTLY (hazard=1, deny=0, delay=0, stale=0) — multiplying/adding them
+into the simulator is bitwise a no-op, which is what lets the zero-fault
+gate (`tests/test_faults.py`) pin the widened pipeline against the
+pre-fault one even in stochastic mode.
+"""
+
+from __future__ import annotations
+
+import math
+from statistics import NormalDist
+
+import jax
+import jax.numpy as jnp
+
+from ccka_tpu.config import FaultsConfig
+from ccka_tpu.faults.types import FaultStep
+from ccka_tpu.signals.synthetic import _ar1_device
+
+# Key-domain tag separating the fault latents from the exo noise streams
+# (the generator splits its key 3 ways for spot/carbon/demand; fault
+# lanes fold this constant into the SAME generation key, so they are
+# paired per (seed, shard) without disturbing the exo streams' draws —
+# the exo rows of a widened stream stay bitwise identical to the
+# un-widened generation).
+FAULT_KEY_TAG = 0xFA117
+
+
+def fault_rows(Z: int) -> int:
+    """Rows of the fault lane block: hazard[Z] + deny + delay + stale,
+    padded to a sublane multiple (mirrors `sim.megakernel._exo_rows`)."""
+    return math.ceil((Z + 3) / 8) * 8
+
+
+def _threshold(frac: float) -> float:
+    """Host-side Gaussian threshold for a stationary in-window fraction
+    ``frac`` of a unit-variance latent; ``frac<=0`` -> +inf (never)."""
+    if frac <= 0.0:
+        return float("inf")
+    return float(NormalDist().inv_cdf(1.0 - frac))
+
+
+def _window(key, shape, *, frac: float, mean_ticks: int) -> jnp.ndarray:
+    """{0,1} window indicator: thresholded stationary AR(1) along axis 0."""
+    rho = math.exp(-1.0 / max(mean_ticks, 1))
+    latent = _ar1_device(key, shape, rho=rho, sigma=1.0, axis=0)
+    return (latent > _threshold(frac)).astype(jnp.float32)
+
+
+# The generator's spot-price AR(1) sigma — the price-coupling unit
+# ("+coupling x hazard per +1 sigma price anomaly"). Shared constant so
+# the docstring in `config.FaultsConfig` can never drift from the math.
+PRICE_DEV_SIGMA = 0.04
+
+
+def packed_fault_lanes(faults: FaultsConfig, key, steps: int, t_pad: int,
+                       Z: int, batch: int, *,
+                       price_dev=None) -> jnp.ndarray:
+    """``[T_pad, fault_rows(Z), B]`` lane block for one stream.
+
+    ``price_dev``: the generator's spot-price AR(1) anomaly ``[T, Z, B]``
+    (relative deviation from the diurnal mean) for the optional
+    price-correlated hazard; None decouples regardless of config.
+    Pure jnp — runs inside the (possibly shard_map'd) generation jit.
+    """
+    ks, ki, kd, ko = jax.random.split(jax.random.fold_in(key, FAULT_KEY_TAG),
+                                      4)
+    f32 = jnp.float32
+
+    storm = _window(ks, (steps, batch), frac=faults.preempt_storm_frac,
+                    mean_ticks=faults.preempt_storm_mean_ticks)  # [T, B]
+    hazard = 1.0 + f32(faults.preempt_storm_hazard) * storm      # [T, B]
+    hazard = jnp.broadcast_to(hazard[:, None, :], (steps, Z, batch))
+    if faults.preempt_price_coupling > 0.0 and price_dev is not None:
+        hazard = hazard * (1.0 + f32(faults.preempt_price_coupling)
+                           * jnp.maximum(price_dev, 0.0) / PRICE_DEV_SIGMA)
+
+    ice = _window(ki, (steps, batch), frac=faults.ice_frac,
+                  mean_ticks=faults.ice_mean_ticks)
+    deny = f32(faults.ice_deny_frac) * ice                       # [T, B]
+
+    if faults.delay_jitter_frac > 0.0:
+        burst = _ar1_device(kd, (steps, batch), rho=0.8, sigma=1.0, axis=0)
+        delay = jnp.clip(f32(faults.delay_jitter_frac)
+                         * (1.0 + 0.5 * burst), 0.0, 0.9)
+    else:
+        delay = jnp.zeros((steps, batch), f32)
+
+    stale = _window(ko, (steps, batch), frac=faults.outage_frac,
+                    mean_ticks=faults.outage_mean_ticks)
+
+    lanes = jnp.concatenate(
+        [hazard, deny[:, None, :], delay[:, None, :], stale[:, None, :]],
+        axis=1).astype(f32)                          # [T, Z+3, B]
+    return jnp.pad(lanes, ((0, t_pad - steps),
+                           (0, fault_rows(Z) - lanes.shape[1]), (0, 0)))
+
+
+def has_fault_lanes(exo_packed, Z: int) -> bool:
+    """Whether a packed stream carries the fault lane block — inferred
+    from the row count, so every kernel entry point auto-detects widened
+    streams with zero API churn. Rejects any other row count outright
+    (a half-widened stream would silently misread lanes as padding)."""
+    from ccka_tpu.sim.megakernel import _exo_rows
+
+    rows = int(exo_packed.shape[1])
+    base, ext = _exo_rows(Z), _exo_rows(Z) + fault_rows(Z)
+    if rows == base:
+        return False
+    if rows == ext:
+        return True
+    raise ValueError(
+        f"packed stream has {rows} rows; this topology (Z={Z}) expects "
+        f"{base} (plain) or {ext} (with fault lanes)")
+
+
+def unpack_fault_lanes(exo_packed, T: int, Z: int) -> FaultStep:
+    """Fault lanes of a widened stream → batched time-major
+    :class:`FaultStep` (leaves ``[B, T, ...]``) for the lax rollout path
+    — the parity-test/bench plumbing mirror of `megakernel.unpack_exo`
+    (it pays the transpose the packed path exists to skip; hot paths
+    never call it)."""
+    from ccka_tpu.sim.megakernel import _exo_rows
+
+    if not has_fault_lanes(exo_packed, Z):
+        raise ValueError("stream carries no fault lanes")
+    base = _exo_rows(Z)
+    x = exo_packed[:T, base:]
+    return FaultStep(
+        preempt_hazard=jnp.transpose(x[:, 0:Z], (2, 0, 1)),   # [B, T, Z]
+        deny_frac=jnp.transpose(x[:, Z], (1, 0)),             # [B, T]
+        delay_frac=jnp.transpose(x[:, Z + 1], (1, 0)),
+        signal_stale=jnp.transpose(x[:, Z + 2], (1, 0)),
+    )
+
+
+def sample_fault_steps(faults: FaultsConfig, key, steps: int,
+                       Z: int) -> FaultStep:
+    """Single-trace time-major FaultStep (leaves ``[T, ...]``) for
+    standalone lax rollouts and controller tests — same processes, same
+    key-tag scheme as the packed lanes (a batch=1 synthesis, squeezed)."""
+    lanes = packed_fault_lanes(faults, key, steps, steps, Z, 1)
+    return FaultStep(
+        preempt_hazard=lanes[:steps, 0:Z, 0],      # [T, Z]
+        deny_frac=lanes[:steps, Z, 0],             # [T]
+        delay_frac=lanes[:steps, Z + 1, 0],
+        signal_stale=lanes[:steps, Z + 2, 0],
+    )

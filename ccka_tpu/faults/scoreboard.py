@@ -1,0 +1,239 @@
+"""Paired robustness scoreboard: policies × fault intensities.
+
+Scores {rule, flagship, MPC-playback} (plus optional carbon) on the SAME
+``n_traces`` paired worlds at each named fault intensity
+(`config.FAULT_PRESETS`) through the megakernel path, and reports
+$/SLO-hour degradation curves + interruption/denial/stale counts. Three
+pairing properties make the curves meaningful:
+
+- **Across policies**: every row of one intensity shares one
+  (stream, seed, b_block, t_chunk) — identical worlds AND identical
+  fault realization (the lanes are part of the stream).
+- **Across intensities**: all intensities are generated from one key, so
+  the exo rows are bitwise identical and the fault latents are the same
+  storms at rising severity (thresholded nested windows) — a genuine
+  dose-response, not four different weather systems.
+- **MPC plans on the calm world**: the planner sees its forecast (the
+  clean exo trace — preemption storms are not forecastable), the kernel
+  executes the plan on the faulted world. That asymmetry is the point:
+  robustness is what survives planning for weather you didn't get.
+
+On TPU this runs the Mosaic kernels in stochastic mode at full-day
+horizons; elsewhere interpret-mode deterministic at CI sizes (labeled —
+the degradation curve's shape is the result, not absolute wall-clock).
+Used by `bench.py bench_faults` (records BASELINE round10) and the
+`ccka chaos-eval` CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccka_tpu.config import FAULT_PRESETS, FrameworkConfig
+
+_CURVE_FIELDS = ("usd_per_slo_hour", "g_co2_per_kreq", "slo_attainment",
+                 "interruptions", "denials", "stale_ticks")
+
+
+def _row(summary) -> dict:
+    vals = {k: np.asarray(getattr(summary, k), np.float64)
+            for k in _CURVE_FIELDS}
+    out = {k: round(float(v.mean()), 4) for k, v in vals.items()}
+    out["per_trace_usd_per_slo_hour"] = vals["usd_per_slo_hour"]
+    return out
+
+
+def _vs_calm(row: dict, calm_per_trace: np.ndarray) -> None:
+    """Paired per-trace degradation vs the calm ('off') intensity of the
+    SAME policy: mean ratio + se (same worlds, so the ratio cancels
+    trace heterogeneity like every other paired gate here)."""
+    r = (row.pop("per_trace_usd_per_slo_hour")
+         / np.maximum(calm_per_trace, 1e-9))
+    row["vs_calm_usd_per_slo_hour"] = round(float(r.mean()), 4)
+    if r.size >= 2:
+        row["vs_calm_usd_per_slo_hour_se"] = round(
+            float(r.std(ddof=1) / np.sqrt(r.size)), 5)
+
+
+def fault_scoreboard(cfg: FrameworkConfig, *,
+                     intensities=("off", "mild", "moderate", "severe"),
+                     policies=("rule", "flagship", "mpc"),
+                     n_traces: int = 256,
+                     eval_steps: int | None = None,
+                     seed: int = 31,
+                     trace_seed: int = 97) -> dict:
+    """The robustness board (module docstring). ``intensities`` must
+    include "off" (the calm denominator) and name `FAULT_PRESETS`
+    entries; ``policies`` ⊆ {rule, carbon, flagship, mpc}."""
+    from ccka_tpu.faults.process import unpack_fault_lanes
+    from ccka_tpu.models import action_to_latent, latent_to_action
+    from ccka_tpu.policy import CarbonAwarePolicy
+    from ccka_tpu.policy.rule import (neutral_action, offpeak_action,
+                                      peak_action)
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+    from ccka_tpu.sim import SimParams, initial_state
+    from ccka_tpu.sim.megakernel import (
+        carbon_megakernel_summary_from_packed,
+        megakernel_summary_from_packed,
+        neural_megakernel_summary_from_packed, pack_plan,
+        plan_megakernel_summary_from_packed, unpack_exo)
+    from ccka_tpu.train.flagship import load_flagship_backend
+    from ccka_tpu.train.mpc import receding_horizon_plan_batch
+
+    bad = [i for i in intensities if i not in FAULT_PRESETS]
+    if bad:
+        raise ValueError(f"unknown fault intensities {bad}; presets: "
+                         f"{sorted(FAULT_PRESETS)}")
+    if "off" not in intensities:
+        raise ValueError('intensities must include "off" (the calm '
+                         "denominator of every degradation curve)")
+    known_policies = ("rule", "carbon", "flagship", "mpc")
+    bad = [p for p in policies if p not in known_policies]
+    if bad:
+        raise ValueError(f"unknown policies {bad}; known: "
+                         f"{list(known_policies)} — a typo here would "
+                         f"otherwise run the full sweep and emit a board "
+                         f"missing that row")
+
+    on_tpu = jax.default_backend() == "tpu"
+    steps = eval_steps or (2880 if on_tpu else 96)
+    t_chunk = 64 if on_tpu else 32
+    b_block = min(256, n_traces)
+    if n_traces % b_block:
+        raise ValueError(f"n_traces={n_traces} must be a multiple of "
+                         f"b_block={b_block}")
+    kw = dict(seed=seed, stochastic=on_tpu, b_block=b_block,
+              t_chunk=t_chunk, interpret=not on_tpu)
+    params = SimParams.from_config(cfg)
+    cluster = cfg.cluster
+    Z = cluster.n_zones
+    off_a, peak_a = offpeak_action(cluster), peak_action(cluster)
+    key = jax.random.key(trace_seed)
+
+    # One stream per intensity, all from ONE key: exo rows bitwise
+    # shared, fault latents shared (nested windows at rising severity).
+    streams = {}
+    for name in intensities:
+        src = SyntheticSignalSource(cluster, cfg.workload, cfg.sim,
+                                    cfg.signals,
+                                    faults=FAULT_PRESETS[name])
+        streams[name] = src.packed_trace_device(steps, key, n_traces,
+                                                t_chunk=t_chunk)
+
+    out: dict = {
+        "engine": "megakernel(fault lanes)",
+        "n_traces": n_traces, "eval_steps": steps,
+        "stochastic": on_tpu, "interpret": not on_tpu,
+        "b_block": b_block, "t_chunk": t_chunk, "seed": seed,
+        "policies": list(policies),
+        "intensities": {},
+    }
+
+    flagship = None
+    if "flagship" in policies:
+        flagship, meta = load_flagship_backend(cfg)
+        if flagship is None:
+            out["flagship_source"] = ("omitted: no flagship checkpoint "
+                                      "for this topology (no stand-ins)")
+        else:
+            out["flagship_source"] = {
+                "checkpoint": "topology-keyed flagship",
+                "selected_iteration": meta.get("selected_iteration")}
+
+    plan_packed = None
+    if "mpc" in policies:
+        # Plan ONCE on the clean world (exo rows are shared across
+        # intensities, so one plan serves every row): lax quick planner
+        # per paired trace, kernel playback on the faulted worlds.
+        quick = dict(horizon=8, replan_every=8, iters=2)
+        out["mpc_planner"] = dict(
+            quick, n_traces=n_traces,
+            mode="lax_quick_plan(clean world)->kernel_playback(faulted)")
+        traces = unpack_exo(streams["off"], steps, Z)
+        base = jnp.zeros_like(action_to_latent(neutral_action(cluster),
+                                               cluster))
+        lat0 = jnp.broadcast_to(
+            base, (n_traces, quick["horizon"]) + base.shape)
+        states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n_traces,) + x.shape),
+            initial_state(cfg))
+        plans = receding_horizon_plan_batch(
+            params, cluster, cfg.train, states, traces, lat0, **quick)
+        plan_actions = jax.vmap(jax.vmap(
+            lambda u: latent_to_action(u, cluster)))(plans)
+        import math as _math
+        t_pad = _math.ceil(steps / t_chunk) * t_chunk
+        plan_packed = pack_plan(plan_actions, t_pad)
+
+    cp = CarbonAwarePolicy(cluster)
+    boards: dict[str, dict] = {}
+    for name in intensities:
+        stream = streams[name]
+        rows: dict[str, dict] = {}
+        if "rule" in policies:
+            rows["rule"] = _row(megakernel_summary_from_packed(
+                params, off_a, peak_a, stream, steps, **kw))
+        if "carbon" in policies:
+            rows["carbon"] = _row(carbon_megakernel_summary_from_packed(
+                params, off_a, peak_a, stream, steps,
+                sharpness=cp.sharpness, min_weight=cp.min_weight,
+                stickiness=cp.stickiness, **kw))
+        if flagship is not None:
+            rows["flagship"] = _row(
+                neural_megakernel_summary_from_packed(
+                    params, cluster, flagship.params, stream, steps,
+                    **kw))
+        if plan_packed is not None:
+            rows["mpc"] = _row(plan_megakernel_summary_from_packed(
+                params, cluster, plan_packed, stream, steps, **kw))
+        # Stream-level fault exposure (identical for every policy row —
+        # the pairing, stated on the record).
+        fs = unpack_fault_lanes(stream, steps, Z)
+        exposure = {
+            "stale_tick_frac": round(
+                float(np.asarray(fs.signal_stale).mean()), 4),
+            "ice_tick_frac": round(
+                float((np.asarray(fs.deny_frac) > 0).mean()), 4),
+            "mean_hazard": round(
+                float(np.asarray(fs.preempt_hazard).mean()), 3),
+        }
+        boards[name] = {
+            "faults": dataclasses.asdict(FAULT_PRESETS[name]),
+            "exposure": exposure,
+            "rows": rows,
+        }
+        print(f"# faults[{name}]: " + " ".join(
+            f"{p}={r['usd_per_slo_hour']:.3f}$/slo-hr"
+            f"@{r['slo_attainment']:.3f}" for p, r in rows.items()),
+            file=sys.stderr)
+
+    # Degradation curves: per policy, paired vs the calm intensity
+    # (capture the calm per-trace arrays first — the off row's own ratio
+    # is computed against itself, identically 1).
+    calm_arrays = {p: row["per_trace_usd_per_slo_hour"]
+                   for p, row in boards["off"]["rows"].items()}
+    for name in intensities:
+        for p, row in boards[name]["rows"].items():
+            _vs_calm(row, calm_arrays[p])
+    curves = {}
+    for p in next(iter(boards.values()))["rows"]:
+        curves[p] = {
+            "intensities": list(intensities),
+            "usd_per_slo_hour": [boards[i]["rows"][p]["usd_per_slo_hour"]
+                                 for i in intensities],
+            "vs_calm_usd_per_slo_hour": [
+                boards[i]["rows"][p]["vs_calm_usd_per_slo_hour"]
+                for i in intensities],
+            "slo_attainment": [boards[i]["rows"][p]["slo_attainment"]
+                               for i in intensities],
+            "interruptions": [boards[i]["rows"][p]["interruptions"]
+                              for i in intensities],
+        }
+    out["intensities"] = boards
+    out["degradation_curves"] = curves
+    return out

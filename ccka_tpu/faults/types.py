@@ -1,0 +1,49 @@
+"""Fault pytrees consumed by the simulator step.
+
+Kept in their own leaf module (imports only jnp) so `sim/dynamics.py`
+can take a :class:`FaultStep` without creating a cycle with the fault
+*synthesis* side (`faults/process.py`, which imports the signal layer).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class FaultStep(NamedTuple):
+    """One tick of disturbance inputs (a time-slice of the fault lanes).
+
+    Shapes use Z = zones. A leading batch/time axis, when present, is
+    handled by ``vmap``/``scan`` like :class:`~ccka_tpu.sim.dynamics.ExoStep`.
+
+    Attributes:
+      preempt_hazard: [Z] multiplier on the base per-step spot-
+        interruption probability (1 = calm baseline; a preemption storm
+        pushes it up, optionally price-correlated).
+      deny_frac: [] fraction of this tick's SPOT provisioning request
+        denied (insufficient-capacity error; denied capacity is simply
+        not requested — Karpenter re-requests next tick from the pending
+        backlog, which is exactly how ICE retry behaves).
+      delay_frac: [] fraction of this tick's pipeline ARRIVALS held back
+        one more tick (provisioning-delay jitter).
+      signal_stale: [] {0,1} signal-outage indicator: policies observe
+        held (last pre-outage) signals this tick; dynamics use true ones.
+    """
+
+    preempt_hazard: jnp.ndarray
+    deny_frac: jnp.ndarray
+    delay_frac: jnp.ndarray
+    signal_stale: jnp.ndarray
+
+    @classmethod
+    def neutral(cls, n_zones: int) -> "FaultStep":
+        """The no-op disturbance: consuming it is bitwise identical to
+        ``fault=None`` (pinned by `tests/test_faults.py`)."""
+        return cls(
+            preempt_hazard=jnp.ones((n_zones,), jnp.float32),
+            deny_frac=jnp.float32(0.0),
+            delay_frac=jnp.float32(0.0),
+            signal_stale=jnp.float32(0.0),
+        )

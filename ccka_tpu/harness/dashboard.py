@@ -49,6 +49,15 @@ _PANEL_DEFS = (
     ("Tick time by stage", "ccka_tick_scrape_ms + ccka_tick_decide_ms + "
      "ccka_tick_act_ms", "ms"),
     ("Tick total", "ccka_tick_total_ms", "ms"),
+    # Robustness panels (ccka_tpu/faults): the degraded-mode state
+    # machine and fault events, next to the KPIs they explain — an
+    # operator must see "rule-fallback since 14:02" on the same board
+    # as the cost spike it prevented from being worse.
+    ("Degraded mode", "ccka_degraded", "short"),
+    ("Stale scrapes", "ccka_signal_stale", "short"),
+    ("Degraded ticks (session)", "ccka_degraded_ticks_total", "short"),
+    ("Fault events", "ccka_nodes_denied + ccka_nodes_delayed + "
+     "ccka_nodes_drained", "short"),
 )
 
 

@@ -57,6 +57,26 @@ SERIES: dict[str, tuple[str, str]] = {
         "Spot interruption/rebalance warnings consumed this tick"),
     "ccka_nodes_drained": (
         "nodes_drained", "Nodes cordoned+drained for interruption warnings"),
+    # Degraded-mode + fault-event series (ccka_tpu/faults; ARCHITECTURE
+    # §12): the controller's stale-signal state machine and the fault
+    # model's provisioning counters, on the wire next to the KPIs they
+    # explain. The _total counter is controller-cumulative (each tick
+    # re-states the running total, kube-state-metrics style).
+    "ccka_degraded": (
+        "degraded_level",
+        "Degraded-mode level: 0 ok, 1 hold-last-action, 2 rule-fallback"),
+    "ccka_degraded_ticks_total": (
+        "degraded_ticks_total",
+        "Cumulative ticks spent in a degraded mode this session"),
+    "ccka_signal_stale": (
+        "signal_stale",
+        "1 if this tick's signal scrape was stale (retries exhausted)"),
+    "ccka_nodes_denied": (
+        "denied_nodes",
+        "Spot provisioning denied this tick (fault model), nodes"),
+    "ccka_nodes_delayed": (
+        "delayed_nodes",
+        "Provisioning arrivals held back this tick (fault model), nodes"),
     "ccka_applied": ("applied", "1 if every patch applied this tick"),
     "ccka_verified": ("verified", "1 if read-back matched intent"),
     "ccka_tick": ("t", "Controller tick counter"),

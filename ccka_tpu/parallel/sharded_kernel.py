@@ -36,6 +36,14 @@ wrapper splitting the cluster-batch/population grid over the mesh's
 The per-shard batch must divide into ``b_block`` lanes exactly like the
 single-chip kernel's batch does; callers choose ``B`` as
 ``n_shards * k * b_block`` (the bench's power-of-two batches are).
+Fault-widened streams (`ccka_tpu/faults`: extra disturbance lanes past
+``_exo_rows(Z)``) pass through unchanged — the lane axis is the sharded
+one, rows replicate per shard, and the inner fused entries auto-detect
+the widened layout from the (static) row count; shard-local synthesis
+via a fault-enabled source gives each chip its own lanes keyed by
+``fold_in(key, shard)``, so paired fault realizations survive sharding
+bit-for-bit exactly like the exo signals (pinned in
+`tests/test_faults.py`).
 Donating variants thread the shard-local stream buffer generation-to-
 generation (`donate_stream=True` → ``(summary, stream)``; recycle via
 ``sharded_packed_trace(recycle=...)``) so back-to-back ES generations
@@ -52,6 +60,7 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec
 
 from ccka_tpu.config import ConfigError
+from ccka_tpu.faults.process import has_fault_lanes
 from ccka_tpu.obs.compile import watch_jit
 from ccka_tpu.sim.megakernel import (
     SEED_BLOCK_STRIDE,
@@ -218,6 +227,7 @@ def sharded_megakernel_summary_from_packed(mesh: Mesh,
     _check_chunking(T_pad, T, t_chunk)
     P = int(off_action.zone_weight.shape[0])
     Z = int(off_action.zone_weight.shape[1])
+    has_fault_lanes(exo_packed, Z)  # raises on a malformed row layout
     fn = _packed_call(mesh, T, P, Z, int(params.provision_pipeline_k),
                       stochastic, b_block, t_chunk, interpret, carbon,
                       b_loc // b_block, donate_stream)
@@ -309,6 +319,7 @@ def sharded_neural_summary_from_packed(mesh: Mesh, params: SimParams,
     b_loc = _split_batch(B, n, b_block, "stream")
     _check_chunking(T_pad, T, t_chunk)
     P, Z = cluster.n_pools, cluster.n_zones
+    has_fault_lanes(exo_packed, Z)  # raises on a malformed row layout
     dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
     if was_single:
         net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
@@ -399,6 +410,7 @@ def sharded_plan_summary_from_packed(mesh: Mesh, params: SimParams,
     b_loc = _split_batch(B, n, b_block, "stream")
     _check_chunking(T_pad, T, t_chunk)
     P, Z = cluster.n_pools, cluster.n_zones
+    has_fault_lanes(exo_packed, Z)  # raises on a malformed row layout
     plan_batched = _check_plan(plan_packed, exo_packed, P, Z)
     fn = _plan_call(mesh, T, P, Z, int(params.provision_pipeline_k),
                     stochastic, b_block, t_chunk, interpret, plan_batched,

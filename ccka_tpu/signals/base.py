@@ -152,6 +152,15 @@ class SignalSource(abc.ABC):
             is_peak=lead(as_f32(tr.is_peak), -1),
         )
 
+    # Staleness protocol (`ccka_tpu/faults` degraded-mode path): a source
+    # sets this True when the sample its latest tick() returned is stale
+    # — scrapes failed/exhausted their retry budget and the tick fell
+    # back to held/prior values. The controller reads it after every
+    # scrape to drive its hold-last-action → rule-fallback state machine
+    # instead of deciding on garbage. Synthetic/replay worlds are never
+    # stale; LiveSignalSource maintains it per tick.
+    last_scrape_stale = False
+
     # Capability flag for on-device trace synthesis (the `--device-traces`
     # fleet path). True only for sources whose batch_trace_device
     # *generates* traces on device under an arbitrary sharding (synthetic);

@@ -40,6 +40,66 @@ def _default_fetch(timeout_s: float) -> Fetch:
     return fetch
 
 
+class RetryingFetch:
+    """Jittered exponential-backoff retry around any ``fetch`` transport.
+
+    One-shot fetches meant a transient 500/flaky LB wasted the whole
+    control tick (the scrape falls back to the synthetic prior for 30s
+    of real decisions). This wrapper retries transport-level failures
+    (``OSError``/``TimeoutError`` — ``urllib.error.URLError`` is an
+    OSError; malformed-body errors are NOT retried, they are the
+    server's answer) with full-jitter exponential backoff:
+    ``backoff_s * 2^attempt * U(0.5, 1.5)``. The retry budget is
+    bounded by ``deadline_s`` (the tick's ``request_timeout_s``): sleeps
+    never push past it and no NEW attempt starts once it is spent —
+    each in-flight attempt is additionally bounded by the transport's
+    own socket timeout, so one call takes at most ``deadline_s`` plus
+    one transport timeout. When the budget is spent the LAST error is
+    re-raised — callers (the per-family try/excepts in
+    :class:`LiveSignalSource`) then mark the tick ``stale`` and fall
+    back, feeding the controller's degraded-mode path instead of
+    raising mid-controller.
+
+    ``sleep``/``rand`` are injectable for tests (and ``rand`` defaults
+    to a private PRNG so retry jitter never perturbs global
+    ``random``)."""
+
+    def __init__(self, fetch: Fetch, *, retries: int = 2,
+                 backoff_s: float = 0.4, deadline_s: float = 10.0,
+                 sleep=None, rand=None, clock=None):
+        import random as _random
+        import time as _time
+
+        self.fetch = fetch
+        self.retries = max(0, int(retries))
+        self.backoff_s = backoff_s
+        self.deadline_s = deadline_s
+        self.sleep = sleep if sleep is not None else _time.sleep
+        self.rand = rand if rand is not None else _random.Random(0x5e7)
+        self.clock = clock if clock is not None else _time.monotonic
+
+    def __call__(self, url: str, headers: Mapping[str, str]) -> bytes:
+        t0 = self.clock()
+        last: BaseException | None = None
+        for attempt in range(self.retries + 1):
+            try:
+                return self.fetch(url, headers)
+            except (OSError, TimeoutError) as e:
+                last = e
+            if attempt >= self.retries:
+                break
+            delay = (self.backoff_s * (2 ** attempt)
+                     * (0.5 + self.rand.random()))
+            remaining = self.deadline_s - (self.clock() - t0)
+            if remaining <= 0.0:
+                break  # budget spent — don't blow the tick deadline
+            self.sleep(min(delay, remaining))
+            if self.clock() - t0 >= self.deadline_s:
+                break  # deadline hit mid-sleep — no new attempt
+        assert last is not None
+        raise last
+
+
 class SignalUnavailable(RuntimeError):
     """A live endpoint could not be reached or returned malformed data."""
 
@@ -237,6 +297,10 @@ class CarbonIntensityClient:
         self.zone = zone
         self.default_g_kwh = default_g_kwh
         self.fetch = fetch or _default_fetch(timeout_s)
+        # Staleness marker for the degraded-mode path: False only when a
+        # keyed fetch actually failed (the documented no-key fallback is
+        # by-design, not stale).
+        self.last_ok = True
 
     def latest(self, zone: str | None = None,
                default: float | None = None) -> float:
@@ -248,15 +312,19 @@ class CarbonIntensityClient:
         zone = zone or self.zone
         fallback = self.default_g_kwh if default is None else default
         if not self.api_key:
+            self.last_ok = True
             return fallback
         url = (f"{self.base_url}/carbon-intensity/latest?"
                f"{urllib.parse.urlencode({'zone': zone})}")
         try:
             raw = self.fetch(url, {"auth-token": self.api_key})
             doc = json.loads(raw)
-            return float(doc["carbonIntensity"])
+            val = float(doc["carbonIntensity"])
         except Exception:  # noqa: BLE001 — documented graceful fallback
+            self.last_ok = False
             return fallback
+        self.last_ok = True
+        return val
 
 
 class SpotPriceClient:
@@ -273,7 +341,8 @@ class SpotPriceClient:
 
     def __init__(self, region: str, instance_type: str, *,
                  runner=None, window_hr: float = 3.0,
-                 cache_ttl_s: float = 300.0, clock=None):
+                 cache_ttl_s: float = 300.0,
+                 failure_ttl_s: float = 60.0, clock=None):
         self.region = region
         self.instance_type = instance_type
         self.window_hr = window_hr
@@ -281,8 +350,13 @@ class SpotPriceClient:
         # but the CLI call sits inside the 30s control tick — uncached, an
         # AWS brownout would block the loop for the runner's full
         # timeout+retry budget every tick (round-3 review). 300s keeps at
-        # most one CLI call per ~10 ticks.
+        # most one CLI call per ~10 ticks. Failures re-probe sooner
+        # (failure_ttl_s): an empty result marks the whole tick stale
+        # (degraded-mode input), and caching a single transient hiccup
+        # for the full TTL would hold the controller in rule-fallback
+        # for ~10 ticks after the CLI already recovered.
         self.cache_ttl_s = cache_ttl_s
+        self.failure_ttl_s = failure_ttl_s
         self._cache: dict[str, float] | None = None
         self._cache_at = float("-inf")
         import time as _time
@@ -305,12 +379,16 @@ class SpotPriceClient:
 
     def latest_by_zone(self) -> dict[str, float]:
         """{availability_zone: $/hr}, newest record per zone; {} if the
-        CLI fails, returns junk, or reports no prices. Cached for
-        ``cache_ttl_s`` (failures too — a broken CLI must not be re-tried
-        every tick)."""
+        CLI fails, returns junk, or reports no prices. Successes are
+        cached for ``cache_ttl_s``; failures for the shorter
+        ``failure_ttl_s`` — a broken CLI must not be re-tried every
+        tick, but a transient hiccup must not pin the stale flag (and
+        the controller's rule-fallback) for the full success TTL."""
         now = self._clock()
-        if self._cache is not None and now - self._cache_at < self.cache_ttl_s:
-            return dict(self._cache)
+        if self._cache is not None:
+            ttl = self.cache_ttl_s if self._cache else self.failure_ttl_s
+            if now - self._cache_at < ttl:
+                return dict(self._cache)
         prices = self._fetch()
         self._cache, self._cache_at = prices, now
         return dict(prices)
@@ -472,13 +550,23 @@ class LiveSignalSource(SignalSource):
         # (is_peak 09:00-21:00, diurnal curves) and Prometheus range windows
         # refer to actual hours, not ticks-since-process-start.
         self.start_unix_s = time.time() if start_unix_s is None else start_unix_s
-        self.prom = PrometheusClient(signals.prometheus_url, fetch=fetch,
+        # Retry/backoff transport (the fault subsystem's live satellite):
+        # every HTTP family rides one RetryingFetch, so a transient 500
+        # costs a sub-second retry instead of the whole tick; exhaustion
+        # surfaces through the per-family fallbacks below as a
+        # ``last_scrape_stale`` tick, not an exception mid-controller.
+        base_fetch = fetch or _default_fetch(signals.request_timeout_s)
+        rfetch: Fetch = RetryingFetch(
+            base_fetch, retries=signals.fetch_retries,
+            backoff_s=signals.fetch_backoff_s,
+            deadline_s=signals.request_timeout_s)
+        self.prom = PrometheusClient(signals.prometheus_url, fetch=rfetch,
                                      timeout_s=signals.request_timeout_s)
-        self.opencost = OpenCostClient(signals.opencost_url, fetch=fetch,
+        self.opencost = OpenCostClient(signals.opencost_url, fetch=rfetch,
                                        timeout_s=signals.request_timeout_s)
         self.carbon = CarbonIntensityClient(
             signals.carbon_url, signals.carbon_api_key, signals.carbon_zone,
-            signals.carbon_default_g_kwh, fetch=fetch,
+            signals.carbon_default_g_kwh, fetch=rfetch,
             timeout_s=signals.request_timeout_s)
         self._synth = SyntheticSignalSource(cluster, workload, sim, signals,
                                             start_unix_s=self.start_unix_s)
@@ -554,17 +642,27 @@ class LiveSignalSource(SignalSource):
         z = self.cluster.n_zones
         nt = self.cluster.node_type
         base = self._synth.trace(t_index + 1, seed=seed).slice_steps(t_index, 0 + 1)
+        # Staleness accounting for the degraded-mode controller: any
+        # family whose (retried) scrape failed and fell back marks the
+        # whole sample stale — the values are priors/held, not measured.
+        stale = False
 
         od = np.asarray(base.od_price_hr).copy()
         demand = np.asarray(base.demand_pods).copy()
 
         # Spot prices: measured per-AZ history when the feed is enabled,
-        # synthetic prior for any zone the feed doesn't cover.
+        # synthetic prior for any zone the feed doesn't cover. A feed
+        # that is CONFIGURED but returned nothing at all (CLI failure or
+        # empty history — latest_by_zone caches both as {}) is a stale
+        # family too: every zone is then running on fabricated prices,
+        # exactly what the degraded-mode machine must see.
         spot = np.asarray(base.spot_price_hr).copy()
         if self.spot_clients:
             by_az: dict[str, float] = {}
             for client in self.spot_clients:
                 by_az.update(client.latest_by_zone())
+            if not by_az:
+                stale = True
             for i, zone in enumerate(self.cluster.zones):
                 if zone in by_az:
                     spot[0, i] = by_az[zone]
@@ -575,7 +673,7 @@ class LiveSignalSource(SignalSource):
                 mean_hr = float(np.mean(list(prices.values())))
                 od[:] = max(mean_hr, nt.od_price_hr)
         except SignalUnavailable:
-            pass
+            stale = True
 
         # Demand: namespace-scoped per-pod series classified into the
         # simulator's spot/od demand classes (burst-web-<i> odd→spot,
@@ -594,18 +692,22 @@ class LiveSignalSource(SignalSource):
                              + sum(v for _, v in running))
                     demand[0, :] = total / demand.shape[-1]
         except SignalUnavailable:
-            pass
+            stale = True
 
         # One API call per distinct grid zone (ElectricityMaps bills per
         # request; a 2-region 4-zone fleet makes 2 calls, not 4), each
         # falling back to its own region's base intensity.
         defaults = {g: d for g, d in zip(self._zone_grid,
                                          self._zone_default)}
-        by_grid = {g: self.carbon.latest(zone=g, default=defaults[g])
-                   for g in dict.fromkeys(self._zone_grid)}
+        by_grid = {}
+        for g in dict.fromkeys(self._zone_grid):
+            by_grid[g] = self.carbon.latest(zone=g, default=defaults[g])
+            if not self.carbon.last_ok:
+                stale = True
         carbon = np.asarray([[by_grid[g] for g in self._zone_grid]],
                             dtype=np.float32)
 
+        self.last_scrape_stale = stale
         return ExogenousTrace(
             spot_price_hr=as_f32(spot), od_price_hr=as_f32(od),
             carbon_g_kwh=as_f32(carbon), demand_pods=as_f32(demand),
@@ -702,22 +804,30 @@ class LiveSignalSource(SignalSource):
 def make_signal_source(cluster: ClusterConfig, workload: WorkloadConfig,
                        sim: SimConfig, signals: SignalsConfig,
                        *, fetch: Fetch | None = None,
-                       replay_path: str | None = None) -> SignalSource:
+                       replay_path: str | None = None,
+                       faults=None) -> SignalSource:
     """Factory keyed on ``signals.backend``.
 
     ``replay_path`` defaults to ``signals.replay_path``, so the replay
     backend is reachable purely through config/CCKA_* env overrides.
+
+    ``faults`` (a ``config.FaultsConfig``) reaches the synthetic and
+    replay backends, whose packed streams synthesize the disturbance
+    lanes; the live backend ignores it — the live world supplies its
+    own faults, and the degraded-mode machinery reacts to the REAL
+    staleness flag instead.
     """
     from ccka_tpu.config import ConfigError
     if signals.backend == "synthetic":
-        return SyntheticSignalSource(cluster, workload, sim, signals)
+        return SyntheticSignalSource(cluster, workload, sim, signals,
+                                     faults=faults)
     if signals.backend == "replay":
         from ccka_tpu.signals.replay import ReplaySignalSource
         path = replay_path or signals.replay_path
         if not path:
             raise ConfigError("signals: replay backend requires replay_path")
         try:
-            return ReplaySignalSource.from_file(path)
+            return ReplaySignalSource.from_file(path, faults=faults)
         except (OSError, KeyError, ValueError) as e:
             raise ConfigError(f"signals: cannot load replay trace "
                               f"{path!r}: {e}") from e

@@ -64,16 +64,25 @@ class ReplaySignalSource(SignalSource):
     """
 
     def __init__(self, trace: ExogenousTrace, meta: TraceMeta,
-                 *, offset_steps: int = 0):
+                 *, offset_steps: int = 0, faults=None):
         trace.validate_shapes()
         self._trace = trace
         self._meta = meta
         self.offset_steps = offset_steps
+        # Fault-injection disturbances (`config.FaultsConfig`): replayed
+        # worlds are recorded calm weather — the stored trace carries no
+        # preemption storms/ICE/outages — so the fault lanes are
+        # SYNTHESIZED on top of the replayed windows (packed path only),
+        # keyed by the window-sampling key: same key → same windows AND
+        # same faults, the pairing contract of the synthetic backend.
+        self.faults = faults if (faults is not None
+                                 and faults.enabled) else None
 
     @classmethod
-    def from_file(cls, path: str, *, offset_steps: int = 0) -> "ReplaySignalSource":
+    def from_file(cls, path: str, *, offset_steps: int = 0,
+                  faults=None) -> "ReplaySignalSource":
         trace, meta = load_trace(path)
-        return cls(trace, meta, offset_steps=offset_steps)
+        return cls(trace, meta, offset_steps=offset_steps, faults=faults)
 
     def meta(self) -> TraceMeta:
         return self._meta
@@ -201,14 +210,34 @@ class ReplaySignalSource(SignalSource):
         ckey = (steps, n, t_chunk, recycled)
         fn = self._packed_fns.get(ckey)
         if fn is None:
+            import jax.numpy as jnp
+
+            faults = self.faults
+            Z = self._trace.n_zones
+
+            def pack(tr, k):
+                packed = _pack_exo(tr, t_pad)
+                if faults is None:
+                    return packed
+                # Fault lanes on replayed windows (see __init__): the
+                # stored trace is calm weather, so disturbances are
+                # synthesized here — appended after the padded exo
+                # block like the synthetic backend's, keyed by the same
+                # window-sampling key. No price_dev: the stored spot
+                # series carries no separable anomaly channel, so the
+                # price-correlated hazard term is synthetic-only.
+                from ccka_tpu.faults.process import packed_fault_lanes
+                lanes = packed_fault_lanes(faults, k, steps, t_pad, Z, n)
+                return jnp.concatenate([packed, lanes], axis=1)
+
             if recycled:
-                fn = jax.jit(lambda tr, buf: _pack_exo(tr, t_pad),
-                             donate_argnums=(1,), keep_unused=True)
+                fn = jax.jit(lambda tr, k, buf: pack(tr, k),
+                             donate_argnums=(2,), keep_unused=True)
             else:
-                fn = jax.jit(lambda tr: _pack_exo(tr, t_pad))
+                fn = jax.jit(pack)
             self._packed_fns[ckey] = fn
         trace = self.batch_trace_device(steps, key, n)
-        return fn(trace, recycle) if recycled else fn(trace)
+        return fn(trace, key, recycle) if recycled else fn(trace, key)
 
 
 def trace_from_arrays(arrays: Mapping[str, np.ndarray], dt_s: float,

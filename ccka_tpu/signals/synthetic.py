@@ -45,11 +45,20 @@ class SyntheticSignalSource(SignalSource):
                  sim: SimConfig,
                  signals: SignalsConfig,
                  *,
-                 start_unix_s: float = 0.0):
+                 start_unix_s: float = 0.0,
+                 faults=None):
         self.cluster = cluster
         self.workload = workload
         self.sim = sim
         self.signals = signals
+        # Fault-injection disturbances (`config.FaultsConfig`): when
+        # enabled, the PACKED stream grows the fault lane block
+        # (`faults/process.py`) — keyed off the same generation key, so
+        # the exo rows stay bitwise identical to a no-faults source and
+        # every policy scored on the stream sees one fault realization.
+        # None/disabled emits the exact pre-fault stream (no lanes).
+        self.faults = faults if (faults is not None
+                                 and faults.enabled) else None
         self.start_unix_s = start_unix_s
         self._zp = self._zone_params()
         # Longest trace generated so far, per seed. Generation is
@@ -224,6 +233,7 @@ class SyntheticSignalSource(SignalSource):
 
         z = self.cluster.n_zones
         t_pad = _math.ceil(steps / t_chunk) * t_chunk
+        faults = self.faults
 
         def generate(k):
             ks, kc, kd = jax.random.split(k, 3)
@@ -235,7 +245,21 @@ class SyntheticSignalSource(SignalSource):
                 _ar1_device(kd, (steps, batch), rho=0.9, sigma=0.5,
                             axis=0),
             )
-            return self._assemble_packed(steps, t_pad, noise)
+            packed = self._assemble_packed(steps, t_pad, noise)
+            if faults is None:
+                return packed
+            # Fault lanes (ccka_tpu/faults): appended AFTER the padded
+            # exo block so existing row offsets are untouched; keyed by
+            # fold_in(k, FAULT_KEY_TAG) so the exo streams' own draws —
+            # and therefore the exo rows — stay bitwise identical to a
+            # no-faults source on the same key. The spot AR(1) anomaly
+            # feeds the optional price-correlated hazard.
+            import jax.numpy as _jnp
+
+            from ccka_tpu.faults.process import packed_fault_lanes
+            lanes = packed_fault_lanes(faults, k, steps, t_pad, z, batch,
+                                       price_dev=noise[0])
+            return _jnp.concatenate([packed, lanes], axis=1)
 
         return generate
 

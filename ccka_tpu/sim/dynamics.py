@@ -78,7 +78,17 @@ def step(params: SimParams,
          exo: ExoStep,
          key: jax.Array,
          *,
-         stochastic: bool = False) -> tuple[ClusterState, StepMetrics]:
+         stochastic: bool = False,
+         fault=None) -> tuple[ClusterState, StepMetrics]:
+    """``fault``: optional :class:`ccka_tpu.faults.FaultStep` disturbance
+    inputs (preemption-hazard multiplier, ICE denial, delay jitter,
+    outage flag). ``None`` — the default everywhere outside the fault
+    subsystem — takes the exact pre-fault code path (the Python-level
+    branch keeps it BITWISE identical, pinned by `tests/test_faults.py`;
+    a neutral FaultStep is bitwise identical too). Signal staleness is
+    an *observation* effect: callers (rollout/controller) feed policies
+    held signals; this step always consumes true ``exo``.
+    """
     ppn = params.pods_per_node
     dt_hr = params.dt_s / 3600.0
 
@@ -87,15 +97,27 @@ def step(params: SimParams,
     desired = exo.demand_pods * action.hpa_scale  # [C]
 
     # ---- 2. Provisioning pipeline arrivals (NodeClaim → Registered).
+    # Delay jitter (fault): a fraction of the arrivals is held back one
+    # more tick — re-queued at the head of the shifted pipeline.
     arrivals = state.pipeline[0]                        # [P, Z, T_CT]
-    nodes = state.nodes + arrivals
+    if fault is not None:
+        held = arrivals * fault.delay_frac
+        nodes = state.nodes + (arrivals - held)
+    else:
+        nodes = state.nodes + arrivals
     pipeline = jnp.concatenate(
         [state.pipeline[1:], jnp.zeros_like(state.pipeline[:1])], axis=0)
+    if fault is not None:
+        pipeline = pipeline.at[0].add(held)
 
     # ---- 3. Spot interruptions — stochastic reclaim, the process the
     # reference disabled (`05_karpenter.sh:136`). Gaussian moment-match of
-    # Binomial(n, p) keeps shapes static and vmap-friendly.
+    # Binomial(n, p) keeps shapes static and vmap-friendly. The fault
+    # hazard lane scales the per-zone probability (preemption storms),
+    # clipped at 1 — a storm can at most reclaim the whole pool.
     p = params.interrupt_p_step
+    if fault is not None:
+        p = jnp.minimum(p * fault.preempt_hazard, 1.0)  # [Z]
     spot_nodes = nodes[..., CT_SPOT]
     mean_int = spot_nodes * p
     if stochastic:
@@ -147,6 +169,17 @@ def step(params: SimParams,
     scale = jnp.where(pool_new > _EPS,
                       jnp.minimum(headroom / (pool_new + _EPS), 1.0), 1.0)
     new_nodes = new_nodes * scale[:, None, None]
+    # Insufficient-capacity errors (fault): the spot share of this tick's
+    # provisioning request is denied. Denied capacity is *not requested*
+    # — the pods stay pending and Karpenter re-requests next tick, which
+    # is exactly how ICE retry behaves (the window's AR(1) persistence is
+    # the cooldown). On-demand is never denied.
+    if fault is not None:
+        denied = new_nodes[..., CT_SPOT].sum() * fault.deny_frac
+        new_nodes = new_nodes.at[..., CT_SPOT].multiply(
+            1.0 - fault.deny_frac)
+    else:
+        denied = jnp.float32(0.0)
     pipeline = pipeline.at[-1].add(new_nodes)
 
     # ---- 6. Consolidation per disruption policy (`demo_20:59-60`,
@@ -263,5 +296,10 @@ def step(params: SimParams,
         evicted_pods=evicted,
         latency_p95_ms=latency_p95_ms,
         queue_depth=queue_depth,
+        denied_nodes=denied,
+        delayed_nodes=(held.sum() if fault is not None
+                       else jnp.float32(0.0)),
+        signal_stale=(fault.signal_stale if fault is not None
+                      else jnp.float32(0.0)),
     )
     return new_state, metrics

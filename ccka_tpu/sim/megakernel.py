@@ -111,7 +111,12 @@ from ccka_tpu.models.nets import (  # noqa: E402
 # nodes[(ct, p, z)] = ct*P*Z + p*Z + z — spot rows contiguous first.
 
 
-def _state_rows(P: int, Z: int, K: int) -> dict:
+def _state_rows(P: int, Z: int, K: int, *, fault_obs: bool = False) -> dict:
+    """``fault_obs``: reserve rows carrying the LAST-OBSERVED signals
+    (spot/od/carbon [Z each] + demand [2]) for the signal-outage fault —
+    observing policies (carbon/mlp) read these instead of the live exo
+    rows while the outage lane is set. Appended after the accumulators so
+    the pre-fault layout is unchanged byte-for-byte."""
     n = P * Z * 2
     rows = {"nodes": (0, n)}
     off = n
@@ -124,9 +129,12 @@ def _state_rows(P: int, Z: int, K: int) -> dict:
     for name in ("acc_cost", "acc_carbon", "acc_requests", "acc_slo",
                  "acc_evict", "nct_spot", "nct_od", "served_sum",
                  "capacity_sum", "waste_sum", "latency_sum", "latency_max",
-                 "queue_sum", "interrupts_sum"):
+                 "queue_sum", "interrupts_sum", "denied_sum", "stale_sum"):
         rows[name] = (off, off + 1)
         off += 1
+    if fault_obs:
+        rows["last_exo"] = (off, off + 3 * Z + 2)
+        off += 3 * Z + 2
     rows["_total"] = (0, off)
     return rows
 
@@ -134,7 +142,12 @@ def _state_rows(P: int, Z: int, K: int) -> dict:
 # Exo rows inside the [T, rows, B] packed stream — offsets depend on the
 # zone count (the multiregion preset has Z=4), so they are computed, not
 # constants: spot[0:Z], od[Z:2Z], carbon[2Z:3Z], demand[3Z:3Z+2],
-# is_peak[3Z+2]; padded to a sublane multiple.
+# is_peak[3Z+2]; padded to a sublane multiple. A FAULT-WIDENED stream
+# (`ccka_tpu/faults`, ARCHITECTURE §12) appends the disturbance lane
+# block after this padding — hazard[FB:FB+Z], deny[FB+Z], delay[FB+Z+1],
+# stale[FB+Z+2] with FB = _exo_rows(Z), itself padded to a multiple of 8
+# (`faults.process.fault_rows`) — so existing offsets never move; the
+# launchers detect the widened layout from the static row count.
 
 
 def _exo_rows(Z: int) -> int:
@@ -223,7 +236,8 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
                  carbon: tuple | None = None,
                  slo_mask: tuple | None = None,
                  mlp_dims: tuple | None = None,
-                 plan_batched: bool = False):
+                 plan_batched: bool = False,
+                 faults: bool = False):
     """``policy``: "profiles" | "carbon" | "mlp" | "plan" (module
     docstring; "plan" executes a precomputed per-tick action stream —
     the diff-MPC playback entry — instead of deciding in-kernel).
@@ -234,8 +248,17 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
     ``plan_batched``: plan streams are ``[T_pad, rows, B]`` (per-cluster
     plans, VMEM-streamed like the exo block) rather than ``[T_pad,
     rows]`` (one broadcast plan, SMEM scalars).
+    ``faults``: the exo stream carries the fault lane block
+    (`ccka_tpu/faults`, rows at base ``_exo_rows(Z)``: hazard[Z], deny,
+    delay, stale — ARCHITECTURE §12): interruption hazard scales per
+    zone, spot provisioning is denied during ICE windows, arrivals are
+    delay-jittered, and observing policies (carbon/mlp) read held
+    signals during outages via the ``last_exo`` state rows. Static: the
+    False kernel is the pre-fault program, untouched (zero-fault gate).
     """
-    ROWS = _state_rows(P, Z, K)
+    ROWS = _state_rows(P, Z, K,
+                       fault_obs=faults and policy in ("carbon", "mlp"))
+    FB = _exo_rows(Z)    # fault lane base row
     NPZ = P * Z * 2  # nodes rows
     # Unpacked here: `carbon` would otherwise be shadowed by the tick
     # body's carbon accumulator local.
@@ -307,6 +330,33 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
             running = rows(state, "running")       # [2, B]
             timer = rows(state, "timer")           # [P, B]
 
+            if faults:
+                haz = [exo[FB + z] for z in range(Z)]    # hazard mult [B]
+                deny = exo[FB + Z]                       # ICE denial [B]
+                delay = exo[FB + Z + 1]                  # arrival hold [B]
+                stale = exo[FB + Z + 2]                  # outage flag [B]
+            if faults and policy in ("carbon", "mlp"):
+                # Signal outage: observing policies read the HELD
+                # last-pre-outage signals instead of the live rows; tick
+                # 0 observes fresh (the zeroed scratch is never served —
+                # tglob > 0 gates the hold, mirroring the lax path's
+                # last0 = exo[0] carry init).
+                last = rows(state, "last_exo")           # [3Z+2, B]
+                cur = exo[0:3 * Z + 2]
+                hold = jnp.logical_and(stale > 0.5, tglob > 0)
+                obs_sig = jnp.where(hold[None, :], last, cur)
+
+                def obs(j):
+                    """Policy-observed signal row j (< 3Z+2: prices,
+                    carbon, demand; is_peak is clock-derived — read it
+                    from exo directly)."""
+                    return obs_sig[j]
+            else:
+                obs_sig = None
+
+                def obs(j):
+                    return exo[j]
+
             if policy in ("profiles", "carbon", "plan"):
                 if policy == "plan":
                     if plan_batched:
@@ -340,8 +390,9 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
                 # CarbonAwarePolicy.decide (policy/carbon.py:84-101):
                 # zone weight = sigmoid(sharpness * carbon-rank +
                 # stickiness * occupancy), floored at min_weight; the
-                # profile base keeps every other coordinate.
-                carbon_z = [exo[2 * Z + z] for z in range(Z)]
+                # profile base keeps every other coordinate. Observed
+                # carbon — stale under a signal outage (fault mode).
+                carbon_z = [obs(2 * Z + z) for z in range(Z)]
                 cmean = sum(carbon_z) / Z
                 nodes_z = [
                     sum(nodes[ct * P * Z + pp * Z + z]
@@ -374,10 +425,12 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
                              k * NPZ + (ct + 1) * P * Z].sum(axis=0)
                         for k in range(K)))
                 ob.extend([running[0], running[1]])
-                ob.extend([exo[3 * Z], exo[3 * Z + 1]])          # demand
-                ob.extend([exo[z] for z in range(Z)])            # spot $
-                ob.extend([exo[Z + z] for z in range(Z)])        # od $
-                ob.extend([exo[2 * Z + z] for z in range(Z)])    # carbon
+                # Signal features via obs(): held (stale) under a fault
+                # outage; is_peak is clock-derived and stays live.
+                ob.extend([obs(3 * Z), obs(3 * Z + 1)])          # demand
+                ob.extend([obs(z) for z in range(Z)])            # spot $
+                ob.extend([obs(Z + z) for z in range(Z)])        # od $
+                ob.extend([obs(2 * Z + z) for z in range(Z)])    # carbon
                 ob.append(exo[3 * Z + 2])                        # is_peak
                 time_s = tglob.astype(jnp.float32) * p["dt_s"]
                 ob.append(jnp.broadcast_to(
@@ -433,14 +486,32 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
             demand = exo[3 * Z:3 * Z + 2]                      # [2, B]
             desired = demand * jnp.stack(hpa)                   # [2, B]
 
-            # 2. provisioning arrivals + pipeline shift.
-            nodes = nodes + pipe[0:NPZ]
-            pipe = jnp.concatenate(
+            # 2. provisioning arrivals + pipeline shift. Fault delay
+            # jitter holds back a fraction of the arrivals one tick
+            # (re-queued at the shifted pipeline's head).
+            arr = pipe[0:NPZ]
+            tail = jnp.concatenate(
                 [pipe[NPZ:], jnp.zeros((NPZ, B), jnp.float32)], axis=0)
+            if faults:
+                held = arr * delay
+                nodes = nodes + (arr - held)
+                pipe = jnp.concatenate([tail[0:NPZ] + held, tail[NPZ:]],
+                                       axis=0)
+            else:
+                nodes = nodes + arr
+                pipe = tail
 
-            # 3. spot interruptions.
+            # 3. spot interruptions — per-zone hazard multiplier under a
+            # fault preemption storm, clipped at 1 (a storm can at most
+            # reclaim the whole pool).
             spot = nodes[0:P * Z]
-            lam = spot * p["interrupt_p"]
+            if faults:
+                haz_block = jnp.stack([haz[z] for pp in range(P)
+                                       for z in range(Z)])    # [P*Z, B]
+                lam = spot * jnp.minimum(p["interrupt_p"] * haz_block,
+                                         1.0)
+            else:
+                lam = spot * p["interrupt_p"]
             if stochastic:
                 interrupted = _poisson_small_kernel(lam, spot)
             else:
@@ -517,6 +588,16 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
                                     ct * P * Z + (pp + 1) * Z]
                     scaled_rows.append(blk * scale[pp])
             new_nodes = jnp.concatenate(scaled_rows, axis=0)
+            # Insufficient-capacity errors (fault): the spot share of
+            # this tick's request is denied — not requested, so pending
+            # pods drive a re-request next tick (dynamics.py order).
+            if faults:
+                spot_new = new_nodes[0:P * Z]
+                denied_b = spot_new.sum(axis=0) * deny
+                new_nodes = jnp.concatenate(
+                    [spot_new * (1.0 - deny), new_nodes[P * Z:]], axis=0)
+            else:
+                denied_b = jnp.zeros((B,), jnp.float32)
             pipe = jnp.concatenate(
                 [pipe[0:(K - 1) * NPZ], pipe[(K - 1) * NPZ:] + new_nodes],
                 axis=0)
@@ -632,6 +713,7 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
             def bump(name, delta):
                 return rows(state, name) + valid * delta[None, :]
 
+            stale_b = stale if faults else jnp.zeros((B,), jnp.float32)
             new_state_parts = [
                 nodes, pipe, running, timer,
                 bump("acc_cost", cost),
@@ -649,7 +731,13 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
                             valid * lat[None, :]),
                 bump("queue_sum", queue),
                 bump("interrupts_sum", interrupted_total),
+                bump("denied_sum", denied_b),
+                bump("stale_sum", stale_b),
             ]
+            if obs_sig is not None:
+                # Held-signal carry: during an outage obs_sig IS the old
+                # last row block, so the hold persists across the window.
+                new_state_parts.append(obs_sig)
             pad = state.shape[0] - ROWS["_total"][1]
             if pad:
                 new_state_parts.append(jnp.zeros((pad, B), jnp.float32))
@@ -665,7 +753,8 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
             names = ("acc_cost", "acc_carbon", "acc_requests", "acc_slo",
                      "acc_evict", "nct_spot", "nct_od", "served_sum",
                      "capacity_sum", "waste_sum", "latency_sum",
-                     "latency_max", "queue_sum", "interrupts_sum")
+                     "latency_max", "queue_sum", "interrupts_sum",
+                     "denied_sum", "stale_sum")
             vals = [state[ROWS[n][0]] for n in names]
             pad = out_ref.shape[-2] - len(vals)
             out = jnp.stack(vals + [jnp.zeros_like(vals[0])] * pad)
@@ -689,6 +778,10 @@ MEAN_PARITY_TOLERANCES = {
     "interruptions": 0.03, "evictions": 0.05, "queue_depth_mean": 0.05,
     "slo_hours": 0.01, "slo_attainment": 0.01, "usd_per_slo_hour": 0.01,
     "latency_p95_ms_max": 0.02,
+    # Fault counters (ccka_tpu/faults): rare-event totals like
+    # interruptions/evictions; identically 0 (rel diff 0) off the fault
+    # path, so the pre-fault gates are untouched.
+    "denials": 0.05, "stale_ticks": 0.01,
 }
 DEFAULT_MEAN_PARITY_TOL = 0.005
 
@@ -767,13 +860,18 @@ def _pack_exo(traces: ExogenousTrace, T_pad: int) -> jnp.ndarray:
                                              "interpret", "carbon"))
 def _run(params_packed, actions_packed, exo_packed, meta, *, P, Z, K,
          stochastic, b_block, t_chunk, interpret=False, carbon=None):
-    T_pad, _, B = exo_packed.shape
+    # Fault lanes auto-detect: a widened stream (`ccka_tpu/faults`) has
+    # extra rows past _exo_rows(Z). Shapes are static at trace time, so
+    # this is a compile-time switch — the plain-stream program is the
+    # pre-fault kernel, untouched.
+    T_pad, exo_rows_total, B = exo_packed.shape
+    faults = exo_rows_total > _exo_rows(Z)
     n_b = B // b_block
     n_t = T_pad // t_chunk
     kernel, ROWS = _make_kernel(
         P, Z, K, t_chunk, n_t, stochastic,
         policy="carbon" if carbon is not None else "profiles",
-        carbon=carbon)
+        carbon=carbon, faults=faults)
     s_rows = math.ceil(ROWS["_total"][1] / 8) * 8
 
     out = pl.pallas_call(
@@ -787,7 +885,7 @@ def _run(params_packed, actions_packed, exo_packed, meta, *, P, Z, K,
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((2, _act_rows(P, Z)), lambda b, t: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((t_chunk, _exo_rows(Z), b_block),
+            pl.BlockSpec((t_chunk, exo_rows_total, b_block),
                          lambda b, t: (t, 0, b),
                          memory_space=pltpu.VMEM),
         ],
@@ -870,7 +968,8 @@ def _pack_mlp_tensors(net_params, dims, b_block: int):
 def _run_mlp(params_packed, weights, exo_packed, meta, *, P, Z, K,
              stochastic, b_block, t_chunk, slo_mask, mlp_dims,
              interpret=False):
-    T_pad, _, B = exo_packed.shape
+    T_pad, exo_rows_total, B = exo_packed.shape
+    faults = exo_rows_total > _exo_rows(Z)   # see _run
     n_b = B // b_block
     n_t = T_pad // t_chunk
     NP = weights[0].shape[0]
@@ -878,7 +977,7 @@ def _run_mlp(params_packed, weights, exo_packed, meta, *, P, Z, K,
     A_pad = weights[4].shape[-1]
     kernel, ROWS = _make_kernel(P, Z, K, t_chunk, n_t, stochastic,
                                 policy="mlp", slo_mask=slo_mask,
-                                mlp_dims=mlp_dims)
+                                mlp_dims=mlp_dims, faults=faults)
     s_rows = math.ceil(ROWS["_total"][1] / 8) * 8
 
     def wspec(rows, cols):
@@ -897,7 +996,7 @@ def _run_mlp(params_packed, weights, exo_packed, meta, *, P, Z, K,
             wspec(F_pad, H), wspec(H, b_block),      # w1, b1
             wspec(H, H), wspec(H, b_block),          # w2, b2
             wspec(H, A_pad), wspec(A_pad, b_block),  # w3, b3
-            pl.BlockSpec((t_chunk, _exo_rows(Z), b_block),
+            pl.BlockSpec((t_chunk, exo_rows_total, b_block),
                          lambda n, b, t: (t, 0, b),
                          memory_space=pltpu.VMEM),
         ],
@@ -973,7 +1072,8 @@ def _finalize(params: SimParams, out: jnp.ndarray, T: int):
     from ccka_tpu.sim.metrics import SummaryAcc, finalize_summary
 
     (cost, carbon, requests, slo_s, evict, nct_spot, nct_od, served,
-     capacity, waste, lat_sum, lat_max, queue, interrupts) = out[:14]
+     capacity, waste, lat_sum, lat_max, queue, interrupts, denied,
+     stale) = out[:16]
     B = cost.shape[0]
 
     zeros = jnp.zeros((B,), jnp.float32)
@@ -985,7 +1085,7 @@ def _finalize(params: SimParams, out: jnp.ndarray, T: int):
         nodes_ct_sum=jnp.stack([nct_spot, nct_od], axis=-1),
         served_sum=served, capacity_sum=capacity, waste_sum=waste,
         latency_sum=lat_sum, latency_max=lat_max, queue_sum=queue,
-        interrupts_sum=interrupts)
+        interrupts_sum=interrupts, denied_sum=denied, stale_sum=stale)
     return jax.vmap(
         lambda init, fin, a: finalize_summary(params, init, fin, a, T)
     )(mk_state(zeros, zeros, zeros, zeros, zeros),
@@ -1143,11 +1243,20 @@ def _check_chunking(T_pad: int, T: int, t_chunk: int) -> None:
                          "generate with the same t_chunk")
 
 
-def _check_packed(exo_packed, T: int, b_block: int, t_chunk: int) -> None:
+def _check_packed(exo_packed, T: int, b_block: int, t_chunk: int,
+                  Z: int | None = None) -> None:
     T_pad, _rows, B = exo_packed.shape
     if B % b_block:
         raise ValueError(f"megakernel needs B % {b_block} == 0, got {B}")
     _check_chunking(T_pad, T, t_chunk)
+    if Z is not None:
+        # Row-count contract: exactly the plain layout or the fault-
+        # widened one (`ccka_tpu/faults`) — anything else would misread
+        # lanes. Raises on mismatch; the bool itself is re-derived from
+        # the static shape inside the launchers.
+        from ccka_tpu.faults.process import has_fault_lanes
+
+        has_fault_lanes(exo_packed, Z)
 
 
 def megakernel_summary_from_packed(params: SimParams,
@@ -1179,9 +1288,9 @@ def megakernel_summary_from_packed(params: SimParams,
     (``packed_trace_device(recycle=...)``) so back-to-back generations
     never hold two streams in HBM.
     """
-    _check_packed(exo_packed, T, b_block, t_chunk)
     P = int(off_action.zone_weight.shape[0])
     Z = int(off_action.zone_weight.shape[1])
+    _check_packed(exo_packed, T, b_block, t_chunk, Z)
     fn = _fused_packed_donate if donate_stream else _fused_packed_summary
     return fn(
         params, off_action, peak_action, exo_packed, jnp.int32(seed),
@@ -1237,8 +1346,8 @@ def neural_megakernel_summary_from_packed(params: SimParams,
     reclaims them instead of double-peaking HBM."""
     from ccka_tpu.policy.constraints import slo_pool_mask
 
-    _check_packed(exo_packed, T, b_block, t_chunk)
     P, Z = cluster.n_pools, cluster.n_zones
+    _check_packed(exo_packed, T, b_block, t_chunk, Z)
     K = int(params.provision_pipeline_k)
     dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
     if was_single:
@@ -1331,11 +1440,13 @@ def pack_plan(actions: Action, T_pad: int) -> jnp.ndarray:
 def _run_plan(params_packed, plan_packed, exo_packed, meta, *, P, Z, K,
               stochastic, b_block, t_chunk, plan_batched,
               interpret=False):
-    T_pad, _, B = exo_packed.shape
+    T_pad, exo_rows_total, B = exo_packed.shape
+    faults = exo_rows_total > _exo_rows(Z)   # see _run
     n_b = B // b_block
     n_t = T_pad // t_chunk
     kernel, ROWS = _make_kernel(P, Z, K, t_chunk, n_t, stochastic,
-                                policy="plan", plan_batched=plan_batched)
+                                policy="plan", plan_batched=plan_batched,
+                                faults=faults)
     s_rows = math.ceil(ROWS["_total"][1] / 8) * 8
     pr = _plan_rows(P, Z)
     if plan_batched:
@@ -1360,7 +1471,7 @@ def _run_plan(params_packed, plan_packed, exo_packed, meta, *, P, Z, K,
             pl.BlockSpec((1, len(_PARAM_NAMES)), lambda b, t: (0, 0),
                          memory_space=pltpu.SMEM),
             plan_spec,
-            pl.BlockSpec((t_chunk, _exo_rows(Z), b_block),
+            pl.BlockSpec((t_chunk, exo_rows_total, b_block),
                          lambda b, t: (t, 0, b),
                          memory_space=pltpu.VMEM),
         ],
@@ -1509,8 +1620,8 @@ def plan_megakernel_summary_from_packed(params: SimParams,
     ``(summary, stream)`` aliased; the plan stream is never donated
     (one plan is typically scored against many fresh worlds — see
     `_plan_packed_donate_impl`)."""
-    _check_packed(exo_packed, T, b_block, t_chunk)
     P, Z = cluster.n_pools, cluster.n_zones
+    _check_packed(exo_packed, T, b_block, t_chunk, Z)
     plan_batched = _check_plan(plan_packed, exo_packed, P, Z)
     fn = (_fused_plan_packed_donate if donate_stream
           else _fused_plan_packed_summary)

@@ -36,6 +36,11 @@ class EpisodeSummary(NamedTuple):
     latency_p95_ms_mean: jnp.ndarray  # [] mean p95 proxy over the episode
     latency_p95_ms_max: jnp.ndarray   # [] worst tick p95
     queue_depth_mean: jnp.ndarray     # [] mean pending-pod backlog
+    # Fault-injection counters (ccka_tpu/faults): identically 0 on the
+    # pre-fault pipeline, so every recorded BASELINE/BENCH number keeps
+    # its meaning (the zero-fault bitwise gate pins the shared fields).
+    denials: jnp.ndarray              # [] total spot nodes denied (ICE)
+    stale_ticks: jnp.ndarray          # [] ticks policies saw stale signals
 
 
 class SummaryAcc(NamedTuple):
@@ -54,6 +59,8 @@ class SummaryAcc(NamedTuple):
     latency_max: jnp.ndarray     # [] max_t p95 proxy
     queue_sum: jnp.ndarray       # [] Σ_t pending backlog
     interrupts_sum: jnp.ndarray  # [] Σ_t spot reclaims
+    denied_sum: jnp.ndarray      # [] Σ_t spot nodes denied (faults)
+    stale_sum: jnp.ndarray       # [] Σ_t stale-signal ticks (faults)
 
     @classmethod
     def zero(cls) -> "SummaryAcc":
@@ -61,7 +68,7 @@ class SummaryAcc(NamedTuple):
         return cls(nodes_ct_sum=jnp.zeros((N_CT,), jnp.float32),
                    served_sum=z, capacity_sum=z, waste_sum=z,
                    latency_sum=z, latency_max=z, queue_sum=z,
-                   interrupts_sum=z)
+                   interrupts_sum=z, denied_sum=z, stale_sum=z)
 
     def update(self, params: SimParams,
                metrics: StepMetrics) -> "SummaryAcc":
@@ -78,6 +85,8 @@ class SummaryAcc(NamedTuple):
                                     metrics.latency_p95_ms),
             queue_sum=self.queue_sum + metrics.queue_depth,
             interrupts_sum=self.interrupts_sum + metrics.interrupted_nodes,
+            denied_sum=self.denied_sum + metrics.denied_nodes,
+            stale_sum=self.stale_sum + metrics.signal_stale,
         )
 
 
@@ -121,6 +130,8 @@ def finalize_summary(params: SimParams, initial: ClusterState,
         latency_p95_ms_mean=acc.latency_sum / t,
         latency_p95_ms_max=acc.latency_max,
         queue_depth_mean=acc.queue_sum / t,
+        denials=acc.denied_sum,
+        stale_ticks=acc.stale_sum,
     )
 
 
@@ -169,4 +180,6 @@ def summarize(params: SimParams, metrics: StepMetrics) -> EpisodeSummary:
         latency_p95_ms_mean=metrics.latency_p95_ms.mean(axis=-1),
         latency_p95_ms_max=metrics.latency_p95_ms.max(axis=-1),
         queue_depth_mean=metrics.queue_depth.mean(axis=-1),
+        denials=metrics.denied_nodes.sum(axis=-1),
+        stale_ticks=metrics.signal_stale.sum(axis=-1),
     )

@@ -59,33 +59,76 @@ def exo_steps(trace: ExogenousTrace) -> ExoStep:
     )
 
 
+def observed_exo(last_obs: ExoStep, exo: ExoStep, stale) -> ExoStep:
+    """Policy-observed signals under a possible outage (`ccka_tpu/faults`):
+    prices/carbon/demand hold the last pre-outage values while ``stale``
+    is set; ``is_peak`` is clock-derived and stays true. Dynamics always
+    consume the true ``exo`` — only the decide's view goes stale (the
+    same split the megakernel's fault mode implements in-register)."""
+    hold = stale > 0.5
+    return ExoStep(
+        spot_price_hr=jnp.where(hold, last_obs.spot_price_hr,
+                                exo.spot_price_hr),
+        od_price_hr=jnp.where(hold, last_obs.od_price_hr, exo.od_price_hr),
+        carbon_g_kwh=jnp.where(hold, last_obs.carbon_g_kwh,
+                               exo.carbon_g_kwh),
+        demand_pods=jnp.where(hold, last_obs.demand_pods, exo.demand_pods),
+        is_peak=exo.is_peak,
+    )
+
+
 def rollout(params: SimParams,
             state0: ClusterState,
             action_fn: ActionFn,
             trace: ExogenousTrace,
             key: jax.Array,
             *,
-            stochastic: bool = False) -> tuple[ClusterState, StepMetrics]:
+            stochastic: bool = False,
+            faults=None) -> tuple[ClusterState, StepMetrics]:
     """Scan the closed loop decide→act→step over the trace horizon.
 
     ``action_fn`` is the PolicyBackend's jittable decide(); it sees the
     current state and tick signals — exactly the observation surface the
     reference's operator has when choosing demo_20 vs demo_21.
+
+    ``faults``: optional time-major :class:`ccka_tpu.faults.FaultStep`
+    pytree (leaves ``[T, ...]``). When given, each tick's disturbances
+    feed the dynamics and the policy observes STALE signals during
+    outage windows (held at the last pre-outage tick; tick 0 observes
+    its own fresh signals, matching the kernel's ``tglob > 0`` gate).
+    ``None`` takes the exact pre-fault path — a Python-level branch, so
+    existing rollouts stay bitwise identical.
     """
     xs = exo_steps(trace)
     t0 = jnp.arange(xs.is_peak.shape[0], dtype=jnp.int32)
 
-    def body(carry, inp):
-        state, k = carry
-        exo, t = inp
-        k, sub = jax.random.split(k)
-        action = action_fn(state, exo, t)
-        state, metrics = step(params, state, action, exo, sub,
-                              stochastic=stochastic)
-        return (state, k), metrics
+    if faults is None:
+        def body(carry, inp):
+            state, k = carry
+            exo, t = inp
+            k, sub = jax.random.split(k)
+            action = action_fn(state, exo, t)
+            state, metrics = step(params, state, action, exo, sub,
+                                  stochastic=stochastic)
+            return (state, k), metrics
 
-    (final, _), metrics = jax.lax.scan(body, (state0, key), (xs, t0),
-                                       unroll=_UNROLL)
+        (final, _), metrics = jax.lax.scan(body, (state0, key), (xs, t0),
+                                           unroll=_UNROLL)
+        return final, metrics
+
+    def body(carry, inp):
+        state, k, last = carry
+        exo, t, f = inp
+        k, sub = jax.random.split(k)
+        obs = observed_exo(last, exo, f.signal_stale)
+        action = action_fn(state, obs, t)
+        state, metrics = step(params, state, action, exo, sub,
+                              stochastic=stochastic, fault=f)
+        return (state, k, obs), metrics
+
+    last0 = jax.tree.map(lambda x: x[0], xs)
+    (final, _, _), metrics = jax.lax.scan(
+        body, (state0, key, last0), (xs, t0, faults), unroll=_UNROLL)
     return final, metrics
 
 
@@ -95,24 +138,40 @@ def rollout_actions(params: SimParams,
                     trace: ExogenousTrace,
                     key: jax.Array,
                     *,
-                    stochastic: bool = False) -> tuple[ClusterState, StepMetrics]:
+                    stochastic: bool = False,
+                    faults=None) -> tuple[ClusterState, StepMetrics]:
     """Rollout under a precomputed action sequence (leading axis = T).
 
     This is the diff-MPC path: gradients flow from episode objectives back
-    through `scan` into every action of the plan.
+    through `scan` into every action of the plan. ``faults``: optional
+    time-major FaultStep pytree — a plan observes nothing, so only the
+    dynamics-side disturbances apply (the playback kernel's contract).
     """
     xs = exo_steps(trace)
 
+    if faults is None:
+        def body(carry, inp):
+            state, k = carry
+            exo, action = inp
+            k, sub = jax.random.split(k)
+            state, metrics = step(params, state, action, exo, sub,
+                                  stochastic=stochastic)
+            return (state, k), metrics
+
+        (final, _), metrics = jax.lax.scan(body, (state0, key),
+                                           (xs, actions), unroll=_UNROLL)
+        return final, metrics
+
     def body(carry, inp):
         state, k = carry
-        exo, action = inp
+        exo, action, f = inp
         k, sub = jax.random.split(k)
         state, metrics = step(params, state, action, exo, sub,
-                              stochastic=stochastic)
+                              stochastic=stochastic, fault=f)
         return (state, k), metrics
 
-    (final, _), metrics = jax.lax.scan(body, (state0, key), (xs, actions),
-                                       unroll=_UNROLL)
+    (final, _), metrics = jax.lax.scan(
+        body, (state0, key), (xs, actions, faults), unroll=_UNROLL)
     return final, metrics
 
 
@@ -122,7 +181,8 @@ def rollout_summary(params: SimParams,
                     trace: ExogenousTrace,
                     key: jax.Array,
                     *,
-                    stochastic: bool = False):
+                    stochastic: bool = False,
+                    faults=None):
     """Closed-loop rollout that reduces to episode KPIs *inside* the scan.
 
     :func:`rollout` materializes per-tick :class:`StepMetrics` stacked over
@@ -141,17 +201,34 @@ def rollout_summary(params: SimParams,
     t0 = jnp.arange(steps, dtype=jnp.int32)
     acc0 = SummaryAcc.zero()
 
-    def body(carry, inp):
-        state, k, acc = carry
-        exo, t = inp
-        k, sub = jax.random.split(k)
-        action = action_fn(state, exo, t)
-        state, metrics = step(params, state, action, exo, sub,
-                              stochastic=stochastic)
-        return (state, k, acc.update(params, metrics)), None
+    if faults is None:
+        def body(carry, inp):
+            state, k, acc = carry
+            exo, t = inp
+            k, sub = jax.random.split(k)
+            action = action_fn(state, exo, t)
+            state, metrics = step(params, state, action, exo, sub,
+                                  stochastic=stochastic)
+            return (state, k, acc.update(params, metrics)), None
 
-    (final, _, acc), _ = jax.lax.scan(body, (state0, key, acc0), (xs, t0),
-                                      unroll=_UNROLL)
+        (final, _, acc), _ = jax.lax.scan(body, (state0, key, acc0),
+                                          (xs, t0), unroll=_UNROLL)
+        return final, finalize_summary(params, state0, final, acc, steps)
+
+    def body(carry, inp):
+        state, k, acc, last = carry
+        exo, t, f = inp
+        k, sub = jax.random.split(k)
+        obs = observed_exo(last, exo, f.signal_stale)
+        action = action_fn(state, obs, t)
+        state, metrics = step(params, state, action, exo, sub,
+                              stochastic=stochastic, fault=f)
+        return (state, k, acc.update(params, metrics), obs), None
+
+    last0 = jax.tree.map(lambda x: x[0], xs)
+    (final, _, acc, _), _ = jax.lax.scan(
+        body, (state0, key, acc0, last0), (xs, t0, faults),
+        unroll=_UNROLL)
     return final, finalize_summary(params, state0, final, acc, steps)
 
 
@@ -161,14 +238,24 @@ def batched_rollout_summary(params: SimParams,
                             traces: ExogenousTrace,
                             keys: jax.Array,
                             *,
-                            stochastic: bool = False):
+                            stochastic: bool = False,
+                            faults=None):
     """`vmap` of :func:`rollout_summary` — per-cluster KPI summaries for
-    fleet batches too large to stack per-tick metrics for."""
+    fleet batches too large to stack per-tick metrics for. ``faults``:
+    optional batched FaultStep pytree (leaves ``[B, T, ...]``, e.g. from
+    `faults.unpack_fault_lanes`)."""
+    if faults is None:
+        fn = jax.vmap(
+            lambda s, tr, k: rollout_summary(params, s, action_fn, tr, k,
+                                             stochastic=stochastic),
+            in_axes=(0, 0, 0))
+        return fn(states0, traces, keys)
     fn = jax.vmap(
-        lambda s, tr, k: rollout_summary(params, s, action_fn, tr, k,
-                                         stochastic=stochastic),
-        in_axes=(0, 0, 0))
-    return fn(states0, traces, keys)
+        lambda s, tr, k, f: rollout_summary(params, s, action_fn, tr, k,
+                                            stochastic=stochastic,
+                                            faults=f),
+        in_axes=(0, 0, 0, 0))
+    return fn(states0, traces, keys, faults)
 
 
 def batched_rollout(params: SimParams,

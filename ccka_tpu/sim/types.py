@@ -158,3 +158,8 @@ class StepMetrics(NamedTuple):
     evicted_pods: jnp.ndarray    # [] consolidation evictions this tick
     latency_p95_ms: jnp.ndarray  # [] queueing-curve p95 proxy (app latency)
     queue_depth: jnp.ndarray     # [] pending-pod backlog (scheduler queue)
+    # Fault-injection counters (ccka_tpu/faults; all 0 when the step runs
+    # without a FaultStep — the pre-fault pipeline's exact values).
+    denied_nodes: jnp.ndarray    # [] spot provisioning denied (ICE), nodes
+    delayed_nodes: jnp.ndarray   # [] arrivals held back (delay jitter)
+    signal_stale: jnp.ndarray    # [] {0,1} policies saw stale signals

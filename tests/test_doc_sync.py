@@ -190,3 +190,48 @@ class TestMPCKernelClaims:
             "the plan-playback bullet lost its virtual-mesh label")
         m2 = re.search(r"n≥(\d+)\s+kernel-paired\s+traces", bullet)
         assert m2 and int(m2.group(1)) == 256
+
+
+class TestRobustnessClaims:
+    """Round 10's fault-injection scoreboard (ISSUE 5 docs satellite):
+    README's robustness claims are PARSED against the BASELINE round10
+    record, not hand-synced."""
+
+    def test_round10_record_is_self_describing(self, baseline):
+        r10 = baseline["published"]["round10"]
+        sb = r10["fault_robustness_scoreboard"]
+        assert sb["n_traces"] >= 256
+        assert len(sb["intensities"]) >= 4 and "off" in sb["intensities"]
+        for policy in ("rule", "flagship", "mpc"):
+            curve = sb["vs_calm_usd_per_slo_hour"][policy]
+            assert curve[0] == 1.0            # calm denominator
+            assert curve[-1] > curve[0]       # severe actually bites
+        # Pairing evidence on the record itself: one stream = one fault
+        # realization, so the policy-independent exposure counter is
+        # identical across every policy row of an intensity.
+        stales = {round(sb["severe"][p]["stale_ticks"], 4)
+                  for p in ("rule", "flagship", "mpc")}
+        assert len(stales) == 1
+        assert "bitwise" in r10["zero_fault_bitwise_gate"]
+        assert "fallback" in r10["degraded_mode_controller"]
+
+    def test_readme_robustness_claims(self, readme, baseline):
+        sb = (baseline["published"]["round10"]
+              ["fault_robustness_scoreboard"])
+        m = re.search(
+            r"rule\s+baseline\s+degrades\s+to\s+([\d.]+)×\s+its\s+calm"
+            r"\s+\$/SLO-hour\s+and\s+open-loop\s+MPC-playback\s+to\s+"
+            r"([\d.]+)×,\s+while\s+the\s+closed-loop\s+flagship\s+holds"
+            r"\s+([\d.]+)×", readme)
+        assert m, ("README's robustness claim no longer states the "
+                   "degradation ratios in the pinned form — update the "
+                   "claim AND this regex together")
+        rule_x, mpc_x, flag_x = map(float, m.groups())
+        sev = {p: sb["vs_calm_usd_per_slo_hour"][p][-1]
+               for p in ("rule", "flagship", "mpc")}
+        assert abs(rule_x - sev["rule"]) < 5e-3
+        assert abs(mpc_x - sev["mpc"]) < 5e-3
+        assert abs(flag_x - sev["flagship"]) < 5e-3
+        m2 = re.search(r"n≥(\d+)\s+kernel-paired\s+traces\s+\(BASELINE"
+                       r"\s+round10", readme)
+        assert m2 and int(m2.group(1)) <= sb["n_traces"]

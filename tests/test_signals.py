@@ -294,13 +294,20 @@ def test_spot_price_client_ttl_cache():
     clock[0] = 301.0
     c.latest_by_zone()
     assert len(calls) == 2          # TTL expiry refetches
-    # Failures cache too.
+    # Failures cache too — but on the SHORTER failure TTL: an empty
+    # result marks the tick stale (degraded-mode input), and holding a
+    # transient hiccup for the success TTL would pin rule-fallback for
+    # ~10 ticks after the CLI recovered.
     fails = []
     cf = SpotPriceClient("r", "t", runner=lambda a: (fails.append(1),
                                                      (1, "boom"))[1],
-                         cache_ttl_s=300.0, clock=lambda: clock[0])
+                         cache_ttl_s=300.0, failure_ttl_s=60.0,
+                         clock=lambda: clock[0])
     assert cf.latest_by_zone() == {} and cf.latest_by_zone() == {}
     assert len(fails) == 1
+    clock[0] += 61.0
+    assert cf.latest_by_zone() == {}
+    assert len(fails) == 2          # failure TTL expiry re-probes sooner
 
 
 def test_live_tick_uses_measured_spot_prices():

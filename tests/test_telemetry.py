@@ -207,6 +207,9 @@ class TestProfileTrace:
         with profile_trace(""):
             pass  # must not create anything or require jax
 
+    @pytest.mark.slow  # round 10 lane budget: ~21s of jax.profiler
+    # start/stop for pure upstream plumbing; the gated no-op contract
+    # (the ccka logic) stays fast-lane above.
     def test_captures_device_trace(self, tmp_path):
         import jax
         import jax.numpy as jnp
@@ -541,6 +544,48 @@ class TestPromExport:
         for line in body.splitlines():
             if line.startswith("ccka_"):
                 assert math.isfinite(float(line.rsplit(" ", 1)[1]))
+
+    def test_degraded_and_fault_gauges_in_series_and_panels(self):
+        """ISSUE 5 observability satellite: the degraded-mode state
+        machine and fault-event gauges stay exported, resolvable from a
+        TickReport, and on the dashboard — both parity directions, like
+        the tick-timing gauges above."""
+        import dataclasses
+
+        from ccka_tpu.harness.controller import TickReport
+        from ccka_tpu.harness.dashboard import _PANEL_DEFS
+        from ccka_tpu.harness.promexport import (SERIES,
+                                                 referenced_series,
+                                                 render_exposition,
+                                                 resolve_field)
+
+        gauges = {"ccka_degraded", "ccka_degraded_ticks_total",
+                  "ccka_signal_stale", "ccka_nodes_denied",
+                  "ccka_nodes_delayed"}
+        assert gauges <= set(SERIES)
+        paneled = set()
+        for _t, expr, _u in _PANEL_DEFS:
+            paneled |= referenced_series(expr)
+        # Every degraded/fault gauge except the delayed counter has its
+        # own panel; delayed rides the "Fault events" sum expression.
+        assert {"ccka_degraded", "ccka_degraded_ticks_total",
+                "ccka_signal_stale", "ccka_nodes_denied"} <= paneled
+
+        rec = dataclasses.asdict(TickReport(
+            t=3, is_peak=False, profile="degraded-fallback:offpeak",
+            applied=True, verified=True, fallbacks=0, cost_usd_hr=0.0,
+            carbon_g_hr=0.0, nodes_spot=0.0, nodes_od=0.0,
+            pending_pods=0.0, slo_ok=True, signal_stale=True,
+            degraded="fallback", degraded_level=2,
+            degraded_ticks_total=4, denied_nodes=1.5, delayed_nodes=0.5))
+        assert resolve_field(rec, SERIES["ccka_degraded"][0]) == 2
+        assert resolve_field(
+            rec, SERIES["ccka_degraded_ticks_total"][0]) == 4
+        text = render_exposition(rec)
+        assert "ccka_degraded 2" in text
+        assert "ccka_degraded_ticks_total 4" in text
+        assert "ccka_signal_stale 1" in text
+        assert "ccka_nodes_denied 1.5" in text
 
     def test_label_value_escaping(self):
         """ADVICE r3: a cluster name containing '"', '\\' or newline must
