@@ -52,14 +52,17 @@ TIMING_MODE = "forced_sync_best_of_n_roofline_gated"
 
 
 def bench_provenance(*, timing_mode: str = TIMING_MODE,
-                     mesh=None) -> dict:
+                     mesh=None, scenarios=None) -> dict:
     """The context a headline needs to be auditable (VERDICT r5 weak #3:
     perf levers shipped with no published, gated wall-clock number —
     and the records that did exist carried no device/version/timing
     provenance). Stamped on every BENCH record. ``mesh``: the
     `jax.sharding.Mesh` a multi-chip stage ran on — its shape and axis
     sizes make multi-chip records self-describing (ISSUE 3); without
-    one the field still records the visible device count."""
+    one the field still records the visible device count.
+    ``scenarios``: the named workload scenarios a stage swept
+    (`ccka_tpu/workloads`) — stamped so scenario records name their own
+    vocabulary."""
     import platform as _platform
 
     try:
@@ -77,7 +80,7 @@ def bench_provenance(*, timing_mode: str = TIMING_MODE,
     else:
         mesh_info = {"shape": None, "axis_names": None,
                      "n_devices": len(jax.devices())}
-    return {
+    out = {
         "device_kind": dev.device_kind,
         "platform": dev.platform,
         "n_devices": len(jax.devices()),
@@ -95,6 +98,9 @@ def bench_provenance(*, timing_mode: str = TIMING_MODE,
             "measured_bw_bytes_per_s": _HBM_BW_CACHE.get("bytes_per_s"),
         },
     }
+    if scenarios is not None:
+        out["scenarios"] = list(scenarios)
+    return out
 
 
 def _make_src(cfg):
@@ -1646,6 +1652,50 @@ def bench_faults(n_traces: int = 256, eval_steps: int | None = None,
     return board
 
 
+def bench_workloads(n_traces: int = 256, eval_steps: int | None = None,
+                    *, seed: int = 31,
+                    scenarios=("diurnal-inference", "flash-crowd",
+                               "batch-backfill", "mixed")) -> dict | None:
+    """Per-family scenario scoreboard (ISSUE 6): {rule, flagship,
+    MPC-playback} x >=4 named workload scenarios on n>=256 PAIRED
+    traces through the kernel path — aggregate $/SLO-hr next to
+    per-family inference SLO-violation and batch deadline-miss columns,
+    recorded into BASELINE.json round11. Runs on the multiregion preset
+    (the topology with a committed flagship checkpoint). On TPU:
+    stochastic Mosaic kernels over full days; off-TPU: deterministic
+    interpret-mode at CI horizons (labeled on the record — the
+    per-family column CONTRASTS are the result).
+
+    Each scenario row carries a roofline floor derived from its own
+    stream geometry (exo + fault + workload lane bytes) — the standard
+    any future timing of that row must clear (`_roofline_floor_s`)."""
+    from ccka_tpu.config import multi_region_config
+    from ccka_tpu.workloads.scoreboard import workload_scoreboard
+
+    board = workload_scoreboard(multi_region_config(), n_traces=n_traces,
+                                eval_steps=eval_steps, seed=seed,
+                                scenarios=scenarios)
+    board["config"] = "multiregion(flagship checkpoint committed)"
+    # Per-row roofline floors: bytes the kernel must stream per scenario
+    # = stream rows (incl. the fault/workload lane blocks) x 4 B x
+    # traces x ticks. Recorded next to each row so a published timing
+    # for that scenario can be audited against physics.
+    steps = board["eval_steps"]
+    plan_rows = board.get("mpc_planner", {}).get("plan_rows", 0)
+    for name, sec in board["scenarios"].items():
+        bytes_touched = (float(sec["stream_bytes_per_cluster_tick"])
+                         * board["n_traces"] * steps)
+        sec["roofline_floor_ms"] = round(
+            _roofline_floor_s(bytes_touched) * 1e3, 3)
+        if plan_rows and "mpc" in sec["rows"]:
+            # The playback row streams the per-cluster plan block ON
+            # TOP of the scenario stream — its floor counts both.
+            sec["roofline_floor_mpc_ms"] = round(_roofline_floor_s(
+                bytes_touched + 4.0 * plan_rows
+                * board["n_traces"] * steps) * 1e3, 3)
+    return board
+
+
 def _run_child(argv, timeout_s=1800, env=None) -> dict | None:
     """Run a bench child phase; relay its narration; parse its JSON."""
     try:
@@ -1737,6 +1787,11 @@ def main(argv=None) -> int:
                          "scoreboard (bench_faults) and print its JSON "
                          "— the BENCH_r10 record path; interpret-mode "
                          "deterministic off-TPU")
+    ap.add_argument("--workloads-only", action="store_true",
+                    help="run ONLY the per-family workload scenario "
+                         "scoreboard (bench_workloads) and print its "
+                         "JSON — the BENCH_r11 record path; "
+                         "interpret-mode deterministic off-TPU")
     ap.add_argument("--mega-phase", choices=("gate", "time"),
                     help="child phases of the isolated megakernel stage "
                          "(see _mega_subprocess): 'gate' prints the "
@@ -1780,6 +1835,15 @@ def main(argv=None) -> int:
             faults["provenance"] = bench_provenance()
         print(json.dumps(faults))
         return 0 if faults is not None else 1
+
+    if args.workloads_only:
+        with _TRACER.span("bench.workloads_stage"):
+            wl = bench_workloads()
+        if wl is not None:
+            wl["provenance"] = bench_provenance(
+                scenarios=list(wl["scenarios"]))
+        print(json.dumps(wl))
+        return 0 if wl is not None else 1
 
     if args.mega_phase == "gate":
         from ccka_tpu.config import default_config
@@ -1930,6 +1994,15 @@ def main(argv=None) -> int:
     except Exception as e:  # noqa: BLE001
         print(f"# faults stage failed (omitted): {e!r}", file=sys.stderr)
         faults = None
+    # Per-family workload scenario scoreboard (ISSUE 6): same guard.
+    try:
+        with _TRACER.span("bench.workloads_stage"):
+            workloads = (bench_workloads(n_traces=64, eval_steps=48)
+                         if args.quick else bench_workloads())
+    except Exception as e:  # noqa: BLE001
+        print(f"# workloads stage failed (omitted): {e!r}",
+              file=sys.stderr)
+        workloads = None
 
     rates = {k: v for k, v in rollout.items()
              if isinstance(v, dict) and "cluster_days_per_sec" in v}
@@ -1983,6 +2056,8 @@ def main(argv=None) -> int:
         line["quality_mega"] = quality_mega
     if faults is not None:
         line["faults"] = faults
+    if workloads is not None:
+        line["workloads"] = workloads
     # Provenance + the session's span trace: a headline without device/
     # version/timing context cannot be audited (VERDICT r5 weak #3).
     line["provenance"] = bench_provenance()

@@ -276,6 +276,37 @@ def _build_parser() -> argparse.ArgumentParser:
                           "day on TPU, CI-sized interpret off-TPU)")
     sch.add_argument("--seed", type=int, default=31)
 
+    sub.add_parser(
+        "scenarios", help="list the named workload scenario library "
+                          "(ccka_tpu/workloads): family mix, fault "
+                          "preset and arrival shapes per scenario — "
+                          "the vocabulary scenario-eval/bench_workloads "
+                          "sweep")
+
+    ssc = sub.add_parser(
+        "scenario-eval", help="per-family workload scoreboard "
+                              "(ccka_tpu/workloads): policies x named "
+                              "scenarios on paired kernel traces, with "
+                              "inference SLO-violation and batch "
+                              "deadline-miss columns next to the "
+                              "$/SLO-hr headline")
+    ssc.add_argument("--scenarios",
+                     default="diurnal-inference,flash-crowd,"
+                             "batch-backfill,mixed",
+                     help="comma list of workload scenario names "
+                          "(see `ccka scenarios`)")
+    ssc.add_argument("--policies", default="rule,flagship,mpc",
+                     help="comma list of rule,carbon,flagship,mpc "
+                          "(flagship rows need a committed checkpoint "
+                          "for the chosen preset's topology)")
+    ssc.add_argument("--traces", type=int, default=0,
+                     help="paired traces per scenario (0 = platform "
+                          "default: 256)")
+    ssc.add_argument("--steps", type=int, default=0,
+                     help="ticks per trace (0 = platform default: one "
+                          "day on TPU, CI-sized interpret off-TPU)")
+    ssc.add_argument("--seed", type=int, default=31)
+
     sg = sub.add_parser(
         "capture", help="record exogenous signals from the configured "
                         "source into a replayable .npz trace (the AMP "
@@ -470,7 +501,8 @@ def _cmd_observe(cfg: FrameworkConfig, backend_name: str,
     from ccka_tpu.signals.live import make_signal_source
 
     src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
-                             faults=cfg.faults)
+                             faults=cfg.faults,
+                             workloads=cfg.workloads)
     tick = src.tick(0)
     from ccka_tpu.sim.rollout import exo_steps
     exo = jax_tree_first(exo_steps(tick))
@@ -576,7 +608,8 @@ def _cmd_simulate(cfg: FrameworkConfig, backend: str, days: float,
     params = SimParams.from_config(cfg)
     steps = int(days * 86400.0 / cfg.sim.dt_s)
     src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
-                             faults=cfg.faults)
+                             faults=cfg.faults,
+                             workloads=cfg.workloads)
 
     if clusters == 1 and (mesh or device_traces):
         raise SystemExit("ccka: --mesh/--device-traces are batch-path "
@@ -697,7 +730,8 @@ def _cmd_forecast_eval(cfg: FrameworkConfig, args) -> int:
     else:
         from ccka_tpu.signals.live import make_signal_source
         src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim,
-                                 cfg.signals, faults=cfg.faults)
+                                 cfg.signals, faults=cfg.faults,
+                                 workloads=cfg.workloads)
         steps = args.steps or int(2 * 86400.0 / cfg.sim.dt_s)
         dt_s = cfg.sim.dt_s
     trace = src.trace(steps, seed=args.seed)
@@ -748,7 +782,8 @@ def _cmd_capture(cfg: FrameworkConfig, out: str, steps: int,
     from ccka_tpu.signals.replay import save_trace
 
     src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
-                             faults=cfg.faults)
+                             faults=cfg.faults,
+                             workloads=cfg.workloads)
     trace = src.trace(steps, seed=seed)
     save_trace(out, trace, src.meta())
     print(json.dumps({"out": out, "steps": steps,
@@ -765,7 +800,8 @@ def _cmd_train(cfg: FrameworkConfig, backend_name: str, iterations: int,
     from ccka_tpu.train.checkpoint import save_state
 
     src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
-                             faults=cfg.faults)
+                             faults=cfg.faults,
+                             workloads=cfg.workloads)
     rl = RunLog(runlog_path or None, kind=f"{backend_name}-train",
                 meta={"iterations": iterations, "seed": seed})
     if backend_name == "ppo":
@@ -815,7 +851,8 @@ def _cmd_evaluate(cfg: FrameworkConfig, backend_names: str, checkpoint: str,
     from ccka_tpu.train.evaluate import compare_backends, heldout_traces
 
     src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
-                             faults=cfg.faults)
+                             faults=cfg.faults,
+                             workloads=cfg.workloads)
     steps = max(int(days * 86400.0 / cfg.sim.dt_s), 1)
     traces = heldout_traces(src, steps=steps, n=n_traces,
                             seed0=10_000 + seed)
@@ -1072,6 +1109,48 @@ def main(argv: list[str] | None = None) -> int:
                     cfg,
                     intensities=tuple(
                         s.strip() for s in args.intensities.split(",")
+                        if s.strip()),
+                    policies=tuple(
+                        s.strip() for s in args.policies.split(",")
+                        if s.strip()),
+                    n_traces=args.traces or 256,
+                    eval_steps=args.steps or None,
+                    seed=args.seed)
+            except ValueError as e:
+                raise SystemExit(f"ccka: {e}")
+            print(json.dumps(board, indent=2))
+            return 0
+        if args.command == "scenarios":
+            from ccka_tpu.workloads.scenarios import WORKLOAD_SCENARIOS
+            listing = []
+            for name, sc in WORKLOAD_SCENARIOS.items():
+                wl = sc.workloads
+                listing.append({
+                    "name": name,
+                    "description": sc.description,
+                    "family_mix": sc.family_mix(),
+                    "fault_preset": sc.fault_preset or None,
+                    "inference": {
+                        "flash_frac": wl.inference_flash_frac,
+                        "flash_mult": wl.inference_flash_mult,
+                        "queue_max": wl.inference_queue_max,
+                        "slo_ms": wl.inference_slo_ms,
+                    },
+                    "batch": {
+                        "burst_frac": wl.batch_burst_frac,
+                        "burst_mult": wl.batch_burst_mult,
+                        "deadline_ticks": wl.batch_deadline_ticks,
+                    },
+                })
+            print(json.dumps({"scenarios": listing}, indent=2))
+            return 0
+        if args.command == "scenario-eval":
+            from ccka_tpu.workloads.scoreboard import workload_scoreboard
+            try:
+                board = workload_scoreboard(
+                    cfg,
+                    scenarios=tuple(
+                        s.strip() for s in args.scenarios.split(",")
                         if s.strip()),
                     policies=tuple(
                         s.strip() for s in args.policies.split(",")

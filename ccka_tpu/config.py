@@ -615,6 +615,91 @@ class FaultsConfig:
                 raise ConfigError(f"faults: {name} must be >= 1")
 
 
+@dataclass(frozen=True)
+class WorkloadsConfig:
+    """Heterogeneous workload families (`ccka_tpu/workloads`).
+
+    Before round 11 the simulator modeled ONE aggregate demand signal —
+    the burst Deployments' pod count — while the ROADMAP north-star
+    ("heavy traffic from millions of users") means clusters that mix
+    latency-sensitive inference serving with deadline-driven batch jobs.
+    This block adds 2–3 workload *families* as extra lanes in the packed
+    exo stream (`workloads/process.py`), consumed as per-family queue
+    state by `sim/dynamics.step` and all four megakernel modes:
+
+    - **inference**: diurnal request load with flash-crowd spikes,
+      served from the fleet's headroom with priority; queueing-curve
+      latency + per-tick SLO-violation accounting, drops beyond
+      ``inference_queue_max``.
+    - **batch**: deadline-driven backfill arriving in bursty waves
+      (anti-diurnal — backfill runs when the fleet is slack), drained
+      EDF from the headroom left after inference; work still unfinished
+      ``batch_deadline_ticks`` after arrival is a deadline miss.
+    - **background**: best-effort filler that consumes whatever
+      headroom remains; backlog only, no SLO.
+
+    ``enabled=False`` (the default) is a hard gate exactly like
+    `FaultsConfig`: generation emits the pre-workload stream (no lanes)
+    and every consumer takes the pre-workload code path — the
+    zero-workload bitwise contract `tests/test_workloads.py` pins.
+    All rates are in pod-equivalents of concurrent work per tick (one
+    pod serves one unit per tick); with every rate at 0 the emitted
+    lanes are EXACTLY 0, so an enabled-but-neutral stream consumes as a
+    bitwise-tight no-op (queues stay empty, counters stay zero).
+
+    Flash-crowd/burst windows reuse the fault subsystem's thresholded
+    stationary AR(1) family (`faults/process._window`): ``*_frac`` is
+    the stationary in-window fraction, ``*_mean_ticks`` the geometric
+    window length.
+    """
+
+    enabled: bool = False
+    # -- inference serving (KIS-S direction): diurnal concurrent load,
+    # multiplied by flash-crowd spikes while a crowd window is active.
+    inference_rate_pods: float = 0.0
+    inference_flash_frac: float = 0.0
+    inference_flash_mult: float = 4.0
+    inference_flash_mean_ticks: int = 12
+    # Queue cap (work units): arrivals beyond it are dropped (load-shed)
+    # and count as an SLO violation tick.
+    inference_queue_max: float = 64.0
+    # p95 bound on the inference queueing-curve latency proxy; a tick
+    # whose proxy exceeds it (or that drops work) is a violation tick.
+    inference_slo_ms: float = 120.0
+    # -- deadline-driven batch backfill (BatchBench direction).
+    batch_rate_pods: float = 0.0
+    batch_burst_frac: float = 0.0
+    batch_burst_mult: float = 6.0
+    batch_burst_mean_ticks: int = 20
+    # Ticks a batch work unit has (arrival tick included) to complete;
+    # unfinished work past it is a deadline miss (dropped, counted).
+    batch_deadline_ticks: int = 16
+    # -- best-effort background family.
+    background_rate_pods: float = 0.0
+
+    def validate(self) -> None:
+        for name in ("inference_rate_pods", "batch_rate_pods",
+                     "background_rate_pods"):
+            if getattr(self, name) < 0:
+                raise ConfigError(f"workloads: negative {name}")
+        for name in ("inference_flash_frac", "batch_burst_frac"):
+            if not 0.0 <= getattr(self, name) < 1.0:
+                raise ConfigError(f"workloads: {name} out of [0, 1)")
+        if self.inference_flash_mult < 1.0 or self.batch_burst_mult < 1.0:
+            raise ConfigError("workloads: spike multipliers must be >= 1 "
+                              "(1 = no spike)")
+        for name in ("inference_flash_mean_ticks", "batch_burst_mean_ticks",
+                     "batch_deadline_ticks"):
+            if getattr(self, name) < 1:
+                raise ConfigError(f"workloads: {name} must be >= 1")
+        if self.inference_queue_max <= 0:
+            raise ConfigError("workloads: inference_queue_max must be "
+                              "positive")
+        if self.inference_slo_ms <= 0:
+            raise ConfigError("workloads: inference_slo_ms must be "
+                              "positive")
+
+
 # The robustness scoreboard's named intensities (`bench.py bench_faults`,
 # `ccka chaos-eval`): the same storm/ICE/outage latent processes (same
 # key → same storm timing) at rising severities, so the degradation curve
@@ -674,6 +759,7 @@ class FrameworkConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
+    workloads: WorkloadsConfig = field(default_factory=WorkloadsConfig)
 
     def validate(self) -> "FrameworkConfig":
         self.cluster.validate()
@@ -683,6 +769,7 @@ class FrameworkConfig:
         self.train.validate()
         self.mesh.validate()
         self.faults.validate()
+        self.workloads.validate()
         # Cross-section: a live multi-region fleet must name each region's
         # grid zone — silently falling back to the global carbon_zone would
         # price one region's zones by another region's grid, flattening the
@@ -830,6 +917,7 @@ _NESTED_TYPES = {
     "train": TrainConfig,
     "mesh": MeshConfig,
     "faults": FaultsConfig,
+    "workloads": WorkloadsConfig,
 }
 
 

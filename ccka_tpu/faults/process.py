@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from ccka_tpu.config import FaultsConfig
 from ccka_tpu.faults.types import FaultStep
 from ccka_tpu.signals.synthetic import _ar1_device
+from ccka_tpu.sim import lanes
 
 # Key-domain tag separating the fault latents from the exo noise streams
 # (the generator splits its key 3 ways for spot/carbon/demand; fault
@@ -54,10 +55,10 @@ from ccka_tpu.signals.synthetic import _ar1_device
 FAULT_KEY_TAG = 0xFA117
 
 
-def fault_rows(Z: int) -> int:
-    """Rows of the fault lane block: hazard[Z] + deny + delay + stale,
-    padded to a sublane multiple (mirrors `sim.megakernel._exo_rows`)."""
-    return math.ceil((Z + 3) / 8) * 8
+# Layout arithmetic lives in the neutral `sim/lanes.py` (the one
+# layout module — faults and workloads both import it DOWNWARD);
+# re-exported here for the existing `faults.fault_rows` surface.
+fault_rows = lanes.fault_rows
 
 
 def _threshold(frac: float) -> float:
@@ -117,29 +118,21 @@ def packed_fault_lanes(faults: FaultsConfig, key, steps: int, t_pad: int,
     stale = _window(ko, (steps, batch), frac=faults.outage_frac,
                     mean_ticks=faults.outage_mean_ticks)
 
-    lanes = jnp.concatenate(
+    block = jnp.concatenate(
         [hazard, deny[:, None, :], delay[:, None, :], stale[:, None, :]],
         axis=1).astype(f32)                          # [T, Z+3, B]
-    return jnp.pad(lanes, ((0, t_pad - steps),
-                           (0, fault_rows(Z) - lanes.shape[1]), (0, 0)))
+    return jnp.pad(block, ((0, t_pad - steps),
+                           (0, fault_rows(Z) - block.shape[1]), (0, 0)))
 
 
 def has_fault_lanes(exo_packed, Z: int) -> bool:
     """Whether a packed stream carries the fault lane block — inferred
     from the row count, so every kernel entry point auto-detects widened
-    streams with zero API churn. Rejects any other row count outright
-    (a half-widened stream would silently misread lanes as padding)."""
-    from ccka_tpu.sim.megakernel import _exo_rows
-
-    rows = int(exo_packed.shape[1])
-    base, ext = _exo_rows(Z), _exo_rows(Z) + fault_rows(Z)
-    if rows == base:
-        return False
-    if rows == ext:
-        return True
-    raise ValueError(
-        f"packed stream has {rows} rows; this topology (Z={Z}) expects "
-        f"{base} (plain) or {ext} (with fault lanes)")
+    streams with zero API churn. Delegates to the one layout resolver
+    (`sim.lanes.stream_layout`), which rejects any unknown row count
+    outright (a half-widened stream would silently misread lanes as
+    padding)."""
+    return lanes.stream_layout(int(exo_packed.shape[1]), Z)[0]
 
 
 def unpack_fault_lanes(exo_packed, T: int, Z: int) -> FaultStep:
@@ -148,11 +141,9 @@ def unpack_fault_lanes(exo_packed, T: int, Z: int) -> FaultStep:
     — the parity-test/bench plumbing mirror of `megakernel.unpack_exo`
     (it pays the transpose the packed path exists to skip; hot paths
     never call it)."""
-    from ccka_tpu.sim.megakernel import _exo_rows
-
     if not has_fault_lanes(exo_packed, Z):
         raise ValueError("stream carries no fault lanes")
-    base = _exo_rows(Z)
+    base = lanes.exo_rows(Z)
     x = exo_packed[:T, base:]
     return FaultStep(
         preempt_hazard=jnp.transpose(x[:, 0:Z], (2, 0, 1)),   # [B, T, Z]

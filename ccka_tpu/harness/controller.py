@@ -93,6 +93,15 @@ class TickReport:
     # Fault-model estimate counters (0 outside fault-aware simulation).
     denied_nodes: float = 0.0
     delayed_nodes: float = 0.0
+    # Workload-family estimate gauges (ccka_tpu/workloads; 0 unless
+    # cfg.workloads is enabled): the per-family queue state of the
+    # model-based estimate, and session-cumulative violation/miss
+    # counters (kube-state-metrics style — each tick re-states the
+    # running total, like degraded_ticks_total).
+    inference_queue_depth: float = 0.0
+    batch_backlog: float = 0.0
+    inference_slo_violations_total: float = 0.0
+    batch_deadline_misses_total: float = 0.0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
@@ -162,6 +171,25 @@ class ControllerLock:
             fcntl.flock(self._fh, fcntl.LOCK_UN)
             self._fh.close()
             self._fh = None
+
+
+def _workload_clock_anchor(source: SignalSource, dt_s: float) -> float:
+    """Unix-seconds anchor for the workload-family arrival track: the
+    source's own clock when it carries one (synthetic/live expose
+    ``start_unix_s``; replay keeps its recorded clock in ``meta()`` and
+    replays from ``offset_steps`` into the store), wall clock otherwise.
+    A timestamp, not a timing measurement — kept in this host-only scope
+    so the diurnal phase anchor stays out of the device-touching
+    ``__init__`` the AST timing guard polices."""
+    start = getattr(source, "start_unix_s", None)
+    if start is None:
+        try:
+            m = source.meta()
+            start = (m.start_unix_s
+                     + getattr(source, "offset_steps", 0) * (m.dt_s or dt_s))
+        except Exception:
+            start = time.time()
+    return float(start)
 
 
 def _verify_pool(observed: dict, ps) -> bool:
@@ -302,6 +330,44 @@ class Controller:
             jax.jit(lambda s, a, e, k: sim_step(self.params, s, a, e, k,
                                                 stochastic=False)),
             "controller.step", hot=True)
+        # Workload-family track (ccka_tpu/workloads): when the config
+        # enables families, the state estimate also carries per-family
+        # queues fed by a deterministic arrival sample (seed-keyed, one
+        # horizon pre-sampled and tiled) — the live analog of the
+        # simulator's workload lanes, surfaced through promexport as
+        # ccka_inference_queue_depth / *_slo_violations_total /
+        # ccka_batch_deadline_misses_total.
+        self._wl_steps = None
+        wl_cfg = getattr(cfg, "workloads", None)
+        self.inference_slo_violations_total = 0.0
+        self.batch_deadline_misses_total = 0.0
+        if wl_cfg is not None and wl_cfg.enabled:
+            from ccka_tpu.workloads.process import (WORKLOAD_KEY_TAG,
+                                                    sample_workload_steps)
+            from ccka_tpu.workloads.types import WorkloadState
+            # Whole-day horizon (the `t % horizon` tile must wrap at a
+            # day boundary or the diurnal arrival process jumps mid-day)
+            # anchored to the source's clock: synthetic and live carry
+            # `.start_unix_s` directly (live = wall clock at source
+            # construction, so the 14:00 inference peak lands at real
+            # 14:00); replay keeps its recorded clock in `meta()` and
+            # replays from `offset_steps` into the store, so the track
+            # stays phased to the window the estimate actually sees.
+            day = max(1, int(round(86400.0 / cfg.sim.dt_s)))
+            self._wl_horizon = -(-max(int(cfg.sim.horizon_steps), day)
+                                 // day) * day
+            self._wl_steps = sample_workload_steps(
+                wl_cfg, jax.random.key(seed ^ WORKLOAD_KEY_TAG),
+                self._wl_horizon,
+                cfg.cluster.n_zones, dt_s=cfg.sim.dt_s,
+                start_unix_s=_workload_clock_anchor(source, cfg.sim.dt_s))
+            self._wl_state = WorkloadState.zero(
+                int(self.params.wl_batch_deadline_ticks))
+            self._step_wl = watch_jit(
+                jax.jit(lambda s, ws, a, e, w, k: sim_step(
+                    self.params, s, a, e, k, stochastic=False,
+                    workload=w, wl_state=ws)),
+                "controller.step_wl", hot=True)
         # MPC-style backends replan against a forecast window. The window
         # provider is the SAME protocol the jitted evaluation loop uses
         # (`forecast.Forecaster`): a backend carrying a forecaster plans
@@ -543,14 +609,28 @@ class Controller:
                 for region, patches in per_region.items()
                 for ps in patches)
 
-        # 6. advance the model-based state estimate (expectation dynamics).
+        # 6. advance the model-based state estimate (expectation dynamics;
+        #    with workload families enabled, the per-family queue track
+        #    advances in the same fused step).
         with timer.stage("estimate") as sp_est:
             self.key, sub = jax.random.split(self.key)
-            self.state, metrics = self._step(self.state, action, exo, sub)
+            if self._wl_steps is not None:
+                w = jax.tree.map(lambda x: x[t % self._wl_horizon],
+                                 self._wl_steps)
+                self.state, metrics, self._wl_state = self._step_wl(
+                    self.state, self._wl_state, action, exo, w, sub)
+            else:
+                self.state, metrics = self._step(self.state, action, exo,
+                                                 sub)
             # Fence on the step outputs: the report pulls these to host
             # floats below anyway, so the estimate stage must carry the
             # device time, not leak it into whatever blocks first.
             sp_est.fence((self.state, metrics))
+        if self._wl_steps is not None:
+            self.inference_slo_violations_total += float(
+                metrics.inf_slo_violation)
+            self.batch_deadline_misses_total += float(
+                metrics.batch_deadline_miss)
 
         # 7. measured app-level SLO metrics, when the source scrapes them
         #    (live Prometheus p95/RPS/queue depth; {} for sources without
@@ -609,6 +689,11 @@ class Controller:
             degraded_ticks_total=self.degraded_ticks_total,
             denied_nodes=float(metrics.denied_nodes),
             delayed_nodes=float(metrics.delayed_nodes),
+            inference_queue_depth=float(metrics.inf_queue_depth),
+            batch_backlog=float(metrics.batch_backlog),
+            inference_slo_violations_total=(
+                self.inference_slo_violations_total),
+            batch_deadline_misses_total=self.batch_deadline_misses_total,
         )
         self.log_fn(report.to_json())
         if self.telemetry is not None:
@@ -675,7 +760,8 @@ def controller_from_config(cfg: FrameworkConfig, backend: PolicyBackend,
     from ccka_tpu.signals.live import make_signal_source
 
     source = make_signal_source(cfg.cluster, cfg.workload, cfg.sim,
-                                cfg.signals, faults=cfg.faults)
+                                cfg.signals, faults=cfg.faults,
+                                workloads=cfg.workloads)
 
     # Spot interruption feed: configured queue URL enables it (live AWS
     # CLI transport by default; tests inject interruption_runner).

@@ -58,6 +58,14 @@ _PANEL_DEFS = (
     ("Degraded ticks (session)", "ccka_degraded_ticks_total", "short"),
     ("Fault events", "ccka_nodes_denied + ccka_nodes_delayed + "
      "ccka_nodes_drained", "short"),
+    # Workload-family panels (ccka_tpu/workloads): per-family queue
+    # pressure and the session's SLO accounting, on the same board as
+    # the fleet cost/SLO panels the families trade against.
+    ("Inference queue", "ccka_inference_queue_depth", "short"),
+    ("Inference SLO violations (session)",
+     "ccka_inference_slo_violations_total", "short"),
+    ("Batch deadline misses (session)",
+     "ccka_batch_deadline_misses_total", "short"),
 )
 
 

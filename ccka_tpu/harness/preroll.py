@@ -84,7 +84,8 @@ def check_signals(cfg: FrameworkConfig) -> PrerollCheck:
 
         from ccka_tpu.signals.live import make_signal_source
         src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim,
-                                 cfg.signals, faults=cfg.faults)
+                                 cfg.signals, faults=cfg.faults,
+                                 workloads=cfg.workloads)
         tick = src.tick(0)
         arr = np.asarray(tick.carbon_g_kwh)
         if not np.all(np.isfinite(arr)) or np.any(arr <= 0):

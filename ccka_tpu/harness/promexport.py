@@ -77,6 +77,19 @@ SERIES: dict[str, tuple[str, str]] = {
     "ccka_nodes_delayed": (
         "delayed_nodes",
         "Provisioning arrivals held back this tick (fault model), nodes"),
+    # Workload-family series (ccka_tpu/workloads): the per-family queue
+    # estimate and session-cumulative SLO accounting, next to the fleet
+    # KPIs they trade against. The _total counters re-state the running
+    # total each tick (kube-state-metrics style).
+    "ccka_inference_queue_depth": (
+        "inference_queue_depth",
+        "Inference work queued after this tick (pod-equivalents)"),
+    "ccka_inference_slo_violations_total": (
+        "inference_slo_violations_total",
+        "Cumulative inference SLO-violation ticks this session"),
+    "ccka_batch_deadline_misses_total": (
+        "batch_deadline_misses_total",
+        "Cumulative batch work missing its deadline this session"),
     "ccka_applied": ("applied", "1 if every patch applied this tick"),
     "ccka_verified": ("verified", "1 if read-back matched intent"),
     "ccka_tick": ("t", "Controller tick counter"),

@@ -171,8 +171,8 @@ def sharded_packed_trace(mesh: Mesh, source, steps: int, key, batch: int,
 
 
 @functools.lru_cache(maxsize=64)
-def _packed_call(mesh: Mesh, T, P, Z, K, stochastic, b_block, t_chunk,
-                 interpret, carbon, blocks_per_shard, donate):
+def _packed_call(mesh: Mesh, T, P, Z, K, WD, stochastic, b_block,
+                 t_chunk, interpret, carbon, blocks_per_shard, donate):
     data = mesh.axis_names[0]
     stream_spec = PartitionSpec(None, None, data)
 
@@ -180,7 +180,7 @@ def _packed_call(mesh: Mesh, T, P, Z, K, stochastic, b_block, t_chunk,
         local = shard_seed(seed, jax.lax.axis_index(data),
                            blocks_per_shard)
         s = _fused_packed_summary(
-            params, off_a, peak_a, exo, local, T=T, P=P, Z=Z, K=K,
+            params, off_a, peak_a, exo, local, T=T, P=P, Z=Z, K=K, WD=WD,
             stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
             interpret=interpret, carbon=carbon)
         return (s, exo) if donate else s
@@ -229,6 +229,7 @@ def sharded_megakernel_summary_from_packed(mesh: Mesh,
     Z = int(off_action.zone_weight.shape[1])
     has_fault_lanes(exo_packed, Z)  # raises on a malformed row layout
     fn = _packed_call(mesh, T, P, Z, int(params.provision_pipeline_k),
+                      int(params.wl_batch_deadline_ticks),
                       stochastic, b_block, t_chunk, interpret, carbon,
                       b_loc // b_block, donate_stream)
     return fn(params, off_action, peak_action, exo_packed,
@@ -259,8 +260,8 @@ def sharded_carbon_summary_from_packed(mesh: Mesh, params: SimParams,
 
 
 @functools.lru_cache(maxsize=64)
-def _neural_packed_call(mesh: Mesh, T, P, Z, K, stochastic, b_block,
-                        t_chunk, interpret, slo_mask, mlp_dims,
+def _neural_packed_call(mesh: Mesh, T, P, Z, K, WD, stochastic,
+                        b_block, t_chunk, interpret, slo_mask, mlp_dims,
                         blocks_per_shard, donate):
     data = mesh.axis_names[0]
     stream_spec = PartitionSpec(None, None, data)
@@ -269,7 +270,7 @@ def _neural_packed_call(mesh: Mesh, T, P, Z, K, stochastic, b_block,
         local = shard_seed(seed, jax.lax.axis_index(data),
                            blocks_per_shard)
         s = _fused_neural_packed_summary(
-            params, net_params, exo, local, T=T, P=P, Z=Z, K=K,
+            params, net_params, exo, local, T=T, P=P, Z=Z, K=K, WD=WD,
             stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
             slo_mask=slo_mask, mlp_dims=mlp_dims, interpret=interpret)
         # Donation lives on the OUTER jit; the identity returns are what
@@ -326,7 +327,8 @@ def sharded_neural_summary_from_packed(mesh: Mesh, params: SimParams,
                                   net_params)
     slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
     fn = _neural_packed_call(
-        mesh, T, P, Z, int(params.provision_pipeline_k), stochastic,
+        mesh, T, P, Z, int(params.provision_pipeline_k),
+        int(params.wl_batch_deadline_ticks), stochastic,
         b_block, t_chunk, interpret, slo, dims, b_loc // b_block,
         donate_stream)
     out = fn(params, net_params, exo_packed, jnp.int32(seed))
@@ -354,8 +356,9 @@ def shard_plan_stream(mesh: Mesh, plan_packed: jnp.ndarray):
 
 
 @functools.lru_cache(maxsize=64)
-def _plan_call(mesh: Mesh, T, P, Z, K, stochastic, b_block, t_chunk,
-               interpret, plan_batched, blocks_per_shard, donate):
+def _plan_call(mesh: Mesh, T, P, Z, K, WD, stochastic, b_block,
+               t_chunk, interpret, plan_batched, blocks_per_shard,
+               donate):
     data = mesh.axis_names[0]
     stream_spec = PartitionSpec(None, None, data)
     # A broadcast plan replicates; per-cluster plans split on the SAME
@@ -367,7 +370,7 @@ def _plan_call(mesh: Mesh, T, P, Z, K, stochastic, b_block, t_chunk,
         local = shard_seed(seed, jax.lax.axis_index(data),
                            blocks_per_shard)
         s = _fused_plan_packed_summary(
-            params, plan, exo, local, T=T, P=P, Z=Z, K=K,
+            params, plan, exo, local, T=T, P=P, Z=Z, K=K, WD=WD,
             stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
             interpret=interpret, plan_batched=plan_batched)
         return (s, exo) if donate else s
@@ -413,6 +416,7 @@ def sharded_plan_summary_from_packed(mesh: Mesh, params: SimParams,
     has_fault_lanes(exo_packed, Z)  # raises on a malformed row layout
     plan_batched = _check_plan(plan_packed, exo_packed, P, Z)
     fn = _plan_call(mesh, T, P, Z, int(params.provision_pipeline_k),
+                    int(params.wl_batch_deadline_ticks),
                     stochastic, b_block, t_chunk, interpret, plan_batched,
                     b_loc // b_block, donate_stream)
     return fn(params, plan_packed, exo_packed, jnp.int32(seed))
@@ -422,8 +426,8 @@ def sharded_plan_summary_from_packed(mesh: Mesh, params: SimParams,
 
 
 @functools.lru_cache(maxsize=64)
-def _profile_call(mesh: Mesh, T, P, Z, K, stochastic, b_block, t_chunk,
-                  interpret, carbon, blocks_per_shard):
+def _profile_call(mesh: Mesh, T, P, Z, K, WD, stochastic, b_block,
+                  t_chunk, interpret, carbon, blocks_per_shard):
     data = mesh.axis_names[0]
 
     def body(params, off_a, peak_a, traces, seed):
@@ -431,8 +435,8 @@ def _profile_call(mesh: Mesh, T, P, Z, K, stochastic, b_block, t_chunk,
                            blocks_per_shard)
         return _fused_profile_summary(
             params, off_a, peak_a, traces, local, T=T, P=P, Z=Z, K=K,
-            stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
-            interpret=interpret, carbon=carbon)
+            WD=WD, stochastic=stochastic, b_block=b_block,
+            t_chunk=t_chunk, interpret=interpret, carbon=carbon)
 
     fn = shard_map(body, mesh=mesh,
                    in_specs=(PartitionSpec(), PartitionSpec(),
@@ -465,6 +469,7 @@ def sharded_megakernel_rollout_summary(mesh: Mesh, params: SimParams,
     P = int(off_action.zone_weight.shape[0])
     Z = int(off_action.zone_weight.shape[1])
     fn = _profile_call(mesh, T, P, Z, int(params.provision_pipeline_k),
+                       int(params.wl_batch_deadline_ticks),
                        stochastic, b_block, t_chunk, interpret, carbon,
                        b_loc // b_block)
     return fn(params, off_action, peak_action, traces, jnp.int32(seed))

@@ -805,29 +805,31 @@ def make_signal_source(cluster: ClusterConfig, workload: WorkloadConfig,
                        sim: SimConfig, signals: SignalsConfig,
                        *, fetch: Fetch | None = None,
                        replay_path: str | None = None,
-                       faults=None) -> SignalSource:
+                       faults=None, workloads=None) -> SignalSource:
     """Factory keyed on ``signals.backend``.
 
     ``replay_path`` defaults to ``signals.replay_path``, so the replay
     backend is reachable purely through config/CCKA_* env overrides.
 
-    ``faults`` (a ``config.FaultsConfig``) reaches the synthetic and
-    replay backends, whose packed streams synthesize the disturbance
-    lanes; the live backend ignores it — the live world supplies its
-    own faults, and the degraded-mode machinery reacts to the REAL
-    staleness flag instead.
+    ``faults`` (a ``config.FaultsConfig``) and ``workloads`` (a
+    ``config.WorkloadsConfig``) reach the synthetic and replay
+    backends, whose packed streams synthesize the disturbance/
+    family-arrival lanes; the live backend ignores both — the live
+    world supplies its own faults and its own tenant mix, and the
+    degraded-mode machinery reacts to the REAL staleness flag instead.
     """
     from ccka_tpu.config import ConfigError
     if signals.backend == "synthetic":
         return SyntheticSignalSource(cluster, workload, sim, signals,
-                                     faults=faults)
+                                     faults=faults, workloads=workloads)
     if signals.backend == "replay":
         from ccka_tpu.signals.replay import ReplaySignalSource
         path = replay_path or signals.replay_path
         if not path:
             raise ConfigError("signals: replay backend requires replay_path")
         try:
-            return ReplaySignalSource.from_file(path, faults=faults)
+            return ReplaySignalSource.from_file(path, faults=faults,
+                                                workloads=workloads)
         except (OSError, KeyError, ValueError) as e:
             raise ConfigError(f"signals: cannot load replay trace "
                               f"{path!r}: {e}") from e

@@ -64,7 +64,7 @@ class ReplaySignalSource(SignalSource):
     """
 
     def __init__(self, trace: ExogenousTrace, meta: TraceMeta,
-                 *, offset_steps: int = 0, faults=None):
+                 *, offset_steps: int = 0, faults=None, workloads=None):
         trace.validate_shapes()
         self._trace = trace
         self._meta = meta
@@ -77,12 +77,20 @@ class ReplaySignalSource(SignalSource):
         # same faults, the pairing contract of the synthetic backend.
         self.faults = faults if (faults is not None
                                  and faults.enabled) else None
+        # Workload families (`config.WorkloadsConfig`): same treatment —
+        # the stored trace records only the primary demand, so family
+        # arrivals are synthesized on top of the sampled windows,
+        # appended after the fault block and keyed by the same
+        # window-sampling key.
+        self.workloads = workloads if (workloads is not None
+                                       and workloads.enabled) else None
 
     @classmethod
     def from_file(cls, path: str, *, offset_steps: int = 0,
-                  faults=None) -> "ReplaySignalSource":
+                  faults=None, workloads=None) -> "ReplaySignalSource":
         trace, meta = load_trace(path)
-        return cls(trace, meta, offset_steps=offset_steps, faults=faults)
+        return cls(trace, meta, offset_steps=offset_steps, faults=faults,
+                   workloads=workloads)
 
     def meta(self) -> TraceMeta:
         return self._meta
@@ -142,6 +150,21 @@ class ReplaySignalSource(SignalSource):
                    for s in seeds]
         return jax.tree.map(lambda *xs: jnp.stack(xs), *windows)
 
+    def _window_offsets(self, key, n: int):
+        """The ONE per-window offset draw (traceable, [n] int32).
+
+        Both `batch_trace_device` (the exo windows) and the packed
+        workload-lane path (which phases each window's diurnal family
+        shapes to the demand it replays) MUST consume these same draws
+        from the same key — the lanes' phase alignment holds only
+        because this is the single place the offsets are sampled.
+        """
+        import jax
+
+        stored = self._trace.steps
+        return (self.offset_steps
+                + jax.random.randint(key, (n,), 0, stored)) % stored
+
     def batch_trace_device(self, steps: int, key, n: int,
                            *, sharding=None) -> ExogenousTrace:
         """[n, T, ...] window batch sampled ON DEVICE: offsets uniform
@@ -174,8 +197,7 @@ class ReplaySignalSource(SignalSource):
                 jnp.asarray, self._trace_at(0, stored + steps))
             self._ext_steps = steps
         ext = self._ext_dev
-        offs = (self.offset_steps
-                + jax.random.randint(key, (n,), 0, stored)) % stored
+        offs = self._window_offsets(key, n)
 
         def window(o):
             def sl(a):
@@ -213,22 +235,45 @@ class ReplaySignalSource(SignalSource):
             import jax.numpy as jnp
 
             faults = self.faults
+            workloads = self.workloads
             Z = self._trace.n_zones
+            dt_s = self._meta.dt_s or 30.0
+            start_s = self._meta.start_unix_s
 
             def pack(tr, k):
                 packed = _pack_exo(tr, t_pad)
-                if faults is None:
+                if faults is None and workloads is None:
                     return packed
-                # Fault lanes on replayed windows (see __init__): the
-                # stored trace is calm weather, so disturbances are
-                # synthesized here — appended after the padded exo
-                # block like the synthetic backend's, keyed by the same
-                # window-sampling key. No price_dev: the stored spot
-                # series carries no separable anomaly channel, so the
-                # price-correlated hazard term is synthetic-only.
-                from ccka_tpu.faults.process import packed_fault_lanes
-                lanes = packed_fault_lanes(faults, k, steps, t_pad, Z, n)
-                return jnp.concatenate([packed, lanes], axis=1)
+                parts = [packed]
+                if faults is not None:
+                    # Fault lanes on replayed windows (see __init__):
+                    # the stored trace is calm weather, so disturbances
+                    # are synthesized here — appended after the padded
+                    # exo block like the synthetic backend's, keyed by
+                    # the same window-sampling key. No price_dev: the
+                    # stored spot series carries no separable anomaly
+                    # channel, so the price-correlated hazard term is
+                    # synthetic-only.
+                    from ccka_tpu.faults.process import packed_fault_lanes
+                    parts.append(packed_fault_lanes(faults, k, steps,
+                                                    t_pad, Z, n))
+                if workloads is not None:
+                    # Workload lanes on replayed windows: appended LAST
+                    # like the synthetic backend's, same key. Each
+                    # window replays from its own offset into the store
+                    # (`_window_offsets` — the shared draw
+                    # `batch_trace_device` consumes from this same key)
+                    # so the diurnal/anti-diurnal family shapes are
+                    # phased per window to the demand it actually sees.
+                    from ccka_tpu.workloads.process import (
+                        packed_workload_lanes)
+                    offs = self._window_offsets(k, n)
+                    parts.append(packed_workload_lanes(
+                        workloads, k, steps, t_pad, Z, n, dt_s=dt_s,
+                        start_unix_s=start_s,
+                        start_offset_s=offs.astype(jnp.float32) * dt_s,
+                        wrap_period_s=self._trace.steps * dt_s))
+                return jnp.concatenate(parts, axis=1)
 
             if recycled:
                 fn = jax.jit(lambda tr, k, buf: pack(tr, k),

@@ -46,7 +46,8 @@ class SyntheticSignalSource(SignalSource):
                  signals: SignalsConfig,
                  *,
                  start_unix_s: float = 0.0,
-                 faults=None):
+                 faults=None,
+                 workloads=None):
         self.cluster = cluster
         self.workload = workload
         self.sim = sim
@@ -59,6 +60,14 @@ class SyntheticSignalSource(SignalSource):
         # None/disabled emits the exact pre-fault stream (no lanes).
         self.faults = faults if (faults is not None
                                  and faults.enabled) else None
+        # Workload families (`config.WorkloadsConfig`): when enabled,
+        # the PACKED stream additionally grows the family-arrival lane
+        # block (`workloads/process.py`), appended AFTER the fault block
+        # and keyed by its own tag off the same generation key — exo
+        # AND fault rows stay bitwise identical to a no-workloads
+        # source. None/disabled emits the exact pre-workload stream.
+        self.workloads = workloads if (workloads is not None
+                                       and workloads.enabled) else None
         self.start_unix_s = start_unix_s
         self._zp = self._zone_params()
         # Longest trace generated so far, per seed. Generation is
@@ -234,6 +243,8 @@ class SyntheticSignalSource(SignalSource):
         z = self.cluster.n_zones
         t_pad = _math.ceil(steps / t_chunk) * t_chunk
         faults = self.faults
+        workloads = self.workloads
+        dt_s, start_s = self.sim.dt_s, self.start_unix_s
 
         def generate(k):
             ks, kc, kd = jax.random.split(k, 3)
@@ -246,20 +257,34 @@ class SyntheticSignalSource(SignalSource):
                             axis=0),
             )
             packed = self._assemble_packed(steps, t_pad, noise)
-            if faults is None:
+            if faults is None and workloads is None:
                 return packed
-            # Fault lanes (ccka_tpu/faults): appended AFTER the padded
-            # exo block so existing row offsets are untouched; keyed by
-            # fold_in(k, FAULT_KEY_TAG) so the exo streams' own draws —
-            # and therefore the exo rows — stay bitwise identical to a
-            # no-faults source on the same key. The spot AR(1) anomaly
-            # feeds the optional price-correlated hazard.
             import jax.numpy as _jnp
 
-            from ccka_tpu.faults.process import packed_fault_lanes
-            lanes = packed_fault_lanes(faults, k, steps, t_pad, z, batch,
-                                       price_dev=noise[0])
-            return _jnp.concatenate([packed, lanes], axis=1)
+            parts = [packed]
+            if faults is not None:
+                # Fault lanes (ccka_tpu/faults): appended AFTER the
+                # padded exo block so existing row offsets are
+                # untouched; keyed by fold_in(k, FAULT_KEY_TAG) so the
+                # exo streams' own draws — and therefore the exo rows —
+                # stay bitwise identical to a no-faults source on the
+                # same key. The spot AR(1) anomaly feeds the optional
+                # price-correlated hazard.
+                from ccka_tpu.faults.process import packed_fault_lanes
+                parts.append(packed_fault_lanes(faults, k, steps, t_pad,
+                                                z, batch,
+                                                price_dev=noise[0]))
+            if workloads is not None:
+                # Workload lanes (ccka_tpu/workloads): appended LAST,
+                # keyed by fold_in(k, WORKLOAD_KEY_TAG) — widening a
+                # stream with families changes neither the exo nor the
+                # fault rows bitwise.
+                from ccka_tpu.workloads.process import (
+                    packed_workload_lanes)
+                parts.append(packed_workload_lanes(
+                    workloads, k, steps, t_pad, z, batch,
+                    dt_s=dt_s, start_unix_s=start_s))
+            return _jnp.concatenate(parts, axis=1)
 
         return generate
 

@@ -79,7 +79,9 @@ def step(params: SimParams,
          key: jax.Array,
          *,
          stochastic: bool = False,
-         fault=None) -> tuple[ClusterState, StepMetrics]:
+         fault=None,
+         workload=None,
+         wl_state=None):
     """``fault``: optional :class:`ccka_tpu.faults.FaultStep` disturbance
     inputs (preemption-hazard multiplier, ICE denial, delay jitter,
     outage flag). ``None`` — the default everywhere outside the fault
@@ -88,7 +90,26 @@ def step(params: SimParams,
     a neutral FaultStep is bitwise identical too). Signal staleness is
     an *observation* effect: callers (rollout/controller) feed policies
     held signals; this step always consumes true ``exo``.
+
+    ``workload``/``wl_state``: optional
+    :class:`ccka_tpu.workloads.WorkloadStep` arrivals +
+    :class:`~ccka_tpu.workloads.WorkloadState` queues (pass both or
+    neither). When given, the per-family queues drain from the
+    post-step fleet's headroom — inference first (queueing-curve
+    latency + SLO-violation accounting, drops beyond the queue cap),
+    then batch EDF over a deadline-deep age pipeline (work aging past
+    ``wl_batch_deadline_ticks`` is a deadline miss), then best-effort
+    background — and the step RETURNS A TRIPLE ``(state, metrics,
+    wl_state')``. ``None`` (the default) takes the exact pre-workload
+    path and the classic ``(state, metrics)`` pair (Python-level
+    branch, bitwise — pinned by `tests/test_workloads.py`). The
+    families consume only slack: the primary demand's scheduling,
+    pricing and SLO accounting are untouched, so policies differ on the
+    per-family columns exactly through the headroom their fleets carry.
     """
+    if (workload is None) != (wl_state is None):
+        raise ValueError("step: pass both workload= and wl_state=, or "
+                         "neither")
     ppn = params.pods_per_node
     dt_hr = params.dt_s / 3600.0
 
@@ -263,6 +284,72 @@ def step(params: SimParams,
     # target — otherwise a policy could "meet" SLO by zeroing its own target
     # (hpa_scale=0) or by overserving one class while starving the other.
     # With a configured p95 bound, the latency gate must hold too.
+    # ---- 7b. Workload families (ccka_tpu/workloads): per-family queues
+    # drained from the post-step fleet's HEADROOM (capacity incl. the
+    # base nodegroup minus the primary demand's running pods), priority
+    # inference -> batch EDF -> background. Python-level branch: the
+    # None path is the exact pre-workload program.
+    if workload is not None:
+        cap_total = nodes_zc.sum() * ppn
+        headroom = jnp.maximum(cap_total - running.sum(), 0.0)
+        # Inference: served first; queue bounded (excess = load-shed).
+        inf_in = wl_state.inf_queue + workload.inf_arrivals
+        inf_served = jnp.minimum(inf_in, headroom)
+        inf_after = inf_in - inf_served
+        inf_dropped = jnp.maximum(
+            inf_after - params.wl_inference_queue_max, 0.0)
+        inf_queue2 = inf_after - inf_dropped
+        rem = headroom - inf_served
+        inf_rho = jnp.clip(inf_in / (headroom + _EPS),
+                           0.0, LATENCY_RHO_CLIP)
+        inf_latency = params.latency_base_ms * (
+            1.0 + LATENCY_CURVE_COEF * inf_rho * inf_rho / (1.0 - inf_rho))
+        inf_viol = jnp.maximum(
+            (inf_latency > params.wl_inference_slo_ms).astype(jnp.float32),
+            (inf_dropped > 0.0).astype(jnp.float32))
+        # Batch: EDF over the age pipeline. pool[k] = work that has
+        # waited k ticks (k=0 arrived now); the state's slot D-1 is 0 by
+        # invariant (it was dropped as missed last tick), so the shift
+        # discards nothing.
+        w_prev = wl_state.batch_backlog                   # [D]
+        D = w_prev.shape[0]
+        pool = jnp.concatenate(
+            [jnp.reshape(workload.batch_arrivals, (1,)), w_prev[:D - 1]])
+        leftover = []
+        batch_served = jnp.float32(0.0)
+        for k in range(D - 1, -1, -1):                    # oldest first
+            take = jnp.minimum(pool[k], rem)
+            rem = rem - take
+            batch_served = batch_served + take
+            leftover.append(pool[k] - take)
+        leftover = jnp.stack(leftover[::-1])              # [D], age order
+        batch_missed = leftover[D - 1]
+        batch_backlog2 = jnp.concatenate(
+            [leftover[:D - 1], jnp.zeros((1,), jnp.float32)])
+        # Background: best-effort, whatever headroom remains.
+        bg_in = wl_state.bg_backlog + workload.bg_arrivals
+        bg_served = jnp.minimum(bg_in, rem)
+        bg_backlog2 = bg_in - bg_served
+        wl_state2 = wl_state._replace(inf_queue=inf_queue2,
+                                      batch_backlog=batch_backlog2,
+                                      bg_backlog=bg_backlog2)
+        wl_metrics = dict(
+            inf_queue_depth=inf_queue2,
+            inf_served=inf_served,
+            inf_dropped=inf_dropped,
+            inf_slo_violation=inf_viol,
+            batch_backlog=batch_backlog2.sum(),
+            batch_served=batch_served,
+            batch_deadline_miss=batch_missed,
+            bg_backlog=bg_backlog2,
+        )
+    else:
+        zero = jnp.float32(0.0)
+        wl_metrics = dict(
+            inf_queue_depth=zero, inf_served=zero, inf_dropped=zero,
+            inf_slo_violation=zero, batch_backlog=zero, batch_served=zero,
+            batch_deadline_miss=zero, bg_backlog=zero)
+
     met_c = running >= params.slo_served_fraction * exo.demand_pods - _EPS
     latency_ok = jnp.where(
         params.latency_slo_ms > 0,
@@ -301,5 +388,8 @@ def step(params: SimParams,
                        else jnp.float32(0.0)),
         signal_stale=(fault.signal_stale if fault is not None
                       else jnp.float32(0.0)),
+        **wl_metrics,
     )
+    if workload is not None:
+        return new_state, metrics, wl_state2
     return new_state, metrics

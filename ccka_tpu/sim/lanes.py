@@ -1,0 +1,80 @@
+"""Packed exo-stream lane-layout arithmetic — the ONE layout module.
+
+Every consumer of the packed ``[T_pad, rows, B]`` exo stream (the
+megakernel's entry points, the fault and workload lane synthesizers,
+the sharded wrappers, bench's roofline byte counts) keys off the same
+row arithmetic: the base exo block, the optional fault block appended
+after it, and the optional workload block appended after that. This
+module is the neutral home for that arithmetic so the subsystems import
+it DOWNWARD — `faults/` and `workloads/` both depend on it, never on
+each other (earlier drafts had `faults.has_fault_lanes` reach up into
+`workloads.process` for the resolver and everyone lazy-importing
+`megakernel._exo_rows`, inverting or tangling the layering). It imports
+nothing but the stdlib, so it can never join a cycle.
+
+Block sizes (all padded to the f32 sublane multiple of 8):
+
+    exo_rows(Z)       3Z+3 signal rows (ARCHITECTURE §6)
+    fault_rows(Z)     hazard[Z] + deny + delay + stale   (§12)
+    workload_rows(Z)  3 family-arrival rows, sized fault_rows(Z)+8 so
+                      the four layouts below stay mutually
+                      distinguishable for ANY zone count (§13)
+
+Layout detection is purely row-count-based (`stream_layout`): a stream
+has exactly ``exo_rows(Z)`` rows (plain), ``+fault_rows`` (+faults),
+``+workload_rows`` (+workloads) or ``+both`` — anything else is
+rejected outright, because a half-widened stream would silently misread
+lanes as padding. ROADMAP item 5's unified rollout-engine refactor
+grows this module into the full packed-stream layout registry.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def exo_rows(Z: int) -> int:
+    """Rows of the base exo-signal block: spot[Z] + od[Z] + carbon[Z] +
+    demand + is_peak + pad, padded to a sublane multiple."""
+    return math.ceil((3 * Z + 3) / 8) * 8
+
+
+def fault_rows(Z: int) -> int:
+    """Rows of the fault lane block: hazard[Z] + deny + delay + stale,
+    padded to a sublane multiple (mirrors :func:`exo_rows`)."""
+    return math.ceil((Z + 3) / 8) * 8
+
+
+def workload_rows(Z: int) -> int:
+    """Rows of the workload lane block. Sized ``fault_rows(Z) + 8`` (not
+    the minimal sublane multiple) so row-count layout detection stays
+    unambiguous — see the module docstring."""
+    return fault_rows(Z) + 8
+
+
+def stream_layout(rows: int, Z: int) -> tuple[bool, bool]:
+    """``(has_faults, has_workloads)`` of a packed stream, inferred from
+    its row count — the zero-API-churn detection every kernel entry
+    point uses. Rejects any other row count outright (a half-widened
+    stream would silently misread lanes as padding)."""
+    base, f, w = exo_rows(Z), fault_rows(Z), workload_rows(Z)
+    layouts = {base: (False, False),
+               base + f: (True, False),
+               base + w: (False, True),
+               base + f + w: (True, True)}
+    got = layouts.get(int(rows))
+    if got is None:
+        raise ValueError(
+            f"packed stream has {rows} rows; this topology (Z={Z}) "
+            f"expects {base} (plain), {base + f} (+faults), {base + w} "
+            f"(+workloads) or {base + f + w} (+both)")
+    return got
+
+
+def workload_base(rows: int, Z: int) -> int:
+    """Row offset of the workload block inside a widened stream (after
+    the fault block when one is present)."""
+    has_faults, has_wl = stream_layout(rows, Z)
+    if not has_wl:
+        raise ValueError("stream carries no workload lanes")
+    return exo_rows(Z) + (fault_rows(Z) if has_faults else 0)

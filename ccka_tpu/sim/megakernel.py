@@ -81,6 +81,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ccka_tpu.config import LATENCY_CURVE_COEF, LATENCY_RHO_CLIP
+from ccka_tpu.sim import lanes
 from ccka_tpu.sim.types import Action, ClusterState, SimParams
 from ccka_tpu.signals.base import ExogenousTrace
 
@@ -111,12 +112,19 @@ from ccka_tpu.models.nets import (  # noqa: E402
 # nodes[(ct, p, z)] = ct*P*Z + p*Z + z — spot rows contiguous first.
 
 
-def _state_rows(P: int, Z: int, K: int, *, fault_obs: bool = False) -> dict:
+def _state_rows(P: int, Z: int, K: int, *, fault_obs: bool = False,
+                wl_D: int = 0) -> dict:
     """``fault_obs``: reserve rows carrying the LAST-OBSERVED signals
     (spot/od/carbon [Z each] + demand [2]) for the signal-outage fault —
     observing policies (carbon/mlp) read these instead of the live exo
     rows while the outage lane is set. Appended after the accumulators so
-    the pre-fault layout is unchanged byte-for-byte."""
+    the pre-fault layout is unchanged byte-for-byte.
+
+    ``wl_D``: nonzero reserves the workload-family rows
+    (`ccka_tpu/workloads`): five per-family accumulators, the inference
+    queue, a ``wl_D``-deep batch age-pipeline (D = batch_deadline_ticks)
+    and the background backlog — appended LAST so every earlier layout
+    is unchanged byte-for-byte."""
     n = P * Z * 2
     rows = {"nodes": (0, n)}
     off = n
@@ -135,6 +143,15 @@ def _state_rows(P: int, Z: int, K: int, *, fault_obs: bool = False) -> dict:
     if fault_obs:
         rows["last_exo"] = (off, off + 3 * Z + 2)
         off += 3 * Z + 2
+    if wl_D:
+        for name in ("inf_viol_sum", "inf_q_sum", "inf_drop_sum",
+                     "batch_miss_sum", "batch_bl_sum", "wl_inf_q"):
+            rows[name] = (off, off + 1)
+            off += 1
+        rows["wl_batch"] = (off, off + wl_D)
+        off += wl_D
+        rows["wl_bg"] = (off, off + 1)
+        off += 1
     rows["_total"] = (0, off)
     return rows
 
@@ -146,12 +163,18 @@ def _state_rows(P: int, Z: int, K: int, *, fault_obs: bool = False) -> dict:
 # (`ccka_tpu/faults`, ARCHITECTURE §12) appends the disturbance lane
 # block after this padding — hazard[FB:FB+Z], deny[FB+Z], delay[FB+Z+1],
 # stale[FB+Z+2] with FB = _exo_rows(Z), itself padded to a multiple of 8
-# (`faults.process.fault_rows`) — so existing offsets never move; the
-# launchers detect the widened layout from the static row count.
+# (`faults.process.fault_rows`) — so existing offsets never move. A
+# WORKLOAD-WIDENED stream (`ccka_tpu/workloads`, ARCHITECTURE §12-13)
+# appends the family-arrival block LAST — inf[WB], batch[WB+1],
+# bg[WB+2] with WB = FB + (fault_rows(Z) if faulted else 0), the block
+# sized fault_rows(Z)+8 so the four layouts stay distinguishable purely
+# by row count; the launchers detect layouts via
+# `sim.lanes.stream_layout` (the one layout module).
 
-
-def _exo_rows(Z: int) -> int:
-    return math.ceil((3 * Z + 3) / 8) * 8
+# The layout arithmetic lives in the neutral `sim/lanes.py` (faults and
+# workloads import it downward); `_exo_rows` stays exported here for
+# the long tail of existing callers.
+_exo_rows = lanes.exo_rows
 
 
 def _act_rows(P: int, Z: int) -> int:
@@ -172,6 +195,7 @@ _PARAM_NAMES = (
     "interrupt_p", "pdb", "frag", "underutil",
     "watts_idle", "watts_full", "rps", "slo_frac", "tau_s",
     "lat_base", "lat_slo",
+    "wl_inf_qmax", "wl_inf_slo",              # workload families
 )
 _PI = {n: i for i, n in enumerate(_PARAM_NAMES)}
 
@@ -185,7 +209,8 @@ def _pack_params(params: SimParams) -> jnp.ndarray:
             params.fragmentation, params.underutil_threshold,
             params.watts_idle, params.watts_full, params.rps_per_pod,
             params.slo_served_fraction, params.consolidate_tau_s,
-            params.latency_base_ms, params.latency_slo_ms]
+            params.latency_base_ms, params.latency_slo_ms,
+            params.wl_inference_queue_max, params.wl_inference_slo_ms]
     return jnp.asarray(vals, jnp.float32).reshape(1, -1)
 
 
@@ -237,7 +262,8 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
                  slo_mask: tuple | None = None,
                  mlp_dims: tuple | None = None,
                  plan_batched: bool = False,
-                 faults: bool = False):
+                 faults: bool = False,
+                 workloads: int = 0):
     """``policy``: "profiles" | "carbon" | "mlp" | "plan" (module
     docstring; "plan" executes a precomputed per-tick action stream —
     the diff-MPC playback entry — instead of deciding in-kernel).
@@ -255,10 +281,21 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
     delay-jittered, and observing policies (carbon/mlp) read held
     signals during outages via the ``last_exo`` state rows. Static: the
     False kernel is the pre-fault program, untouched (zero-fault gate).
+
+    ``workloads``: nonzero means the stream carries the workload lane
+    block (`ccka_tpu/workloads`, rows after the fault block: inference/
+    batch/background arrivals) and names the STATIC batch-deadline
+    depth D — per-family queues ride the VMEM state scratch and drain
+    from the post-step fleet's headroom exactly as `dynamics.step`'s
+    workload path does. 0 is the pre-workload program, untouched
+    (zero-workload gate).
     """
     ROWS = _state_rows(P, Z, K,
-                       fault_obs=faults and policy in ("carbon", "mlp"))
+                       fault_obs=faults and policy in ("carbon", "mlp"),
+                       wl_D=workloads)
     FB = _exo_rows(Z)    # fault lane base row
+    if workloads:
+        WB = FB + (lanes.fault_rows(Z) if faults else 0)  # workload base
     NPZ = P * Z * 2  # nodes rows
     # Unpacked here: `carbon` would otherwise be shadowed by the tick
     # body's carbon accumulator local.
@@ -710,6 +747,53 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
             capacity = (nodes_total + p["base_od"]) * p["ppn"]
             served = running.sum(axis=0)
 
+            # 7b. workload families (ccka_tpu/workloads): per-family
+            # queues drained from the post-step fleet's headroom —
+            # inference first (queue cap + latency-proxy SLO), then
+            # batch EDF over the D-deep age pipeline, then best-effort
+            # background. Mirrors dynamics.step's workload path
+            # line-for-line in feature-first form.
+            if workloads:
+                D = workloads
+                inf_arr = exo[WB]
+                bat_arr = exo[WB + 1]
+                bg_arr = exo[WB + 2]
+                headroom = jnp.maximum(capacity - served, 0.0)
+                inf_q = rows(state, "wl_inf_q")[0]          # [B]
+                inf_in = inf_q + inf_arr
+                inf_served = jnp.minimum(inf_in, headroom)
+                inf_after = inf_in - inf_served
+                inf_dropped = jnp.maximum(inf_after - p["wl_inf_qmax"],
+                                          0.0)
+                inf_q2 = inf_after - inf_dropped
+                rem = headroom - inf_served
+                inf_rho = jnp.clip(inf_in / (headroom + _EPS),
+                                   0.0, LATENCY_RHO_CLIP)
+                inf_lat = p["lat_base"] * (
+                    1.0 + LATENCY_CURVE_COEF * inf_rho * inf_rho
+                    / (1.0 - inf_rho))
+                inf_viol = jnp.maximum(
+                    (inf_lat > p["wl_inf_slo"]).astype(jnp.float32),
+                    (inf_dropped > 0.0).astype(jnp.float32))
+                wbat = rows(state, "wl_batch")              # [D, B]
+                pool = [bat_arr] + [wbat[kk] for kk in range(D - 1)]
+                rem_b = rem
+                batch_leftover = [None] * D
+                for kk in range(D - 1, -1, -1):             # oldest first
+                    take = jnp.minimum(pool[kk], rem_b)
+                    rem_b = rem_b - take
+                    batch_leftover[kk] = pool[kk] - take
+                batch_missed = batch_leftover[D - 1]
+                keep = batch_leftover[:D - 1]
+                batch_bl = (sum(keep) if keep
+                            else jnp.zeros((B,), jnp.float32))
+                new_wbat = jnp.stack(
+                    keep + [jnp.zeros((B,), jnp.float32)])  # [D, B]
+                bg_q = rows(state, "wl_bg")[0]
+                bg_in = bg_q + bg_arr
+                bg_served = jnp.minimum(bg_in, rem_b)
+                bg_q2 = bg_in - bg_served
+
             def bump(name, delta):
                 return rows(state, name) + valid * delta[None, :]
 
@@ -738,6 +822,20 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
                 # Held-signal carry: during an outage obs_sig IS the old
                 # last row block, so the hold persists across the window.
                 new_state_parts.append(obs_sig)
+            if workloads:
+                # Row order matches _state_rows' workload block; the
+                # final valid-gate below reverts queue rows (like all
+                # dynamic state) on padding ticks.
+                new_state_parts += [
+                    bump("inf_viol_sum", inf_viol),
+                    bump("inf_q_sum", inf_q2),
+                    bump("inf_drop_sum", inf_dropped),
+                    bump("batch_miss_sum", batch_missed),
+                    bump("batch_bl_sum", batch_bl),
+                    inf_q2[None, :],
+                    new_wbat,
+                    bg_q2[None, :],
+                ]
             pad = state.shape[0] - ROWS["_total"][1]
             if pad:
                 new_state_parts.append(jnp.zeros((pad, B), jnp.float32))
@@ -755,6 +853,9 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
                      "capacity_sum", "waste_sum", "latency_sum",
                      "latency_max", "queue_sum", "interrupts_sum",
                      "denied_sum", "stale_sum")
+            if workloads:
+                names += ("inf_viol_sum", "inf_q_sum", "inf_drop_sum",
+                          "batch_miss_sum", "batch_bl_sum")
             vals = [state[ROWS[n][0]] for n in names]
             pad = out_ref.shape[-2] - len(vals)
             out = jnp.stack(vals + [jnp.zeros_like(vals[0])] * pad)
@@ -766,7 +867,10 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
     return kernel, ROWS
 
 
-_OUT_ROWS = 16
+# Output block rows: 16 shared accumulators + 5 workload-family ones
+# (zero-padded by kernels without workload lanes), padded to a sublane
+# multiple.
+_OUT_ROWS = 24
 
 # Batch-mean parity tolerances — the ONE table both gates use
 # (`tests/test_megakernel.py` and bench.py's inline gate), so the bench
@@ -782,6 +886,12 @@ MEAN_PARITY_TOLERANCES = {
     # interruptions/evictions; identically 0 (rel diff 0) off the fault
     # path, so the pre-fault gates are untouched.
     "denials": 0.05, "stale_ticks": 0.01,
+    # Workload-family counters (ccka_tpu/workloads): threshold-gated
+    # (violation/miss flips) and queue-depth means amplify small fleet
+    # differences; identically 0 (rel diff 0) off the workload path.
+    "inf_slo_violations": 0.02, "inf_queue_mean": 0.05,
+    "inf_dropped": 0.05, "batch_deadline_misses": 0.05,
+    "batch_backlog_mean": 0.05,
 }
 DEFAULT_MEAN_PARITY_TOL = 0.005
 
@@ -855,23 +965,25 @@ def _pack_exo(traces: ExogenousTrace, T_pad: int) -> jnp.ndarray:
     return packed
 
 
-@functools.partial(jax.jit, static_argnames=("P", "Z", "K", "stochastic",
-                                             "b_block", "t_chunk",
-                                             "interpret", "carbon"))
-def _run(params_packed, actions_packed, exo_packed, meta, *, P, Z, K,
+@functools.partial(jax.jit, static_argnames=("P", "Z", "K", "WD",
+                                             "stochastic", "b_block",
+                                             "t_chunk", "interpret",
+                                             "carbon"))
+def _run(params_packed, actions_packed, exo_packed, meta, *, P, Z, K, WD,
          stochastic, b_block, t_chunk, interpret=False, carbon=None):
-    # Fault lanes auto-detect: a widened stream (`ccka_tpu/faults`) has
-    # extra rows past _exo_rows(Z). Shapes are static at trace time, so
-    # this is a compile-time switch — the plain-stream program is the
-    # pre-fault kernel, untouched.
+    # Lane auto-detect: widened streams (`ccka_tpu/faults` /
+    # `ccka_tpu/workloads`) carry extra row blocks past _exo_rows(Z),
+    # resolved purely from the static row count. Shapes are static at
+    # trace time, so this is a compile-time switch — the plain-stream
+    # program is the pre-fault/pre-workload kernel, untouched.
     T_pad, exo_rows_total, B = exo_packed.shape
-    faults = exo_rows_total > _exo_rows(Z)
+    faults, wl = lanes.stream_layout(exo_rows_total, Z)
     n_b = B // b_block
     n_t = T_pad // t_chunk
     kernel, ROWS = _make_kernel(
         P, Z, K, t_chunk, n_t, stochastic,
         policy="carbon" if carbon is not None else "profiles",
-        carbon=carbon, faults=faults)
+        carbon=carbon, faults=faults, workloads=WD if wl else 0)
     s_rows = math.ceil(ROWS["_total"][1] / 8) * 8
 
     out = pl.pallas_call(
@@ -963,13 +1075,13 @@ def _pack_mlp_tensors(net_params, dims, b_block: int):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "P", "Z", "K", "stochastic", "b_block", "t_chunk", "interpret",
+    "P", "Z", "K", "WD", "stochastic", "b_block", "t_chunk", "interpret",
     "slo_mask", "mlp_dims"))
-def _run_mlp(params_packed, weights, exo_packed, meta, *, P, Z, K,
+def _run_mlp(params_packed, weights, exo_packed, meta, *, P, Z, K, WD,
              stochastic, b_block, t_chunk, slo_mask, mlp_dims,
              interpret=False):
     T_pad, exo_rows_total, B = exo_packed.shape
-    faults = exo_rows_total > _exo_rows(Z)   # see _run
+    faults, wl = lanes.stream_layout(exo_rows_total, Z)   # see _run
     n_b = B // b_block
     n_t = T_pad // t_chunk
     NP = weights[0].shape[0]
@@ -977,7 +1089,8 @@ def _run_mlp(params_packed, weights, exo_packed, meta, *, P, Z, K,
     A_pad = weights[4].shape[-1]
     kernel, ROWS = _make_kernel(P, Z, K, t_chunk, n_t, stochastic,
                                 policy="mlp", slo_mask=slo_mask,
-                                mlp_dims=mlp_dims, faults=faults)
+                                mlp_dims=mlp_dims, faults=faults,
+                                workloads=WD if wl else 0)
     s_rows = math.ceil(ROWS["_total"][1] / 8) * 8
 
     def wspec(rows, cols):
@@ -1036,16 +1149,17 @@ def megakernel_rollout_summary(params: SimParams,
 
     return _fused_profile_summary(
         params, off_action, peak_action, traces, jnp.int32(seed),
-        T=T, P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
+        T=T, P=P, Z=Z, K=K, WD=int(params.wl_batch_deadline_ticks),
+        stochastic=stochastic, b_block=b_block,
         t_chunk=t_chunk, interpret=interpret, carbon=None)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "T", "P", "Z", "K", "stochastic", "b_block", "t_chunk", "interpret",
-    "carbon"))
+    "T", "P", "Z", "K", "WD", "stochastic", "b_block", "t_chunk",
+    "interpret", "carbon"))
 def _fused_profile_summary(params, off_action, peak_action, traces, seed,
-                           *, T, P, Z, K, stochastic, b_block, t_chunk,
-                           interpret, carbon):
+                           *, T, P, Z, K, WD, stochastic, b_block,
+                           t_chunk, interpret, carbon):
     """pack → kernel → finalize as ONE jitted program: the eager path
     paid a tunnel round-trip per pack/finalize op (~17ms of dispatch for
     a ~11ms kernel at B=32k — measured round 5), which the fusion
@@ -1055,8 +1169,9 @@ def _fused_profile_summary(params, off_action, peak_action, traces, seed,
     T_pad = math.ceil(T / t_chunk) * t_chunk
     return _fused_packed_summary(
         params, off_action, peak_action, _pack_exo(traces, T_pad), seed,
-        T=T, P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
-        t_chunk=t_chunk, interpret=interpret, carbon=carbon)
+        T=T, P=P, Z=Z, K=K, WD=WD, stochastic=stochastic,
+        b_block=b_block, t_chunk=t_chunk, interpret=interpret,
+        carbon=carbon)
 
 
 def _meta(T: int, stochastic: bool, seed) -> jnp.ndarray:
@@ -1074,6 +1189,9 @@ def _finalize(params: SimParams, out: jnp.ndarray, T: int):
     (cost, carbon, requests, slo_s, evict, nct_spot, nct_od, served,
      capacity, waste, lat_sum, lat_max, queue, interrupts, denied,
      stale) = out[:16]
+    # Workload-family accumulator rows (zeros from kernels without
+    # workload lanes — matching the lax path's identically-zero fields).
+    inf_viol, inf_q, inf_drop, b_miss, b_bl = out[16:21]
     B = cost.shape[0]
 
     zeros = jnp.zeros((B,), jnp.float32)
@@ -1085,7 +1203,9 @@ def _finalize(params: SimParams, out: jnp.ndarray, T: int):
         nodes_ct_sum=jnp.stack([nct_spot, nct_od], axis=-1),
         served_sum=served, capacity_sum=capacity, waste_sum=waste,
         latency_sum=lat_sum, latency_max=lat_max, queue_sum=queue,
-        interrupts_sum=interrupts, denied_sum=denied, stale_sum=stale)
+        interrupts_sum=interrupts, denied_sum=denied, stale_sum=stale,
+        inf_viol_sum=inf_viol, inf_queue_sum=inf_q, inf_drop_sum=inf_drop,
+        batch_miss_sum=b_miss, batch_bl_sum=b_bl)
     return jax.vmap(
         lambda init, fin, a: finalize_summary(params, init, fin, a, T)
     )(mk_state(zeros, zeros, zeros, zeros, zeros),
@@ -1117,7 +1237,8 @@ def carbon_megakernel_rollout_summary(params: SimParams,
     K = int(params.provision_pipeline_k)
     return _fused_profile_summary(
         params, off_action, peak_action, traces, jnp.int32(seed),
-        T=T, P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
+        T=T, P=P, Z=Z, K=K, WD=int(params.wl_batch_deadline_ticks),
+        stochastic=stochastic, b_block=b_block,
         t_chunk=t_chunk, interpret=interpret,
         carbon=(float(sharpness), float(min_weight), float(stickiness)))
 
@@ -1166,6 +1287,7 @@ def neural_megakernel_rollout_summary(params: SimParams,
     slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
     summary = _fused_neural_summary(
         params, net_params, traces, jnp.int32(seed), T=T, P=P, Z=Z, K=K,
+        WD=int(params.wl_batch_deadline_ticks),
         stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
         slo_mask=slo, mlp_dims=dims, interpret=interpret)
     if was_single:
@@ -1174,13 +1296,13 @@ def neural_megakernel_rollout_summary(params: SimParams,
 
 
 def _neural_packed_impl(params, net_params, exo_packed, seed, *, T, P, Z,
-                        K, stochastic, b_block, t_chunk, slo_mask,
+                        K, WD, stochastic, b_block, t_chunk, slo_mask,
                         mlp_dims, interpret):
     """Weight pack → population kernel → finalize on an ALREADY-PACKED
     exo stream — the shared body of both neural fused entries."""
     weights = _pack_mlp_tensors(net_params, mlp_dims, b_block)
     out = _run_mlp(_pack_params(params), weights, exo_packed,
-                   _meta(T, stochastic, seed), P=P, Z=Z, K=K,
+                   _meta(T, stochastic, seed), P=P, Z=Z, K=K, WD=WD,
                    stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
                    slo_mask=slo_mask, mlp_dims=mlp_dims,
                    interpret=interpret)
@@ -1188,10 +1310,10 @@ def _neural_packed_impl(params, net_params, exo_packed, seed, *, T, P, Z,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "T", "P", "Z", "K", "stochastic", "b_block", "t_chunk", "interpret",
-    "slo_mask", "mlp_dims"))
+    "T", "P", "Z", "K", "WD", "stochastic", "b_block", "t_chunk",
+    "interpret", "slo_mask", "mlp_dims"))
 def _fused_neural_summary(params, net_params, traces, seed, *, T, P, Z,
-                          K, stochastic, b_block, t_chunk, slo_mask,
+                          K, WD, stochastic, b_block, t_chunk, slo_mask,
                           mlp_dims, interpret):
     """Weight pack → exo pack → population kernel → finalize, one jitted
     program (same dispatch-fusion rationale as
@@ -1200,20 +1322,22 @@ def _fused_neural_summary(params, net_params, traces, seed, *, T, P, Z,
     T_pad = math.ceil(T / t_chunk) * t_chunk
     return _neural_packed_impl(
         params, net_params, _pack_exo(traces, T_pad), seed, T=T, P=P, Z=Z,
-        K=K, stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
-        slo_mask=slo_mask, mlp_dims=mlp_dims, interpret=interpret)
+        K=K, WD=WD, stochastic=stochastic, b_block=b_block,
+        t_chunk=t_chunk, slo_mask=slo_mask, mlp_dims=mlp_dims,
+        interpret=interpret)
 
 
-_NEURAL_PACKED_STATICS = ("T", "P", "Z", "K", "stochastic", "b_block",
-                          "t_chunk", "interpret", "slo_mask", "mlp_dims")
+_NEURAL_PACKED_STATICS = ("T", "P", "Z", "K", "WD", "stochastic",
+                          "b_block", "t_chunk", "interpret", "slo_mask",
+                          "mlp_dims")
 
 _fused_neural_packed_summary = functools.partial(
     jax.jit, static_argnames=_NEURAL_PACKED_STATICS)(_neural_packed_impl)
 
 
 def _neural_packed_donate_impl(params, net_params, exo_packed, seed, *,
-                               T, P, Z, K, stochastic, b_block, t_chunk,
-                               slo_mask, mlp_dims, interpret):
+                               T, P, Z, K, WD, stochastic, b_block,
+                               t_chunk, slo_mask, mlp_dims, interpret):
     """Donating variant: consumes the packed exo stream and weights
     buffers and returns them aliased (ping-pong), so back-to-back ES
     generations hold ONE stream in HBM instead of two — the caller
@@ -1223,7 +1347,7 @@ def _neural_packed_donate_impl(params, net_params, exo_packed, seed, *,
     jax donation is input→output aliasing, and a donated buffer with no
     same-shaped output is ignored with a warning."""
     s = _neural_packed_impl(
-        params, net_params, exo_packed, seed, T=T, P=P, Z=Z, K=K,
+        params, net_params, exo_packed, seed, T=T, P=P, Z=Z, K=K, WD=WD,
         stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
         slo_mask=slo_mask, mlp_dims=mlp_dims, interpret=interpret)
     return s, exo_packed, net_params
@@ -1295,6 +1419,7 @@ def megakernel_summary_from_packed(params: SimParams,
     return fn(
         params, off_action, peak_action, exo_packed, jnp.int32(seed),
         T=T, P=P, Z=Z, K=int(params.provision_pipeline_k),
+        WD=int(params.wl_batch_deadline_ticks),
         stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
         interpret=interpret, carbon=carbon)
 
@@ -1354,7 +1479,9 @@ def neural_megakernel_summary_from_packed(params: SimParams,
         net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
                                   net_params)
     slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
-    kw = dict(T=T, P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
+    kw = dict(T=T, P=P, Z=Z, K=K,
+              WD=int(params.wl_batch_deadline_ticks),
+              stochastic=stochastic, b_block=b_block,
               t_chunk=t_chunk, slo_mask=slo, mlp_dims=dims,
               interpret=interpret)
     if donate_stream:
@@ -1370,26 +1497,27 @@ def neural_megakernel_summary_from_packed(params: SimParams,
 
 
 def _packed_summary_impl(params, off_action, peak_action, exo_packed,
-                         seed, *, T, P, Z, K, stochastic, b_block,
+                         seed, *, T, P, Z, K, WD, stochastic, b_block,
                          t_chunk, interpret, carbon=None):
     out = _run(_pack_params(params),
                jnp.stack([_pack_action(off_action),
                           _pack_action(peak_action)]),
                exo_packed, _meta(T, stochastic, seed),
-               P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
-               t_chunk=t_chunk, interpret=interpret, carbon=carbon)
+               P=P, Z=Z, K=K, WD=WD, stochastic=stochastic,
+               b_block=b_block, t_chunk=t_chunk, interpret=interpret,
+               carbon=carbon)
     return _finalize(params, out, T)
 
 
-_PACKED_STATICS = ("T", "P", "Z", "K", "stochastic", "b_block", "t_chunk",
-                   "interpret", "carbon")
+_PACKED_STATICS = ("T", "P", "Z", "K", "WD", "stochastic", "b_block",
+                   "t_chunk", "interpret", "carbon")
 
 _fused_packed_summary = functools.partial(
     jax.jit, static_argnames=_PACKED_STATICS)(_packed_summary_impl)
 
 
 def _packed_summary_donate_impl(params, off_action, peak_action,
-                                exo_packed, seed, *, T, P, Z, K,
+                                exo_packed, seed, *, T, P, Z, K, WD,
                                 stochastic, b_block, t_chunk, interpret,
                                 carbon=None):
     """Donating variant of the packed entry: the stream buffer is
@@ -1397,8 +1525,8 @@ def _packed_summary_donate_impl(params, off_action, peak_action,
     why the identity return is load-bearing)."""
     s = _packed_summary_impl(
         params, off_action, peak_action, exo_packed, seed, T=T, P=P, Z=Z,
-        K=K, stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
-        interpret=interpret, carbon=carbon)
+        K=K, WD=WD, stochastic=stochastic, b_block=b_block,
+        t_chunk=t_chunk, interpret=interpret, carbon=carbon)
     return s, exo_packed
 
 
@@ -1435,18 +1563,18 @@ def pack_plan(actions: Action, T_pad: int) -> jnp.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "P", "Z", "K", "stochastic", "b_block", "t_chunk", "interpret",
+    "P", "Z", "K", "WD", "stochastic", "b_block", "t_chunk", "interpret",
     "plan_batched"))
 def _run_plan(params_packed, plan_packed, exo_packed, meta, *, P, Z, K,
-              stochastic, b_block, t_chunk, plan_batched,
+              WD, stochastic, b_block, t_chunk, plan_batched,
               interpret=False):
     T_pad, exo_rows_total, B = exo_packed.shape
-    faults = exo_rows_total > _exo_rows(Z)   # see _run
+    faults, wl = lanes.stream_layout(exo_rows_total, Z)   # see _run
     n_b = B // b_block
     n_t = T_pad // t_chunk
     kernel, ROWS = _make_kernel(P, Z, K, t_chunk, n_t, stochastic,
                                 policy="plan", plan_batched=plan_batched,
-                                faults=faults)
+                                faults=faults, workloads=WD if wl else 0)
     s_rows = math.ceil(ROWS["_total"][1] / 8) * 8
     pr = _plan_rows(P, Z)
     if plan_batched:
@@ -1502,25 +1630,25 @@ def _check_plan(plan_packed, exo_packed, P: int, Z: int) -> bool:
 
 
 def _plan_packed_impl(params, plan_packed, exo_packed, seed, *, T, P, Z,
-                      K, stochastic, b_block, t_chunk, interpret,
+                      K, WD, stochastic, b_block, t_chunk, interpret,
                       plan_batched):
     out = _run_plan(_pack_params(params), plan_packed, exo_packed,
-                    _meta(T, stochastic, seed), P=P, Z=Z, K=K,
+                    _meta(T, stochastic, seed), P=P, Z=Z, K=K, WD=WD,
                     stochastic=stochastic, b_block=b_block,
                     t_chunk=t_chunk, plan_batched=plan_batched,
                     interpret=interpret)
     return _finalize(params, out, T)
 
 
-_PLAN_STATICS = ("T", "P", "Z", "K", "stochastic", "b_block", "t_chunk",
-                 "interpret", "plan_batched")
+_PLAN_STATICS = ("T", "P", "Z", "K", "WD", "stochastic", "b_block",
+                 "t_chunk", "interpret", "plan_batched")
 
 _fused_plan_packed_summary = functools.partial(
     jax.jit, static_argnames=_PLAN_STATICS)(_plan_packed_impl)
 
 
 def _plan_packed_donate_impl(params, plan_packed, exo_packed, seed, *, T,
-                             P, Z, K, stochastic, b_block, t_chunk,
+                             P, Z, K, WD, stochastic, b_block, t_chunk,
                              interpret, plan_batched):
     """Donating variant: the EXO stream is consumed and returned aliased
     (``(summary, stream)`` — recycle via ``packed_trace_device``). The
@@ -1528,7 +1656,7 @@ def _plan_packed_donate_impl(params, plan_packed, exo_packed, seed, *, T,
     plan against many fresh worlds, so the plan buffer outlives the
     launch by design."""
     s = _plan_packed_impl(
-        params, plan_packed, exo_packed, seed, T=T, P=P, Z=Z, K=K,
+        params, plan_packed, exo_packed, seed, T=T, P=P, Z=Z, K=K, WD=WD,
         stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
         interpret=interpret, plan_batched=plan_batched)
     return s, exo_packed
@@ -1541,7 +1669,7 @@ _fused_plan_packed_donate = functools.partial(
 
 @functools.partial(jax.jit, static_argnames=_PLAN_STATICS)
 def _fused_plan_summary(params, plan_actions, traces, seed, *, T, P, Z,
-                        K, stochastic, b_block, t_chunk, interpret,
+                        K, WD, stochastic, b_block, t_chunk, interpret,
                         plan_batched):
     """Plan pack → exo pack → playback kernel → finalize, one jitted
     program (same dispatch-fusion rationale as `_fused_profile_summary`).
@@ -1550,8 +1678,9 @@ def _fused_plan_summary(params, plan_actions, traces, seed, *, T, P, Z,
     T_pad = math.ceil(T / t_chunk) * t_chunk
     return _plan_packed_impl(
         params, pack_plan(plan_actions, T_pad), _pack_exo(traces, T_pad),
-        seed, T=T, P=P, Z=Z, K=K, stochastic=stochastic, b_block=b_block,
-        t_chunk=t_chunk, interpret=interpret, plan_batched=plan_batched)
+        seed, T=T, P=P, Z=Z, K=K, WD=WD, stochastic=stochastic,
+        b_block=b_block, t_chunk=t_chunk, interpret=interpret,
+        plan_batched=plan_batched)
 
 
 def plan_megakernel_rollout_summary(params: SimParams,
@@ -1594,7 +1723,8 @@ def plan_megakernel_rollout_summary(params: SimParams,
     Z = int(plan_actions.zone_weight.shape[-1])
     return _fused_plan_summary(
         params, plan_actions, traces, jnp.int32(seed), T=T, P=P, Z=Z,
-        K=int(params.provision_pipeline_k), stochastic=stochastic,
+        K=int(params.provision_pipeline_k),
+        WD=int(params.wl_batch_deadline_ticks), stochastic=stochastic,
         b_block=b_block, t_chunk=t_chunk, interpret=interpret,
         plan_batched=per_cluster)
 
@@ -1627,6 +1757,7 @@ def plan_megakernel_summary_from_packed(params: SimParams,
           else _fused_plan_packed_summary)
     return fn(params, plan_packed, exo_packed, jnp.int32(seed), T=T, P=P,
               Z=Z, K=int(params.provision_pipeline_k),
+              WD=int(params.wl_batch_deadline_ticks),
               stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
               interpret=interpret, plan_batched=plan_batched)
 

@@ -41,6 +41,13 @@ class EpisodeSummary(NamedTuple):
     # its meaning (the zero-fault bitwise gate pins the shared fields).
     denials: jnp.ndarray              # [] total spot nodes denied (ICE)
     stale_ticks: jnp.ndarray          # [] ticks policies saw stale signals
+    # Workload-family columns (ccka_tpu/workloads): identically 0 on the
+    # pre-workload pipeline — same contract as the fault counters.
+    inf_slo_violations: jnp.ndarray   # [] inference SLO-violation ticks
+    inf_queue_mean: jnp.ndarray       # [] mean inference queue depth
+    inf_dropped: jnp.ndarray          # [] inference work load-shed, total
+    batch_deadline_misses: jnp.ndarray  # [] batch work aged out, total
+    batch_backlog_mean: jnp.ndarray   # [] mean batch backlog
 
 
 class SummaryAcc(NamedTuple):
@@ -61,6 +68,12 @@ class SummaryAcc(NamedTuple):
     interrupts_sum: jnp.ndarray  # [] Σ_t spot reclaims
     denied_sum: jnp.ndarray      # [] Σ_t spot nodes denied (faults)
     stale_sum: jnp.ndarray       # [] Σ_t stale-signal ticks (faults)
+    # Workload-family sufficient statistics (ccka_tpu/workloads).
+    inf_viol_sum: jnp.ndarray    # [] Σ_t inference SLO-violation ticks
+    inf_queue_sum: jnp.ndarray   # [] Σ_t inference queue depth
+    inf_drop_sum: jnp.ndarray    # [] Σ_t inference work load-shed
+    batch_miss_sum: jnp.ndarray  # [] Σ_t batch deadline misses
+    batch_bl_sum: jnp.ndarray    # [] Σ_t batch backlog
 
     @classmethod
     def zero(cls) -> "SummaryAcc":
@@ -68,7 +81,9 @@ class SummaryAcc(NamedTuple):
         return cls(nodes_ct_sum=jnp.zeros((N_CT,), jnp.float32),
                    served_sum=z, capacity_sum=z, waste_sum=z,
                    latency_sum=z, latency_max=z, queue_sum=z,
-                   interrupts_sum=z, denied_sum=z, stale_sum=z)
+                   interrupts_sum=z, denied_sum=z, stale_sum=z,
+                   inf_viol_sum=z, inf_queue_sum=z, inf_drop_sum=z,
+                   batch_miss_sum=z, batch_bl_sum=z)
 
     def update(self, params: SimParams,
                metrics: StepMetrics) -> "SummaryAcc":
@@ -87,6 +102,12 @@ class SummaryAcc(NamedTuple):
             interrupts_sum=self.interrupts_sum + metrics.interrupted_nodes,
             denied_sum=self.denied_sum + metrics.denied_nodes,
             stale_sum=self.stale_sum + metrics.signal_stale,
+            inf_viol_sum=self.inf_viol_sum + metrics.inf_slo_violation,
+            inf_queue_sum=self.inf_queue_sum + metrics.inf_queue_depth,
+            inf_drop_sum=self.inf_drop_sum + metrics.inf_dropped,
+            batch_miss_sum=(self.batch_miss_sum
+                            + metrics.batch_deadline_miss),
+            batch_bl_sum=self.batch_bl_sum + metrics.batch_backlog,
         )
 
 
@@ -132,6 +153,11 @@ def finalize_summary(params: SimParams, initial: ClusterState,
         queue_depth_mean=acc.queue_sum / t,
         denials=acc.denied_sum,
         stale_ticks=acc.stale_sum,
+        inf_slo_violations=acc.inf_viol_sum,
+        inf_queue_mean=acc.inf_queue_sum / t,
+        inf_dropped=acc.inf_drop_sum,
+        batch_deadline_misses=acc.batch_miss_sum,
+        batch_backlog_mean=acc.batch_bl_sum / t,
     )
 
 
@@ -182,4 +208,9 @@ def summarize(params: SimParams, metrics: StepMetrics) -> EpisodeSummary:
         queue_depth_mean=metrics.queue_depth.mean(axis=-1),
         denials=metrics.denied_nodes.sum(axis=-1),
         stale_ticks=metrics.signal_stale.sum(axis=-1),
+        inf_slo_violations=metrics.inf_slo_violation.sum(axis=-1),
+        inf_queue_mean=metrics.inf_queue_depth.mean(axis=-1),
+        inf_dropped=metrics.inf_dropped.sum(axis=-1),
+        batch_deadline_misses=metrics.batch_deadline_miss.sum(axis=-1),
+        batch_backlog_mean=metrics.batch_backlog.mean(axis=-1),
     )

@@ -77,6 +77,13 @@ def observed_exo(last_obs: ExoStep, exo: ExoStep, stale) -> ExoStep:
     )
 
 
+def _wl_zero(params: SimParams):
+    """Fresh per-family queue state (ccka_tpu/workloads)."""
+    from ccka_tpu.workloads.types import WorkloadState
+
+    return WorkloadState.zero(int(params.wl_batch_deadline_ticks))
+
+
 def rollout(params: SimParams,
             state0: ClusterState,
             action_fn: ActionFn,
@@ -84,7 +91,8 @@ def rollout(params: SimParams,
             key: jax.Array,
             *,
             stochastic: bool = False,
-            faults=None) -> tuple[ClusterState, StepMetrics]:
+            faults=None,
+            workloads=None) -> tuple[ClusterState, StepMetrics]:
     """Scan the closed loop decide→act→step over the trace horizon.
 
     ``action_fn`` is the PolicyBackend's jittable decide(); it sees the
@@ -96,13 +104,22 @@ def rollout(params: SimParams,
     feed the dynamics and the policy observes STALE signals during
     outage windows (held at the last pre-outage tick; tick 0 observes
     its own fresh signals, matching the kernel's ``tglob > 0`` gate).
-    ``None`` takes the exact pre-fault path — a Python-level branch, so
-    existing rollouts stay bitwise identical.
+
+    ``workloads``: optional time-major
+    :class:`ccka_tpu.workloads.WorkloadStep` pytree (leaves ``[T]``).
+    When given, per-family queue state (zero-initialized) is carried
+    through the scan and each tick's arrivals drain from the fleet's
+    headroom (`sim/dynamics.step` workload path); policies do not
+    observe the queues — families are tenant load the fleet's slack
+    either absorbs or doesn't.
+
+    ``None`` for both takes the exact pre-fault/pre-workload path — a
+    Python-level branch, so existing rollouts stay bitwise identical.
     """
     xs = exo_steps(trace)
     t0 = jnp.arange(xs.is_peak.shape[0], dtype=jnp.int32)
 
-    if faults is None:
+    if faults is None and workloads is None:
         def body(carry, inp):
             state, k = carry
             exo, t = inp
@@ -116,19 +133,38 @@ def rollout(params: SimParams,
                                            unroll=_UNROLL)
         return final, metrics
 
-    def body(carry, inp):
-        state, k, last = carry
-        exo, t, f = inp
-        k, sub = jax.random.split(k)
-        obs = observed_exo(last, exo, f.signal_stale)
-        action = action_fn(state, obs, t)
-        state, metrics = step(params, state, action, exo, sub,
-                              stochastic=stochastic, fault=f)
-        return (state, k, obs), metrics
+    hf, hw = faults is not None, workloads is not None
 
-    last0 = jax.tree.map(lambda x: x[0], xs)
-    (final, _, _), metrics = jax.lax.scan(
-        body, (state0, key, last0), (xs, t0, faults), unroll=_UNROLL)
+    def body(carry, inp):
+        state, k = carry[0], carry[1]
+        rest = list(carry[2:])
+        last = rest.pop(0) if hf else None
+        ws = rest.pop(0) if hw else None
+        exo, t = inp[0], inp[1]
+        extra = list(inp[2:])
+        f = extra.pop(0) if hf else None
+        w = extra.pop(0) if hw else None
+        k, sub = jax.random.split(k)
+        obs = observed_exo(last, exo, f.signal_stale) if hf else exo
+        action = action_fn(state, obs, t)
+        if hw:
+            state, metrics, ws = step(params, state, action, exo, sub,
+                                      stochastic=stochastic, fault=f,
+                                      workload=w, wl_state=ws)
+        else:
+            state, metrics = step(params, state, action, exo, sub,
+                                  stochastic=stochastic, fault=f)
+        carry2 = (state, k) + ((obs,) if hf else ()) + ((ws,) if hw else ())
+        return carry2, metrics
+
+    carry0 = (state0, key)
+    if hf:
+        carry0 += (jax.tree.map(lambda x: x[0], xs),)
+    if hw:
+        carry0 += (_wl_zero(params),)
+    inps = (xs, t0) + ((faults,) if hf else ()) + (
+        (workloads,) if hw else ())
+    (final, *_), metrics = jax.lax.scan(body, carry0, inps, unroll=_UNROLL)
     return final, metrics
 
 
@@ -139,17 +175,19 @@ def rollout_actions(params: SimParams,
                     key: jax.Array,
                     *,
                     stochastic: bool = False,
-                    faults=None) -> tuple[ClusterState, StepMetrics]:
+                    faults=None,
+                    workloads=None) -> tuple[ClusterState, StepMetrics]:
     """Rollout under a precomputed action sequence (leading axis = T).
 
     This is the diff-MPC path: gradients flow from episode objectives back
-    through `scan` into every action of the plan. ``faults``: optional
-    time-major FaultStep pytree — a plan observes nothing, so only the
-    dynamics-side disturbances apply (the playback kernel's contract).
+    through `scan` into every action of the plan. ``faults``/
+    ``workloads``: optional time-major pytrees — a plan observes
+    nothing, so only the dynamics-side disturbances/queues apply (the
+    playback kernel's contract).
     """
     xs = exo_steps(trace)
 
-    if faults is None:
+    if faults is None and workloads is None:
         def body(carry, inp):
             state, k = carry
             exo, action = inp
@@ -162,16 +200,29 @@ def rollout_actions(params: SimParams,
                                            (xs, actions), unroll=_UNROLL)
         return final, metrics
 
-    def body(carry, inp):
-        state, k = carry
-        exo, action, f = inp
-        k, sub = jax.random.split(k)
-        state, metrics = step(params, state, action, exo, sub,
-                              stochastic=stochastic, fault=f)
-        return (state, k), metrics
+    hf, hw = faults is not None, workloads is not None
 
-    (final, _), metrics = jax.lax.scan(
-        body, (state0, key), (xs, actions, faults), unroll=_UNROLL)
+    def body(carry, inp):
+        state, k = carry[0], carry[1]
+        ws = carry[2] if hw else None
+        exo, action = inp[0], inp[1]
+        extra = list(inp[2:])
+        f = extra.pop(0) if hf else None
+        w = extra.pop(0) if hw else None
+        k, sub = jax.random.split(k)
+        if hw:
+            state, metrics, ws = step(params, state, action, exo, sub,
+                                      stochastic=stochastic, fault=f,
+                                      workload=w, wl_state=ws)
+        else:
+            state, metrics = step(params, state, action, exo, sub,
+                                  stochastic=stochastic, fault=f)
+        return (state, k) + ((ws,) if hw else ()), metrics
+
+    carry0 = (state0, key) + ((_wl_zero(params),) if hw else ())
+    inps = (xs, actions) + ((faults,) if hf else ()) + (
+        (workloads,) if hw else ())
+    (final, *_), metrics = jax.lax.scan(body, carry0, inps, unroll=_UNROLL)
     return final, metrics
 
 
@@ -182,7 +233,8 @@ def rollout_summary(params: SimParams,
                     key: jax.Array,
                     *,
                     stochastic: bool = False,
-                    faults=None):
+                    faults=None,
+                    workloads=None):
     """Closed-loop rollout that reduces to episode KPIs *inside* the scan.
 
     :func:`rollout` materializes per-tick :class:`StepMetrics` stacked over
@@ -192,7 +244,8 @@ def rollout_summary(params: SimParams,
     and emits no per-tick output, so memory is O(B) regardless of horizon
     — the fleet-scoring path. Returns ``(final_state, EpisodeSummary)``
     identical (same keys, same dynamics) to
-    ``summarize(params, rollout(...)[1])``.
+    ``summarize(params, rollout(...)[1])``. ``faults``/``workloads``:
+    per :func:`rollout`.
     """
     from ccka_tpu.sim.metrics import SummaryAcc, finalize_summary
 
@@ -201,7 +254,7 @@ def rollout_summary(params: SimParams,
     t0 = jnp.arange(steps, dtype=jnp.int32)
     acc0 = SummaryAcc.zero()
 
-    if faults is None:
+    if faults is None and workloads is None:
         def body(carry, inp):
             state, k, acc = carry
             exo, t = inp
@@ -215,20 +268,40 @@ def rollout_summary(params: SimParams,
                                           (xs, t0), unroll=_UNROLL)
         return final, finalize_summary(params, state0, final, acc, steps)
 
-    def body(carry, inp):
-        state, k, acc, last = carry
-        exo, t, f = inp
-        k, sub = jax.random.split(k)
-        obs = observed_exo(last, exo, f.signal_stale)
-        action = action_fn(state, obs, t)
-        state, metrics = step(params, state, action, exo, sub,
-                              stochastic=stochastic, fault=f)
-        return (state, k, acc.update(params, metrics), obs), None
+    hf, hw = faults is not None, workloads is not None
 
-    last0 = jax.tree.map(lambda x: x[0], xs)
-    (final, _, acc, _), _ = jax.lax.scan(
-        body, (state0, key, acc0, last0), (xs, t0, faults),
-        unroll=_UNROLL)
+    def body(carry, inp):
+        state, k, acc = carry[0], carry[1], carry[2]
+        rest = list(carry[3:])
+        last = rest.pop(0) if hf else None
+        ws = rest.pop(0) if hw else None
+        exo, t = inp[0], inp[1]
+        extra = list(inp[2:])
+        f = extra.pop(0) if hf else None
+        w = extra.pop(0) if hw else None
+        k, sub = jax.random.split(k)
+        obs = observed_exo(last, exo, f.signal_stale) if hf else exo
+        action = action_fn(state, obs, t)
+        if hw:
+            state, metrics, ws = step(params, state, action, exo, sub,
+                                      stochastic=stochastic, fault=f,
+                                      workload=w, wl_state=ws)
+        else:
+            state, metrics = step(params, state, action, exo, sub,
+                                  stochastic=stochastic, fault=f)
+        carry2 = (state, k, acc.update(params, metrics))
+        carry2 += ((obs,) if hf else ()) + ((ws,) if hw else ())
+        return carry2, None
+
+    carry0 = (state0, key, acc0)
+    if hf:
+        carry0 += (jax.tree.map(lambda x: x[0], xs),)
+    if hw:
+        carry0 += (_wl_zero(params),)
+    inps = (xs, t0) + ((faults,) if hf else ()) + (
+        (workloads,) if hw else ())
+    (final, _, acc, *_), _ = jax.lax.scan(body, carry0, inps,
+                                          unroll=_UNROLL)
     return final, finalize_summary(params, state0, final, acc, steps)
 
 
@@ -239,23 +312,29 @@ def batched_rollout_summary(params: SimParams,
                             keys: jax.Array,
                             *,
                             stochastic: bool = False,
-                            faults=None):
+                            faults=None,
+                            workloads=None):
     """`vmap` of :func:`rollout_summary` — per-cluster KPI summaries for
-    fleet batches too large to stack per-tick metrics for. ``faults``:
-    optional batched FaultStep pytree (leaves ``[B, T, ...]``, e.g. from
-    `faults.unpack_fault_lanes`)."""
-    if faults is None:
+    fleet batches too large to stack per-tick metrics for. ``faults``/
+    ``workloads``: optional batched pytrees (leaves ``[B, T, ...]``,
+    e.g. from `faults.unpack_fault_lanes` /
+    `workloads.unpack_workload_lanes`)."""
+    if faults is None and workloads is None:
         fn = jax.vmap(
             lambda s, tr, k: rollout_summary(params, s, action_fn, tr, k,
                                              stochastic=stochastic),
             in_axes=(0, 0, 0))
         return fn(states0, traces, keys)
-    fn = jax.vmap(
-        lambda s, tr, k, f: rollout_summary(params, s, action_fn, tr, k,
-                                            stochastic=stochastic,
-                                            faults=f),
-        in_axes=(0, 0, 0, 0))
-    return fn(states0, traces, keys, faults)
+    hf, hw = faults is not None, workloads is not None
+
+    def one(s, tr, k, f, w):
+        return rollout_summary(params, s, action_fn, tr, k,
+                               stochastic=stochastic, faults=f,
+                               workloads=w)
+
+    fn = jax.vmap(one, in_axes=(0, 0, 0, 0 if hf else None,
+                                0 if hw else None))
+    return fn(states0, traces, keys, faults, workloads)
 
 
 def batched_rollout(params: SimParams,

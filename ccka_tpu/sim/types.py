@@ -54,6 +54,13 @@ class SimParams(NamedTuple):
     consolidate_tau_s: jnp.ndarray    # [] softness of the consolidate-after gate
     latency_base_ms: jnp.ndarray      # [] idle p95 of the latency proxy
     latency_slo_ms: jnp.ndarray       # [] p95 SLO bound (0 = disabled)
+    # Workload-family parameters (ccka_tpu/workloads; unused — but still
+    # present — when the step runs without a WorkloadStep, so one
+    # compiled step serves both modes). The deadline is a STATIC python
+    # int like provision_pipeline_k: it sizes the batch age-pipeline.
+    wl_inference_queue_max: jnp.ndarray  # [] drop inference work beyond
+    wl_inference_slo_ms: jnp.ndarray     # [] inference p95 violation bound
+    wl_batch_deadline_ticks: int         # static: batch age-pipeline depth
 
     @classmethod
     def from_config(cls, cfg: FrameworkConfig) -> "SimParams":
@@ -89,6 +96,10 @@ class SimParams(NamedTuple):
             consolidate_tau_s=jnp.float32(0.25 * sm.dt_s),
             latency_base_ms=jnp.float32(sm.latency_base_ms),
             latency_slo_ms=jnp.float32(sm.latency_slo_ms),
+            wl_inference_queue_max=jnp.float32(
+                cfg.workloads.inference_queue_max),
+            wl_inference_slo_ms=jnp.float32(cfg.workloads.inference_slo_ms),
+            wl_batch_deadline_ticks=int(cfg.workloads.batch_deadline_ticks),
         )
 
 
@@ -163,3 +174,14 @@ class StepMetrics(NamedTuple):
     denied_nodes: jnp.ndarray    # [] spot provisioning denied (ICE), nodes
     delayed_nodes: jnp.ndarray   # [] arrivals held back (delay jitter)
     signal_stale: jnp.ndarray    # [] {0,1} policies saw stale signals
+    # Workload-family counters (ccka_tpu/workloads; all 0 when the step
+    # runs without a WorkloadStep — the pre-workload pipeline's exact
+    # values). Units: pod-equivalents of work (1 pod = 1 unit/tick).
+    inf_queue_depth: jnp.ndarray     # [] inference queue after this tick
+    inf_served: jnp.ndarray          # [] inference work served this tick
+    inf_dropped: jnp.ndarray         # [] load-shed beyond the queue cap
+    inf_slo_violation: jnp.ndarray   # [] {0,1} inference SLO violated
+    batch_backlog: jnp.ndarray       # [] total batch backlog after tick
+    batch_served: jnp.ndarray        # [] batch work served this tick
+    batch_deadline_miss: jnp.ndarray  # [] work aged past its deadline
+    bg_backlog: jnp.ndarray          # [] best-effort backlog after tick

@@ -1,0 +1,58 @@
+"""Heterogeneous workload families: queue dynamics + scenario library.
+
+Three halves (ARCHITECTURE §13), mirroring `ccka_tpu/faults`:
+
+- **Processes** (`workloads/process.py`): diurnal inference traffic
+  with flash-crowd spikes, deadline-driven batch backfill with bursty
+  arrival waves, and a best-effort background family — all pure-jnp,
+  synthesized as extra lanes in the packed exo stream and keyed by the
+  same ``(seed, shard, block)`` PRNG scheme as the exo signals, so
+  every policy being compared sees the bitwise-identical family
+  arrivals.
+- **Consumption**: `sim/dynamics.step` (``workload=``/``wl_state=``
+  kwargs) and the fused Pallas megakernel (workload lanes auto-detected
+  from the packed stream's row count) drain per-family queues from the
+  fleet's headroom — inference with latency/SLO-violation accounting,
+  batch EDF with deadline-miss accounting — surfacing per-family
+  StepMetrics/EpisodeSummary counters.
+- **Scenarios + scoreboard** (`workloads/scenarios.py`,
+  `workloads/scoreboard.py`): the named scenario library
+  (`WORKLOAD_SCENARIOS`: diurnal-inference / flash-crowd /
+  batch-backfill / mixed, composable with `FAULT_PRESETS`) and the
+  per-family scoreboard — `bench.py bench_workloads` and
+  `ccka scenario-eval` both drive it; `ccka scenarios` lists the
+  library.
+"""
+
+from ccka_tpu.config import WorkloadsConfig  # noqa: F401
+from ccka_tpu.workloads.process import (  # noqa: F401
+    has_workload_lanes,
+    packed_workload_lanes,
+    sample_workload_steps,
+    stream_layout,
+    unpack_workload_lanes,
+    workload_rows,
+)
+from ccka_tpu.workloads.scenarios import (  # noqa: F401
+    Scenario,
+    WORKLOAD_SCENARIOS,
+    resolve_scenarios,
+    scenario_source,
+)
+from ccka_tpu.workloads.types import WorkloadState, WorkloadStep  # noqa: F401
+
+__all__ = [
+    "WORKLOAD_SCENARIOS",
+    "Scenario",
+    "WorkloadState",
+    "WorkloadStep",
+    "WorkloadsConfig",
+    "has_workload_lanes",
+    "packed_workload_lanes",
+    "resolve_scenarios",
+    "sample_workload_steps",
+    "scenario_source",
+    "stream_layout",
+    "unpack_workload_lanes",
+    "workload_rows",
+]

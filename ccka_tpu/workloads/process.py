@@ -1,0 +1,171 @@
+"""Batched workload-family demand generators, synthesized as packed lanes.
+
+The workload subsystem's generation half, mirroring `faults/process.py`:
+pure-jnp processes emitting ``[T_pad, workload_rows(Z), B]`` lane blocks
+that ride the SAME packed exo stream the megakernel reads. Because the
+lanes are part of stream synthesis they inherit every pairing property
+of the exo signals: shard-local on a mesh (`parallel/sharded_kernel.
+sharded_packed_trace` runs the generator per shard on ``fold_in(key,
+shard)``), and bitwise identical for every policy scored on the stream —
+rule, flagship and MPC-playback see the same flash crowd.
+
+Lane layout, offsets relative to the workload block base (which sits
+AFTER the fault block when one is present — see :func:`stream_layout`):
+
+    row 0   inf_arrivals    inference work arriving this tick (pods)
+    row 1   batch_arrivals  batch work arriving this tick (pod-ticks)
+    row 2   bg_arrivals     best-effort background work
+    rows pad to ``workload_rows(Z) = fault_rows(Z) + 8`` (zeros)
+
+The +8 over the fault block's size is deliberate: layout detection is
+purely row-count-based (`stream_layout`), and the four layouts — plain,
++faults, +workloads, +both — must be mutually distinguishable for any
+zone count; sizing the workload block ``fault_rows(Z) + 8`` guarantees
+all four counts are distinct without threading any side-channel flag.
+
+Flash-crowd / burst-wave windows reuse the fault subsystem's
+thresholded stationary AR(1) family (`faults/process._window`); diurnal
+shape reuses the signal generator's `_bump`. The neutral contract: with
+every rate at 0 the emitted lanes are EXACTLY 0 — consuming them is a
+no-op (queues stay empty, counters zero), which is what lets the
+zero-workload gate (`tests/test_workloads.py`) pin the widened pipeline
+against the pre-workload one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ccka_tpu.config import WorkloadsConfig
+from ccka_tpu.faults.process import _window
+from ccka_tpu.signals.synthetic import _ar1_device, _bump
+from ccka_tpu.sim import lanes
+from ccka_tpu.workloads.types import WorkloadStep
+
+_DAY_S = 86400.0
+
+# Key-domain tag separating the workload latents from the exo noise AND
+# the fault latents (FAULT_KEY_TAG = 0xFA117): folded into the same
+# generation key, so widening a stream with workload lanes changes
+# neither the exo rows nor the fault rows bitwise.
+WORKLOAD_KEY_TAG = 0x301AD
+
+
+# The layout arithmetic lives in the neutral `sim/lanes.py` (the one
+# layout module — faults and workloads both import it DOWNWARD, never
+# each other); re-exported here for the existing `workloads.*` surface.
+workload_rows = lanes.workload_rows
+stream_layout = lanes.stream_layout
+workload_base = lanes.workload_base
+
+
+def packed_workload_lanes(wl: WorkloadsConfig, key, steps: int, t_pad: int,
+                          Z: int, batch: int, *,
+                          dt_s: float, start_unix_s: float = 0.0,
+                          start_offset_s=None,
+                          wrap_period_s: float | None = None) -> jnp.ndarray:
+    """``[T_pad, workload_rows(Z), B]`` lane block for one stream.
+
+    Pure jnp — runs inside the (possibly shard_map'd) generation jit.
+    ``dt_s``/``start_unix_s`` anchor the diurnal shapes to the same
+    clock the exo generator uses. ``start_offset_s``: optional ``[B]``
+    per-trace second offsets added to that clock — the replay backend
+    samples each window at a different offset into its stored trace, and
+    the diurnal/anti-diurnal family shapes must stay phase-aligned with
+    the exo demand each window actually replays (``None``: one shared
+    clock, the synthetic backend's contract). ``wrap_period_s``: the
+    store's length in seconds — a window running past the store end
+    replays samples that jump back to store-start wall-clock, so the
+    lane clock must wrap with it or the family shapes de-phase for the
+    wrapped tail.
+    """
+    ki, kif, kb, kbf, kg = jax.random.split(
+        jax.random.fold_in(key, WORKLOAD_KEY_TAG), 5)
+    f32 = jnp.float32
+    t = start_unix_s + np.arange(steps) * dt_s
+    if start_offset_s is None:
+        tod = jnp.asarray((t % _DAY_S) / _DAY_S, f32)[:, None]      # [T,1]
+    else:
+        # Per-window seconds into the store, wrapped to the store
+        # period (the clock of the sample each tick actually replays),
+        # then anchored to the recorded start. The day reduction
+        # happens in float64 / at small magnitudes BEFORE the f32
+        # cast: at unix-epoch scale (~1.7e9 s) the f32 ulp is 128 s,
+        # which would quantize the 30 s tick grid into a staircase and
+        # corrupt the per-window phase these offsets exist to carry.
+        t_rel = (jnp.asarray(np.arange(steps) * dt_s, f32)[:, None]
+                 + jnp.asarray(start_offset_s, f32)[None, :])       # [T,B]
+        if wrap_period_s is not None:
+            t_rel = t_rel % f32(wrap_period_s)
+        tt = f32(start_unix_s % _DAY_S) + (t_rel % f32(_DAY_S))
+        tod = (tt % _DAY_S) / _DAY_S
+
+    # Inference: diurnal concurrent load (same 14:00-centered peak as the
+    # demand signal) x flash-crowd spikes while a crowd window is active.
+    diurnal = 0.4 + 0.6 * _bump(tod, center=14.0 / 24, width=5.0 / 24,
+                                xp=jnp)                          # [T,1]
+    noise_i = _ar1_device(ki, (steps, batch), rho=0.9, sigma=0.2, axis=0)
+    flash = _window(kif, (steps, batch), frac=wl.inference_flash_frac,
+                    mean_ticks=wl.inference_flash_mean_ticks)
+    inf = (f32(wl.inference_rate_pods) * diurnal * (1.0 + noise_i)
+           * (1.0 + (f32(wl.inference_flash_mult) - 1.0) * flash))
+    inf = jnp.maximum(inf, 0.0)
+
+    # Batch backfill: anti-diurnal (runs when the fleet is slack) with
+    # bursty arrival waves.
+    anti = 1.5 - _bump(tod, center=14.0 / 24, width=5.0 / 24, xp=jnp)
+    noise_b = _ar1_device(kb, (steps, batch), rho=0.85, sigma=0.3, axis=0)
+    burst = _window(kbf, (steps, batch), frac=wl.batch_burst_frac,
+                    mean_ticks=wl.batch_burst_mean_ticks)
+    bat = (f32(wl.batch_rate_pods) * anti * (1.0 + noise_b)
+           * (1.0 + (f32(wl.batch_burst_mult) - 1.0) * burst))
+    bat = jnp.maximum(bat, 0.0)
+
+    # Background: flat best-effort filler with mild noise.
+    noise_g = _ar1_device(kg, (steps, batch), rho=0.9, sigma=0.2, axis=0)
+    bg = jnp.maximum(f32(wl.background_rate_pods) * (1.0 + noise_g), 0.0)
+
+    block = jnp.stack([inf, bat, bg], axis=1).astype(f32)  # [T, 3, B]
+    return jnp.pad(block, ((0, t_pad - steps),
+                           (0, workload_rows(Z) - block.shape[1]), (0, 0)))
+
+
+def has_workload_lanes(exo_packed, Z: int) -> bool:
+    """Whether a packed stream carries the workload lane block — row-
+    count detection like `faults.has_fault_lanes` (raises on malformed
+    layouts)."""
+    return stream_layout(int(exo_packed.shape[1]), Z)[1]
+
+
+def unpack_workload_lanes(exo_packed, T: int, Z: int) -> WorkloadStep:
+    """Workload lanes of a widened stream → batched time-major
+    :class:`WorkloadStep` (leaves ``[B, T]``) for the lax rollout path —
+    the parity-test/bench plumbing mirror of `megakernel.unpack_exo`
+    (it pays the transpose the packed path exists to skip; hot paths
+    never call it)."""
+    wb = workload_base(int(exo_packed.shape[1]), Z)
+    x = exo_packed[:T, wb:wb + 3]
+    return WorkloadStep(
+        inf_arrivals=jnp.transpose(x[:, 0], (1, 0)),     # [B, T]
+        batch_arrivals=jnp.transpose(x[:, 1], (1, 0)),
+        bg_arrivals=jnp.transpose(x[:, 2], (1, 0)),
+    )
+
+
+def sample_workload_steps(wl: WorkloadsConfig, key, steps: int, Z: int,
+                          *, dt_s: float = 30.0,
+                          start_unix_s: float = 0.0) -> WorkloadStep:
+    """Single-trace time-major WorkloadStep (leaves ``[T]``) for
+    standalone lax rollouts and the live controller's workload track —
+    same processes, same key-tag scheme as the packed lanes (a batch=1
+    synthesis, squeezed)."""
+    lanes = packed_workload_lanes(wl, key, steps, steps, Z, 1,
+                                  dt_s=dt_s, start_unix_s=start_unix_s)
+    return WorkloadStep(
+        inf_arrivals=lanes[:steps, 0, 0],
+        batch_arrivals=lanes[:steps, 1, 0],
+        bg_arrivals=lanes[:steps, 2, 0],
+    )

@@ -1,0 +1,125 @@
+"""The named scenario library: workload-family mixes x fault presets.
+
+Round 10 gave fault intensities names (`config.FAULT_PRESETS`) so the
+robustness board reads "severe", not a bag of floats; this module does
+the same for workload mixes. A :class:`Scenario` is a named, validated
+(workload-family mix, fault preset) pair — the benchmark vocabulary the
+per-family scoreboard (`workloads/scoreboard.py`), `bench.py
+bench_workloads`, and the `ccka scenarios` / `ccka scenario-eval` CLI
+all share, and the axis every later mixed-workload comparison
+(geo-arbitrage, fleet service, distillation factory) will sweep.
+
+Rates are sized against the demo topology (60-pod burst peak, 9 pods/
+node, 3 base nodes): the inference family is a material fraction of the
+fleet's typical headroom so queues genuinely build under tight fleets,
+and the batch family needs sustained slack to meet deadlines — which is
+exactly what makes per-family columns separate policies that look
+identical on the aggregate $/SLO-hr headline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ccka_tpu.config import FAULT_PRESETS, WorkloadsConfig
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One named benchmark scenario.
+
+    ``fault_preset`` names a `config.FAULT_PRESETS` entry composed into
+    the same stream ("" = calm weather, no fault lanes) — scenarios and
+    fault intensities are orthogonal axes sharing one generation key,
+    so a faulted scenario's exo AND workload rows stay bitwise identical
+    to its calm twin's.
+    """
+
+    name: str
+    description: str
+    workloads: WorkloadsConfig
+    fault_preset: str = ""
+
+    def validate(self) -> None:
+        self.workloads.validate()
+        if not self.workloads.enabled:
+            raise ValueError(f"scenario {self.name!r}: workloads disabled")
+        if self.fault_preset and self.fault_preset not in FAULT_PRESETS:
+            raise ValueError(
+                f"scenario {self.name!r}: unknown fault preset "
+                f"{self.fault_preset!r}; presets: {sorted(FAULT_PRESETS)}")
+
+    def family_mix(self) -> dict[str, float]:
+        """Mean arrival rate per family (the `ccka scenarios` listing)."""
+        w = self.workloads
+        return {"inference": w.inference_rate_pods,
+                "batch": w.batch_rate_pods,
+                "background": w.background_rate_pods}
+
+
+WORKLOAD_SCENARIOS: dict[str, Scenario] = {
+    "diurnal-inference": Scenario(
+        name="diurnal-inference",
+        description="latency-sensitive inference serving: diurnal "
+                    "request load with occasional mild flash crowds",
+        workloads=WorkloadsConfig(
+            enabled=True, inference_rate_pods=6.0,
+            inference_flash_frac=0.02, inference_flash_mult=3.0)),
+    "flash-crowd": Scenario(
+        name="flash-crowd",
+        description="inference serving under heavy flash crowds: the "
+                    "same diurnal base, 8x spikes in frequent windows",
+        workloads=WorkloadsConfig(
+            enabled=True, inference_rate_pods=6.0,
+            inference_flash_frac=0.06, inference_flash_mult=8.0,
+            inference_flash_mean_ticks=8)),
+    "batch-backfill": Scenario(
+        name="batch-backfill",
+        description="deadline-driven batch backfill waves (anti-diurnal) "
+                    "plus a best-effort background floor",
+        workloads=WorkloadsConfig(
+            enabled=True, batch_rate_pods=5.0, batch_burst_frac=0.08,
+            batch_burst_mult=6.0, background_rate_pods=3.0)),
+    "mixed": Scenario(
+        name="mixed",
+        description="all three families sharing one fleet, under mild "
+                    "fault weather (the millions-of-users composite)",
+        workloads=WorkloadsConfig(
+            enabled=True, inference_rate_pods=6.0,
+            inference_flash_frac=0.04, inference_flash_mult=6.0,
+            batch_rate_pods=5.0, batch_burst_frac=0.06,
+            background_rate_pods=3.0),
+        fault_preset="mild"),
+}
+
+
+def resolve_scenarios(names) -> dict[str, Scenario]:
+    """Validated name→Scenario map; rejects unknown names UP FRONT
+    (mirroring the round-10 unknown-policy/intensity guard — a typo
+    must not run a long sweep and emit a board missing that row)."""
+    names = [n for n in names if n]
+    if not names:
+        raise ValueError(f"no scenarios named; library: "
+                         f"{sorted(WORKLOAD_SCENARIOS)}")
+    bad = [n for n in names if n not in WORKLOAD_SCENARIOS]
+    if bad:
+        raise ValueError(f"unknown scenarios {bad}; library: "
+                         f"{sorted(WORKLOAD_SCENARIOS)}")
+    out = {n: WORKLOAD_SCENARIOS[n] for n in names}
+    for sc in out.values():
+        sc.validate()
+    return out
+
+
+def scenario_source(cfg, scenario: Scenario):
+    """A SyntheticSignalSource generating this scenario's widened stream
+    (workload lanes, plus fault lanes when the scenario names a
+    preset). All scenarios driven from ONE key share bitwise-identical
+    exo rows — the cross-scenario pairing the scoreboard leans on."""
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+    faults = (FAULT_PRESETS[scenario.fault_preset]
+              if scenario.fault_preset else None)
+    return SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                 cfg.signals, faults=faults,
+                                 workloads=scenario.workloads)

@@ -1,0 +1,239 @@
+"""Per-family scenario scoreboard: policies × named workload scenarios.
+
+Scores {rule, flagship, MPC-playback} (plus optional carbon) on the SAME
+``n_traces`` paired worlds for each named scenario
+(`workloads/scenarios.WORKLOAD_SCENARIOS`) through the megakernel path,
+and reports the aggregate $/SLO-hr headline NEXT TO the per-family
+columns — inference SLO-violation ticks / queue depth / load-shed and
+batch deadline misses / backlog — that separate policies the aggregate
+hides. The pairing properties mirror the round-10 fault board:
+
+- **Across policies**: every row of one scenario shares one
+  (stream, seed, b_block, t_chunk) — identical worlds AND identical
+  family arrivals (the lanes are part of the stream).
+- **Across scenarios**: all scenarios are generated from one key, so
+  the exo rows are bitwise identical — scenario columns differ only by
+  the family mix (and, for fault-composed scenarios, the fault lanes),
+  not by different price/carbon weather.
+- **MPC plans blind**: the planner sees the clean exo trace (family
+  arrivals are not part of its objective), the kernel executes the plan
+  on the workload-laden world — open-loop plans pay for the headroom
+  they didn't reserve, which is exactly the effect worth measuring.
+
+On TPU this runs the Mosaic kernels in stochastic mode at full-day
+horizons; elsewhere interpret-mode deterministic at CI sizes (labeled —
+the per-family column CONTRASTS are the result, not wall-clock). Used
+by `bench.py bench_workloads` (records BASELINE round11) and the
+`ccka scenario-eval` CLI.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccka_tpu.config import FrameworkConfig
+from ccka_tpu.workloads.scenarios import resolve_scenarios, scenario_source
+
+# Aggregate headline + the per-family columns, per row.
+_ROW_FIELDS = ("usd_per_slo_hour", "slo_attainment",
+               "inf_slo_violations", "inf_queue_mean", "inf_dropped",
+               "batch_deadline_misses", "batch_backlog_mean")
+
+
+def _row(summary) -> dict:
+    return {k: round(float(np.asarray(getattr(summary, k),
+                                      np.float64).mean()), 4)
+            for k in _ROW_FIELDS}
+
+
+def workload_scoreboard(cfg: FrameworkConfig, *,
+                        scenarios=("diurnal-inference", "flash-crowd",
+                                   "batch-backfill", "mixed"),
+                        policies=("rule", "flagship", "mpc"),
+                        n_traces: int = 256,
+                        eval_steps: int | None = None,
+                        seed: int = 31,
+                        trace_seed: int = 97) -> dict:
+    """The scenario board (module docstring). ``scenarios`` name
+    `WORKLOAD_SCENARIOS` entries, ``policies`` ⊆ {rule, carbon,
+    flagship, mpc} — both validated UP FRONT (the round-10 guard: a
+    typo must not run the sweep and emit a board missing that row)."""
+    from ccka_tpu.models import action_to_latent, latent_to_action
+    from ccka_tpu.policy import CarbonAwarePolicy
+    from ccka_tpu.policy.rule import (neutral_action, offpeak_action,
+                                      peak_action)
+    from ccka_tpu.sim import SimParams, initial_state
+    from ccka_tpu.sim.megakernel import (
+        carbon_megakernel_summary_from_packed,
+        megakernel_summary_from_packed,
+        neural_megakernel_summary_from_packed, pack_plan,
+        plan_megakernel_summary_from_packed, unpack_exo)
+    from ccka_tpu.train.flagship import load_flagship_backend
+    from ccka_tpu.train.mpc import receding_horizon_plan_batch
+    from ccka_tpu.workloads.process import unpack_workload_lanes
+
+    library = resolve_scenarios(scenarios)
+    known_policies = ("rule", "carbon", "flagship", "mpc")
+    bad = [p for p in policies if p not in known_policies]
+    if bad:
+        raise ValueError(f"unknown policies {bad}; known: "
+                         f"{list(known_policies)}")
+
+    on_tpu = jax.default_backend() == "tpu"
+    steps = eval_steps or (2880 if on_tpu else 96)
+    t_chunk = 64 if on_tpu else 32
+    b_block = min(256, n_traces)
+    if n_traces % b_block:
+        raise ValueError(f"n_traces={n_traces} must be a multiple of "
+                         f"b_block={b_block}")
+    kw = dict(seed=seed, stochastic=on_tpu, b_block=b_block,
+              t_chunk=t_chunk, interpret=not on_tpu)
+    import dataclasses as _dc
+    params = SimParams.from_config(cfg)
+    # Queue/SLO/deadline knobs are SCENARIO properties (`ccka scenarios`
+    # lists them per scenario) — score each scenario under its OWN
+    # WorkloadsConfig, not the caller's.
+    sc_params = {name: SimParams.from_config(
+        _dc.replace(cfg, workloads=sc.workloads))
+        for name, sc in library.items()}
+    cluster = cfg.cluster
+    Z = cluster.n_zones
+    off_a, peak_a = offpeak_action(cluster), peak_action(cluster)
+    key = jax.random.key(trace_seed)
+
+    # One stream per scenario, all from ONE key: exo rows bitwise
+    # shared, family lanes per scenario mix. Generated lazily — one
+    # resident stream at a time; a full board would otherwise pin 4+
+    # [T_pad, rows, B] device buffers for the whole multi-policy sweep.
+    def _scenario_stream(sc):
+        return scenario_source(cfg, sc).packed_trace_device(
+            steps, key, n_traces, t_chunk=t_chunk)
+
+    out: dict = {
+        "engine": "megakernel(workload lanes)",
+        "n_traces": n_traces, "eval_steps": steps,
+        "stochastic": on_tpu, "interpret": not on_tpu,
+        "b_block": b_block, "t_chunk": t_chunk, "seed": seed,
+        "policies": list(policies),
+        "row_fields": list(_ROW_FIELDS),
+        "scenarios": {},
+    }
+
+    flagship = None
+    if "flagship" in policies:
+        flagship, meta = load_flagship_backend(cfg)
+        if flagship is None:
+            out["flagship_source"] = ("omitted: no flagship checkpoint "
+                                      "for this topology (no stand-ins)")
+        else:
+            out["flagship_source"] = {
+                "checkpoint": "topology-keyed flagship",
+                "selected_iteration": meta.get("selected_iteration")}
+
+    plan_packed = None
+    first_stream = None
+    if "mpc" in policies:
+        # Plan ONCE on the clean exo world (exo rows are shared across
+        # scenarios, and the planner is blind to family arrivals, so
+        # one plan serves every scenario row): lax quick planner per
+        # paired trace, kernel playback on the workload-laden worlds.
+        quick = dict(horizon=8, replan_every=8, iters=2)
+        out["mpc_planner"] = dict(
+            quick, n_traces=n_traces,
+            mode="lax_quick_plan(clean exo)->kernel_playback(scenario)")
+        # Any scenario's stream carries the shared exo rows; the first
+        # scenario's is generated here and handed to its own scoring
+        # iteration below (not regenerated).
+        first_stream = _scenario_stream(next(iter(library.values())))
+        traces = unpack_exo(first_stream, steps, Z)
+        base = jnp.zeros_like(action_to_latent(neutral_action(cluster),
+                                               cluster))
+        lat0 = jnp.broadcast_to(
+            base, (n_traces, quick["horizon"]) + base.shape)
+        states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n_traces,) + x.shape),
+            initial_state(cfg))
+        plans = receding_horizon_plan_batch(
+            params, cluster, cfg.train, states, traces, lat0, **quick)
+        plan_actions = jax.vmap(jax.vmap(
+            lambda u: latent_to_action(u, cluster)))(plans)
+        import math as _math
+        t_pad = _math.ceil(steps / t_chunk) * t_chunk
+        plan_packed = pack_plan(plan_actions, t_pad)
+        # Plan-stream geometry on the record: the playback row streams
+        # these rows ON TOP of the scenario stream (bench's mpc floor).
+        out["mpc_planner"]["plan_rows"] = int(plan_packed.shape[1])
+
+    cp = CarbonAwarePolicy(cluster)
+    for name, sc in library.items():
+        if first_stream is not None:
+            stream, first_stream = first_stream, None
+        else:
+            stream = _scenario_stream(sc)
+        sp = sc_params[name]
+        rows: dict[str, dict] = {}
+        if "rule" in policies:
+            rows["rule"] = _row(megakernel_summary_from_packed(
+                sp, off_a, peak_a, stream, steps, **kw))
+        if "carbon" in policies:
+            rows["carbon"] = _row(carbon_megakernel_summary_from_packed(
+                sp, off_a, peak_a, stream, steps,
+                sharpness=cp.sharpness, min_weight=cp.min_weight,
+                stickiness=cp.stickiness, **kw))
+        if flagship is not None:
+            rows["flagship"] = _row(
+                neural_megakernel_summary_from_packed(
+                    sp, cluster, flagship.params, stream, steps,
+                    **kw))
+        if plan_packed is not None:
+            rows["mpc"] = _row(plan_megakernel_summary_from_packed(
+                sp, cluster, plan_packed, stream, steps, **kw))
+        # Stream-level family exposure (identical for every policy row
+        # — the pairing, stated on the record) + the stream geometry
+        # bench needs for its per-row roofline floors.
+        wl = unpack_workload_lanes(stream, steps, Z)
+        exposure = {
+            "inference_arrivals_mean": round(
+                float(np.asarray(wl.inf_arrivals).mean()), 4),
+            "batch_arrivals_mean": round(
+                float(np.asarray(wl.batch_arrivals).mean()), 4),
+            "background_arrivals_mean": round(
+                float(np.asarray(wl.bg_arrivals).mean()), 4),
+        }
+        out["scenarios"][name] = {
+            "description": sc.description,
+            "family_mix": sc.family_mix(),
+            "fault_preset": sc.fault_preset or None,
+            "stream_rows": int(stream.shape[1]),
+            "stream_bytes_per_cluster_tick": 4 * int(stream.shape[1]),
+            "exposure": exposure,
+            "rows": rows,
+        }
+        print(f"# workloads[{name}]: " + " ".join(
+            f"{p}={r['inf_slo_violations']:.1f}viol/"
+            f"{r['batch_deadline_misses']:.1f}miss"
+            f"@{r['slo_attainment']:.3f}" for p, r in rows.items()),
+            file=sys.stderr)
+
+    # Cross-scenario per-family comparison table: one line per policy,
+    # the columns every later mixed-workload axis sweeps.
+    compare = {}
+    for p in next(iter(out["scenarios"].values()))["rows"]:
+        compare[p] = {
+            "scenarios": list(out["scenarios"]),
+            "inf_slo_violations": [
+                out["scenarios"][s]["rows"][p]["inf_slo_violations"]
+                for s in out["scenarios"]],
+            "batch_deadline_misses": [
+                out["scenarios"][s]["rows"][p]["batch_deadline_misses"]
+                for s in out["scenarios"]],
+            "usd_per_slo_hour": [
+                out["scenarios"][s]["rows"][p]["usd_per_slo_hour"]
+                for s in out["scenarios"]],
+        }
+    out["per_family_curves"] = compare
+    return out

@@ -1,0 +1,69 @@
+"""Workload-family pytrees consumed by the simulator step.
+
+Kept in their own leaf module (imports only jnp) so `sim/dynamics.py`
+can take a :class:`WorkloadStep`/:class:`WorkloadState` without creating
+a cycle with the workload *synthesis* side (`workloads/process.py`,
+which imports the signal layer) — the same split `faults/types.py` uses.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class WorkloadStep(NamedTuple):
+    """One tick of workload-family arrivals (a time-slice of the
+    workload lanes). All values are pod-equivalents of concurrent work:
+    one pod serves one unit per tick. A leading batch/time axis, when
+    present, is handled by ``vmap``/``scan`` like
+    :class:`~ccka_tpu.sim.dynamics.ExoStep`.
+
+    Attributes:
+      inf_arrivals:   [] inference request load arriving this tick
+        (diurnal + flash crowds; served from fleet headroom with
+        priority).
+      batch_arrivals: [] batch work arriving this tick (bursty backfill
+        waves; drained EDF from the headroom left after inference, with
+        a deadline of ``batch_deadline_ticks``).
+      bg_arrivals:    [] best-effort background work (consumes whatever
+        headroom remains; backlog only, no SLO).
+    """
+
+    inf_arrivals: jnp.ndarray
+    batch_arrivals: jnp.ndarray
+    bg_arrivals: jnp.ndarray
+
+    @classmethod
+    def neutral(cls) -> "WorkloadStep":
+        """The no-op arrival tick: consuming it leaves every queue and
+        counter at zero (pinned by `tests/test_workloads.py`)."""
+        z = jnp.float32(0.0)
+        return cls(inf_arrivals=z, batch_arrivals=z, bg_arrivals=z)
+
+
+class WorkloadState(NamedTuple):
+    """Per-family queue state carried across ticks.
+
+    Attributes:
+      inf_queue:     [] unserved inference work (bounded by
+        ``inference_queue_max``; the excess is dropped = load-shed).
+      batch_backlog: [D] unfinished batch work by age: slot k = work
+        that has waited k ticks (slot 0 = arrived this tick). Slot D-1
+        is always 0 after an update — work reaching that age unserved
+        was dropped as a deadline miss. D = ``batch_deadline_ticks``.
+      bg_backlog:    [] best-effort backlog (unbounded; arrival rates
+        are bounded by config).
+    """
+
+    inf_queue: jnp.ndarray
+    batch_backlog: jnp.ndarray
+    bg_backlog: jnp.ndarray
+
+    @classmethod
+    def zero(cls, deadline_ticks: int) -> "WorkloadState":
+        z = jnp.float32(0.0)
+        return cls(inf_queue=z,
+                   batch_backlog=jnp.zeros((deadline_ticks,), jnp.float32),
+                   bg_backlog=z)

@@ -126,6 +126,71 @@ class TestDynamicsInvariants:
             assert float(m.latency_p95_ms) >= 0.0
             assert 0.0 <= float(m.slo_ok) <= 1.0
 
+    def test_workload_queue_conservation(self, cfg):
+        """Per-family queue conservation (ISSUE 6): for every fuzzed
+        tick, arrivals − served − dropped == Δqueue — EXACT in f32
+        accounting for the inference queue (the test replays the step's
+        own f32 op order bit-for-bit), and to f32-rounding tolerance
+        for the bucketed batch pipeline / background backlog (their
+        deltas sum across buckets, so only the per-op roundings
+        differ)."""
+        import dataclasses
+
+        from ccka_tpu.config import WorkloadsConfig
+        from ccka_tpu.workloads.types import WorkloadState, WorkloadStep
+
+        wl_cfg = WorkloadsConfig(enabled=True, inference_queue_max=12.0,
+                                 batch_deadline_ticks=5)
+        params = SimParams.from_config(
+            dataclasses.replace(cfg, workloads=wl_cfg))
+        cl = cfg.cluster
+        jstep = jax.jit(lambda s, a, e, w, ws, k: step(
+            params, s, a, e, k, stochastic=True, workload=w, wl_state=ws))
+        state = initial_state(cfg)
+        ws = WorkloadState.zero(int(params.wl_batch_deadline_ticks))
+        f32 = np.float32
+        for i in range(N_FUZZ):
+            k = jax.random.key(2000 + i)
+            ka, ke, kw, ks = jax.random.split(k, 4)
+            action = project_feasible(
+                _random_action(ka, cl.n_pools, cl.n_zones), cl)
+            exo = _random_exo(ke, cl.n_zones)
+            r = jax.random.uniform(kw, (3,), minval=0.0, maxval=25.0)
+            wl = WorkloadStep(inf_arrivals=r[0], batch_arrivals=r[1],
+                              bg_arrivals=r[2])
+            prev = ws
+            state, m, ws = jstep(state, action, exo, wl, ws, ks)
+
+            # Inference: EXACT f32 replay of the step's op order
+            # q2 = ((q + a) − served) − dropped.
+            in_q = f32(f32(prev.inf_queue) + f32(r[0]))
+            q2 = f32(f32(in_q - f32(m.inf_served)) - f32(m.inf_dropped))
+            assert q2 == f32(ws.inf_queue), i
+            assert float(ws.inf_queue) <= 12.0 + 1e-4
+
+            # Batch: arrivals − served − missed == Δbacklog (f64 over
+            # the f32 bucket values; per-bucket roundings only).
+            d_bl = (np.asarray(ws.batch_backlog, np.float64).sum()
+                    - np.asarray(prev.batch_backlog, np.float64).sum())
+            lhs = (float(r[1]) - float(m.batch_served)
+                   - float(m.batch_deadline_miss))
+            assert abs(lhs - d_bl) < 1e-3 * max(1.0, abs(lhs)), i
+            # The aged-out slot is always drained (state invariant).
+            assert float(np.asarray(ws.batch_backlog)[-1]) == 0.0
+
+            # Background: backlog only ever grows by at most arrivals.
+            d_bg = float(ws.bg_backlog) - float(prev.bg_backlog)
+            assert d_bg <= float(r[2]) + 1e-4
+            assert float(ws.bg_backlog) >= -1e-6
+
+            # Counters physical and finite.
+            for fname in ("inf_queue_depth", "inf_served", "inf_dropped",
+                          "batch_backlog", "batch_served",
+                          "batch_deadline_miss", "bg_backlog"):
+                v = float(getattr(m, fname))
+                assert np.isfinite(v) and v >= -1e-6, fname
+            assert float(m.inf_slo_violation) in (0.0, 1.0)
+
     def test_no_nan_under_degenerate_inputs(self, cfg):
         """Zero demand, zero prices... the step must stay finite (guards
         against division blowups in utilization/latency/accounting)."""

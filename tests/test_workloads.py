@@ -1,0 +1,564 @@
+"""Workload-family subsystem tests (ISSUE 6, ARCHITECTURE §13).
+
+Four contracts, mirroring the round-10 fault gates:
+
+- **Zero-workload bitwise gate**: with workloads DISABLED the packed
+  stream and every consumer take the exact pre-workload code path —
+  bitwise identical arrays/summaries, protecting every recorded
+  BASELINE/BENCH number. The enabled-but-neutral config (all rates 0)
+  additionally pins exo/fault-row bitwise identity plus summary
+  equality to 1e-5 (the workload-mode kernel is a DIFFERENT XLA
+  program) with the family counters exactly zero.
+- **Queue semantics**: inference queue/cap/violation, batch EDF aging
+  to deadline misses, background best-effort — unit-level on
+  `dynamics.step`'s workload path (the conservation invariant lives in
+  `tests/test_invariants.py`).
+- **Kernel↔lax workload parity**: the workload-mode kernel (fault+
+  workload widened stream — the most layered program) matches the
+  workloads-threaded lax rollout on the same lanes, deterministic
+  interpret mode, under the ONE shared tolerance table.
+- **Paired realization**: every policy scored on one stream sees the
+  same family arrivals — rule vs plan-playback on one widened stream,
+  plus the 8-shard shard-local generation pin (slow lane).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import (ConfigError, FrameworkConfig,
+                             WorkloadsConfig)
+from ccka_tpu.policy import RulePolicy
+from ccka_tpu.policy.rule import offpeak_action, peak_action
+from ccka_tpu.signals.synthetic import SyntheticSignalSource
+from ccka_tpu.sim import SimParams, initial_state
+from ccka_tpu.sim.dynamics import ExoStep, step
+from ccka_tpu.sim.megakernel import (
+    _exo_rows,
+    megakernel_summary_from_packed,
+    pack_plan,
+    plan_megakernel_summary_from_packed,
+    unpack_exo,
+)
+from ccka_tpu.sim.rollout import batched_rollout_summary
+from ccka_tpu.workloads import (
+    WORKLOAD_SCENARIOS,
+    WorkloadState,
+    WorkloadStep,
+    resolve_scenarios,
+    sample_workload_steps,
+    stream_layout,
+    unpack_workload_lanes,
+    workload_rows,
+)
+from ccka_tpu.faults.process import fault_rows, unpack_fault_lanes
+
+STEPS, B, T_CHUNK, B_BLOCK = 48, 16, 8, 8
+KERNEL_KW = dict(stochastic=False, b_block=B_BLOCK, t_chunk=T_CHUNK,
+                 interpret=True)
+
+# A deliberately HOT mix for the parity/paired tests: tight queue cap
+# and a short deadline so violations AND misses both fire within the
+# CI-sized 48-tick window starting at midnight.
+HOT = WorkloadsConfig(enabled=True, inference_rate_pods=12.0,
+                      inference_flash_frac=0.1, inference_flash_mult=6.0,
+                      inference_queue_max=16.0,
+                      batch_rate_pods=8.0, batch_burst_frac=0.1,
+                      batch_deadline_ticks=6,
+                      background_rate_pods=4.0)
+
+
+def _src(cfg, faults=None, workloads=None):
+    return SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                 cfg.signals, faults=faults,
+                                 workloads=workloads)
+
+
+@pytest.fixture(scope="module")
+def hot_cfg(cfg):
+    """The session config with the HOT mix enabled — its SimParams carry
+    the mix's queue cap / SLO bound / deadline depth."""
+    return dataclasses.replace(cfg, workloads=HOT)
+
+
+@pytest.fixture(scope="module")
+def streams(cfg, hot_cfg):
+    """One generation key, three stream variants (shape-shared where
+    possible so the interpret-mode kernel compiles once per program)."""
+    from ccka_tpu.config import FAULT_PRESETS
+
+    key = jax.random.key(5)
+    return {
+        "plain": _src(cfg).packed_trace_device(
+            STEPS, key, B, t_chunk=T_CHUNK),
+        "neutral": _src(cfg, workloads=WorkloadsConfig(
+            enabled=True)).packed_trace_device(
+            STEPS, key, B, t_chunk=T_CHUNK),
+        # The most layered program: fault lanes AND workload lanes.
+        "hot": _src(hot_cfg, faults=FAULT_PRESETS["mild"],
+                    workloads=HOT).packed_trace_device(
+            STEPS, key, B, t_chunk=T_CHUNK),
+    }
+
+
+class TestConfig:
+    def test_scenarios_validate(self):
+        assert len(WORKLOAD_SCENARIOS) >= 4
+        for name, sc in WORKLOAD_SCENARIOS.items():
+            sc.validate()
+            assert sc.name == name
+            assert sc.workloads.enabled
+
+    def test_roundtrip_and_overrides(self, cfg):
+        c2 = cfg.with_overrides(**{"workloads.enabled": True,
+                                   "workloads.batch_rate_pods": 2.5})
+        assert c2.workloads.enabled
+        assert c2.workloads.batch_rate_pods == 2.5
+        c3 = FrameworkConfig.from_json(c2.to_json())
+        assert c3.workloads == c2.workloads
+
+    def test_bad_values_rejected(self):
+        with pytest.raises(ConfigError):
+            WorkloadsConfig(inference_rate_pods=-1.0).validate()
+        with pytest.raises(ConfigError):
+            WorkloadsConfig(inference_flash_mult=0.5).validate()
+        with pytest.raises(ConfigError):
+            WorkloadsConfig(batch_deadline_ticks=0).validate()
+        with pytest.raises(ConfigError):
+            WorkloadsConfig(inference_queue_max=0.0).validate()
+
+    def test_unknown_scenarios_rejected_up_front(self):
+        with pytest.raises(ValueError, match="unknown scenarios"):
+            resolve_scenarios(("mixed", "no-such-scenario"))
+        from ccka_tpu.workloads.scoreboard import workload_scoreboard
+        from ccka_tpu.config import default_config
+        with pytest.raises(ValueError, match="unknown scenarios"):
+            workload_scoreboard(default_config(),
+                                scenarios=("typo-scenario",))
+        with pytest.raises(ValueError, match="unknown policies"):
+            workload_scoreboard(default_config(),
+                                scenarios=("mixed",),
+                                policies=("rule", "pppo"))
+
+
+class TestLanes:
+    def test_disabled_is_bitwise_pre_workload_stream(self, cfg):
+        """THE zero-workload gate, stream half: disabled workloads emit
+        the exact pre-PR stream — same shape, same bits."""
+        key = jax.random.key(5)
+        plain = _src(cfg).packed_trace_device(16, key, 4, t_chunk=8)
+        disabled = _src(cfg, workloads=WorkloadsConfig(enabled=False)) \
+            .packed_trace_device(16, key, 4, t_chunk=8)
+        assert plain.shape == disabled.shape
+        assert np.array_equal(np.asarray(plain), np.asarray(disabled))
+
+    def test_widened_exo_and_fault_rows_bitwise(self, cfg, streams):
+        Z = cfg.cluster.n_zones
+        base = _exo_rows(Z)
+        assert streams["neutral"].shape[1] == base + workload_rows(Z)
+        assert streams["hot"].shape[1] == (base + fault_rows(Z)
+                                           + workload_rows(Z))
+        assert stream_layout(streams["neutral"].shape[1], Z) == (False,
+                                                                 True)
+        assert stream_layout(streams["hot"].shape[1], Z) == (True, True)
+        # Exo rows bitwise shared with the plain stream.
+        for name in ("neutral", "hot"):
+            assert np.array_equal(np.asarray(streams["plain"]),
+                                  np.asarray(streams[name][:, :base]))
+        # Neutral config (rates 0): lanes are EXACTLY zero.
+        lanes = np.asarray(streams["neutral"][:STEPS, base:])
+        assert np.all(lanes == 0.0)
+
+    def test_hot_lanes_in_range(self, cfg, streams):
+        Z = cfg.cluster.n_zones
+        wl = unpack_workload_lanes(streams["hot"], STEPS, Z)
+        for leaf in wl:
+            a = np.asarray(leaf)
+            assert a.shape == (B, STEPS)
+            assert a.min() >= 0.0 and np.isfinite(a).all()
+        assert np.asarray(wl.inf_arrivals).mean() > 0.0
+        assert np.asarray(wl.batch_arrivals).mean() > 0.0
+        # Fault lanes still unpack cleanly past the workload block.
+        fs = unpack_fault_lanes(streams["hot"], STEPS, Z)
+        assert np.asarray(fs.preempt_hazard).min() >= 1.0
+
+    def test_bad_row_count_rejected(self, cfg, streams):
+        Z = cfg.cluster.n_zones
+        with pytest.raises(ValueError, match="rows"):
+            stream_layout(streams["neutral"].shape[1] - 1, Z)
+        with pytest.raises(ValueError, match="no workload lanes"):
+            unpack_workload_lanes(streams["plain"], STEPS, Z)
+
+    @pytest.mark.slow  # integration-grade: lane mechanics already fast-covered
+    def test_replay_packed_stream_carries_lanes(self, cfg):
+        from ccka_tpu.signals.base import TraceMeta
+        from ccka_tpu.signals.replay import ReplaySignalSource
+
+        stored = _src(cfg).trace(48, seed=3)
+        meta = TraceMeta(source="replay", start_unix_s=0.0, dt_s=30.0,
+                         zones=cfg.cluster.zones)
+        Z = cfg.cluster.n_zones
+        key = jax.random.key(9)
+        plain = ReplaySignalSource(stored, meta).packed_trace_device(
+            16, key, 4, t_chunk=8)
+        laden = ReplaySignalSource(
+            stored, meta, workloads=HOT).packed_trace_device(
+            16, key, 4, t_chunk=8)
+        assert laden.shape[1] == _exo_rows(Z) + workload_rows(Z)
+        # Same key → same windows: exo rows bitwise shared.
+        assert np.array_equal(np.asarray(plain),
+                              np.asarray(laden[:, :_exo_rows(Z)]))
+        assert np.asarray(
+            unpack_workload_lanes(laden, 16, Z).inf_arrivals).mean() > 0
+
+
+class TestZeroWorkloadGate:
+    def test_lax_neutral_workload_step_bitwise(self, cfg):
+        """step(workload=neutral, wl_state=zero) == step(), bitwise —
+        state AND metrics' shared fields, stochastic mode included; the
+        family counters and queues exactly zero."""
+        params = SimParams.from_config(cfg)
+        tr = _src(cfg).trace(1, seed=0)
+        from ccka_tpu.sim.rollout import exo_steps
+        exo = jax.tree.map(lambda x: x[0], exo_steps(tr))
+        st = initial_state(cfg)
+        act = RulePolicy(cfg.cluster).decide(st, exo, jnp.int32(0))
+        key = jax.random.key(7)
+        wl0 = WorkloadState.zero(int(params.wl_batch_deadline_ticks))
+        s1, m1 = jax.jit(lambda: step(params, st, act, exo, key,
+                                      stochastic=True))()
+        s2, m2, w2 = jax.jit(lambda: step(
+            params, st, act, exo, key, stochastic=True,
+            workload=WorkloadStep.neutral(), wl_state=wl0))()
+        for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        for f in m1._fields:
+            assert np.array_equal(np.asarray(getattr(m1, f)),
+                                  np.asarray(getattr(m2, f))), f
+        for leaf in jax.tree.leaves(w2):
+            assert np.all(np.asarray(leaf) == 0.0)
+
+    def test_step_rejects_half_given_workload(self, cfg):
+        params = SimParams.from_config(cfg)
+        st = initial_state(cfg)
+        with pytest.raises(ValueError, match="both workload="):
+            step(params, st, None, None, None,
+                 workload=WorkloadStep.neutral())
+
+    def test_kernel_disabled_stream_bitwise(self, cfg):
+        """Disabled workloads → un-widened stream → the pre-workload
+        kernel program — summaries bitwise identical end to end."""
+        params = SimParams.from_config(cfg)
+        off, peak = offpeak_action(cfg.cluster), peak_action(cfg.cluster)
+        key = jax.random.key(5)
+        kw = dict(stochastic=False, b_block=4, t_chunk=8, interpret=True)
+        s1 = megakernel_summary_from_packed(
+            params, off, peak,
+            _src(cfg).packed_trace_device(16, key, 4, t_chunk=8),
+            16, seed=3, **kw)
+        s2 = megakernel_summary_from_packed(
+            params, off, peak,
+            _src(cfg, workloads=WorkloadsConfig(
+                enabled=False)).packed_trace_device(16, key, 4,
+                                                    t_chunk=8),
+            16, seed=3, **kw)
+        for f in s1._fields:
+            assert np.array_equal(np.asarray(getattr(s1, f)),
+                                  np.asarray(getattr(s2, f))), f
+        assert np.all(np.asarray(s1.inf_slo_violations) == 0.0)
+        assert np.all(np.asarray(s1.batch_deadline_misses) == 0.0)
+
+    @pytest.mark.slow  # weaker than the disabled-bitwise + lax-neutral gates
+    def test_kernel_neutral_lanes_match_plain(self, cfg, streams):
+        """Enabled-but-neutral lanes: the workload-mode kernel on
+        all-zero arrivals reproduces the plain kernel to 1e-5 (different
+        XLA program → ~1 ulp of fusion skew) with the family counters
+        exactly zero."""
+        params = SimParams.from_config(cfg)
+        off, peak = offpeak_action(cfg.cluster), peak_action(cfg.cluster)
+        s1 = megakernel_summary_from_packed(
+            params, off, peak, streams["plain"], STEPS, seed=3,
+            **KERNEL_KW)
+        s2 = megakernel_summary_from_packed(
+            params, off, peak, streams["neutral"], STEPS, seed=3,
+            **KERNEL_KW)
+        for f in s1._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(s2, f)), np.asarray(getattr(s1, f)),
+                rtol=1e-5, atol=1e-6, err_msg=f)
+        for f in ("inf_slo_violations", "inf_queue_mean", "inf_dropped",
+                  "batch_deadline_misses", "batch_backlog_mean"):
+            assert np.all(np.asarray(getattr(s2, f)) == 0.0), f
+
+
+class TestWorkloadDynamics:
+    """Lax-side semantics of each family's queue."""
+
+    def _exo_demand(self, cfg, demand_total: float) -> ExoStep:
+        z = cfg.cluster.n_zones
+        return ExoStep(
+            spot_price_hr=jnp.full((z,), 0.03),
+            od_price_hr=jnp.full((z,), 0.096),
+            carbon_g_kwh=jnp.full((z,), 400.0),
+            demand_pods=jnp.full((2,), demand_total / 2.0),
+            is_peak=jnp.float32(0.0))
+
+    def _saturate(self, cfg, params):
+        """A state+exo pair with ~zero headroom: demand soaks the base
+        capacity and no Karpenter nodes exist."""
+        cap = float(params.base_od_nodes) * float(params.pods_per_node)
+        return initial_state(cfg), self._exo_demand(cfg, 2.0 * cap)
+
+    def test_inference_queue_builds_drops_and_violates(self, hot_cfg):
+        params = SimParams.from_config(hot_cfg)
+        st, exo = self._saturate(hot_cfg, params)
+        act = RulePolicy(hot_cfg.cluster).decide(st, exo, jnp.int32(0))
+        key = jax.random.key(0)
+        wl = WorkloadStep.neutral()._replace(
+            inf_arrivals=jnp.float32(30.0))
+        ws = WorkloadState.zero(int(params.wl_batch_deadline_ticks))
+        stepf = jax.jit(lambda s, w: step(params, s, act, exo, key,
+                                          workload=wl, wl_state=w))
+        for _ in range(3):
+            st, m, ws = stepf(st, ws)
+        # Headroom ~0: queue pinned at the cap, the rest shed, violation.
+        assert float(ws.inf_queue) == pytest.approx(
+            float(params.wl_inference_queue_max), abs=1e-3)
+        assert float(m.inf_dropped) > 0.0
+        assert float(m.inf_slo_violation) == 1.0
+        assert float(m.inf_queue_depth) == float(ws.inf_queue)
+
+    def test_batch_work_ages_to_deadline_miss(self, hot_cfg):
+        params = SimParams.from_config(hot_cfg)
+        D = int(params.wl_batch_deadline_ticks)
+        st, exo = self._saturate(hot_cfg, params)
+        act = RulePolicy(hot_cfg.cluster).decide(st, exo, jnp.int32(0))
+        key = jax.random.key(0)
+        ws = WorkloadState.zero(D)
+        one = WorkloadStep.neutral()._replace(
+            batch_arrivals=jnp.float32(5.0))
+        stepf = jax.jit(lambda s, w, a: step(params, s, act, exo, key,
+                                             workload=a, wl_state=w))
+        # One burst of work, then silence: with zero headroom it must
+        # age through the D-slot pipeline and miss at exactly tick D.
+        misses = []
+        st, m, ws = stepf(st, ws, one)
+        misses.append(float(m.batch_deadline_miss))
+        for _ in range(D):
+            st, m, ws = stepf(st, ws, WorkloadStep.neutral())
+            misses.append(float(m.batch_deadline_miss))
+        assert misses[D - 1] == pytest.approx(5.0, abs=1e-4)
+        assert sum(misses) == pytest.approx(5.0, abs=1e-4)
+        assert float(np.asarray(ws.batch_backlog).sum()) == pytest.approx(
+            0.0, abs=1e-5)
+
+    def test_priority_inference_before_batch_before_bg(self, cfg):
+        """With headroom for exactly the inference load, batch and bg
+        starve; with ample headroom everything drains."""
+        params = SimParams.from_config(cfg)
+        st = initial_state(cfg)
+        exo = self._exo_demand(cfg, 0.0)   # whole base capacity free
+        cap = float(params.base_od_nodes) * float(params.pods_per_node)
+        act = RulePolicy(cfg.cluster).decide(st, exo, jnp.int32(0))
+        wl = WorkloadStep(inf_arrivals=jnp.float32(cap),
+                          batch_arrivals=jnp.float32(4.0),
+                          bg_arrivals=jnp.float32(2.0))
+        ws = WorkloadState.zero(int(params.wl_batch_deadline_ticks))
+        _, m, ws2 = step(params, st, act, exo, jax.random.key(0),
+                         workload=wl, wl_state=ws)
+        assert float(m.inf_served) == pytest.approx(cap, rel=1e-5)
+        assert float(m.batch_served) == 0.0
+        assert float(m.batch_backlog) == pytest.approx(4.0, rel=1e-5)
+        assert float(ws2.bg_backlog) == pytest.approx(2.0, rel=1e-5)
+
+    @pytest.mark.slow  # duplicates TestLanes' hot/neutral coverage sampler-side
+    def test_sample_workload_steps_matches_config(self, cfg):
+        Z = cfg.cluster.n_zones
+        wl = jax.jit(lambda k: sample_workload_steps(
+            HOT, k, 64, Z, dt_s=30.0))(jax.random.key(3))
+        assert wl.inf_arrivals.shape == (64,)
+        assert float(np.asarray(wl.inf_arrivals).mean()) > 0.0
+        neutral = jax.jit(lambda k: sample_workload_steps(
+            WorkloadsConfig(enabled=True), k, 64, Z,
+            dt_s=30.0))(jax.random.key(3))
+        for leaf in neutral:
+            assert np.all(np.asarray(leaf) == 0.0)
+
+
+class TestKernelLaxWorkloadParity:
+    """The workload-mode kernel (fault+workload stream — the most
+    layered program) against the workloads-threaded lax rollout on the
+    SAME lanes — deterministic interpret mode."""
+
+    def test_rule_profile(self, hot_cfg, streams):
+        params = SimParams.from_config(hot_cfg)
+        off = offpeak_action(hot_cfg.cluster)
+        peak = peak_action(hot_cfg.cluster)
+        Z = hot_cfg.cluster.n_zones
+        stream = streams["hot"]
+        sk = megakernel_summary_from_packed(
+            params, off, peak, stream, STEPS, seed=3, **KERNEL_KW)
+        traces = unpack_exo(stream, STEPS, Z)
+        faults = unpack_fault_lanes(stream, STEPS, Z)
+        wl = unpack_workload_lanes(stream, STEPS, Z)
+        states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (B,) + x.shape),
+            initial_state(hot_cfg))
+        keys = jax.random.split(jax.random.key(0), B)
+        _, sl = batched_rollout_summary(
+            params, states, RulePolicy(hot_cfg.cluster).action_fn(),
+            traces, keys, stochastic=False, faults=faults, workloads=wl)
+        for f in sk._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(sk, f)), np.asarray(getattr(sl, f)),
+                rtol=3e-4, atol=1e-4, err_msg=f)
+        # The families actually bit (this is not a trivial pass).
+        assert float(np.asarray(sk.inf_slo_violations).mean()) > 0.0
+        assert float(np.asarray(sk.batch_deadline_misses).mean()) > 0.0
+
+
+class TestPairedRealization:
+    """Two policies under one seed see ONE family-arrival realization."""
+
+    def test_rule_vs_plan_playback_same_laden_world(self, hot_cfg,
+                                                    streams):
+        """A rule-replaying per-cluster plan through the playback kernel
+        reproduces the profile kernel on the SAME workload-laden stream
+        — the round-9/10 pin extended to workload mode (both consume
+        identical family lanes)."""
+        import math
+
+        params = SimParams.from_config(hot_cfg)
+        off = offpeak_action(hot_cfg.cluster)
+        peak = peak_action(hot_cfg.cluster)
+        Z = hot_cfg.cluster.n_zones
+        stream = streams["hot"]
+        s_rule = megakernel_summary_from_packed(
+            params, off, peak, stream, STEPS, seed=3, **KERNEL_KW)
+        traces = unpack_exo(stream, STEPS, Z)
+        is_peak = traces.is_peak > 0.5
+        rule_plan = jax.tree.map(
+            lambda o, p: jnp.where(
+                is_peak.reshape(is_peak.shape + (1,) * o.ndim), p, o),
+            off, peak)
+        t_pad = math.ceil(STEPS / T_CHUNK) * T_CHUNK
+        s_plan = plan_megakernel_summary_from_packed(
+            params, hot_cfg.cluster, pack_plan(rule_plan, t_pad),
+            stream, STEPS, seed=3, **KERNEL_KW)
+        for f in s_rule._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(s_plan, f)),
+                np.asarray(getattr(s_rule, f)), rtol=1e-5, atol=1e-6,
+                err_msg=f)
+
+    @pytest.mark.slow  # the 8-shard mesh + kernel compiles cost ~30s
+    # and the sharding machinery is pinned plain-stream in
+    # tests/test_sharded_kernel.py (and fault-stream in test_faults);
+    # the fast lane keeps the cross-policy paired pin above — this
+    # extends the shard-local lane pin to workload lanes in the slow
+    # lane (ISSUE 6 lane-hygiene satellite).
+    def test_sharded_generation_lanes_bitwise(self, hot_cfg):
+        """8 interpret-mode shards: each shard's workload lanes equal
+        the single-device generation with that shard's folded key, and
+        the sharded rule kernel on the laden stream matches the
+        single-device kernel on the gathered stream."""
+        from ccka_tpu.config import FAULT_PRESETS, MeshConfig
+        from ccka_tpu.parallel import make_mesh
+        from ccka_tpu.parallel.sharded_kernel import (
+            sharded_megakernel_summary_from_packed, sharded_packed_trace)
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual CPU mesh")
+        mesh = make_mesh(MeshConfig(data_parallel=8))
+        src = _src(hot_cfg, faults=FAULT_PRESETS["mild"], workloads=HOT)
+        key = jax.random.key(11)
+        b_loc = 2
+        stream = sharded_packed_trace(mesh, src, STEPS, key, 8 * b_loc,
+                                      t_chunk=T_CHUNK)
+        gathered = np.asarray(stream)
+        for shard in range(8):
+            want = np.asarray(src.packed_trace_device(
+                STEPS, jax.random.fold_in(key, shard), b_loc,
+                t_chunk=T_CHUNK))
+            got = gathered[:, :, shard * b_loc:(shard + 1) * b_loc]
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6,
+                                       err_msg=f"shard {shard}")
+
+        params = SimParams.from_config(hot_cfg)
+        off = offpeak_action(hot_cfg.cluster)
+        peak = peak_action(hot_cfg.cluster)
+        kw = dict(stochastic=False, b_block=b_loc, t_chunk=T_CHUNK,
+                  interpret=True)
+        s_sh = sharded_megakernel_summary_from_packed(
+            mesh, params, off, peak, stream, STEPS, seed=3, **kw)
+        s_1d = megakernel_summary_from_packed(
+            params, off, peak, jnp.asarray(gathered), STEPS, seed=3,
+            **kw)
+        for f in s_sh._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(s_sh, f)),
+                np.asarray(getattr(s_1d, f)),
+                rtol=1e-5, atol=1e-6, err_msg=f)
+
+
+class TestPromExportWorkloads:
+    def test_workload_gauges_exported_and_paneled(self):
+        """ISSUE 6 observability satellite: the per-family gauges stay
+        exported, resolvable from a TickReport, and on the dashboard —
+        both parity directions, like the fault gauges."""
+        from ccka_tpu.harness.controller import TickReport
+        from ccka_tpu.harness.dashboard import _PANEL_DEFS
+        from ccka_tpu.harness.promexport import (SERIES,
+                                                 referenced_series,
+                                                 render_exposition,
+                                                 resolve_field)
+
+        gauges = {"ccka_inference_queue_depth",
+                  "ccka_inference_slo_violations_total",
+                  "ccka_batch_deadline_misses_total"}
+        assert gauges <= set(SERIES)
+        paneled = set()
+        for _t, expr, _u in _PANEL_DEFS:
+            paneled |= referenced_series(expr)
+        assert gauges <= paneled, "workload gauges missing a panel"
+
+        rec = dataclasses.asdict(TickReport(
+            t=3, is_peak=False, profile="offpeak", applied=True,
+            verified=True, fallbacks=0, cost_usd_hr=0.0, carbon_g_hr=0.0,
+            nodes_spot=0.0, nodes_od=0.0, pending_pods=0.0, slo_ok=True,
+            inference_queue_depth=3.5, batch_backlog=7.0,
+            inference_slo_violations_total=2.0,
+            batch_deadline_misses_total=9.25))
+        assert resolve_field(
+            rec, SERIES["ccka_inference_queue_depth"][0]) == 3.5
+        text = render_exposition(rec)
+        assert "ccka_inference_queue_depth 3.5" in text
+        assert "ccka_inference_slo_violations_total 2" in text
+        assert "ccka_batch_deadline_misses_total 9.25" in text
+
+    @pytest.mark.slow  # end-to-end duplicate of dynamics + gauge-parity tests
+    def test_controller_tracks_workload_queues(self, cfg):
+        """A workloads-enabled controller advances the family track and
+        re-states cumulative counters on every TickReport."""
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+
+        cfg2 = dataclasses.replace(cfg, workloads=HOT)
+        src = _src(cfg2, workloads=HOT)
+        ctrl = Controller(cfg2, RulePolicy(cfg2.cluster), src,
+                          DryRunSink(), interval_s=0.0,
+                          log_fn=lambda _l: None)
+        reports = ctrl.run(ticks=3)
+        assert all(r.inference_queue_depth >= 0.0 for r in reports)
+        totals = [r.inference_slo_violations_total for r in reports]
+        assert totals == sorted(totals)   # cumulative, never decreasing
+        # The plain controller keeps the pre-workload shape: zeros.
+        ctrl0 = Controller(cfg, RulePolicy(cfg.cluster), _src(cfg),
+                           DryRunSink(), interval_s=0.0,
+                           log_fn=lambda _l: None)
+        r0 = ctrl0.tick(0)
+        assert r0.inference_queue_depth == 0.0
+        assert r0.batch_deadline_misses_total == 0.0
