@@ -1696,6 +1696,29 @@ def bench_workloads(n_traces: int = 256, eval_steps: int | None = None,
     return board
 
 
+def bench_recovery(runs_per_cell: int = 8, ticks: int = 32,
+                   *, seed: int = 101) -> dict | None:
+    """Crash-recovery scoreboard (ISSUE 9): paired kill/no-kill
+    controller runs per {rule, flagship} x >=3 actuation-fault
+    intensities through a ChaosSink'd dry-run cluster with the
+    reconciler converging every tick and durable snapshots at tick
+    boundaries — duplicate/lost patch counts (MUST be 0),
+    bitwise-resume fraction, ticks-to-reconverge, and the paired
+    $/SLO-hour delta killed-vs-uninterrupted, recorded into
+    BASELINE.json round12. Runs on the multiregion preset (the topology
+    with a committed flagship checkpoint). Host-side harness: the
+    result is the INVARIANT (zero dup/lost, ratio 1.0), not a
+    wall-clock number — no roofline floor applies."""
+    from ccka_tpu.config import multi_region_config
+    from ccka_tpu.harness.recovery import recovery_scoreboard
+
+    board = recovery_scoreboard(multi_region_config(),
+                                runs_per_cell=runs_per_cell, ticks=ticks,
+                                seed=seed)
+    board["config"] = "multiregion(flagship checkpoint committed)"
+    return board
+
+
 def _run_child(argv, timeout_s=1800, env=None) -> dict | None:
     """Run a bench child phase; relay its narration; parse its JSON."""
     try:
@@ -1787,6 +1810,11 @@ def main(argv=None) -> int:
                          "scoreboard (bench_faults) and print its JSON "
                          "— the BENCH_r10 record path; interpret-mode "
                          "deterministic off-TPU")
+    ap.add_argument("--recovery-only", action="store_true",
+                    help="run ONLY the crash-recovery kill/resume "
+                         "scoreboard (bench_recovery) and print its "
+                         "JSON — the BENCH_r12 record path; host-side "
+                         "dry-run harness")
     ap.add_argument("--workloads-only", action="store_true",
                     help="run ONLY the per-family workload scenario "
                          "scoreboard (bench_workloads) and print its "
@@ -1844,6 +1872,14 @@ def main(argv=None) -> int:
                 scenarios=list(wl["scenarios"]))
         print(json.dumps(wl))
         return 0 if wl is not None else 1
+
+    if args.recovery_only:
+        with _TRACER.span("bench.recovery_stage"):
+            rec = bench_recovery()
+        if rec is not None:
+            rec["provenance"] = bench_provenance()
+        print(json.dumps(rec))
+        return 0 if rec is not None else 1
 
     if args.mega_phase == "gate":
         from ccka_tpu.config import default_config
@@ -2003,6 +2039,16 @@ def main(argv=None) -> int:
         print(f"# workloads stage failed (omitted): {e!r}",
               file=sys.stderr)
         workloads = None
+    # Crash-recovery scoreboard (ISSUE 9): kill/resume invariant sweep —
+    # same guard; host-side, so --quick only shrinks the pair count.
+    try:
+        with _TRACER.span("bench.recovery_stage"):
+            recovery = (bench_recovery(runs_per_cell=2, ticks=12)
+                        if args.quick else bench_recovery())
+    except Exception as e:  # noqa: BLE001
+        print(f"# recovery stage failed (omitted): {e!r}",
+              file=sys.stderr)
+        recovery = None
 
     rates = {k: v for k, v in rollout.items()
              if isinstance(v, dict) and "cluster_days_per_sec" in v}
@@ -2058,6 +2104,8 @@ def main(argv=None) -> int:
         line["faults"] = faults
     if workloads is not None:
         line["workloads"] = workloads
+    if recovery is not None:
+        line["recovery"] = recovery
     # Provenance + the session's span trace: a headline without device/
     # version/timing context cannot be audited (VERDICT r5 weak #3).
     line["provenance"] = bench_provenance()

@@ -16,7 +16,12 @@ gaps (§2.3: HPA never created, KEDA never installed):
 - ``bootstrap`` — NodePool + EC2NodeClass creation and demo_50-ordered
   teardown (the reference's missing `demo_01`);
 - ``burst``    — the demo_30 load generator as manifests (odd/even
-  spot/on-demand Deployments, RBAC, PDB) with Pending-pod diagnostics.
+  spot/on-demand Deployments, RBAC, PDB) with Pending-pod diagnostics;
+- ``chaos``    — seeded kubectl-edge fault injection (ChaosSink wraps any
+  sink: timeouts, transient exits, dropped patches, admission rewrites);
+- ``reconcile`` — desired-state convergence over a sink: bounded retry +
+  read-back verification turning one-shot apply_all into reconciliation
+  (every harness actuation path routes through it — AST-guarded).
 """
 
 from ccka_tpu.actuation.patches import (  # noqa: F401
@@ -32,6 +37,15 @@ from ccka_tpu.actuation.sink import (  # noqa: F401
     KubectlSink,
     ManifestCommand,
     PatchCommand,
+)
+from ccka_tpu.actuation.chaos import (  # noqa: F401
+    ChaosSink,
+    make_chaos_sink,
+)
+from ccka_tpu.actuation.reconcile import (  # noqa: F401
+    ReconcileOutcome,
+    Reconciler,
+    verify_pool,
 )
 from ccka_tpu.actuation.bootstrap import (  # noqa: F401
     bootstrap,

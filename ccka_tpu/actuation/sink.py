@@ -18,6 +18,7 @@ import json
 import shlex
 import subprocess
 import time
+import weakref
 from dataclasses import dataclass
 from typing import Callable, Sequence
 
@@ -31,22 +32,39 @@ from ccka_tpu.actuation.patches import (
 Runner = Callable[[Sequence[str]], tuple[int, str]]
 
 
+# Memoized probe results, keyed weakly per runner object: the fleet
+# fan-out calls apply_all on many sinks every tick, and re-running
+# `inspect.signature` per call site was measurable host work in that hot
+# path. Weak keys keep dead runners (closures swapped out by tests) from
+# pinning cache rows; unweakreffable callables just re-probe.
+_BUDGET_PROBE_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
 def _accepts_budget(fn) -> bool:
     """Whether a runner accepts the widened-budget kwargs
-    (``timeout_s``/``deadline_s``). Probed ONCE per runner — probing at
-    call time via catch-TypeError would re-run a side-effecting kubectl
-    command when a custom runner raises TypeError after launching it.
-    Requires BOTH names (or ``**kwargs``): a runner taking only one
-    would TypeError on the paired call."""
+    (``timeout_s``/``deadline_s``). Probed ONCE per runner object (see
+    cache above) — probing at call time via catch-TypeError would re-run
+    a side-effecting kubectl command when a custom runner raises
+    TypeError after launching it. Requires BOTH names (or ``**kwargs``):
+    a runner taking only one would TypeError on the paired call."""
+    try:
+        return _BUDGET_PROBE_CACHE[fn]
+    except (KeyError, TypeError):
+        pass
     import inspect
     try:
         params = inspect.signature(fn).parameters.values()
         names = {p.name for p in params}
-        return ({"timeout_s", "deadline_s"} <= names
-                or any(p.kind is inspect.Parameter.VAR_KEYWORD
-                       for p in params))
+        result = ({"timeout_s", "deadline_s"} <= names
+                  or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                         for p in params))
     except (TypeError, ValueError):
-        return False
+        result = False
+    try:
+        _BUDGET_PROBE_CACHE[fn] = result
+    except TypeError:
+        pass                     # unweakreffable callable: probe each time
+    return result
 
 
 @dataclass(frozen=True)

@@ -92,6 +92,22 @@ def _build_parser() -> argparse.ArgumentParser:
     sr.add_argument("--telemetry", default="",
                     help="append per-tick JSONL records (incl. per-phase "
                          "timings) to this file")
+    sr.add_argument("--snapshot", default="",
+                    help="write a durable, checksummed controller "
+                         "snapshot to this path each tick (atomic "
+                         "write-temp-then-rename); the crash-recovery "
+                         "state `--resume` restores")
+    sr.add_argument("--snapshot-every", type=int, default=1,
+                    help="ticks between snapshot writes (default 1)")
+    sr.add_argument("--resume", action="store_true",
+                    help="restore from --snapshot before running and "
+                         "continue at the saved tick; --ticks stays the "
+                         "RUN's total length, so re-running the exact "
+                         "killed command completes the original run — "
+                         "a killed-and-resumed run replays the decision "
+                         "stream bitwise (requires --snapshot; refuses "
+                         "config/backend/seed mismatches and corrupt "
+                         "snapshots)")
     sr.add_argument("--metrics-port", type=int, default=-1,
                     help="serve the ccka_* Prometheus gauges on "
                          "127.0.0.1:PORT/metrics (0 = pick a free port); "
@@ -275,6 +291,27 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="ticks per trace (0 = platform default: one "
                           "day on TPU, CI-sized interpret off-TPU)")
     sch.add_argument("--seed", type=int, default=31)
+
+    sre = sub.add_parser(
+        "recover-eval", help="crash-recovery scoreboard "
+                             "(harness/recovery.py): paired kill/no-kill "
+                             "controller runs per {policy x actuation "
+                             "intensity} through a ChaosSink'd dry-run "
+                             "cluster — duplicate/lost patch counts "
+                             "(must be 0), bitwise-resume fraction, "
+                             "ticks-to-reconverge and paired $/SLO-hr "
+                             "delta")
+    sre.add_argument("--intensities", default="off,mild,moderate,severe",
+                     help="comma list of config.CHAOS_PRESETS names")
+    sre.add_argument("--policies", default="rule,flagship",
+                     help="comma list of rule,carbon,flagship (flagship "
+                          "rows need a committed checkpoint for the "
+                          "chosen preset's topology)")
+    sre.add_argument("--runs", type=int, default=8,
+                     help="paired kill/no-kill runs per cell")
+    sre.add_argument("--ticks", type=int, default=32,
+                     help="control ticks per run")
+    sre.add_argument("--seed", type=int, default=101)
 
     sub.add_parser(
         "scenarios", help="list the named workload scenario library "
@@ -530,9 +567,21 @@ def _cmd_run(cfg: FrameworkConfig, backend_name: str, checkpoint: str,
              seed: int, hpa: bool = False, keda: bool = False,
              telemetry: str = "", metrics_port: int = -1,
              metrics_textfile: str = "", forecaster: str = "",
-             trace_out: str = "") -> int:
+             trace_out: str = "", snapshot: str = "",
+             snapshot_every: int = 1, resume: bool = False) -> int:
     from ccka_tpu.harness.controller import controller_from_config
 
+    if resume and not snapshot:
+        raise SystemExit("ccka: --resume needs --snapshot PATH (the "
+                         "snapshot file to restore from and keep "
+                         "writing to)")
+    resume_body = None
+    if resume:
+        from ccka_tpu.harness.snapshot import SnapshotError, load_snapshot
+        try:
+            resume_body = load_snapshot(snapshot)
+        except SnapshotError as e:
+            raise SystemExit(f"ccka: {e}")
     backend = make_backend(cfg, backend_name, checkpoint,
                            forecaster=forecaster)
     from ccka_tpu.harness.controller import ControllerLockHeld
@@ -560,7 +609,9 @@ def _cmd_run(cfg: FrameworkConfig, backend_name: str, checkpoint: str,
                                       interval_s=interval, seed=seed,
                                       apply_hpa=hpa, apply_keda=keda,
                                       lock=live, telemetry_path=telemetry,
-                                      exporter=exporter, tracer=tracer)
+                                      exporter=exporter, tracer=tracer,
+                                      snapshot_path=snapshot,
+                                      snapshot_every=snapshot_every)
     except ValueError as e:  # e.g. --keda without the SQS config
         if exporter is not None:
             exporter.close()
@@ -570,7 +621,20 @@ def _cmd_run(cfg: FrameworkConfig, backend_name: str, checkpoint: str,
             exporter.close()
         raise SystemExit(f"ccka: {e}")
     try:
-        reports = ctrl.run(ticks if ticks > 0 else None)
+        start_tick = 0
+        if resume_body is not None:
+            from ccka_tpu.harness.snapshot import SnapshotError
+            try:
+                start_tick = ctrl.restore(resume_body)
+            except SnapshotError as e:
+                raise SystemExit(f"ccka: {e}")
+            print(f"[ok] resumed at tick {start_tick} "
+                  f"(resume #{ctrl.resumes_total})", file=sys.stderr)
+        # --ticks is the RUN's length, resumed or not: re-running the
+        # identical command after a crash completes the original N-tick
+        # run (ticks already done count), it does not run N more.
+        remaining = None if ticks <= 0 else max(ticks - start_tick, 0)
+        reports = ctrl.run(remaining, start_tick=start_tick)
     finally:
         ctrl.close()
         if exporter is not None:
@@ -1005,7 +1069,8 @@ def main(argv: list[str] | None = None) -> int:
                             args.interval, args.live, args.seed, args.hpa,
                             args.keda, args.telemetry, args.metrics_port,
                             args.metrics_textfile, args.forecaster,
-                            args.trace_out)
+                            args.trace_out, args.snapshot,
+                            args.snapshot_every, args.resume)
         if args.command == "dashboard":
             from ccka_tpu.actuation import DryRunSink, KubectlSink
             from ccka_tpu.harness.dashboard import (
@@ -1115,6 +1180,24 @@ def main(argv: list[str] | None = None) -> int:
                         if s.strip()),
                     n_traces=args.traces or 256,
                     eval_steps=args.steps or None,
+                    seed=args.seed)
+            except ValueError as e:
+                raise SystemExit(f"ccka: {e}")
+            print(json.dumps(board, indent=2))
+            return 0
+        if args.command == "recover-eval":
+            from ccka_tpu.harness.recovery import recovery_scoreboard
+            try:
+                board = recovery_scoreboard(
+                    cfg,
+                    intensities=tuple(
+                        s.strip() for s in args.intensities.split(",")
+                        if s.strip()),
+                    policies=tuple(
+                        s.strip() for s in args.policies.split(",")
+                        if s.strip()),
+                    runs_per_cell=args.runs,
+                    ticks=args.ticks,
                     seed=args.seed)
             except ValueError as e:
                 raise SystemExit(f"ccka: {e}")

@@ -700,6 +700,70 @@ class WorkloadsConfig:
                               "positive")
 
 
+@dataclass(frozen=True)
+class ChaosConfig:
+    """Actuation-edge fault injection (`ccka_tpu/actuation/chaos.py`).
+
+    `FaultsConfig` disturbs the *world* (preemption storms, ICE, signal
+    outages); this block disturbs the *kubectl edge* — the failure modes
+    the reference's apply-and-verify scripts were written to survive
+    (`demo_20_offpeak_configure.sh:84-127`) and that a long-running
+    controller daemon meets constantly: command timeouts, transient
+    non-zero exits, patches that report success but never land (a lost
+    write the read-back catches), and admission-webhook rewrites that
+    mutate the patch on its way in. A `ChaosSink` wrapper injects them
+    from a seeded host-side RNG, so a given chaos realization is
+    identical for every paired run that shares a seed.
+
+    ``enabled=False`` (the default) is a hard gate exactly like
+    `FaultsConfig`: the wrapper delegates verbatim, draws nothing from
+    its RNG, and a wrapped run is command-for-command identical to the
+    bare sink (the zero-injection gate `tests/test_recovery.py` pins).
+    """
+
+    enabled: bool = False
+    # P(command "hangs" and times out): reported rc!=0, no mutation.
+    timeout_prob: float = 0.0
+    # P(transient non-zero exit — apiserver pressure): rc!=0, no mutation.
+    transient_exit_prob: float = 0.0
+    # P(silent drop): the command REPORTS success but the mutation never
+    # lands — the partial-apply lie only a skeptical read-back catches.
+    drop_prob: float = 0.0
+    # P(admission rewrite): a mutating webhook alters the patch before it
+    # lands (requirement values trimmed, consolidation settings clamped);
+    # the command succeeds, the read-back diverges from intent.
+    rewrite_prob: float = 0.0
+
+    def validate(self) -> None:
+        for name in ("timeout_prob", "transient_exit_prob", "drop_prob",
+                     "rewrite_prob"):
+            if not 0.0 <= getattr(self, name) <= 1.0:
+                raise ConfigError(f"chaos: {name} out of [0, 1]")
+        if (self.timeout_prob + self.transient_exit_prob + self.drop_prob
+                + self.rewrite_prob) > 1.0:
+            raise ConfigError("chaos: failure probabilities sum past 1 — "
+                              "each command draws one fate")
+
+
+# The recovery scoreboard's named actuation intensities (`bench.py
+# bench_recovery`, `ccka recover-eval`) — the kubectl-edge mirror of
+# FAULT_PRESETS. "off" is enabled-but-neutral: the wrapper is in the
+# path but injects nothing, which the zero-injection gate pins as
+# command-for-command identical to the bare sink.
+CHAOS_PRESETS: dict[str, ChaosConfig] = {
+    "off": ChaosConfig(enabled=True),
+    "mild": ChaosConfig(
+        enabled=True, timeout_prob=0.02, transient_exit_prob=0.03,
+        drop_prob=0.02, rewrite_prob=0.01),
+    "moderate": ChaosConfig(
+        enabled=True, timeout_prob=0.05, transient_exit_prob=0.08,
+        drop_prob=0.05, rewrite_prob=0.03),
+    "severe": ChaosConfig(
+        enabled=True, timeout_prob=0.10, transient_exit_prob=0.15,
+        drop_prob=0.12, rewrite_prob=0.08),
+}
+
+
 # The robustness scoreboard's named intensities (`bench.py bench_faults`,
 # `ccka chaos-eval`): the same storm/ICE/outage latent processes (same
 # key → same storm timing) at rising severities, so the degradation curve
@@ -760,6 +824,7 @@ class FrameworkConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
     workloads: WorkloadsConfig = field(default_factory=WorkloadsConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     def validate(self) -> "FrameworkConfig":
         self.cluster.validate()
@@ -770,6 +835,7 @@ class FrameworkConfig:
         self.mesh.validate()
         self.faults.validate()
         self.workloads.validate()
+        self.chaos.validate()
         # Cross-section: a live multi-region fleet must name each region's
         # grid zone — silently falling back to the global carbon_zone would
         # price one region's zones by another region's grid, flattening the
@@ -918,6 +984,7 @@ _NESTED_TYPES = {
     "mesh": MeshConfig,
     "faults": FaultsConfig,
     "workloads": WorkloadsConfig,
+    "chaos": ChaosConfig,
 }
 
 

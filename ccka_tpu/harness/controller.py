@@ -23,6 +23,7 @@ KSM→ADOT→AMP pipeline carried in the reference.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import os
 import tempfile
@@ -34,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ccka_tpu.actuation.patches import render_region_nodepool_patches
+from ccka_tpu.actuation.reconcile import Reconciler
 from ccka_tpu.actuation.sink import ActuationSink
 from ccka_tpu.config import FrameworkConfig
 from ccka_tpu.policy.base import PolicyBackend
@@ -41,6 +43,31 @@ from ccka_tpu.sim.dynamics import step as sim_step
 from ccka_tpu.sim.rollout import exo_steps, initial_state
 from ccka_tpu.sim.types import CT_SPOT, Action, ClusterState, SimParams
 from ccka_tpu.signals.base import SignalSource
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_steps(cfg: FrameworkConfig):
+    """Jitted estimate steps shared across Controller instances of one
+    config. Pre-round-12 every Controller jitted its own lambda, so a
+    crash-resume (or the recovery scoreboard's hundreds of paired runs)
+    paid a fresh XLA compile per construction — the same
+    instance-vs-config keying hazard the forecaster cache fix closed
+    (ARCHITECTURE §8). FrameworkConfig is frozen/hashable and SimParams
+    derives from it deterministically, so config-keying is sound;
+    `shared_stats=True` accumulates all instances into one watch entry."""
+    from ccka_tpu.obs.compile import watch_jit
+
+    params = SimParams.from_config(cfg)
+    step = watch_jit(
+        jax.jit(lambda s, a, e, k: sim_step(params, s, a, e, k,
+                                            stochastic=False)),
+        "controller.step", hot=True, shared_stats=True)
+    step_wl = watch_jit(
+        jax.jit(lambda s, ws, a, e, w, k: sim_step(
+            params, s, a, e, k, stochastic=False, workload=w,
+            wl_state=ws)),
+        "controller.step_wl", hot=True, shared_stats=True)
+    return step, step_wl
 
 
 @dataclasses.dataclass
@@ -102,6 +129,23 @@ class TickReport:
     batch_backlog: float = 0.0
     inference_slo_violations_total: float = 0.0
     batch_deadline_misses_total: float = 0.0
+    # Crash-safety surfaces (ARCHITECTURE §14). The reconciler turns the
+    # apply stage into convergence: ``reconcile_retries`` counts this
+    # tick's re-apply attempts, ``reconcile_diverged`` the pools still
+    # diverged at give-up (0 = converged), and ``actuation_failures``
+    # the failed applies + failed read-backs this tick. The _total
+    # fields are session-cumulative (kube-state-metrics style, like
+    # degraded_ticks_total) and survive snapshot/resume.
+    reconcile_retries: int = 0
+    reconcile_retries_total: int = 0
+    reconcile_diverged: int = 0
+    actuation_failures: int = 0
+    actuation_failures_total: int = 0
+    # Ticks since the last durable snapshot write (0 right after one;
+    # stays 0 when snapshotting is disabled) and how many times this
+    # logical run has been resumed from a snapshot.
+    snapshot_age_ticks: int = 0
+    resumes_total: int = 0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
@@ -192,19 +236,6 @@ def _workload_clock_anchor(source: SignalSource, dt_s: float) -> float:
     return float(start)
 
 
-def _verify_pool(observed: dict, ps) -> bool:
-    """Rendered intent vs sink read-back (never vs what we meant to send)."""
-    want_policy = ps.disruption_merge["spec"]["disruption"][
-        "consolidationPolicy"]
-    if observed.get("consolidationPolicy") != want_policy:
-        return False
-    want = {r["key"]: r["values"] for r in ps.requirements_json[0]["value"]}
-    if observed.get("capacity_types") != want.get(
-            "karpenter.sh/capacity-type"):
-        return False
-    if observed.get("zones") != want.get("topology.kubernetes.io/zone"):
-        return False
-    return True
 
 
 class Controller:
@@ -228,6 +259,11 @@ class Controller:
                  lock: bool = False,
                  lock_dir: str | None = None,
                  degraded_fallback_after: int = 3,
+                 reconcile_rounds: int = 3,
+                 reconcile_backoff_s: float = 0.05,
+                 reconcile_deadline_s: float = 5.0,
+                 snapshot_path: str = "",
+                 snapshot_every: int = 1,
                  telemetry_path: str = "",
                  exporter=None,
                  tracer=None,
@@ -276,6 +312,24 @@ class Controller:
         # Home-region sink: workload-scoped objects (HPA) live here.
         self.sink = self.region_sinks.get(
             cfg.cluster.region, next(iter(self.region_sinks.values())))
+        # Desired-state reconciliation (actuation/reconcile.py): the
+        # apply stage converges each region's sink onto the rendered
+        # intent with deadline-bounded retries + read-back verification
+        # instead of firing apply_all once and hoping. One reconciler
+        # per DISTINCT sink object: regions sharing a sink share its
+        # retry state, and the AST guard (tests/test_timing_guard.py)
+        # pins that harness code never bypasses this path.
+        by_sink: dict[int, Reconciler] = {}
+        self._reconcilers: dict[str, Reconciler] = {}
+        for region, snk in self.region_sinks.items():
+            rec = by_sink.get(id(snk))
+            if rec is None:
+                rec = by_sink[id(snk)] = Reconciler(
+                    snk, max_rounds=reconcile_rounds,
+                    backoff_s=reconcile_backoff_s,
+                    deadline_s=reconcile_deadline_s,
+                    seed=seed ^ 0x5EC0)
+            self._reconcilers[region] = rec
         self.interval_s = (cfg.signals.scrape_interval_s
                            if interval_s is None else interval_s)
         self.apply_hpa = apply_hpa
@@ -301,8 +355,27 @@ class Controller:
         self._fallback_policy = RulePolicy(cfg.cluster)
         self._degraded = "ok"
         self._stale_streak = 0
+        # Actuation divergence feeds the SAME state machine (round 12):
+        # a reconciler give-up increments this streak, and a cluster
+        # that will not converge drives hold → rule-fallback exactly
+        # like a stale signal — stop pushing fresh complex intents at
+        # an edge that is not accepting them.
+        self._diverge_streak = 0
         self._last_action: Action | None = None
         self.degraded_ticks_total = 0
+        # Crash-safety session counters + durable snapshot wiring
+        # (harness/snapshot.py; "" disables). Snapshots are written at
+        # the END of a tick (next_tick = t+1), so a kill between writes
+        # resumes at the last completed tick boundary and the decision
+        # stream replays bitwise.
+        self.reconcile_retries_total = 0
+        self.actuation_failures_total = 0
+        self.resumes_total = 0
+        self.snapshot_path = snapshot_path
+        self.snapshot_every = max(1, int(snapshot_every))
+        self._last_snapshot_tick: int | None = None
+        self._last_verified_desired: dict = {}
+        self._force_replan = False
         self.log_fn = log_fn if log_fn is not None else (
             lambda line: print(line, flush=True))
         self.sleep_fn = sleep_fn
@@ -325,11 +398,9 @@ class Controller:
         # Watched jit (obs/compile.py): the state-estimate step is the
         # controller's hot device path — after the warmup compile, a
         # recompile mid-run means a static-arg leak and gets warned.
-        from ccka_tpu.obs.compile import watch_jit
-        self._step = watch_jit(
-            jax.jit(lambda s, a, e, k: sim_step(self.params, s, a, e, k,
-                                                stochastic=False)),
-            "controller.step", hot=True)
+        # Config-keyed and shared across instances (`_compiled_steps`),
+        # so a crash-resumed controller reuses the dead one's compile.
+        self._step, self._step_wl = _compiled_steps(cfg)
         # Workload-family track (ccka_tpu/workloads): when the config
         # enables families, the state estimate also carries per-family
         # queues fed by a deterministic arrival sample (seed-keyed, one
@@ -356,18 +427,17 @@ class Controller:
             day = max(1, int(round(86400.0 / cfg.sim.dt_s)))
             self._wl_horizon = -(-max(int(cfg.sim.horizon_steps), day)
                                  // day) * day
+            # The anchor is snapshot state: a resumed run must re-sample
+            # the SAME arrival track, not re-anchor to its own clock.
+            self._wl_anchor = _workload_clock_anchor(source, cfg.sim.dt_s)
+            self._wl_cfg = wl_cfg
             self._wl_steps = sample_workload_steps(
                 wl_cfg, jax.random.key(seed ^ WORKLOAD_KEY_TAG),
                 self._wl_horizon,
                 cfg.cluster.n_zones, dt_s=cfg.sim.dt_s,
-                start_unix_s=_workload_clock_anchor(source, cfg.sim.dt_s))
+                start_unix_s=self._wl_anchor)
             self._wl_state = WorkloadState.zero(
                 int(self.params.wl_batch_deadline_ticks))
-            self._step_wl = watch_jit(
-                jax.jit(lambda s, ws, a, e, w, k: sim_step(
-                    self.params, s, a, e, k, stochastic=False,
-                    workload=w, wl_state=ws)),
-                "controller.step_wl", hot=True)
         # MPC-style backends replan against a forecast window. The window
         # provider is the SAME protocol the jitted evaluation loop uses
         # (`forecast.Forecaster`): a backend carrying a forecaster plans
@@ -491,13 +561,19 @@ class Controller:
             is_peak = bool(float(exo.is_peak) > 0.5)
 
         # 1a. degraded-mode state machine (see __init__): classify this
-        #     tick BEFORE deciding, on the source's staleness flag.
+        #     tick BEFORE deciding, on the source's staleness flag AND
+        #     the previous tick's actuation-divergence streak (round 12:
+        #     a reconciler give-up means the cluster is not accepting
+        #     patches — hold the last intent instead of thrashing it,
+        #     and after the threshold fall back to the simple rule
+        #     profile a flaky edge is most likely to converge on).
         stale = bool(getattr(self.source, "last_scrape_stale", False))
         self._stale_streak = self._stale_streak + 1 if stale else 0
+        streak = max(self._stale_streak, self._diverge_streak)
         prev_mode = self._degraded
-        if self._stale_streak == 0:
+        if streak == 0:
             self._degraded = "ok"
-        elif (self._stale_streak >= self.degraded_fallback_after
+        elif (streak >= self.degraded_fallback_after
               or self._last_action is None):
             # No held action to trust yet → straight to the fallback.
             self._degraded = "fallback"
@@ -508,7 +584,8 @@ class Controller:
         if prev_mode != self._degraded:
             self.log_fn(f"# degraded-mode: {prev_mode} -> "
                         f"{self._degraded} (stale streak "
-                        f"{self._stale_streak})")
+                        f"{self._stale_streak}, diverge streak "
+                        f"{self._diverge_streak})")
 
         # 1b. spot interruption warnings → cordon+drain BEFORE the decide,
         #     so displaced pods go Pending under the profile this tick is
@@ -549,7 +626,13 @@ class Controller:
             else:
                 # Replans are skipped while degraded (a window forecast
                 # anchored on stale measurements is garbage squared).
-                if self._replan_every and t % self._replan_every == 0:
+                # `_force_replan` re-plans once right after a snapshot
+                # resume: receding-horizon plan state does not survive a
+                # crash, so the first resumed decide must not execute a
+                # stale segment of the dead process's plan.
+                if self._replan_every and (
+                        t % self._replan_every == 0 or self._force_replan):
+                    self._force_replan = False
                     if self._forecaster is not None:
                         from ccka_tpu.forecast.base import planning_window
                         hist = self.source.history(t, self._hist_steps,
@@ -574,15 +657,26 @@ class Controller:
             per_region = render_region_nodepool_patches(
                 action, self.cfg.cluster, op="add" if is_peak else "replace")
 
-        # 4. apply through each region's sink (kubectl-shaped, with
-        #    fallback). With apply_hpa, the tick also realizes the HPA lever
-        #    as actual HorizontalPodAutoscaler objects in the home region —
-        #    the §2.3 capability the reference installed prometheus-adapter
-        #    for but never created.
-        with timer.stage("apply"):
+        # 4. apply through each region's RECONCILER (round 12): the
+        #    one-shot apply became convergence — deadline-bounded retries
+        #    with read-back verification per round, so a kubectl timeout
+        #    or a dropped patch is re-applied instead of silently lost.
+        #    With apply_hpa, the tick also realizes the HPA lever as
+        #    actual HorizontalPodAutoscaler objects in the home region —
+        #    the §2.3 capability the reference installed
+        #    prometheus-adapter for but never created.
+        with timer.stage("apply") as sp_apply:
             results = []
+            tick_retries = tick_failures = diverged_pools = 0
+            pools_converged = True
             for region, patches in per_region.items():
-                results += self.region_sinks[region].apply_all(patches)
+                outcome = self._reconcilers[region].converge(patches)
+                results += outcome.results
+                tick_retries += outcome.retries
+                tick_failures += outcome.failures
+                diverged_pools += len(outcome.diverged)
+                pools_converged &= outcome.converged
+            n_pool_results = len(results)
             if self.apply_hpa:
                 from ccka_tpu.actuation.patches import render_hpa_manifests
                 results += self.sink.apply_manifests(
@@ -599,15 +693,35 @@ class Controller:
                         region=self.cfg.cluster.region)))
             applied = all(r.ok for r in results)
             fallbacks = sum(1 for r in results if r.used_fallback)
+            self.reconcile_retries_total += tick_retries
+            # Manifest (HPA/KEDA) failures only: the reconciler's own
+            # failed applies are already inside outcome.failures, so
+            # counting the pool results again would double-book them.
+            tick_failures += sum(
+                1 for r in results[n_pool_results:] if not r.ok)
+            self.actuation_failures_total += tick_failures
+            sp_apply.args["retries"] = tick_retries
+            sp_apply.args["diverged"] = diverged_pools
 
-        # 5. verify: skeptical read-back against the rendered intent,
-        #    region by region.
+        # 5. verify: the reconciler already read back every pool against
+        #    the rendered intent (actuation/reconcile.verify_pool — ONE
+        #    definition of converged); a verified tick is one where every
+        #    pool converged AND every manifest applied. A give-up feeds
+        #    the degraded-mode streak the NEXT tick classifies on.
         with timer.stage("verify"):
-            verified = applied and all(
-                _verify_pool(
-                    self.region_sinks[region].observed_state(ps.pool), ps)
-                for region, patches in per_region.items()
-                for ps in patches)
+            verified = applied and pools_converged
+            self._diverge_streak = (0 if pools_converged
+                                    else self._diverge_streak + 1)
+            if verified:
+                self._last_verified_desired = {
+                    region: {ps.pool: {
+                        "consolidationPolicy": ps.disruption_merge["spec"]
+                        ["disruption"]["consolidationPolicy"],
+                        "requirements": {
+                            r["key"]: r["values"]
+                            for r in ps.requirements_json[0]["value"]},
+                    } for ps in patches}
+                    for region, patches in per_region.items()}
 
         # 6. advance the model-based state estimate (expectation dynamics;
         #    with workload families enabled, the per-family queue track
@@ -694,13 +808,195 @@ class Controller:
             inference_slo_violations_total=(
                 self.inference_slo_violations_total),
             batch_deadline_misses_total=self.batch_deadline_misses_total,
+            reconcile_retries=tick_retries,
+            reconcile_retries_total=self.reconcile_retries_total,
+            reconcile_diverged=diverged_pools,
+            actuation_failures=tick_failures,
+            actuation_failures_total=self.actuation_failures_total,
+            resumes_total=self.resumes_total,
         )
+        # 8. durable snapshot (harness/snapshot.py; "" disables): written
+        #    at the END of the tick with next_tick=t+1, atomically, so a
+        #    kill at any point resumes at the last completed boundary.
+        if self.snapshot_path:
+            if t % self.snapshot_every == 0:
+                self.write_snapshot(t + 1)
+                self._last_snapshot_tick = t
+            report.snapshot_age_ticks = (
+                t - self._last_snapshot_tick
+                if self._last_snapshot_tick is not None else t + 1)
         self.log_fn(report.to_json())
         if self.telemetry is not None:
             self.telemetry.write(dataclasses.asdict(report))
         if self.exporter is not None:
             self.exporter.update(report)
         return report
+
+    # -- durable snapshot / resume (ARCHITECTURE §14) -----------------------
+
+    def snapshot_body(self, next_tick: int) -> dict:
+        """Everything a fresh process needs to continue this run bitwise:
+        tick index, PRNG key data (the (split) key path), the state
+        estimate, the degraded-mode machine, session counters, and the
+        last applied+verified desired state (the audit record that makes
+        re-applying after a mid-tick kill provably idempotent)."""
+        from ccka_tpu.harness import snapshot as snap
+
+        body: dict = {
+            "kind": "controller",
+            "next_tick": int(next_tick),
+            "seed": int(self.seed),
+            "backend": getattr(self.backend, "name",
+                               type(self.backend).__name__),
+            "config_sha256": snap.config_digest(self.cfg),
+            "prng_key": snap.encode_key(self.key),
+            "state": snap.encode_tree(self.state),
+            "degraded": self._degraded,
+            "stale_streak": int(self._stale_streak),
+            "diverge_streak": int(self._diverge_streak),
+            "degraded_ticks_total": int(self.degraded_ticks_total),
+            "reconcile_retries_total": int(self.reconcile_retries_total),
+            "actuation_failures_total": int(self.actuation_failures_total),
+            "resumes_total": int(self.resumes_total),
+            "drained_instances": list(self._drained_instances),
+            # Carried-over unresolved interruption warnings: the SQS ack
+            # happened at poll time, so this buffer is the warning's ONLY
+            # memory — losing it across a crash would waste the 2-minute
+            # notice (the drained-instances sibling above has the same
+            # property for dedupe).
+            "pending_warnings": [
+                {"instance_id": w.instance_id, "action": w.action,
+                 "detail_type": w.detail_type, "region": w.region,
+                 "ttl": int(ttl)}
+                for w, ttl in self._pending_warnings.values()],
+            "desired": self._last_verified_desired,
+            "last_action": (snap.encode_tree(self._last_action)
+                            if self._last_action is not None else None),
+            "wl": None,
+        }
+        # Receding-horizon backend plan state (MPCBackend._plan): with it
+        # in the snapshot, a resumed MPC run continues executing the SAME
+        # optimized plan at the same cadence — bitwise, like the
+        # stateless-decide backends.
+        plan = getattr(self.backend, "_plan", None)
+        if plan is not None:
+            body["backend_plan"] = snap.encode_tree(plan)
+            body["backend_plan_age"] = int(
+                getattr(self.backend, "_plan_age", 0))
+        if self._wl_steps is not None:
+            body["wl"] = {
+                "state": snap.encode_tree(self._wl_state),
+                "anchor_unix_s": float(self._wl_anchor),
+                "inference_slo_violations_total": float(
+                    self.inference_slo_violations_total),
+                "batch_deadline_misses_total": float(
+                    self.batch_deadline_misses_total),
+            }
+        return body
+
+    def write_snapshot(self, next_tick: int) -> str:
+        from ccka_tpu.harness.snapshot import save_snapshot
+        return save_snapshot(self.snapshot_path, self.snapshot_body(
+            next_tick))
+
+    def restore(self, body: dict) -> int:
+        """Restore from a snapshot body (`snapshot.load_snapshot`);
+        returns the tick to resume at. Refuses identity mismatches —
+        config, backend, seed — loudly: resuming another run's snapshot
+        would not crash, it would silently corrupt the estimate."""
+        from ccka_tpu.harness import snapshot as snap
+
+        if body.get("kind") != "controller":
+            raise snap.SnapshotError(
+                f"snapshot kind {body.get('kind')!r} is not a controller "
+                "snapshot")
+        digest = snap.config_digest(self.cfg)
+        if body.get("config_sha256") != digest:
+            raise snap.SnapshotError(
+                "snapshot was taken under a different config "
+                f"(stored {body.get('config_sha256', '')[:12]}…, running "
+                f"{digest[:12]}…) — resuming across configs would corrupt "
+                "the state estimate; rerun with the original config")
+        want_backend = getattr(self.backend, "name",
+                               type(self.backend).__name__)
+        if body.get("backend") != want_backend:
+            raise snap.SnapshotError(
+                f"snapshot was taken with backend {body.get('backend')!r}, "
+                f"this controller runs {want_backend!r} — the decision "
+                "stream would silently change policy mid-run")
+        if int(body.get("seed", -1)) != int(self.seed):
+            raise snap.SnapshotError(
+                f"snapshot seed {body.get('seed')} != controller seed "
+                f"{self.seed} — the PRNG path would fork")
+        self.key = snap.decode_key(body["prng_key"])
+        self.state = snap.decode_like(self.state, body["state"])
+        la = body.get("last_action")
+        template = Action.neutral(self.cfg.cluster.n_pools,
+                                  self.cfg.cluster.n_zones)
+        self._last_action = (snap.decode_like(template, la)
+                             if la is not None else None)
+        self._degraded = body.get("degraded", "ok")
+        self._stale_streak = int(body.get("stale_streak", 0))
+        self._diverge_streak = int(body.get("diverge_streak", 0))
+        self.degraded_ticks_total = int(body.get("degraded_ticks_total", 0))
+        self.reconcile_retries_total = int(
+            body.get("reconcile_retries_total", 0))
+        self.actuation_failures_total = int(
+            body.get("actuation_failures_total", 0))
+        self.resumes_total = int(body.get("resumes_total", 0)) + 1
+        self._drained_instances = dict.fromkeys(
+            body.get("drained_instances", []))
+        if body.get("pending_warnings"):
+            from ccka_tpu.signals.live import InterruptionWarning
+            self._pending_warnings = {
+                rec["instance_id"]: (
+                    InterruptionWarning(rec["instance_id"], rec["action"],
+                                        rec["detail_type"],
+                                        rec.get("region", "")),
+                    int(rec["ttl"]))
+                for rec in body["pending_warnings"]}
+        self._last_verified_desired = body.get("desired", {})
+        wl = body.get("wl")
+        if wl is not None and self._wl_steps is not None:
+            self._wl_state = snap.decode_like(self._wl_state, wl["state"])
+            self.inference_slo_violations_total = float(
+                wl["inference_slo_violations_total"])
+            self.batch_deadline_misses_total = float(
+                wl["batch_deadline_misses_total"])
+            if wl["anchor_unix_s"] != self._wl_anchor:
+                # Re-sample the arrival track on the ORIGINAL clock
+                # anchor: a live resume must not re-phase the diurnal
+                # arrivals to its own (later) start time.
+                from ccka_tpu.workloads.process import (
+                    WORKLOAD_KEY_TAG, sample_workload_steps)
+                self._wl_anchor = float(wl["anchor_unix_s"])
+                self._wl_steps = sample_workload_steps(
+                    self._wl_cfg,
+                    jax.random.key(self.seed ^ WORKLOAD_KEY_TAG),
+                    self._wl_horizon, self.cfg.cluster.n_zones,
+                    dt_s=self.cfg.sim.dt_s,
+                    start_unix_s=self._wl_anchor)
+        next_tick = int(body["next_tick"])
+        self._last_snapshot_tick = next_tick - 1
+        # Receding-horizon plan state: restored from the snapshot when
+        # the backend carries it (resume stays bitwise — the plan and
+        # its replan cadence both survive); only a snapshot from before
+        # plan-state capture falls back to an immediate replan, so the
+        # first resumed decide never executes a plan that died with the
+        # old process.
+        bp = body.get("backend_plan")
+        if bp is not None and getattr(self.backend, "_plan",
+                                      None) is not None:
+            self.backend._plan = snap.decode_like(self.backend._plan, bp)
+            if hasattr(self.backend, "_plan_age"):
+                self.backend._plan_age = int(
+                    body.get("backend_plan_age", 0))
+            self._force_replan = False
+        else:
+            self._force_replan = bool(self._replan_every)
+        self.log_fn(f"# resumed from snapshot at tick {next_tick} "
+                    f"(resume #{self.resumes_total})")
+        return next_tick
 
     # -- the loop ----------------------------------------------------------
 
@@ -771,6 +1067,26 @@ def controller_from_config(cfg: FrameworkConfig, backend: PolicyBackend,
             cfg.signals.interruption_queue_url, region=cfg.cluster.region,
             runner=interruption_runner)
 
+    chaos_on = cfg.chaos.enabled and (
+        cfg.chaos.timeout_prob + cfg.chaos.transient_exit_prob
+        + cfg.chaos.drop_prob + cfg.chaos.rewrite_prob) > 0.0
+    if chaos_on and live:
+        raise ValueError(
+            "chaos injection (cfg.chaos) is a dry-run recovery-harness "
+            "tool; injecting failures into a live kubectl path would "
+            "fight a real cluster — drop --live or disable chaos")
+
+    def wrap(s, idx=0):
+        if not chaos_on:
+            return s
+        from ccka_tpu.actuation.chaos import ChaosSink
+        # Per-region seed derivation (the fleet's per-sink idiom): one
+        # shared seed would draw IDENTICAL fate sequences in every
+        # region — region-asymmetric failure, the case the per-region
+        # reconciler + divergence streak exist for, would never occur.
+        return ChaosSink(s, cfg.chaos,
+                         seed=kwargs.get("seed", 0) ^ (0xC4A05 + idx))
+
     if cfg.cluster.regions:
         # One sink per regional cluster.
         if live:
@@ -792,10 +1108,11 @@ def controller_from_config(cfg: FrameworkConfig, backend: PolicyBackend,
             sink = {r.name: KubectlSink(runners[r.name])
                     for r in cfg.cluster.regions}
         else:
-            sink = {r.name: DryRunSink() for r in cfg.cluster.regions}
+            sink = {r.name: wrap(DryRunSink(), i)
+                    for i, r in enumerate(cfg.cluster.regions)}
     else:
         if live:
             sink = KubectlSink(runner) if runner else KubectlSink()
         else:
-            sink = DryRunSink()
+            sink = wrap(DryRunSink())
     return Controller(cfg, backend, source, sink, **kwargs)

@@ -58,6 +58,18 @@ _PANEL_DEFS = (
     ("Degraded ticks (session)", "ccka_degraded_ticks_total", "short"),
     ("Fault events", "ccka_nodes_denied + ccka_nodes_delayed + "
      "ccka_nodes_drained", "short"),
+    # Crash-safety panels (round 12; ARCHITECTURE §14): reconciler
+    # convergence pressure, actuation failure budget, and the snapshot/
+    # resume health of the control loop itself — an operator must see
+    # "3 pools diverged, snapshot 40 ticks old" BEFORE restarting the
+    # daemon, not find out after.
+    ("Reconcile retries (session)", "ccka_reconcile_retries_total",
+     "short"),
+    ("Actuation divergence", "ccka_reconcile_diverged", "short"),
+    ("Actuation failures (session)", "ccka_actuation_failures_total",
+     "short"),
+    ("Snapshot age", "ccka_snapshot_age_ticks", "short"),
+    ("Resumes (session)", "ccka_resumes_total", "short"),
     # Workload-family panels (ccka_tpu/workloads): per-family queue
     # pressure and the session's SLO accounting, on the same board as
     # the fleet cost/SLO panels the families trade against.

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ccka_tpu.actuation.patches import render_nodepool_patches
+from ccka_tpu.actuation.reconcile import Reconciler
 from ccka_tpu.actuation.sink import ActuationSink
 from ccka_tpu.config import FrameworkConfig
 from ccka_tpu.policy.base import PolicyBackend
@@ -108,6 +109,17 @@ class FleetController:
         self.backend = backend
         self.sinks = list(sinks)
         self.n = len(self.sinks)
+        # Desired-state reconciliation per cluster (round 12): the
+        # fan-out converges each sink onto its rendered patches (retry +
+        # read-back) instead of one-shot apply_all — same discipline as
+        # the single-cluster controller, and the AST guard pins that no
+        # harness code bypasses it. Backoff is kept tiny: a fleet tick
+        # has a 30s budget and the worker pool already parallelizes
+        # per-sink stalls.
+        self._reconcilers = [
+            Reconciler(s, max_rounds=2, backoff_s=0.01, deadline_s=2.0,
+                       seed=seed ^ (0x5EC0 + i))
+            for i, s in enumerate(self.sinks)]
         self.params = SimParams.from_config(cfg)
         self.log_fn = log_fn or (lambda s: None)
         # Shared span tracer (obs/trace.py): dispatch/harvest/fanout spans
@@ -122,6 +134,7 @@ class FleetController:
         self._traces = source.batch_trace_device(
             horizon_ticks, jax.random.key(seed), n)
         self.horizon_ticks = horizon_ticks
+        self._seed = seed
         base = initial_state(cfg)
         self.states: ClusterState = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (n,) + x.shape), base)
@@ -215,8 +228,7 @@ class FleetController:
                 patches = render_nodepool_patches(
                     a_i, self.cfg.cluster,
                     op="add" if is_peak else "replace")
-                results = self.sinks[i].apply_all(patches)
-                ok += all(r.ok for r in results)
+                ok += self._reconcilers[i].converge(patches).converged
             return ok
 
         # Width adapts to the fleet: a 12-cluster live fleet still spreads
@@ -261,6 +273,59 @@ class FleetController:
     def tick(self, t: int) -> FleetTickReport:
         """Synchronous single tick (tests / cadenced live loops)."""
         return self._harvest_and_fanout(self._dispatch(t))
+
+    # -- durable snapshot / resume (ARCHITECTURE §14) -----------------------
+    #
+    # The fleet's device state is the [N, ...] ClusterState batch plus a
+    # CONSTANT key (ticks fold t in, the key never advances), so resume
+    # is states + tick index; traces regenerate deterministically from
+    # (source, seed) at construction. Same codec + identity checks as
+    # the single-cluster controller.
+
+    def snapshot_body(self, next_tick: int) -> dict:
+        from ccka_tpu.harness import snapshot as snap
+
+        return {
+            "kind": "fleet",
+            "next_tick": int(next_tick),
+            "n_clusters": int(self.n),
+            "seed": int(self._seed),
+            "horizon_ticks": int(self.horizon_ticks),
+            "config_sha256": snap.config_digest(self.cfg),
+            "prng_key": snap.encode_key(self.key),
+            "states": snap.encode_tree(self.states),
+        }
+
+    def write_snapshot(self, path: str, next_tick: int) -> str:
+        from ccka_tpu.harness.snapshot import save_snapshot
+        return save_snapshot(path, self.snapshot_body(next_tick))
+
+    def restore(self, body: dict) -> int:
+        """Restore device state from a snapshot body; returns the resume
+        tick. Identity mismatches (config, fleet size, seed) are refused
+        — see Controller.restore for why loudness matters here."""
+        from ccka_tpu.harness import snapshot as snap
+
+        if body.get("kind") != "fleet":
+            raise snap.SnapshotError(
+                f"snapshot kind {body.get('kind')!r} is not a fleet "
+                "snapshot")
+        if body.get("config_sha256") != snap.config_digest(self.cfg):
+            raise snap.SnapshotError(
+                "fleet snapshot was taken under a different config")
+        if int(body.get("n_clusters", -1)) != self.n:
+            raise snap.SnapshotError(
+                f"fleet snapshot holds {body.get('n_clusters')} clusters, "
+                f"this controller drives {self.n}")
+        if (int(body.get("seed", -1)) != self._seed
+                or int(body.get("horizon_ticks", -1))
+                != self.horizon_ticks):
+            raise snap.SnapshotError(
+                "fleet snapshot seed/horizon mismatch — the exo streams "
+                "would fork from the run being resumed")
+        self.key = snap.decode_key(body["prng_key"])
+        self.states = snap.decode_like(self.states, body["states"])
+        return int(body["next_tick"])
 
     def run(self, ticks: int, start_tick: int = 0, *,
             pipeline_depth: int = 2) -> list[FleetTickReport]:

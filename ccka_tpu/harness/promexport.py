@@ -90,6 +90,28 @@ SERIES: dict[str, tuple[str, str]] = {
     "ccka_batch_deadline_misses_total": (
         "batch_deadline_misses_total",
         "Cumulative batch work missing its deadline this session"),
+    # Crash-safety series (round 12; ARCHITECTURE §14): the reconciler's
+    # convergence counters, the actuation failure budget, and the
+    # snapshot/resume health of the loop itself. The _total counters are
+    # session-cumulative (kube-state-metrics style) and survive
+    # snapshot/resume — a resumed controller re-states the dead one's
+    # running totals instead of resetting the wire to zero.
+    "ccka_reconcile_retries_total": (
+        "reconcile_retries_total",
+        "Cumulative reconciler re-apply attempts this session"),
+    "ccka_reconcile_diverged": (
+        "reconcile_diverged",
+        "Pools still diverged from intent after this tick's "
+        "reconciliation (0 = converged)"),
+    "ccka_actuation_failures_total": (
+        "actuation_failures_total",
+        "Cumulative failed applies + failed read-backs this session"),
+    "ccka_snapshot_age_ticks": (
+        "snapshot_age_ticks",
+        "Ticks since the last durable snapshot write (0 = fresh)"),
+    "ccka_resumes_total": (
+        "resumes_total",
+        "Times this logical run was resumed from a snapshot"),
     "ccka_applied": ("applied", "1 if every patch applied this tick"),
     "ccka_verified": ("verified", "1 if read-back matched intent"),
     "ccka_tick": ("t", "Controller tick counter"),

@@ -1,0 +1,166 @@
+"""Durable controller snapshots: versioned, checksummed, atomic.
+
+The reference's only persistence was idempotent re-runnable scripts plus
+state left in the cluster; a controller daemon that dies mid-run lost
+its tick index, PRNG path, state estimate and degraded-mode machine —
+everything `ccka run --resume` needs to continue *bitwise* where it
+stopped. This module is the codec + disk discipline:
+
+- **versioned**: every snapshot carries ``format``/``version``; a reader
+  refuses formats it does not understand instead of mis-decoding them;
+- **checksummed**: the body's canonical JSON is SHA-256'd at write time
+  and re-verified at load — a torn or hand-edited file is refused with
+  a :class:`SnapshotError`, never half-restored;
+- **atomic**: write-temp-then-rename in the target directory (the same
+  discipline as promexport's textfile and orbax checkpoints), so a
+  crash mid-write leaves the previous good snapshot in place;
+- **pytree-faithful**: device arrays round-trip through base64-encoded
+  raw bytes with dtype/shape, keyed by their `jax.tree_util` key paths,
+  so restore rebuilds the exact leaves (PRNG key data included) —
+  `tests/test_recovery.py` pins save→load→tree-equality.
+
+The body schema is owned by the writers (`harness/controller.py`,
+`harness/fleet.py`); this module only guarantees integrity + fidelity.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccka_tpu.train.checkpoint import _path_part
+
+SNAPSHOT_FORMAT = "ccka-snapshot"
+SNAPSHOT_VERSION = 1
+
+
+class SnapshotError(RuntimeError):
+    """Unreadable, corrupt, or incompatible snapshot."""
+
+
+# -- pytree <-> JSON-safe encoding ------------------------------------------
+
+
+def encode_tree(tree: Any) -> dict:
+    """Flatten a pytree of arrays to {key-path: {dtype, shape, b64}}."""
+    out: dict[str, dict] = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        out["/".join(_path_part(p) for p in kp) or "."] = {
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+        }
+    return out
+
+
+def decode_like(template: Any, enc: dict) -> Any:
+    """Rebuild a pytree shaped like ``template`` from :func:`encode_tree`
+    output. Leaves are matched by key path; a missing or shape-mismatched
+    leaf is a :class:`SnapshotError` (schema drift must fail loudly, not
+    restore a half-right state)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for kp, leaf in flat:
+        key = "/".join(_path_part(p) for p in kp) or "."
+        rec = enc.get(key)
+        if rec is None:
+            raise SnapshotError(f"snapshot missing leaf {key!r}")
+        raw = base64.b64decode(rec["b64"])
+        arr = np.frombuffer(raw, dtype=np.dtype(rec["dtype"])).reshape(
+            rec["shape"])
+        want = tuple(np.shape(leaf))
+        if tuple(arr.shape) != want:
+            raise SnapshotError(
+                f"snapshot leaf {key!r} has shape {tuple(arr.shape)}, "
+                f"expected {want}")
+        leaves.append(jnp.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def encode_key(key: jax.Array) -> dict:
+    """A typed PRNG key as its raw key data (impl-stable uint32 words)."""
+    return encode_tree(jax.random.key_data(key))
+
+
+def decode_key(enc: dict) -> jax.Array:
+    rec = enc.get(".")
+    if rec is None:
+        raise SnapshotError("snapshot missing PRNG key data")
+    raw = np.frombuffer(base64.b64decode(rec["b64"]),
+                        dtype=np.dtype(rec["dtype"])).reshape(rec["shape"])
+    return jax.random.wrap_key_data(jnp.asarray(raw))
+
+
+# -- disk format -------------------------------------------------------------
+
+
+def _canonical(body: dict) -> str:
+    return json.dumps(body, sort_keys=True, separators=(",", ":"))
+
+
+def save_snapshot(path: str, body: dict) -> str:
+    """Atomically write ``body`` with integrity envelope; returns path."""
+    doc = {
+        "format": SNAPSHOT_FORMAT,
+        "version": SNAPSHOT_VERSION,
+        "sha256": hashlib.sha256(_canonical(body).encode()).hexdigest(),
+        "body": body,
+    }
+    path = os.path.abspath(path)
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".snap.tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path
+
+
+def load_snapshot(path: str) -> dict:
+    """Read + verify a snapshot; returns the body. Raises SnapshotError
+    on any integrity/compatibility problem."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise SnapshotError(f"cannot read snapshot {path!r}: {e}")
+    except json.JSONDecodeError as e:
+        raise SnapshotError(f"snapshot {path!r} is not valid JSON "
+                            f"(torn write?): {e}")
+    if not isinstance(doc, dict) or doc.get("format") != SNAPSHOT_FORMAT:
+        raise SnapshotError(f"{path!r} is not a {SNAPSHOT_FORMAT} file")
+    if doc.get("version") != SNAPSHOT_VERSION:
+        raise SnapshotError(
+            f"snapshot {path!r} has version {doc.get('version')!r}; this "
+            f"reader understands version {SNAPSHOT_VERSION} only")
+    body = doc.get("body")
+    want = doc.get("sha256")
+    got = hashlib.sha256(_canonical(body).encode()).hexdigest()
+    if got != want:
+        raise SnapshotError(
+            f"snapshot {path!r} failed its checksum (stored {want!r}, "
+            f"recomputed {got!r}) — refusing to restore corrupt state")
+    return body
+
+
+def config_digest(cfg) -> str:
+    """Identity digest of a FrameworkConfig — resumed runs must refuse a
+    snapshot taken under a different config (silently mixing topologies
+    would corrupt the state estimate)."""
+    return hashlib.sha256(cfg.to_json().encode()).hexdigest()

@@ -148,3 +148,19 @@ def test_faults_only_flag_and_stage_wiring():
 
     src = inspect.getsource(bench.bench_faults)
     assert "fault_scoreboard" in src
+
+
+def test_recovery_only_flag_and_stage_wiring():
+    """ISSUE 9: the crash-recovery scoreboard has a record path
+    (`--recovery-only`) and the main sweep carries the stage — argparse
+    contract only (the harness itself is exercised in
+    tests/test_recovery.py and the BENCH_r12 record)."""
+    parser_src = open(bench.__file__, encoding="utf-8").read()
+    assert "--recovery-only" in parser_src
+    assert "bench_recovery" in parser_src
+    # bench_recovery delegates to the shared harness module (the CLI's
+    # recover-eval uses the same one — one implementation, two drivers).
+    import inspect
+
+    src = inspect.getsource(bench.bench_recovery)
+    assert "recovery_scoreboard" in src

@@ -237,6 +237,69 @@ class TestRobustnessClaims:
         assert m2 and int(m2.group(1)) <= sb["n_traces"]
 
 
+class TestRecoveryClaims:
+    """Round 12's crash-recovery scoreboard (ISSUE 9 docs satellite):
+    README's crash-recovery claims are PARSED against the BASELINE
+    round12 record, not hand-synced."""
+
+    def test_round12_record_is_self_describing(self, baseline):
+        r12 = baseline["published"]["round12"]
+        sb = r12["recovery_scoreboard"]
+        assert sb["n_paired_runs"] >= 64
+        assert len(sb["intensities"]) >= 3 and "off" in sb["intensities"]
+        assert set(sb["policies"]) >= {"rule", "flagship"}
+        # The invariant holds on the record itself, cell by cell: zero
+        # duplicate/lost patches, fully bitwise resume, paired $/SLO-hr
+        # ratio exactly 1 — under every intensity, for every policy.
+        for name, cell in sb["cells"].items():
+            for policy, row in cell["rows"].items():
+                assert row["duplicate_patches_total"] == 0, (name, policy)
+                assert row["lost_patches_total"] == 0, (name, policy)
+                assert row["resume_bitwise_frac"] == 1.0, (name, policy)
+                assert row["ticks_to_reconverge_max"] == 0, (name, policy)
+                assert row["usd_per_slo_hr_vs_baseline"] == 1.0
+        # And the stress was real: the severe cell injected failures and
+        # the reconciler actually retried through them.
+        sev = sb["cells"]["severe"]["rows"]["rule"]
+        assert sum(sev["chaos_injected"].values()) > 0
+        assert sev["reconcile_retries_mean"] > 0
+        assert "bitwise" in r12["kill_resume_bitwise_gate"]
+        assert "command-for-command" in r12["zero_injection_gate"]
+
+    def test_readme_recovery_claims(self, readme, baseline):
+        sb = (baseline["published"]["round12"]["recovery_scoreboard"])
+        m = re.search(
+            r"(\d+)\s+paired\s+kill/no-kill\s+runs\s+\(BASELINE"
+            r"\s+round12", readme)
+        assert m, ("README's recovery claim no longer states the paired-"
+                   "run count in the pinned form — update the claim AND "
+                   "this regex together")
+        assert int(m.group(1)) == sb["n_paired_runs"]
+        m2 = re.search(
+            r"(\d+)\s+duplicate\s+patches,\s+(\d+)\s+lost\s+patches,\s+"
+            r"bitwise-resume\s+fraction\s+([\d.]+),\s+(\d+)\s+ticks\s+to"
+            r"\s+reconverge,\s+and\s+a\s+killed-vs-uninterrupted\s+"
+            r"\$/SLO-hour\s+ratio\s+of\s+([\d.]+)", readme)
+        assert m2, "README's invariant sentence lost its pinned form"
+        dup, lost, bitwise, reconv, ratio = m2.groups()
+        inv = sb["invariants"]
+        assert int(dup) == inv["duplicate_patches_total"]
+        assert int(lost) == inv["lost_patches_total"]
+        assert abs(float(bitwise) - inv["resume_bitwise_frac"]) < 5e-4
+        sev = sb["cells"]["severe"]["rows"]["rule"]
+        assert int(reconv) == sev["ticks_to_reconverge_max"]
+        assert abs(float(ratio)
+                   - sev["usd_per_slo_hr_vs_baseline"]) < 5e-5
+        m3 = re.search(r"~(\d+)\s+injected\s+kubectl\s+failures\s+and\s+"
+                       r"spent\s+([\d.]+)\s+reconcile\s+retries", readme)
+        assert m3, "README's severe-cell stress claim lost its form"
+        injected_per_run = (sum(sev["chaos_injected"].values())
+                            / sev["n_pairs"])
+        assert abs(float(m3.group(1)) - injected_per_run) < 1.0
+        assert abs(float(m3.group(2))
+                   - sev["reconcile_retries_mean"]) < 0.1
+
+
 class TestWorkloadScenarioClaims:
     """Round 11's per-family scenario scoreboard (ISSUE 6 docs
     satellite): README's workload-scenario claims are PARSED against

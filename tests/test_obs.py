@@ -223,7 +223,11 @@ class TestMPCRecompileDetection:
 class TestControllerSteadyState:
     def test_zero_recompiles_after_warmup(self, cfg):
         """Acceptance: the steady-state controller loop compiles its
-        estimate step exactly once; every later tick is a cache hit."""
+        estimate step at most once PER CONFIG for the whole session —
+        the round-12 config-keyed shared step cache
+        (`controller._compiled_steps`) means a second controller of the
+        same config (a crash-resume, a recovery-harness pair) reuses the
+        first one's compile, so stats are measured as deltas."""
         from ccka_tpu.actuation.sink import DryRunSink
         from ccka_tpu.harness.controller import Controller
         from ccka_tpu.policy import RulePolicy
@@ -233,11 +237,22 @@ class TestControllerSteadyState:
                                     cfg.signals)
         ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, DryRunSink(),
                           interval_s=0.0, log_fn=lambda _l: None)
-        ctrl.run(ticks=4)
         s = ctrl._step.stats
-        assert s.calls == 4
-        assert s.compiles == 1
-        assert s.cache_hits == 3
+        calls0, compiles0 = s.calls, s.compiles
+        ctrl.run(ticks=4)
+        assert s.calls - calls0 == 4
+        assert s.compiles - compiles0 <= 1     # 0 if the cfg already ran
+        assert s.compiles >= 1
+        assert s.cache_hits >= 3
+        # And a SECOND controller of the same config pays zero compiles
+        # — the crash-resume property, pinned at the compile counter.
+        ctrl2 = Controller(cfg, RulePolicy(cfg.cluster), src, DryRunSink(),
+                           interval_s=0.0, log_fn=lambda _l: None)
+        assert ctrl2._step is ctrl._step
+        compiles1 = s.compiles
+        ctrl2.run(ticks=2)
+        assert s.compiles == compiles1
+        ctrl2.close()
         ctrl.close()
 
     def test_tick_spans_share_a_tracer(self, cfg):

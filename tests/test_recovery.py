@@ -1,0 +1,703 @@
+"""Crash-safe control loop (ISSUE 9): ChaosSink injection modes, the
+desired-state Reconciler, durable snapshot/resume with the kill-and-
+resume bitwise invariant, and the recovery harness end to end.
+
+The load-bearing pins:
+
+- **zero-injection gate**: a `ChaosSink(off)`-wrapped run is
+  command-for-command identical to the bare sink (the chaos analog of
+  the zero-fault bitwise gate);
+- **snapshot round-trip**: save -> load -> `jax.tree_util` equality,
+  PRNG key path included (subsequent splits produce identical keys);
+- **kill-and-resume bitwise invariant**: for a fixed seed and kill
+  tick, the resumed run's decision stream and applied-patch sequence
+  are identical to an uninterrupted run's, with ZERO duplicate and
+  ZERO lost patches — pinned across >= 3 kill points fast-lane and at
+  every tick in the slow sweep;
+- **reconciler convergence** under each chaos failure mode, with a
+  bounded give-up that never raises.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.actuation.chaos import ChaosSink, make_chaos_sink
+from ccka_tpu.actuation.patches import render_nodepool_patches
+from ccka_tpu.actuation.reconcile import Reconciler, verify_pool
+from ccka_tpu.actuation.sink import DryRunSink
+from ccka_tpu.config import (CHAOS_PRESETS, ChaosConfig, ConfigError,
+                             default_config)
+from ccka_tpu.harness.controller import Controller
+from ccka_tpu.harness.snapshot import (SnapshotError, decode_key,
+                                       decode_like, encode_key,
+                                       encode_tree, load_snapshot,
+                                       save_snapshot)
+from ccka_tpu.policy import RulePolicy
+from ccka_tpu.policy.rule import offpeak_action
+from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+
+def _src(cfg, **kw):
+    return SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                 cfg.signals, **kw)
+
+
+def _controller(cfg, sink, *, seed=0, snapshot_path="", src=None, **kw):
+    return Controller(cfg, RulePolicy(cfg.cluster), src or _src(cfg),
+                      sink, interval_s=0.0, seed=seed,
+                      log_fn=lambda _l: None, snapshot_path=snapshot_path,
+                      reconcile_backoff_s=0.0, **kw)
+
+
+def _fingerprints(reports):
+    return [(r.t, r.profile, r.cost_usd_hr, r.carbon_g_hr, r.nodes_spot,
+             r.nodes_od, r.pending_pods, r.slo_ok, r.applied, r.verified)
+            for r in reports]
+
+
+# ---------------------------------------------------------------------------
+# ChaosSink
+# ---------------------------------------------------------------------------
+
+
+class TestChaosConfig:
+    def test_presets_validate(self):
+        for name, preset in CHAOS_PRESETS.items():
+            preset.validate()
+            assert preset.enabled, name
+
+    def test_bad_probabilities_rejected(self):
+        with pytest.raises(ConfigError):
+            ChaosConfig(enabled=True, drop_prob=1.5).validate()
+        with pytest.raises(ConfigError):
+            ChaosConfig(enabled=True, timeout_prob=0.6,
+                        drop_prob=0.6).validate()
+
+    def test_unknown_intensity_rejected_up_front(self):
+        with pytest.raises(ValueError, match="unknown chaos intensity"):
+            make_chaos_sink(DryRunSink(), "catastrophic")
+
+
+class TestChaosSink:
+    def _patches(self, cfg):
+        return render_nodepool_patches(offpeak_action(cfg.cluster),
+                                       cfg.cluster, op="replace")
+
+    def test_zero_injection_gate_is_command_for_command(self, cfg):
+        """ChaosSink(off) must be a bitwise no-op on the command stream —
+        wrapper in the path, nothing injected, nothing drawn."""
+        bare, inner = DryRunSink(), DryRunSink()
+        wrapped = make_chaos_sink(inner, "off", seed=123)
+        for sink in (bare, wrapped):
+            for ps in self._patches(cfg):
+                sink.apply_nodepool(ps)
+        assert ([c.render() for c in bare.commands]
+                == [c.render() for c in inner.commands])
+        assert wrapped.stats["commands"] == 0   # no draws, not just no hits
+
+    def test_timeout_and_transient_block_the_mutation(self, cfg):
+        for field, counter in (("timeout_prob", "timeouts"),
+                               ("transient_exit_prob", "transient_exits")):
+            inner = DryRunSink()
+            sink = ChaosSink(inner, ChaosConfig(enabled=True,
+                                                **{field: 1.0}), seed=0)
+            results = [sink.apply_nodepool(ps)
+                       for ps in self._patches(cfg)]
+            assert not any(r.ok for r in results)
+            assert inner.commands == []          # nothing reached kubectl
+            assert sink.stats[counter] > 0
+
+    def test_silent_drop_reports_ok_but_readback_catches_it(self, cfg):
+        inner = DryRunSink()
+        sink = ChaosSink(inner, ChaosConfig(enabled=True, drop_prob=1.0),
+                         seed=0)
+        results = [sink.apply_nodepool(ps) for ps in self._patches(cfg)]
+        # The disruption merge "succeeded" (the lie), so apply proceeds,
+        # but the requirements never land and BOTH read-backs come up
+        # empty — the apply-and-verify discipline catches the drop.
+        assert not any(r.ok for r in results)
+        assert all(r.used_fallback for r in results)
+        assert inner.commands == []
+        assert sink.stats["dropped"] > 0
+
+    def test_admission_rewrite_lands_but_diverges_from_intent(self, cfg):
+        inner = DryRunSink()
+        sink = ChaosSink(inner, ChaosConfig(enabled=True,
+                                            rewrite_prob=1.0), seed=0)
+        patches = self._patches(cfg)
+        results = [sink.apply_nodepool(ps) for ps in patches]
+        # Rewritten patches APPLY cleanly (read-back is non-empty)...
+        assert all(r.ok for r in results)
+        assert inner.commands
+        # ...but the skeptical intent-vs-observed check fails: the
+        # webhook trimmed a requirement value list / clamped disruption.
+        assert not all(verify_pool(sink.observed_state(ps.pool), ps)
+                       for ps in patches)
+        assert sink.stats["rewrites"] > 0
+
+    def test_seeded_realization_is_deterministic(self, cfg):
+        stats = []
+        for _ in range(2):
+            sink = ChaosSink(DryRunSink(), CHAOS_PRESETS["severe"],
+                             seed=42)
+            for _i in range(4):
+                for ps in self._patches(cfg):
+                    sink.apply_nodepool(ps)
+            stats.append(dict(sink.stats))
+        assert stats[0] == stats[1]
+
+    def test_reads_stay_honest_under_full_chaos(self, cfg):
+        inner = DryRunSink()
+        for ps in self._patches(cfg):
+            inner.apply_nodepool(ps)
+        sink = ChaosSink(inner, CHAOS_PRESETS["severe"], seed=0)
+        pool = cfg.cluster.pools[0].name
+        assert sink.observed_state(pool) == inner.observed_state(pool)
+
+
+# ---------------------------------------------------------------------------
+# Reconciler
+# ---------------------------------------------------------------------------
+
+
+class _FlakyFirstN(DryRunSink):
+    """Rejects the first ``n`` patch commands, then behaves."""
+
+    def __init__(self, n):
+        super().__init__()
+        self.reject_left = n
+
+    def _patch(self, cmd):
+        if self.reject_left > 0:
+            self.reject_left -= 1
+            return False
+        return super()._patch(cmd)
+
+
+class TestReconciler:
+    def _patches(self, cfg):
+        return render_nodepool_patches(offpeak_action(cfg.cluster),
+                                       cfg.cluster, op="replace")
+
+    def test_converges_through_transient_failures(self, cfg):
+        sink = _FlakyFirstN(2)
+        rec = Reconciler(sink, max_rounds=3, backoff_s=0.0)
+        out = rec.converge(self._patches(cfg))
+        assert out.converged
+        assert out.retries > 0 and out.rounds > 1
+        assert all(r.ok for r in out.results)
+        assert rec.retries_total == out.retries
+
+    def test_converges_under_each_seeded_chaos_mode(self, cfg):
+        """Sub-certain per-command failure: a few retry rounds converge
+        every mode (drop included — retries re-issue the write)."""
+        for field in ("timeout_prob", "transient_exit_prob", "drop_prob",
+                      "rewrite_prob"):
+            sink = ChaosSink(DryRunSink(),
+                             ChaosConfig(enabled=True, **{field: 0.4}),
+                             seed=4)
+            rec = Reconciler(sink, max_rounds=8, backoff_s=0.0,
+                             deadline_s=30.0)
+            out = rec.converge(self._patches(cfg))
+            assert out.converged, field
+            assert verify_pool(
+                sink.observed_state(self._patches(cfg)[0].pool),
+                self._patches(cfg)[0]), field
+
+    def test_bounded_give_up_surfaces_instead_of_raising(self, cfg):
+        sink = ChaosSink(DryRunSink(),
+                         ChaosConfig(enabled=True, drop_prob=1.0), seed=0)
+        rec = Reconciler(sink, max_rounds=3, backoff_s=0.0)
+        out = rec.converge(self._patches(cfg))
+        assert not out.converged
+        assert out.rounds == 3
+        assert set(out.diverged) == {ps.pool for ps in self._patches(cfg)}
+        assert all(out.divergence[p] == 3 for p in out.diverged)
+
+    def test_deadline_bounds_the_rounds(self, cfg):
+        clock = {"t": 0.0}
+        sleeps = []
+        sink = ChaosSink(DryRunSink(),
+                         ChaosConfig(enabled=True, drop_prob=1.0), seed=0)
+        rec = Reconciler(sink, max_rounds=100, backoff_s=1.0,
+                         deadline_s=2.5, sleep_fn=sleeps.append,
+                         clock=lambda: clock["t"])
+
+        def tick_clock(s):
+            clock["t"] += s
+        rec.sleep_fn = tick_clock
+        out = rec.converge(self._patches(cfg))
+        assert not out.converged
+        assert out.rounds < 5                  # deadline, not max_rounds
+
+    def test_reapply_is_idempotent(self, cfg):
+        """Converging the same desired state twice changes nothing — the
+        property that makes re-applying after a mid-tick kill safe."""
+        sink = DryRunSink()
+        rec = Reconciler(sink, backoff_s=0.0)
+        patches = self._patches(cfg)
+        assert rec.converge(patches).converged
+        store_before = json.loads(json.dumps(sink.store))
+        out2 = rec.converge(patches)
+        assert out2.converged and out2.retries == 0
+        assert sink.store == store_before
+
+
+# ---------------------------------------------------------------------------
+# Snapshot codec
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotCodec:
+    def test_pytree_round_trip_with_key_path(self, tmp_path, cfg):
+        """save -> load -> tree equality, PRNG key path included: a
+        restored key's NEXT split matches the original's next split."""
+        from ccka_tpu.sim.rollout import initial_state
+
+        key = jax.random.key(11)
+        for _ in range(5):                      # walk the split path
+            key, _sub = jax.random.split(key)
+        state = initial_state(cfg)
+        body = {"state": encode_tree(state), "prng_key": encode_key(key),
+                "next_tick": 5}
+        path = os.path.join(tmp_path, "s.snap")
+        save_snapshot(path, body)
+        loaded = load_snapshot(path)
+        state2 = decode_like(state, loaded["state"])
+        assert jax.tree_util.tree_all(jax.tree.map(
+            lambda a, b: bool(jnp.array_equal(a, b)), state, state2))
+        key2 = decode_key(loaded["prng_key"])
+        assert jnp.array_equal(jax.random.key_data(key),
+                               jax.random.key_data(key2))
+        n1, s1 = jax.random.split(key)
+        n2, s2 = jax.random.split(key2)
+        assert jnp.array_equal(jax.random.key_data(n1),
+                               jax.random.key_data(n2))
+        assert jnp.array_equal(jax.random.key_data(s1),
+                               jax.random.key_data(s2))
+
+    def test_corrupt_file_is_refused(self, tmp_path):
+        path = os.path.join(tmp_path, "c.snap")
+        save_snapshot(path, {"next_tick": 3, "x": encode_tree(
+            jnp.arange(4.0))})
+        doc = json.load(open(path))
+        doc["body"]["next_tick"] = 4            # tamper without re-hashing
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        with pytest.raises(SnapshotError, match="checksum"):
+            load_snapshot(path)
+
+    def test_torn_write_and_bad_format_refused(self, tmp_path):
+        path = os.path.join(tmp_path, "t.snap")
+        with open(path, "w") as f:
+            f.write('{"format": "ccka-snapshot", "version": 1, "bo')
+        with pytest.raises(SnapshotError, match="JSON"):
+            load_snapshot(path)
+        with open(path, "w") as f:
+            json.dump({"format": "something-else"}, f)
+        with pytest.raises(SnapshotError):
+            load_snapshot(path)
+        with pytest.raises(SnapshotError, match="cannot read"):
+            load_snapshot(os.path.join(tmp_path, "absent.snap"))
+
+    def test_version_mismatch_refused(self, tmp_path):
+        path = os.path.join(tmp_path, "v.snap")
+        save_snapshot(path, {"next_tick": 1})
+        doc = json.load(open(path))
+        doc["version"] = 99
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        with pytest.raises(SnapshotError, match="version"):
+            load_snapshot(path)
+
+    def test_write_is_atomic_no_temp_left(self, tmp_path):
+        path = os.path.join(tmp_path, "a.snap")
+        for _ in range(3):                      # overwrites replace atomically
+            save_snapshot(path, {"next_tick": 1})
+        assert sorted(os.listdir(tmp_path)) == ["a.snap"]
+
+    def test_missing_leaf_and_shape_drift_refused(self, cfg):
+        tree = {"a": jnp.ones((2, 3))}
+        enc = encode_tree(tree)
+        with pytest.raises(SnapshotError, match="missing leaf"):
+            decode_like({"b": jnp.ones(1)}, enc)
+        with pytest.raises(SnapshotError, match="shape"):
+            decode_like({"a": jnp.ones((3, 2))}, enc)
+
+
+# ---------------------------------------------------------------------------
+# Kill-and-resume bitwise invariant
+# ---------------------------------------------------------------------------
+
+
+def _kill_resume_pair(cfg, *, ticks, kill_tick, seed, chaos, tmp_path,
+                      stale_frac=0.0):
+    from ccka_tpu.harness.recovery import _run_pair
+    return _run_pair(cfg, RulePolicy(cfg.cluster), chaos, stale_frac,
+                     ticks=ticks, seed=seed, kill_tick=kill_tick,
+                     snap_path=os.path.join(tmp_path,
+                                            f"k{kill_tick}.snap"))
+
+
+class TestKillResume:
+    def test_bitwise_across_three_kill_points_under_chaos(self, cfg,
+                                                          tmp_path):
+        """ACCEPTANCE: fixed seed, >= 3 kill points, severe actuation
+        chaos + stale scrapes — decision stream and patch sequence
+        identical, zero duplicate, zero lost."""
+        for kill_tick in (2, 4, 6):
+            pair = _kill_resume_pair(
+                cfg, ticks=8, kill_tick=kill_tick, seed=17,
+                chaos=CHAOS_PRESETS["severe"], tmp_path=tmp_path,
+                stale_frac=0.15)
+            assert pair["resume_bitwise"], kill_tick
+            assert pair["duplicate_patches"] == 0
+            assert pair["lost_patches"] == 0
+            assert pair["ticks_to_reconverge"] == 0
+            assert pair["usd_ratio"] == pytest.approx(1.0)
+            assert pair["resumes"] == 1
+
+    def test_killed_at_every_tick_sweep(self, cfg, tmp_path):
+        """The full boundary sweep on a short horizon: killing after ANY
+        completed tick resumes bitwise."""
+        ticks = 6
+        for kill_tick in range(1, ticks):
+            pair = _kill_resume_pair(
+                cfg, ticks=ticks, kill_tick=kill_tick, seed=23,
+                chaos=CHAOS_PRESETS["moderate"], tmp_path=tmp_path)
+            assert pair["resume_bitwise"], kill_tick
+            assert pair["duplicate_patches"] == 0
+            assert pair["lost_patches"] == 0
+
+    def test_resume_restores_session_counters_and_machine(self, cfg,
+                                                          tmp_path):
+        snap = os.path.join(tmp_path, "ctr.snap")
+        sink = ChaosSink(DryRunSink(), CHAOS_PRESETS["severe"], seed=3)
+        ctrl = _controller(cfg, sink, seed=3, snapshot_path=snap)
+        reports = ctrl.run(6)
+        ctrl.close()
+        ctrl2 = _controller(cfg, sink, seed=3, snapshot_path=snap)
+        start = ctrl2.restore(load_snapshot(snap))
+        assert start == 6
+        assert ctrl2.reconcile_retries_total == \
+            reports[-1].reconcile_retries_total
+        assert ctrl2.actuation_failures_total == \
+            reports[-1].actuation_failures_total
+        assert ctrl2.degraded_ticks_total == \
+            reports[-1].degraded_ticks_total
+        assert ctrl2._degraded == reports[-1].degraded
+        assert ctrl2.resumes_total == 1
+        ctrl2.close()
+
+    def test_mpc_plan_state_survives_resume_bitwise(self, cfg, tmp_path):
+        """Receding-horizon plan state rides the snapshot: a resumed MPC
+        controller keeps executing the SAME optimized plan at the same
+        cadence — killing at a non-replan tick (3, with replan_every=4)
+        would otherwise force a fresh replan and fork the stream."""
+        from ccka_tpu.train.mpc import MPCBackend
+
+        def mk(sink, snap=""):
+            return Controller(cfg, MPCBackend(cfg, horizon=8, iters=2,
+                                              replan_every=4),
+                              _src(cfg), sink, interval_s=0.0, seed=4,
+                              log_fn=lambda _l: None, snapshot_path=snap,
+                              reconcile_backoff_s=0.0)
+
+        sink_b = DryRunSink()
+        base = mk(sink_b).run(6)
+        snap = os.path.join(tmp_path, "mpc.snap")
+        sink_k = DryRunSink()
+        c1 = mk(sink_k, snap)
+        pre = c1.run(3)
+        c1.close()
+        c2 = mk(sink_k, snap)                  # fresh backend, fresh plan
+        start = c2.restore(load_snapshot(snap))
+        assert c2._force_replan is False       # plan restored, not rebuilt
+        post = c2.run(6 - start, start_tick=start)
+        c2.close()
+        assert _fingerprints(pre + post) == _fingerprints(base)
+        assert ([c.render() for c in sink_k.commands]
+                == [c.render() for c in sink_b.commands])
+
+    def test_pending_interruption_warnings_survive_resume(self, cfg,
+                                                          tmp_path):
+        """The SQS ack happens at poll time, so the carried-warning
+        buffer is a warning's ONLY memory — a crash must not drop an
+        unresolved terminate warning (the drain would never happen; the
+        queue will not redeliver)."""
+        from ccka_tpu.signals.live import InterruptionWarning
+
+        snap = os.path.join(tmp_path, "pw.snap")
+        ctrl = _controller(cfg, DryRunSink(), seed=2, snapshot_path=snap)
+        ctrl.run(2)
+        w = InterruptionWarning("i-0abc", "terminate",
+                                "EC2 Spot Instance Interruption Warning",
+                                region="us-east-2")
+        ctrl._pending_warnings = {"i-0abc": (w, 3)}
+        ctrl.write_snapshot(2)
+        ctrl.close()
+        ctrl2 = _controller(cfg, DryRunSink(), seed=2, snapshot_path=snap)
+        ctrl2.restore(load_snapshot(snap))
+        (w2, ttl) = ctrl2._pending_warnings["i-0abc"]
+        assert (w2.instance_id, w2.action, w2.detail_type, w2.region) == \
+            ("i-0abc", "terminate",
+             "EC2 Spot Instance Interruption Warning", "us-east-2")
+        assert ttl == 3
+        ctrl2.close()
+
+    def test_restore_refuses_identity_mismatches(self, cfg, tmp_path):
+        snap = os.path.join(tmp_path, "id.snap")
+        ctrl = _controller(cfg, DryRunSink(), seed=1, snapshot_path=snap)
+        ctrl.run(2)
+        ctrl.close()
+        body = load_snapshot(snap)
+        # Wrong seed: the PRNG path would fork.
+        with pytest.raises(SnapshotError, match="seed"):
+            _controller(cfg, DryRunSink(), seed=2).restore(body)
+        # Wrong backend: the decision stream would change policy.
+        from ccka_tpu.policy import CarbonAwarePolicy
+        other = Controller(cfg, CarbonAwarePolicy(cfg.cluster), _src(cfg),
+                           DryRunSink(), interval_s=0.0, seed=1,
+                           log_fn=lambda _l: None)
+        with pytest.raises(SnapshotError, match="backend"):
+            other.restore(body)
+        # Wrong config: the estimate topology would not match.
+        cfg2 = cfg.with_overrides(**{"sim.dt_s": 15})
+        with pytest.raises(SnapshotError, match="config"):
+            _controller(cfg2, DryRunSink(), seed=1).restore(body)
+
+    def test_workload_family_state_survives_resume(self, tmp_path):
+        """Per-family queue state + session SLO counters round-trip, and
+        the resumed arrival track stays phase-anchored to the ORIGINAL
+        clock (wl.anchor_unix_s), so the estimate stream stays bitwise."""
+        cfg = default_config().with_overrides(**{
+            "workloads.enabled": True,
+            "workloads.inference_rate_pods": 6.0,
+            "workloads.batch_rate_pods": 3.0,
+            "sim.horizon_steps": 64,
+        })
+        src = _src(cfg, start_unix_s=8 * 3600)
+        snap = os.path.join(tmp_path, "wl.snap")
+        base = _controller(cfg, DryRunSink(), seed=5, src=src)
+        base_reports = base.run(6)
+        base.close()
+        k1 = _controller(cfg, DryRunSink(), seed=5, src=src,
+                         snapshot_path=snap)
+        pre = k1.run(3)
+        k1.close()
+        k2 = _controller(cfg, DryRunSink(), seed=5, src=src,
+                         snapshot_path=snap)
+        start = k2.restore(load_snapshot(snap))
+        post = k2.run(6 - start, start_tick=start)
+        k2.close()
+        assert _fingerprints(pre + post) == _fingerprints(base_reports)
+        got = [(r.inference_queue_depth, r.batch_backlog,
+                r.inference_slo_violations_total,
+                r.batch_deadline_misses_total) for r in pre + post]
+        want = [(r.inference_queue_depth, r.batch_backlog,
+                 r.inference_slo_violations_total,
+                 r.batch_deadline_misses_total) for r in base_reports]
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# Controller integration: divergence -> degraded mode, gauges on the wire
+# ---------------------------------------------------------------------------
+
+
+class TestControllerChaosIntegration:
+    def test_unconvergeable_actuation_drives_degraded_fallback(self, cfg):
+        """A cluster that never accepts patches walks the existing
+        ok -> hold -> rule-fallback machine via the divergence streak —
+        the reconciler's give-up surfaces, it does not raise."""
+        sink = ChaosSink(DryRunSink(),
+                         ChaosConfig(enabled=True, drop_prob=1.0), seed=0)
+        ctrl = _controller(cfg, sink, degraded_fallback_after=3)
+        reports = ctrl.run(6)
+        ctrl.close()
+        assert all(not r.verified for r in reports)
+        assert all(r.reconcile_diverged > 0 for r in reports)
+        modes = [r.degraded for r in reports]
+        assert modes[0] == "ok"              # divergence is known post-apply
+        assert "hold" in modes and "fallback" in modes
+        assert reports[-1].degraded == "fallback"
+        assert reports[-1].actuation_failures_total > 0
+
+    def test_recovery_gauges_reach_the_exposition(self, cfg, tmp_path):
+        from ccka_tpu.harness.promexport import MetricsExporter
+
+        snap = os.path.join(tmp_path, "g.snap")
+        exporter = MetricsExporter()
+        sink = ChaosSink(DryRunSink(), CHAOS_PRESETS["moderate"], seed=1)
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), _src(cfg), sink,
+                          interval_s=0.0, log_fn=lambda _l: None,
+                          snapshot_path=snap, reconcile_backoff_s=0.0,
+                          exporter=exporter)
+        ctrl.run(3)
+        ctrl.close()
+        body = exporter.exposition()
+        for series in ("ccka_reconcile_retries_total",
+                       "ccka_reconcile_diverged",
+                       "ccka_actuation_failures_total",
+                       "ccka_snapshot_age_ticks", "ccka_resumes_total"):
+            assert series in body, series
+
+
+# ---------------------------------------------------------------------------
+# Fleet snapshot/resume
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSnapshotResume:
+    def _fleet(self, cfg, n=8, seed=3):
+        from ccka_tpu.harness.fleet import fleet_controller_from_config
+        return fleet_controller_from_config(
+            cfg, RulePolicy(cfg.cluster), n, horizon_ticks=16, seed=seed,
+            fanout_workers=1)
+
+    def test_fleet_resume_is_bitwise(self, cfg, tmp_path):
+        base = self._fleet(cfg)
+        base_reports = [base.tick(t) for t in range(6)]
+        base.close()
+
+        path = os.path.join(tmp_path, "fleet.snap")
+        k1 = self._fleet(cfg)
+        pre = [k1.tick(t) for t in range(3)]
+        k1.write_snapshot(path, 3)
+        k1.close()
+        k2 = self._fleet(cfg)
+        start = k2.restore(load_snapshot(path))
+        post = [k2.tick(t) for t in range(start, 6)]
+        k2.close()
+
+        def fp(rs):
+            return [(r.t, r.applied, r.slo_ok, r.cost_usd_hr,
+                     r.carbon_g_hr, r.pending_pods) for r in rs]
+        assert fp(pre + post) == fp(base_reports)
+
+    def test_fleet_restore_refuses_mismatch(self, cfg, tmp_path):
+        path = os.path.join(tmp_path, "f.snap")
+        f8 = self._fleet(cfg, n=8)
+        f8.write_snapshot(path, 1)
+        f8.close()
+        f4 = self._fleet(cfg, n=4)
+        with pytest.raises(SnapshotError, match="clusters"):
+            f4.restore(load_snapshot(path))
+        f9 = self._fleet(cfg, n=8, seed=9)
+        with pytest.raises(SnapshotError, match="seed"):
+            f9.restore(load_snapshot(path))
+        f4.close()
+        f9.close()
+
+
+# ---------------------------------------------------------------------------
+# Recovery scoreboard + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestRecoveryScoreboard:
+    def test_unknown_names_rejected_up_front(self, cfg):
+        from ccka_tpu.harness.recovery import recovery_scoreboard
+        with pytest.raises(ValueError, match="unknown chaos intensities"):
+            recovery_scoreboard(cfg, intensities=("off", "apocalyptic"))
+        with pytest.raises(ValueError, match="unknown policies"):
+            recovery_scoreboard(cfg, policies=("rule", "oracle"))
+
+    def test_tiny_board_holds_the_invariants(self, cfg):
+        from ccka_tpu.harness.recovery import recovery_scoreboard
+        board = recovery_scoreboard(cfg, policies=("rule",),
+                                    intensities=("off", "severe"),
+                                    runs_per_cell=2, ticks=6, seed=9)
+        inv = board["invariants"]
+        assert inv["duplicate_patches_total"] == 0
+        assert inv["lost_patches_total"] == 0
+        assert inv["resume_bitwise_frac"] == 1.0
+        assert board["n_paired_runs"] == 4
+        sev = board["cells"]["severe"]["rows"]["rule"]
+        assert sev["chaos_injected"]["dropped"] >= 0
+        assert sev["usd_per_slo_hr_vs_baseline"] == pytest.approx(1.0)
+
+
+class TestCLI:
+    def test_recover_eval_rejects_unknown_intensity(self):
+        from ccka_tpu.cli import main
+        with pytest.raises(SystemExit, match="unknown chaos intensities"):
+            main(["recover-eval", "--intensities", "off,bogus",
+                  "--policies", "rule", "--runs", "1", "--ticks", "4"])
+        with pytest.raises(SystemExit, match="unknown policies"):
+            main(["recover-eval", "--policies", "rule,bogus",
+                  "--runs", "1", "--ticks", "4"])
+
+    def test_run_resume_needs_snapshot(self):
+        from ccka_tpu.cli import main
+        with pytest.raises(SystemExit, match="--resume needs --snapshot"):
+            main(["run", "--ticks", "1", "--resume"])
+
+    def test_run_resume_refuses_corrupt_snapshot(self, tmp_path):
+        from ccka_tpu.cli import main
+        path = os.path.join(tmp_path, "bad.snap")
+        with open(path, "w") as f:
+            f.write("not a snapshot")
+        with pytest.raises(SystemExit, match="not valid JSON"):
+            main(["run", "--ticks", "1", "--snapshot", path, "--resume"])
+
+    def test_run_snapshot_then_resume_round_trip(self, tmp_path, capsys):
+        """--ticks is the RUN's total length: re-running the identical
+        killed command with --resume completes the original run (ticks
+        already done count toward it), it does not run N more."""
+        from ccka_tpu.cli import main
+        path = os.path.join(tmp_path, "cli.snap")
+        assert main(["run", "--ticks", "3", "--interval", "0",
+                     "--snapshot", path]) == 0
+        assert os.path.exists(path)
+        assert main(["run", "--ticks", "5", "--interval", "0",
+                     "--snapshot", path, "--resume"]) == 0
+        err = capsys.readouterr().err
+        assert "resumed at tick 3" in err
+        assert "controller ran 2 tick(s)" in err
+        assert load_snapshot(path)["next_tick"] == 5
+        # Already complete: the same command again runs zero ticks.
+        assert main(["run", "--ticks", "5", "--interval", "0",
+                     "--snapshot", path, "--resume"]) == 0
+        assert "controller ran 0 tick(s)" in capsys.readouterr().err
+        assert load_snapshot(path)["next_tick"] == 5
+
+
+# ---------------------------------------------------------------------------
+# Satellite fix: the runner capability probe is cached per runner object
+# ---------------------------------------------------------------------------
+
+
+class TestBudgetProbeCache:
+    def test_signature_probed_once_per_runner(self, monkeypatch):
+        import inspect
+
+        from ccka_tpu.actuation import sink as sink_mod
+
+        calls = {"n": 0}
+        real = inspect.signature
+
+        def counting(fn, *a, **kw):
+            calls["n"] += 1
+            return real(fn, *a, **kw)
+        monkeypatch.setattr(inspect, "signature", counting)
+
+        def runner(argv, **kw):
+            return (0, "")
+        assert sink_mod._accepts_budget(runner) is True
+        for _ in range(5):                      # hot-path repeats: cached
+            assert sink_mod._accepts_budget(runner) is True
+        assert calls["n"] == 1
+
+        def narrow(argv):
+            return (0, "")
+        assert sink_mod._accepts_budget(narrow) is False
+        assert sink_mod._accepts_budget(narrow) is False
+        assert calls["n"] == 2

@@ -508,6 +508,43 @@ class TestPromExport:
         # No timings yet (e.g. a hand-built record): skipped, not 0.
         assert resolve_field({}, SERIES["ccka_tick_total_ms"][0]) is None
 
+    def test_recovery_gauges_cover_both_directions(self):
+        """Round-12 satellite: the crash-safety series (reconciler
+        convergence, actuation failures, snapshot/resume health) must be
+        exported, panel-referenced, AND resolve from a real TickReport —
+        both directions of the parity contract, like the tick gauges."""
+        import dataclasses
+
+        from ccka_tpu.harness.controller import TickReport
+        from ccka_tpu.harness.dashboard import _PANEL_DEFS
+        from ccka_tpu.harness.promexport import (SERIES, referenced_series,
+                                                 resolve_field)
+
+        gauges = {"ccka_reconcile_retries_total", "ccka_reconcile_diverged",
+                  "ccka_actuation_failures_total",
+                  "ccka_snapshot_age_ticks", "ccka_resumes_total"}
+        assert gauges <= set(SERIES)
+        paneled = set()
+        for _t, expr, _u in _PANEL_DEFS:
+            paneled |= referenced_series(expr)
+        assert gauges <= paneled, "recovery gauges missing from dashboard"
+        rec = dataclasses.asdict(TickReport(
+            t=3, is_peak=False, profile="offpeak", applied=True,
+            verified=False, fallbacks=0, cost_usd_hr=1.0, carbon_g_hr=1.0,
+            nodes_spot=1.0, nodes_od=1.0, pending_pods=0.0, slo_ok=True,
+            reconcile_retries=2, reconcile_retries_total=7,
+            reconcile_diverged=1, actuation_failures=3,
+            actuation_failures_total=9, snapshot_age_ticks=0,
+            resumes_total=2))
+        assert resolve_field(
+            rec, SERIES["ccka_reconcile_retries_total"][0]) == 7
+        assert resolve_field(rec, SERIES["ccka_reconcile_diverged"][0]) == 1
+        assert resolve_field(
+            rec, SERIES["ccka_actuation_failures_total"][0]) == 9
+        assert resolve_field(
+            rec, SERIES["ccka_snapshot_age_ticks"][0]) == 0
+        assert resolve_field(rec, SERIES["ccka_resumes_total"][0]) == 2
+
     def test_live_scrape_serves_all_panel_series(self):
         """Drive two controller ticks with an exporter on a real socket
         and scrape /metrics — every panel series must come back."""

@@ -120,6 +120,54 @@ def test_guard_scans_a_nontrivial_tree():
     assert any(os.path.join("harness", "fleet.py") in p for p in files)
 
 
+_HARNESS_DIR = os.path.join(ROOT, "ccka_tpu", "harness")
+
+
+def _apply_all_calls(tree: ast.AST) -> list[int]:
+    """Line numbers of ``<expr>.apply_all(...)`` call sites."""
+    return [node.lineno for node in ast.walk(tree)
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "apply_all"]
+
+
+def test_no_direct_apply_all_in_harness():
+    """Round-12 guard: harness code must route NodePool actuation through
+    `actuation.reconcile.Reconciler.converge` — a direct `sink.apply_all`
+    is one-shot fire-and-hope, loses the retry/read-back/divergence
+    discipline, and silently bypasses the degraded-mode surface. The
+    one-shot verbs stay available to CLI demo commands and tests; the
+    *control loops* (controller, fleet, lifecycle) may not use them."""
+    violations = []
+    for dirpath, _dirs, files in os.walk(_HARNESS_DIR):
+        if "__pycache__" in dirpath:
+            continue
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, f)
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+            for lineno in _apply_all_calls(tree):
+                violations.append(
+                    f"{os.path.relpath(path, ROOT)}:{lineno}")
+    assert not violations, (
+        "direct sink.apply_all call(s) in harness code — route actuation "
+        "through Reconciler.converge (actuation/reconcile.py):\n  "
+        + "\n  ".join(violations))
+
+
+def test_apply_all_guard_catches_the_pattern():
+    """Self-test: the banned one-shot call is flagged; the reconciled
+    form passes."""
+    bad = ("def tick(self, patches):\n"
+           "    return self.sink.apply_all(patches)\n")
+    good = ("def tick(self, patches):\n"
+            "    return self.reconciler.converge(patches).results\n")
+    assert _apply_all_calls(ast.parse(bad))
+    assert not _apply_all_calls(ast.parse(good))
+
+
 def test_guard_catches_the_footgun_pattern(tmp_path):
     """Self-test on a synthetic violation: the exact VERDICT weak-#2
     pattern must be flagged, and its fenced fix must pass."""
