@@ -1719,6 +1719,31 @@ def bench_recovery(runs_per_cell: int = 8, ticks: int = 32,
     return board
 
 
+def bench_overload(*, tenants=(16, 64),
+                   intensities=("off", "moderate", "severe"),
+                   slow_fracs=(0.0, 0.25, 0.5),
+                   ticks: int = 48, seed: int = 211) -> dict | None:
+    """Overload scoreboard (ISSUE 10): paired stressed/calm multi-tenant
+    FleetService runs per {tenant count x chaos intensity x slow-tenant
+    fraction} — healthy tenants' paired $/SLO-hr isolation ratios,
+    per-tick p50/p99 latency vs the configured deadline, shed/deferral/
+    bulkhead counters and breaker transitions, recorded into
+    BASELINE.json round13. Runs on the multiregion preset (the topology
+    with a committed flagship checkpoint). Host-side harness on a
+    virtual clock: the result is the ISOLATION INVARIANT (healthy ratio
+    1.0, p99 under the deadline), not device throughput — no roofline
+    floor applies."""
+    from ccka_tpu.config import multi_region_config
+    from ccka_tpu.harness.overload import overload_scoreboard
+
+    board = overload_scoreboard(multi_region_config(), tenants=tenants,
+                                intensities=intensities,
+                                slow_fracs=slow_fracs, ticks=ticks,
+                                seed=seed)
+    board["config"] = "multiregion(flagship checkpoint committed)"
+    return board
+
+
 def _run_child(argv, timeout_s=1800, env=None) -> dict | None:
     """Run a bench child phase; relay its narration; parse its JSON."""
     try:
@@ -1815,6 +1840,11 @@ def main(argv=None) -> int:
                          "scoreboard (bench_recovery) and print its "
                          "JSON — the BENCH_r12 record path; host-side "
                          "dry-run harness")
+    ap.add_argument("--overload-only", action="store_true",
+                    help="run ONLY the multi-tenant overload scoreboard "
+                         "(bench_overload) and print its JSON — the "
+                         "BENCH_r13 record path; host-side virtual-clock "
+                         "harness")
     ap.add_argument("--workloads-only", action="store_true",
                     help="run ONLY the per-family workload scenario "
                          "scoreboard (bench_workloads) and print its "
@@ -1880,6 +1910,14 @@ def main(argv=None) -> int:
             rec["provenance"] = bench_provenance()
         print(json.dumps(rec))
         return 0 if rec is not None else 1
+
+    if args.overload_only:
+        with _TRACER.span("bench.overload_stage"):
+            ov = bench_overload()
+        if ov is not None:
+            ov["provenance"] = bench_provenance()
+        print(json.dumps(ov))
+        return 0 if ov is not None else 1
 
     if args.mega_phase == "gate":
         from ccka_tpu.config import default_config
@@ -2049,6 +2087,20 @@ def main(argv=None) -> int:
         print(f"# recovery stage failed (omitted): {e!r}",
               file=sys.stderr)
         recovery = None
+    # Multi-tenant overload scoreboard (ISSUE 10): isolation invariant
+    # sweep — same guard; host-side virtual clock, so --quick only
+    # shrinks the grid.
+    try:
+        with _TRACER.span("bench.overload_stage"):
+            overload = (bench_overload(tenants=(8,),
+                                       intensities=("off", "severe"),
+                                       slow_fracs=(0.0, 0.25),
+                                       ticks=12)
+                        if args.quick else bench_overload())
+    except Exception as e:  # noqa: BLE001
+        print(f"# overload stage failed (omitted): {e!r}",
+              file=sys.stderr)
+        overload = None
 
     rates = {k: v for k, v in rollout.items()
              if isinstance(v, dict) and "cluster_days_per_sec" in v}
@@ -2106,6 +2158,8 @@ def main(argv=None) -> int:
         line["workloads"] = workloads
     if recovery is not None:
         line["recovery"] = recovery
+    if overload is not None:
+        line["overload"] = overload
     # Provenance + the session's span trace: a headline without device/
     # version/timing context cannot be audited (VERDICT r5 weak #3).
     line["provenance"] = bench_provenance()

@@ -140,6 +140,15 @@ def _build_parser() -> argparse.ArgumentParser:
                     choices=("rule", "carbon", "ppo"))
     sf.add_argument("--checkpoint", default="")
     sf.add_argument("--seed", type=int, default=0)
+    sf.add_argument("--service", default="",
+                    help="run the multi-tenant service layer at this "
+                         "config.SERVICE_PRESETS posture instead of the "
+                         "bare fleet loop ('' = bare fleet; 'off' = the "
+                         "service wrapper's delegating gate)")
+    sf.add_argument("--profiles", default="healthy",
+                    help="with --service: comma list of tenant profile "
+                         "archetypes (service.TENANT_PROFILES), cycled "
+                         "over the fleet")
 
     swatch = sub.add_parser(
         "watch", help="the demo_40 observe session: port-forward Grafana/"
@@ -312,6 +321,36 @@ def _build_parser() -> argparse.ArgumentParser:
     sre.add_argument("--ticks", type=int, default=32,
                      help="control ticks per run")
     sre.add_argument("--seed", type=int, default=101)
+
+    sov = sub.add_parser(
+        "overload-eval", help="multi-tenant overload scoreboard "
+                              "(harness/overload.py): paired stressed/"
+                              "calm FleetService runs per {tenant count "
+                              "x chaos intensity x slow-tenant fraction} "
+                              "— healthy-tenant $/SLO-hr isolation "
+                              "ratios, p50/p99 tick latency vs the "
+                              "deadline, shed/deferral counts and "
+                              "breaker transitions")
+    sov.add_argument("--tenants", default="16,64",
+                     help="comma list of fleet sizes")
+    sov.add_argument("--intensities", default="off,moderate,severe",
+                     help="comma list of config.CHAOS_PRESETS names "
+                          "composed onto the stressed tenants' sinks")
+    sov.add_argument("--slow-fracs", default="0,0.25,0.5",
+                     help="comma list of stressed-tenant fractions in "
+                          "[0, 1); 0 is the zero-overhead control cell")
+    sov.add_argument("--profile", default="slow",
+                     help="stressed-tenant archetype "
+                          "(service.TENANT_PROFILES name)")
+    sov.add_argument("--service", default="default",
+                     help="config.SERVICE_PRESETS posture for the runs")
+    sov.add_argument("--policies", default="rule,flagship",
+                     help="comma list of rule,carbon,flagship (flagship "
+                          "rows need a committed checkpoint for the "
+                          "chosen preset's topology)")
+    sov.add_argument("--ticks", type=int, default=48,
+                     help="service ticks per run")
+    sov.add_argument("--seed", type=int, default=211)
 
     sub.add_parser(
         "scenarios", help="list the named workload scenario library "
@@ -1203,6 +1242,31 @@ def main(argv: list[str] | None = None) -> int:
                 raise SystemExit(f"ccka: {e}")
             print(json.dumps(board, indent=2))
             return 0
+        if args.command == "overload-eval":
+            from ccka_tpu.harness.overload import overload_scoreboard
+            try:
+                board = overload_scoreboard(
+                    cfg,
+                    tenants=tuple(
+                        int(s) for s in args.tenants.split(",")
+                        if s.strip()),
+                    intensities=tuple(
+                        s.strip() for s in args.intensities.split(",")
+                        if s.strip()),
+                    slow_fracs=tuple(
+                        float(s) for s in args.slow_fracs.split(",")
+                        if s.strip()),
+                    slow_profile=args.profile,
+                    service_preset=args.service,
+                    policies=tuple(
+                        s.strip() for s in args.policies.split(",")
+                        if s.strip()),
+                    ticks=args.ticks,
+                    seed=args.seed)
+            except ValueError as e:
+                raise SystemExit(f"ccka: {e}")
+            print(json.dumps(board, indent=2))
+            return 0
         if args.command == "scenarios":
             from ccka_tpu.workloads.scenarios import WORKLOAD_SCENARIOS
             listing = []
@@ -1290,11 +1354,62 @@ def main(argv: list[str] | None = None) -> int:
                 raise SystemExit("ccka: fleet needs --clusters >= 1 and "
                                  "--ticks >= 1")
             backend = make_backend(cfg, args.backend, args.checkpoint)
-            ctrl = fleet_controller_from_config(
-                cfg, backend, args.clusters,
-                horizon_ticks=max(args.ticks + 2, 8), seed=args.seed,
-                log_fn=lambda s: print(s, file=sys.stderr))
-            reports = ctrl.run(args.ticks)
+            if args.service:
+                from ccka_tpu.config import SERVICE_PRESETS
+                from ccka_tpu.harness.service import (
+                    fleet_service_from_config, resolve_profiles)
+                if args.service not in SERVICE_PRESETS:
+                    raise SystemExit(
+                        f"ccka: unknown service preset {args.service!r}; "
+                        f"presets: {sorted(SERVICE_PRESETS)}")
+                names = [s.strip() for s in args.profiles.split(",")
+                         if s.strip()]
+                if not names:
+                    raise SystemExit("ccka: --profiles needs at least "
+                                     "one tenant profile name")
+                try:
+                    resolve_profiles(names)
+                except ValueError as e:
+                    raise SystemExit(f"ccka: {e}")
+                profiles = [names[i % len(names)]
+                            for i in range(args.clusters)]
+                service = fleet_service_from_config(
+                    cfg, backend, args.clusters, profiles=profiles,
+                    service=SERVICE_PRESETS[args.service],
+                    horizon_ticks=max(args.ticks + 2, 8),
+                    seed=args.seed,
+                    log_fn=lambda s: print(s, file=sys.stderr))
+                service.warmup()
+                sreports = service.run(args.ticks)
+                if SERVICE_PRESETS[args.service].enabled:
+                    summary = {
+                        "clusters": args.clusters,
+                        "ticks": args.ticks,
+                        "service": args.service,
+                        "admitted_frac": sum(r.admitted for r in sreports)
+                        / (args.clusters * len(sreports)),
+                        "sheds_total": sreports[-1].sheds_total,
+                        "deferrals_total": sreports[-1].deferrals_total,
+                        "breaker_transitions_total":
+                            sreports[-1].breaker_transitions_total,
+                        "tick_latency_ms_last":
+                            sreports[-1].tick_latency_ms,
+                        "fleet_cost_usd_hr_last":
+                            sreports[-1].cost_usd_hr,
+                    }
+                    service.close()
+                    print(json.dumps(summary, indent=2))
+                    return 0
+                # The off gate delegates: fall through to the bare-fleet
+                # summary over the delegated FleetTickReports.
+                reports = sreports
+                ctrl = service.ctrl
+            else:
+                ctrl = fleet_controller_from_config(
+                    cfg, backend, args.clusters,
+                    horizon_ticks=max(args.ticks + 2, 8), seed=args.seed,
+                    log_fn=lambda s: print(s, file=sys.stderr))
+                reports = ctrl.run(args.ticks)
             ok = all(r.applied == r.n_clusters for r in reports)
             summary = {
                 "clusters": args.clusters,

@@ -745,6 +745,120 @@ class ChaosConfig:
                               "each command draws one fate")
 
 
+@dataclass(frozen=True)
+class ServiceConfig:
+    """Multi-tenant fleet service layer (`ccka_tpu/harness/service.py`).
+
+    ROADMAP item 4's host-loop half: `harness/fleet.tick` grown into a
+    service that fans in scrapes from many tenant clusters and batches
+    every pending decide() into ONE device dispatch per tick — while
+    staying responsive when individual tenants misbehave (hung scrapes,
+    chaos-injected kubectl edges). The knobs below are the three
+    robustness mechanisms:
+
+    - **bounded batched ticks**: per-tick scrape work is budgeted
+      (``tick_deadline_ms`` split by ``scrape_budget_frac``); tenants
+      whose scrape would run past the budget are DEFERRED to the next
+      tick (the straggler is abandoned, never awaited), so one hung
+      tenant cannot stall the fleet's dispatch cadence.
+    - **per-tenant bulkheads + circuit breakers**: ``breaker_failures``
+      consecutive scrape/actuation failures open a tenant's breaker
+      (closed→open→half-open, seeded-jitter probe schedule mirroring
+      `RetryingFetch`); while open, the tenant's scrape AND fan-out are
+      skipped entirely (no tick budget spent on a known-bad edge) and
+      it rides a hold/rule-fallback decision lane. After
+      ``hold_fallback_after`` open ticks the lane escalates from
+      hold-last-action to the rule fallback — the same ok→hold→fallback
+      shape as the single-cluster degraded machine.
+    - **backpressure + load shedding**: ``admission_queue_cap`` bounds
+      how many tenant decides are admitted per tick (0 = fleet size);
+      overflow is SHED by explicit priority (stale-tolerant tenants
+      first), every shed is counted, and ``shed_backoff_after``
+      consecutive saturated ticks degrade stale-tolerant tenants' decide
+      cadence (up to ``cadence_backoff_max``x) instead of growing
+      unbounded backlog.
+
+    ``enabled=False`` (the default, preset "off") is a hard gate in the
+    ChaosSink-"off" idiom: `FleetService` delegates every tick verbatim
+    to the pre-service `FleetController` path — byte-identical packed
+    actions and command streams, pinned by `tests/test_service.py`.
+    """
+
+    enabled: bool = False
+    # Admission-queue capacity in tenant decides per tick; 0 = fleet
+    # size (bounded by the batch, never unbounded backlog).
+    admission_queue_cap: int = 0
+    # Hard per-tick budget; 0 disables deadline enforcement. Stragglers
+    # past the scrape share of it are deferred, not awaited.
+    tick_deadline_ms: float = 0.0
+    # Fraction of the deadline granted to the scrape/admission phase;
+    # the rest bounds the actuation fan-out.
+    scrape_budget_frac: float = 0.5
+    # Consecutive per-tenant failures (scrape timeout/stale OR reconcile
+    # give-up) that open the tenant's breaker.
+    breaker_failures: int = 3
+    # Open→half-open probe schedule: base delay in ticks, doubled per
+    # consecutive re-open, jittered multiplicatively by U(1-j, 1+j)
+    # from a seeded RNG (deterministic for paired runs), capped.
+    breaker_probe_ticks: int = 4
+    breaker_probe_jitter: float = 0.25
+    breaker_max_probe_ticks: int = 64
+    # Open ticks after which a tenant's decision lane escalates from
+    # hold-last-action to the rule fallback profile.
+    hold_fallback_after: int = 6
+    # Saturated (shedding) ticks before stale-tolerant tenants' decide
+    # cadence degrades; each further saturation streak doubles the
+    # cadence divisor up to the cap.
+    shed_backoff_after: int = 2
+    cadence_backoff_max: int = 8
+
+    def validate(self) -> None:
+        if self.admission_queue_cap < 0:
+            raise ConfigError("service: negative admission_queue_cap")
+        if self.tick_deadline_ms < 0:
+            raise ConfigError("service: negative tick_deadline_ms")
+        if not 0.0 < self.scrape_budget_frac < 1.0:
+            raise ConfigError("service: scrape_budget_frac out of (0,1) "
+                              "— both phases need a share of the tick")
+        if self.breaker_failures < 1:
+            raise ConfigError("service: breaker_failures must be >= 1")
+        if self.breaker_probe_ticks < 1:
+            raise ConfigError("service: breaker_probe_ticks must be >= 1")
+        if not 0.0 <= self.breaker_probe_jitter < 1.0:
+            raise ConfigError("service: breaker_probe_jitter out of "
+                              "[0, 1)")
+        if self.breaker_max_probe_ticks < self.breaker_probe_ticks:
+            raise ConfigError("service: breaker_max_probe_ticks below "
+                              "breaker_probe_ticks")
+        if self.hold_fallback_after < 1:
+            raise ConfigError("service: hold_fallback_after must be >= 1")
+        if self.shed_backoff_after < 1:
+            raise ConfigError("service: shed_backoff_after must be >= 1")
+        if self.cadence_backoff_max < 1:
+            raise ConfigError("service: cadence_backoff_max must be >= 1")
+
+
+# The overload scoreboard's named service postures (`bench.py
+# bench_overload`, `ccka overload-eval`). "off" is the hard gate the
+# byte-identity test pins against the pre-service fleet loop; "default"
+# is the bounded posture the scoreboard runs; "strict" tightens the
+# deadline and cap for saturation studies.
+SERVICE_PRESETS: dict[str, ServiceConfig] = {
+    "off": ServiceConfig(enabled=False),
+    # The scrape share is deliberately below half: the batched device
+    # dispatch between scrape and fan-out is ONE un-preemptible unit
+    # (the host cannot abandon it at the deadline the way it abandons a
+    # hung scrape), so the posture must leave it structural headroom —
+    # deadline - scrape budget - fan-out reserve is the dispatch's
+    # allowance, not a hope.
+    "default": ServiceConfig(enabled=True, tick_deadline_ms=250.0,
+                             scrape_budget_frac=0.4),
+    "strict": ServiceConfig(enabled=True, tick_deadline_ms=100.0,
+                            scrape_budget_frac=0.4, breaker_failures=2,
+                            breaker_probe_ticks=8),
+}
+
+
 # The recovery scoreboard's named actuation intensities (`bench.py
 # bench_recovery`, `ccka recover-eval`) — the kubectl-edge mirror of
 # FAULT_PRESETS. "off" is enabled-but-neutral: the wrapper is in the
@@ -825,6 +939,7 @@ class FrameworkConfig:
     faults: FaultsConfig = field(default_factory=FaultsConfig)
     workloads: WorkloadsConfig = field(default_factory=WorkloadsConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    service: ServiceConfig = field(default_factory=ServiceConfig)
 
     def validate(self) -> "FrameworkConfig":
         self.cluster.validate()
@@ -836,6 +951,7 @@ class FrameworkConfig:
         self.faults.validate()
         self.workloads.validate()
         self.chaos.validate()
+        self.service.validate()
         # Cross-section: a live multi-region fleet must name each region's
         # grid zone — silently falling back to the global carbon_zone would
         # price one region's zones by another region's grid, flattening the
@@ -985,6 +1101,7 @@ _NESTED_TYPES = {
     "faults": FaultsConfig,
     "workloads": WorkloadsConfig,
     "chaos": ChaosConfig,
+    "service": ServiceConfig,
 }
 
 

@@ -20,3 +20,10 @@ from ccka_tpu.harness.telemetry import (  # noqa: F401
     read_telemetry,
     summarize_telemetry,
 )
+from ccka_tpu.harness.service import (  # noqa: F401
+    CircuitBreaker,
+    FleetService,
+    ServiceTickReport,
+    TENANT_PROFILES,
+    fleet_service_from_config,
+)

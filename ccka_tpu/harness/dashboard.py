@@ -70,6 +70,14 @@ _PANEL_DEFS = (
      "short"),
     ("Snapshot age", "ccka_snapshot_age_ticks", "short"),
     ("Resumes (session)", "ccka_resumes_total", "short"),
+    # Multi-tenant service panels (round 13; ARCHITECTURE §15): the
+    # overload-control surfaces — an operator must see "4 breakers
+    # open, shedding, 180ms ticks" on the SAME board as the fleet KPIs
+    # the bulkheads are protecting.
+    ("Breaker pressure", "ccka_tenant_breaker_state", "short"),
+    ("Decides shed (session)", "ccka_ticks_shed_total", "short"),
+    ("Admission queue depth", "ccka_admission_queue_depth", "short"),
+    ("Service tick latency", "ccka_tick_latency_ms", "ms"),
     # Workload-family panels (ccka_tpu/workloads): per-family queue
     # pressure and the session's SLO accounting, on the same board as
     # the fleet cost/SLO panels the families trade against.

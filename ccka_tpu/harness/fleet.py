@@ -36,6 +36,7 @@ device→host pull per aggregate metric). Now one tick is:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from concurrent.futures import ThreadPoolExecutor
 from functools import partial
 from typing import Callable, Sequence
@@ -76,8 +77,107 @@ class _Dispatched:
 
     t: int
     packed: jax.Array          # [N, A+1] actions ++ is_peak column
-    agg: jax.Array             # [4] slo_ok, cost, carbon, pending sums
+    per_metrics: jax.Array     # [N, 4] slo_ok, cost, carbon, pending rows
     dispatch_ms: float
+
+
+def action_layout(cluster) -> tuple[list[tuple], list[int]]:
+    """Host-side (shapes, sizes) unpack plan for a packed action row,
+    derived from a template Action so it tracks the NamedTuple's field
+    order and leaf shapes by construction. Shared by the fleet
+    controller and the multi-tenant service (`harness/service.py`)."""
+    template = Action.neutral(cluster.n_pools, cluster.n_zones)
+    shapes = [tuple(leaf.shape) for leaf in template]
+    sizes = [int(np.prod(s)) for s in shapes]
+    return shapes, sizes
+
+
+def unpack_action_row(row: np.ndarray, shapes, sizes) -> Action:
+    """One packed [A] row (is_peak column already stripped) -> Action."""
+    leaves, off = [], 0
+    for shape, size in zip(shapes, sizes):
+        leaves.append(row[off:off + size].reshape(shape))
+        off += size
+    return Action(*leaves)
+
+
+# -- shared device-side tick pieces (used by this module's batched tick
+# AND the service layer's lane-selecting variant, so the packed-row and
+# per-metrics layouts cannot drift apart between the two builders) ------
+
+
+def exo_at(xs_all, t, horizon_ticks: int):
+    """Slice every [N, T, ...] trace leaf at tick t (mod horizon)."""
+    t_mod = jnp.mod(t, horizon_ticks)
+    return jax.tree.map(
+        lambda x: jax.lax.dynamic_index_in_dim(
+            x, t_mod, axis=1, keepdims=False), xs_all)
+
+
+def flatten_actions(actions, n: int) -> jnp.ndarray:
+    """Batched Action pytree -> [N, A] packed rows (field order)."""
+    return jnp.concatenate(
+        [jnp.reshape(a, (n, -1)) for a in actions], axis=-1)
+
+
+def pack_rows(flat: jnp.ndarray, exo_n) -> jnp.ndarray:
+    """[N, A] action rows ++ the is_peak column -> [N, A+1]."""
+    return jnp.concatenate(
+        [flat, (exo_n.is_peak > 0.5).astype(jnp.float32)[:, None]],
+        axis=-1)
+
+
+def per_cluster_metrics(metrics) -> jnp.ndarray:
+    """StepMetrics -> [N, 4] rows: slo_ok, cost, carbon, pending."""
+    return jnp.stack([
+        metrics.slo_ok.astype(jnp.float32),
+        metrics.cost_usd,
+        metrics.carbon_g,
+        metrics.pending_pods.sum(axis=tuple(range(
+            1, metrics.pending_pods.ndim))),
+    ], axis=-1)
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_fleet_tick(cfg: FrameworkConfig, backend,
+                         n: int, horizon_ticks: int):
+    """The batched fleet tick, jitted ONCE per (config, backend, fleet
+    size, horizon) — the config-keyed shared-compile idiom from the
+    round-12 `_compiled_steps` fix. Pre-round-13 every FleetController
+    closed a fresh lambda over its own traces, so the overload
+    scoreboard's paired stressed/calm services (and any resumed fleet)
+    would each pay a full XLA compile; keying on the BACKEND instance
+    (identity-hashed, like the forecaster cache keys on config) keeps
+    the cache sound — `backend.action_fn()` mints a fresh closure per
+    call and must therefore be resolved INSIDE the cached builder —
+    while trace arrays move to arguments. Returns (packed [N, A+1],
+    new_states, per_metrics [N, 4]) — per-CLUSTER metric rows, so
+    callers that need per-tenant accounting (the service's bulkhead
+    isolation evidence) read them without a second transfer; fleet
+    aggregates are a host-side sum over the same rows."""
+    from ccka_tpu.obs.compile import watch_jit
+
+    action_fn = backend.action_fn()
+    params = SimParams.from_config(cfg)
+
+    @jax.jit
+    def fleet_tick(states, xs_all, t, key):
+        """One dispatch: slice exo, decide, estimate, pack per-cluster."""
+        exo_n = exo_at(xs_all, t, horizon_ticks)
+        actions = jax.vmap(lambda s, e: action_fn(s, e, t))(states, exo_n)
+        keys = jax.random.split(jax.random.fold_in(key, t), n)
+        new_states, metrics = jax.vmap(
+            partial(sim_step, params, stochastic=False)
+        )(states, actions, exo_n, keys)
+        packed = pack_rows(flatten_actions(actions, n), exo_n)
+        return packed, new_states, per_cluster_metrics(metrics)
+
+    # Watched jit (obs/compile.py): the batched decide is THE fleet
+    # hot path — one warmup compile is expected; any recompile after
+    # it (a leaked static-arg rebind) warns loudly. shared_stats: every
+    # fleet/service instance of one config accumulates into one entry.
+    return watch_jit(fleet_tick, "fleet.tick", hot=True,
+                     shared_stats=True)
 
 
 class FleetController:
@@ -140,53 +240,26 @@ class FleetController:
             lambda x: jnp.broadcast_to(x, (n,) + x.shape), base)
         self.key = jax.random.key(seed + 1)
 
-        p, z = cfg.cluster.n_pools, cfg.cluster.n_zones
-        # Host-side unpack plan for the packed action row, derived from a
-        # template Action so it tracks the NamedTuple's field order and
-        # leaf shapes by construction (the device pack iterates the same
-        # fields; trailing column is is_peak).
-        template = Action.neutral(p, z)
-        self._action_shapes = [tuple(leaf.shape) for leaf in template]
-        self._action_sizes = [int(np.prod(s)) for s in self._action_shapes]
+        # Host-side unpack plan for the packed action row (the device
+        # pack iterates the same fields; trailing column is is_peak).
+        self._action_shapes, self._action_sizes = action_layout(
+            cfg.cluster)
         self._pool = (ThreadPoolExecutor(max_workers=fanout_workers,
                                          thread_name_prefix="ccka-fanout")
                       if fanout_workers > 1 else None)
         self._workers = max(1, fanout_workers)
 
-        action_fn = backend.action_fn()
-        xs_all = exo_steps(self._traces)          # [N, T, ...] device pytree
+        self._xs_all = exo_steps(self._traces)    # [N, T, ...] device pytree
+        # Config-keyed shared compile (see `_compiled_fleet_tick`):
+        # traces are an argument, so every fleet/service of this
+        # (config, backend, N, horizon) shares ONE XLA program.
+        self._tick_fn = _compiled_fleet_tick(cfg, backend, n,
+                                             horizon_ticks)
 
-        @jax.jit
-        def fleet_tick(states, t, key):
-            """One dispatch: slice exo, decide, estimate, aggregate, pack."""
-            t_mod = jnp.mod(t, horizon_ticks)
-            exo_n = jax.tree.map(
-                lambda x: jax.lax.dynamic_index_in_dim(
-                    x, t_mod, axis=1, keepdims=False), xs_all)
-            actions = jax.vmap(lambda s, e: action_fn(s, e, t))(states,
-                                                                exo_n)
-            keys = jax.random.split(jax.random.fold_in(key, t), n)
-            new_states, metrics = jax.vmap(
-                partial(sim_step, self.params, stochastic=False)
-            )(states, actions, exo_n, keys)
-            flat = jnp.concatenate(
-                [jnp.reshape(a, (n, -1)) for a in actions], axis=-1)
-            packed = jnp.concatenate(
-                [flat, (exo_n.is_peak > 0.5).astype(jnp.float32)[:, None]],
-                axis=-1)
-            agg = jnp.stack([
-                metrics.slo_ok.sum(),
-                metrics.cost_usd.sum(),
-                metrics.carbon_g.sum(),
-                metrics.pending_pods.sum(),
-            ])
-            return packed, new_states, agg
-
-        # Watched jit (obs/compile.py): the batched decide is THE fleet
-        # hot path — one warmup compile is expected; any recompile after
-        # it (a leaked static-arg rebind) warns loudly.
-        from ccka_tpu.obs.compile import watch_jit
-        self._fleet_tick = watch_jit(fleet_tick, "fleet.tick", hot=True)
+    def _fleet_tick(self, states, t, key):
+        """The batched tick over this fleet's traces (kept as a bound
+        3-arg entry point: tests probe it directly)."""
+        return self._tick_fn(states, self._xs_all, t, key)
 
     # -- device side --------------------------------------------------------
 
@@ -197,26 +270,23 @@ class FleetController:
         # — the device chain is timed as its own fenced region by
         # bench_fleet. A fence here would serialize the pipeline.
         with self.tracer.span("fleet.dispatch", t=t) as sp:
-            packed, new_states, agg = self._fleet_tick(
+            packed, new_states, per = self._fleet_tick(
                 self.states, jnp.int32(t), self.key)
             self.states = new_states
             # Start the device→host copy immediately so it overlaps the
             # previous tick's fan-out (harvest then finds it already
             # local).
-            for arr in (packed, agg):
+            for arr in (packed, per):
                 if hasattr(arr, "copy_to_host_async"):
                     arr.copy_to_host_async()
-        return _Dispatched(t=t, packed=packed, agg=agg,
+        return _Dispatched(t=t, packed=packed, per_metrics=per,
                            dispatch_ms=sp.dur_ms)
 
     # -- host side ----------------------------------------------------------
 
     def _unpack_action(self, row: np.ndarray) -> Action:
-        leaves, off = [], 0
-        for shape, size in zip(self._action_shapes, self._action_sizes):
-            leaves.append(row[off:off + size].reshape(shape))
-            off += size
-        return Action(*leaves)
+        return unpack_action_row(row, self._action_shapes,
+                                 self._action_sizes)
 
     def _fanout(self, packed: np.ndarray) -> int:
         """Render + apply every cluster's patches; returns #applied-ok."""
@@ -247,7 +317,9 @@ class FleetController:
         # on device work — near zero when pipelining hides the chain.
         with self.tracer.span("fleet.harvest", t=disp.t) as sp_h:
             packed = np.asarray(disp.packed)  # no-op if async copy landed
-            agg = np.asarray(disp.agg)
+            # Fleet aggregates are a host sum over the per-cluster rows
+            # (the rows themselves feed per-tenant accounting upstream).
+            agg = np.asarray(disp.per_metrics).sum(axis=0)
         with self.tracer.span("fleet.fanout", t=disp.t) as sp_f:
             applied = self._fanout(packed)
 

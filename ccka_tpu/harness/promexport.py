@@ -112,6 +112,26 @@ SERIES: dict[str, tuple[str, str]] = {
     "ccka_resumes_total": (
         "resumes_total",
         "Times this logical run was resumed from a snapshot"),
+    # Multi-tenant service series (round 13; ARCHITECTURE §15): the
+    # overload-control surfaces of `harness/service.py`. These resolve
+    # from a ServiceTickReport (the fleet service's per-tick record);
+    # single-cluster TickReports skip them. The breaker gauge sums the
+    # per-tenant levels (0 closed, 1 half-open, 2 open) via the dotted
+    # dict spec, so one number states the fleet's breaker pressure.
+    "ccka_tenant_breaker_state": (
+        "breaker_states.*",
+        "Sum of per-tenant circuit-breaker levels "
+        "(0 closed, 1 half-open, 2 open)"),
+    "ccka_ticks_shed_total": (
+        "sheds_total",
+        "Cumulative tenant decides shed by admission backpressure "
+        "this session"),
+    "ccka_admission_queue_depth": (
+        "admission_queue_depth",
+        "Tenant decides wanting admission this tick (pre-cap)"),
+    "ccka_tick_latency_ms": (
+        "tick_latency_ms",
+        "Service tick latency (admission+decide+fanout), milliseconds"),
     "ccka_applied": ("applied", "1 if every patch applied this tick"),
     "ccka_verified": ("verified", "1 if read-back matched intent"),
     "ccka_tick": ("t", "Controller tick counter"),
@@ -130,6 +150,15 @@ SERIES: dict[str, tuple[str, str]] = {
     "ccka_tick_total_ms": (
         "timings_ms.*", "Total instrumented tick time, milliseconds"),
 }
+
+# Series that resolve only from the fleet service's ServiceTickReport
+# (`harness/service.py`): a single-cluster controller's scrape
+# legitimately omits them (resolve_field -> None skips the series), and
+# the telemetry parity test checks them against a service tick instead.
+SERVICE_ONLY_SERIES = frozenset({
+    "ccka_tenant_breaker_state", "ccka_ticks_shed_total",
+    "ccka_admission_queue_depth", "ccka_tick_latency_ms",
+})
 
 _LABEL = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
 

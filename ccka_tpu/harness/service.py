@@ -1,0 +1,754 @@
+"""Overload-safe multi-tenant fleet service (ROADMAP item 4, host half).
+
+`harness/fleet.py` scaled the decide sideways: one batched device
+dispatch over N homogeneous clusters, host fan-out to N sinks. But the
+host loop it wraps is only as healthy as its worst tenant — a hung
+scrape blocks the tick, a chaos-ridden kubectl edge burns the whole
+fan-out budget in retries, and nothing bounds queue growth when arrival
+rate exceeds dispatch rate. This module is the fleet loop rebuilt with
+robustness as the design axis (KIS-S and NeuroScaler both stress that an
+autoscaling control plane must stay responsive *under the load it
+manages*):
+
+- **bounded batched ticks** — each tick has a hard deadline
+  (`ServiceConfig.tick_deadline_ms`), split between a scrape/admission
+  budget and a fan-out budget. Tenant scrapes that would run past the
+  scrape budget are abandoned at the budget edge and DEFERRED to the
+  next tick (a straggler is never awaited); all admitted decides still
+  pack into ONE device dispatch per tick through the config-keyed
+  shared jit (`fleet._compiled_fleet_tick` idiom), with held/fallback
+  lanes selected per tenant *inside* the same dispatch so a degraded
+  fleet never pays a second device round trip.
+- **per-tenant bulkheads + circuit breakers** — scrape timeouts/stale
+  samples and reconcile give-ups feed a per-tenant
+  closed→open→half-open :class:`CircuitBreaker` (seeded-jitter
+  exponential probe schedule, the `RetryingFetch` idiom). While open,
+  the tenant's scrape AND actuation are skipped outright — no tick
+  budget is spent on a known-bad edge — and its decision lane degrades
+  to hold-last-action, escalating to the rule fallback after
+  ``hold_fallback_after`` open ticks (the single-cluster degraded
+  machine's ok→hold→fallback shape, per tenant). Healthy tenants
+  proceed untouched: their decide rows are bitwise the calm run's.
+- **backpressure + load shedding** — `ServiceConfig.admission_queue_cap`
+  bounds admitted decides per tick; overflow is shed by EXPLICIT
+  priority (stale-tolerant tenants first), every shed/deferral is
+  counted on the report, and sustained saturation degrades
+  stale-tolerant tenants' decide cadence (bounded divisor) instead of
+  growing unbounded backlog.
+
+Time is read through an injectable :class:`VirtualClock` so the
+dry-run overload harness (`harness/overload.py`) models slow/hung
+scrapes by advancing the clock instead of sleeping — deterministic,
+fast, and the deadline arithmetic is identical to real time. All
+host timing here rides inside tracer spans (the AST timing guard in
+`tests/test_timing_guard.py` scans this hot loop, `time.monotonic`
+included).
+
+The ``off`` preset (`config.SERVICE_PRESETS`) is a hard gate in the
+ChaosSink-"off" idiom: every tick delegates verbatim to the wrapped
+pre-service :class:`FleetController`, byte-identical packed actions and
+per-sink command streams (pinned by `tests/test_service.py`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import random
+import time
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccka_tpu.actuation.patches import render_nodepool_patches
+from ccka_tpu.actuation.sink import ActuationSink
+from ccka_tpu.config import FrameworkConfig, ServiceConfig
+from ccka_tpu.harness.fleet import (FleetController, action_layout,
+                                    unpack_action_row)
+from ccka_tpu.policy.base import PolicyBackend
+from ccka_tpu.sim.dynamics import step as sim_step
+from ccka_tpu.sim.types import Action, SimParams
+from ccka_tpu.signals.base import SignalSource
+
+# Decision lanes, selected per tenant INSIDE the one batched dispatch.
+LANE_FRESH = 0      # admitted scrape → the backend's fresh decide
+LANE_HOLD = 1       # shed/deferred/breaker-open → hold last fresh action
+LANE_FALLBACK = 2   # breaker open past hold_fallback_after → rule profile
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantProfile:
+    """A tenant's behavioral archetype for the dry-run service harness.
+
+    ``scrape_delay_ms`` is virtual host time one scrape consumes
+    (advanced on the service's :class:`VirtualClock`); a delay larger
+    than the remaining scrape budget models the hung scrape that times
+    out at the budget edge. ``chaos`` names a `config.CHAOS_PRESETS`
+    intensity wrapped onto the tenant's sink (its kubectl edge).
+    ``priority`` orders admission AND shedding: lower numbers scrape
+    first, higher numbers shed first; ``stale_tolerant`` additionally
+    opts the tenant into cadence degradation under sustained saturation.
+    """
+
+    name: str
+    scrape_delay_ms: float = 0.0
+    scrape_fail_prob: float = 0.0
+    chaos: str = ""
+    priority: int = 1
+    stale_tolerant: bool = False
+
+
+# The named tenant archetypes `bench_overload` / `ccka overload-eval`
+# compose into fleets; unknown names are rejected up front (the
+# chaos-eval convention).
+TENANT_PROFILES: dict[str, TenantProfile] = {
+    # The well-behaved tenant: instant scrape, honest kubectl edge.
+    "healthy": TenantProfile("healthy"),
+    # Stale-tolerant batch tenant: first to shed, cadence-degradable.
+    "batch": TenantProfile("batch", priority=2, stale_tolerant=True),
+    # Slow-but-bounded scrape: consumes real budget, never times out on
+    # a default-posture budget (deferral pressure without breaker trips).
+    "jittery": TenantProfile("jittery", scrape_delay_ms=20.0),
+    # The hung scrape from the issue: always exceeds any sane scrape
+    # budget, so every attempt times out at the budget edge.
+    "slow": TenantProfile("slow", scrape_delay_ms=400.0),
+    # Byzantine edge: failing scrapes AND severe kubectl chaos.
+    "flaky": TenantProfile("flaky", scrape_fail_prob=0.35,
+                           chaos="severe"),
+}
+
+
+def resolve_profiles(names: Sequence) -> list[TenantProfile]:
+    """Profile names (or explicit TenantProfile instances, e.g. the
+    overload grid's chaos-composed derivatives) -> profiles, rejecting
+    unknown names up front — a typo must fail fast, not produce an
+    empty/meaningless board."""
+    out: list[TenantProfile] = []
+    bad: set[str] = set()
+    for p in names:
+        if isinstance(p, TenantProfile):
+            out.append(p)
+        elif p in TENANT_PROFILES:
+            out.append(TENANT_PROFILES[p])
+        else:
+            bad.add(str(p))
+    if bad:
+        raise ValueError(f"unknown tenant profiles {sorted(bad)}; known: "
+                         f"{sorted(TENANT_PROFILES)}")
+    return out
+
+
+class VirtualClock:
+    """Monotonic clock plus injectable virtual delay.
+
+    The overload harness models slow/hung tenant scrapes by calling
+    :meth:`advance` instead of sleeping, so stress runs are
+    deterministic and wall-clock-fast while every deadline comparison
+    is arithmetically identical to real time. The base clock is
+    injectable for fully-virtual tests."""
+
+    def __init__(self, base: Callable[[], float] = time.monotonic):
+        self._base = base
+        self._offset = 0.0
+
+    def __call__(self) -> float:
+        return self._base() + self._offset
+
+    def advance(self, seconds: float) -> None:
+        self._offset += float(seconds)
+
+
+_BREAKER_LEVEL = {"closed": 0, "half-open": 1, "open": 2}
+
+
+class CircuitBreaker:
+    """Per-tenant closed→open→half-open breaker.
+
+    ``breaker_failures`` consecutive failures (scrape timeout/stale or
+    reconcile give-up) OPEN the breaker; while open, :meth:`allow`
+    refuses work until the seeded-jittered probe tick arrives, at which
+    point ONE half-open probe is allowed through — success re-closes,
+    failure re-opens with the probe delay doubled (capped at
+    ``breaker_max_probe_ticks``). The jitter RNG is seeded so paired
+    runs see identical probe schedules (`RetryingFetch` idiom)."""
+
+    def __init__(self, svc: ServiceConfig, seed: int = 0):
+        self._svc = svc
+        self._rng = random.Random(seed)
+        self.state = "closed"
+        self._fails = 0          # consecutive failures while closed
+        self._opens = 0          # consecutive opens (probe backoff expo)
+        self._probe_at = 0
+        self._opened_at: int | None = None
+        self.transitions = {"opened": 0, "half_open": 0, "closed": 0}
+
+    @property
+    def level(self) -> int:
+        return _BREAKER_LEVEL[self.state]
+
+    def open_ticks(self, t: int) -> int:
+        """Ticks since the breaker first left closed (0 when closed)."""
+        return 0 if self._opened_at is None else max(0, t - self._opened_at)
+
+    def allow(self, t: int) -> bool:
+        """May this tenant's scrape/actuation be attempted at tick t?
+        Transitions open→half-open when the probe is due."""
+        if self.state == "closed":
+            return True
+        if self.state == "open" and t >= self._probe_at:
+            self.state = "half-open"
+            self.transitions["half_open"] += 1
+            return True
+        return self.state == "half-open"
+
+    def record_success(self) -> None:
+        if self.state != "closed":
+            self.transitions["closed"] += 1
+        self.state = "closed"
+        self._fails = 0
+        self._opens = 0
+        self._opened_at = None
+
+    def record_failure(self, t: int) -> None:
+        self._fails += 1
+        if self.state == "half-open" or self._fails >= \
+                self._svc.breaker_failures:
+            self._open(t)
+
+    def _open(self, t: int) -> None:
+        svc = self._svc
+        if self.state != "open":
+            self.transitions["opened"] += 1
+        if self._opened_at is None:
+            self._opened_at = t
+        self.state = "open"
+        self._opens += 1
+        self._fails = 0
+        base = svc.breaker_probe_ticks * (2.0 ** min(self._opens - 1, 8))
+        jit = 1.0 + svc.breaker_probe_jitter * (
+            2.0 * self._rng.random() - 1.0)
+        delay = int(round(base * jit))
+        self._probe_at = t + max(1, min(delay, svc.breaker_max_probe_ticks))
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_service_tick(cfg: FrameworkConfig, backend,
+                           n: int, horizon_ticks: int):
+    """The lane-selecting batched tick, jitted once per (config,
+    backend, fleet size, horizon) — `fleet._compiled_fleet_tick` with
+    the service's three decision lanes folded into the SAME single
+    dispatch: the backend's fresh decide and the rule fallback are both
+    computed batched, then selected per row by the host-built lane
+    vector, with held actions supplied as an input buffer. One device
+    round trip per tick regardless of how degraded the fleet is. Keyed
+    on the backend INSTANCE (identity hash), so the overload board's
+    paired stressed/calm services share one XLA program."""
+    from ccka_tpu.obs.compile import watch_jit
+    from ccka_tpu.policy.rule import RulePolicy
+
+    from ccka_tpu.harness.fleet import (exo_at, flatten_actions,
+                                        pack_rows, per_cluster_metrics)
+
+    action_fn = backend.action_fn()
+    params = SimParams.from_config(cfg)
+    fallback_fn = RulePolicy(cfg.cluster).action_fn()
+    shapes, sizes = action_layout(cfg.cluster)
+
+    def _unflatten(flat: jnp.ndarray) -> Action:
+        leaves, off = [], 0
+        for shape, size in zip(shapes, sizes):
+            leaves.append(jnp.reshape(flat[:, off:off + size],
+                                      (n,) + shape))
+            off += size
+        return Action(*leaves)
+
+    @jax.jit
+    def service_tick(states, xs_all, t, key, lanes, held):
+        exo_n = exo_at(xs_all, t, horizon_ticks)
+        fresh = jax.vmap(lambda s, e: action_fn(s, e, t))(states, exo_n)
+        fb = jax.vmap(lambda s, e: fallback_fn(s, e, t))(states, exo_n)
+        lane_col = lanes[:, None]
+        flat_sel = jnp.where(
+            lane_col == LANE_FRESH, flatten_actions(fresh, n),
+            jnp.where(lane_col == LANE_HOLD, held,
+                      flatten_actions(fb, n)))
+        actions = _unflatten(flat_sel)
+        keys = jax.random.split(jax.random.fold_in(key, t), n)
+        new_states, metrics = jax.vmap(
+            functools.partial(sim_step, params, stochastic=False)
+        )(states, actions, exo_n, keys)
+        packed = pack_rows(flat_sel, exo_n)
+        return packed, new_states, per_cluster_metrics(metrics)
+
+    return watch_jit(service_tick, "service.tick", hot=True,
+                     shared_stats=True)
+
+
+@dataclasses.dataclass
+class ServiceTickReport:
+    """One service tick: fleet KPIs + the overload-control surfaces."""
+
+    t: int
+    n_tenants: int
+    admitted: int              # tenants whose fresh decide was used
+    deferred: int              # scrape stragglers abandoned at the budget
+    shed: int                  # decides shed by admission backpressure
+    cadence_skipped: int       # stale-tolerant tenants skipped by backoff
+    bulkhead_skipped: int      # open-breaker tenants not even attempted
+    scrape_failed: int         # scrapes attempted but timed out / failed
+    probes: int                # half-open probes attempted this tick
+    applied: int               # tenants whose reconcile converged
+    fanout_deferred: int       # tenants un-actuated at the tick deadline
+    slo_ok: int                # tenants meeting the SLO gate
+    cost_usd_hr: float
+    carbon_g_hr: float
+    pending_pods: float
+    tick_latency_ms: float     # admission+decide+fanout on the clock
+    admission_queue_depth: int  # decides wanting in this tick (pre-cap)
+    sheds_total: int           # session-cumulative (promexport counter)
+    deferrals_total: int
+    breaker_transitions_total: int
+    cadence_divisor: int       # 1 = full cadence for stale-tolerant rows
+    decide_ms: float
+    fanout_ms: float
+    # Per-tenant breaker levels {tenant index as str: 0|1|2}; promexport
+    # sums this dict ("breaker_states.*") into the fleet's aggregate
+    # breaker-pressure gauge.
+    breaker_states: dict = dataclasses.field(default_factory=dict)
+
+
+class FleetService:
+    """N tenant clusters behind one bounded, bulkheaded batched tick.
+
+    Construction mirrors :class:`FleetController` (which it wraps for
+    the device machinery and per-tenant reconcilers) plus per-tenant
+    ``profiles`` (names into :data:`TENANT_PROFILES`; default all
+    "healthy") and a ``service`` posture (default ``cfg.service``).
+    Tenants whose profile names a chaos intensity get their sink wrapped
+    in a seeded `ChaosSink` (per-tenant seed derivation, the fleet
+    idiom), so the breaker's actuation-failure signal is driven by the
+    same injected kubectl edge the recovery scoreboard uses.
+
+    With ``service.enabled`` False every tick delegates verbatim to the
+    wrapped FleetController — the zero-overhead "off" gate.
+    """
+
+    def __init__(self, cfg: FrameworkConfig, backend: PolicyBackend,
+                 source: SignalSource, sinks: Sequence[ActuationSink],
+                 *, profiles: Sequence[str] | None = None,
+                 service: ServiceConfig | None = None,
+                 horizon_ticks: int = 2880, seed: int = 0,
+                 clock: VirtualClock | None = None, tracer=None,
+                 log_fn: Callable[[str], None] | None = None):
+        svc = cfg.service if service is None else service
+        svc.validate()
+        self.svc = svc
+        self.cfg = cfg
+        n = len(sinks)
+        names = list(profiles) if profiles is not None else ["healthy"] * n
+        if len(names) != n:
+            raise ValueError(f"{len(names)} profiles for {n} sinks — one "
+                             "profile per tenant")
+        self.profiles = resolve_profiles(names)
+        self.profile_names = [p.name for p in self.profiles]
+        # Per-tenant kubectl-edge chaos per the profile (seed derivation
+        # per tenant: one shared seed would fail every tenant in
+        # lockstep, hiding exactly the asymmetric-failure case bulkheads
+        # exist for).
+        wrapped: list[ActuationSink] = []
+        for i, (snk, prof) in enumerate(zip(sinks, self.profiles)):
+            if prof.chaos:
+                from ccka_tpu.actuation.chaos import make_chaos_sink
+                snk = make_chaos_sink(snk, prof.chaos,
+                                      seed=seed ^ (0xC4A05 + i))
+            wrapped.append(snk)
+        self.ctrl = FleetController(
+            cfg, backend, source, wrapped, horizon_ticks=horizon_ticks,
+            seed=seed, fanout_workers=1, tracer=tracer, log_fn=log_fn)
+        self.n = n
+        self.sinks = self.ctrl.sinks
+        self.tracer = self.ctrl.tracer
+        self.log_fn = log_fn or (lambda s: None)
+        self._seed = seed
+        if not svc.enabled:
+            return  # hard gate: tick()/run() delegate to the controller
+
+        self.clock = clock if clock is not None else VirtualClock()
+        self._tick_fn = _compiled_service_tick(cfg, backend, n,
+                                               horizon_ticks)
+        # Service-tuned reconcilers over the (chaos-wrapped) sinks: the
+        # fleet controller's defaults carry a 2s internal deadline and
+        # 10ms backoffs — one converge started just before the tick
+        # deadline would blow through it. Each converge is budgeted to
+        # a small slice of the fan-out share, and the fan-out loop only
+        # STARTS a converge whose worst case still fits the remaining
+        # tick budget, so the deadline is a guarantee, not a hope.
+        from ccka_tpu.actuation.reconcile import Reconciler
+        if svc.tick_deadline_ms > 0.0:
+            fan_budget_s = (svc.tick_deadline_ms
+                            * (1.0 - svc.scrape_budget_frac) / 1e3)
+            self._converge_budget_s = min(0.05, fan_budget_s / 4.0)
+        else:
+            self._converge_budget_s = 2.0
+        self._reconcilers = [
+            Reconciler(snk, max_rounds=2, backoff_s=0.002,
+                       deadline_s=self._converge_budget_s,
+                       seed=seed ^ (0x5EC0 + i))
+            for i, snk in enumerate(self.ctrl.sinks)]
+        self.breakers = [CircuitBreaker(svc, seed=seed ^ (0xB4EA + i))
+                         for i in range(n)]
+        self._scrape_rngs = [random.Random((seed, i, "scrape").__repr__())
+                             for i in range(n)]
+        # Held action rows [N, A] (packed layout minus the is_peak
+        # column); neutral until a tenant's first fresh decide lands.
+        neutral = np.concatenate(
+            [np.asarray(leaf, np.float32).reshape(-1)
+             for leaf in Action.neutral(cfg.cluster.n_pools,
+                                        cfg.cluster.n_zones)])
+        self._held = np.tile(neutral[None, :], (n, 1))
+        # Admission order: priority ascending, index-stable — critical
+        # tenants scrape (and actuate) inside the budget first.
+        self._order = sorted(range(n),
+                             key=lambda i: (self.profiles[i].priority, i))
+        # Session counters + per-tenant accounting (the overload board's
+        # isolation evidence reads these).
+        self.sheds_total = 0
+        self.deferrals_total = 0
+        self.cadence_skips_total = 0
+        self.bulkhead_skips_total = 0
+        self.scrape_timeouts_total = 0
+        self.scrape_failures_total = 0
+        self.actuation_giveups_total = 0
+        self.tenant_cost_usd = np.zeros(n, np.float64)
+        self.tenant_slo_ticks = np.zeros(n, np.float64)
+        self.tenant_fresh_ticks = np.zeros(n, np.int64)
+        # Retention-bounded like the fleet's default tracer: a service
+        # daemon ticks forever, and an unbounded per-tick float list on
+        # the hot loop is a slow leak. 4096 covers any overload-board
+        # run; long-lived owners wanting full history can drain it.
+        from collections import deque
+        self.latencies_ms: "deque[float]" = deque(maxlen=4096)
+        self._sat_streak = 0
+        self._cadence_divisor = 1
+
+    # -- delegation surface --------------------------------------------------
+
+    @property
+    def states(self):
+        return self.ctrl.states
+
+    def close(self) -> None:
+        self.ctrl.close()
+
+    def warmup(self) -> None:
+        """Trigger (or reuse) the XLA compile without advancing any
+        state: a cold service's first tick would otherwise spend its
+        entire deadline inside the compile and defer its whole fan-out.
+        The overload harness calls this before measuring latencies; a
+        daemon may skip it and simply eat one deferred first tick."""
+        if not self.svc.enabled:
+            return
+        out = self._tick_fn(
+            self.ctrl.states, self.ctrl._xs_all, jnp.int32(0),
+            self.ctrl.key, jnp.zeros(self.n, jnp.int32),
+            jnp.asarray(self._held))
+        jax.block_until_ready(out[0])
+
+    # -- scrape simulation ---------------------------------------------------
+
+    def _scrape(self, i: int, budget_s: float) -> tuple[bool, bool]:
+        """Attempt tenant i's scrape within ``budget_s``; returns
+        (ok, timed_out). A profile delay larger than the remaining
+        budget consumes the WHOLE remaining budget and times out — the
+        straggler is abandoned at the budget edge, exactly what a
+        scrape-with-timeout does to a hung endpoint."""
+        prof = self.profiles[i]
+        delay_s = prof.scrape_delay_ms / 1e3
+        if delay_s > 0.0:
+            if delay_s > budget_s:
+                self.clock.advance(max(budget_s, 0.0))
+                return False, True
+            self.clock.advance(delay_s)
+        if prof.scrape_fail_prob > 0.0 and \
+                self._scrape_rngs[i].random() < prof.scrape_fail_prob:
+            return False, False
+        return True, False
+
+    # -- one bounded tick ----------------------------------------------------
+
+    def tick(self, t: int) -> "ServiceTickReport | object":
+        if not self.svc.enabled:
+            # The "off" gate: verbatim pre-service fleet behavior.
+            return self.ctrl.tick(t)
+        svc = self.svc
+        with self.tracer.span("service.tick", t=t):
+            t0 = self.clock()
+            has_deadline = svc.tick_deadline_ms > 0.0
+            deadline = (t0 + svc.tick_deadline_ms / 1e3
+                        if has_deadline else math.inf)
+            scrape_end = (t0 + svc.tick_deadline_ms
+                          * svc.scrape_budget_frac / 1e3
+                          if has_deadline else math.inf)
+
+            # 1. arrivals: every tenant is due unless cadence-degraded
+            #    (stale-tolerant tenants decide every `divisor` ticks
+            #    while the queue has been saturating). Tenants whose
+            #    breaker is not closed are NEVER cadence-skipped: the
+            #    seeded probe schedule must not silently depend on
+            #    admission outcomes.
+            due: list[int] = []
+            cadence_skipped = 0
+            div = self._cadence_divisor
+            for i in self._order:
+                if (div > 1 and self.profiles[i].stale_tolerant
+                        and self.breakers[i].state == "closed"
+                        and (t + i) % div != 0):
+                    cadence_skipped += 1
+                    continue
+                due.append(i)
+
+            # 2. bulkheads BEFORE the cap: an open breaker must not
+            #    consume an admission slot (known-bad tenants filling
+            #    the queue would starve healthy ones into being shed —
+            #    the inverse of the isolation contract). allow() is the
+            #    probe gate: it flips open→half-open exactly when the
+            #    seeded schedule says so.
+            live: list[int] = []
+            probing: set[int] = set()
+            bulkhead_skipped = 0
+            for i in due:
+                br = self.breakers[i]
+                if not br.allow(t):
+                    # Bulkheaded for the WHOLE tick (scrape and fan-out
+                    # both skipped); the fan-out loop must not count it
+                    # again.
+                    bulkhead_skipped += 1
+                    continue
+                live.append(i)
+                if br.state == "half-open":
+                    probing.add(i)
+            queue_depth = len(live)
+
+            # 3. admission cap: shed overflow from the BACK of the
+            #    priority order (stale-tolerant/low-priority first).
+            #    Due half-open probes are EXEMPT from the cap — the
+            #    seeded probe schedule must not be shed by backpressure
+            #    — but they keep their priority position in the scrape
+            #    order, so a probe never burns the budget ahead of a
+            #    healthier tenant.
+            cap = svc.admission_queue_cap or self.n
+            non_probing = [i for i in live if i not in probing]
+            shed = max(0, len(non_probing) - cap)
+            keep = set(non_probing[:cap]) | probing
+            ready = [i for i in live if i in keep]
+
+            # 4. bounded scrape loop: stragglers defer when the budget
+            #    runs out — abandoned at the budget edge, never awaited.
+            admitted: list[int] = []
+            scraped_ok = np.zeros(self.n, bool)
+            deferred = scrape_failed = probes = 0
+            for pos, i in enumerate(ready):
+                now = self.clock()
+                if now >= scrape_end:
+                    deferred += len(ready) - pos
+                    self.deferrals_total += len(ready) - pos
+                    break
+                if self.breakers[i].state == "half-open":
+                    probes += 1
+                ok, timed_out = self._scrape(i, scrape_end - now)
+                if ok:
+                    admitted.append(i)
+                    scraped_ok[i] = True
+                else:
+                    scrape_failed += 1
+                    self.scrape_timeouts_total += int(timed_out)
+                    self.scrape_failures_total += int(not timed_out)
+                    self.breakers[i].record_failure(t)
+            self.sheds_total += shed
+
+            # 5. lanes: fresh for admitted; open breakers escalate
+            #    hold → rule-fallback after hold_fallback_after ticks.
+            lanes = np.full(self.n, LANE_HOLD, np.int32)
+            if admitted:
+                lanes[np.asarray(admitted, int)] = LANE_FRESH
+            for i in range(self.n):
+                if lanes[i] == LANE_HOLD and self.breakers[i].open_ticks(
+                        t) >= svc.hold_fallback_after:
+                    lanes[i] = LANE_FALLBACK
+            self.last_lanes = lanes.copy()
+
+            # 6. ONE batched dispatch, lanes selected on device.
+            with self.tracer.span("service.dispatch", t=t) as sp_d:
+                packed, new_states, per = self._tick_fn(
+                    self.ctrl.states, self.ctrl._xs_all, jnp.int32(t),
+                    self.ctrl.key, jnp.asarray(lanes),
+                    jnp.asarray(self._held))
+                self.ctrl.states = new_states
+                for arr in (packed, per):
+                    if hasattr(arr, "copy_to_host_async"):
+                        arr.copy_to_host_async()
+
+            # 7. bounded fan-out through the per-tenant reconcilers
+            #    (priority order; open breakers bulkheaded; stragglers
+            #    deferred at the tick deadline).
+            with self.tracer.span("service.fanout", t=t) as sp_f:
+                packed_np = np.asarray(packed)
+                per_np = np.asarray(per)
+                applied = fanout_deferred = 0
+                for pos, i in enumerate(self._order):
+                    br = self.breakers[i]
+                    if br.state == "open":
+                        # Not re-counted: either it was bulkheaded at
+                        # scrape time (already in bulkhead_skipped) or
+                        # it opened on THIS tick's scrape/probe failure
+                        # (already in scrape_failed) — one tenant, one
+                        # bucket per tick.
+                        continue
+                    # Only START a converge whose worst case (its own
+                    # bounded deadline) still fits the tick budget,
+                    # with one further converge-budget of headroom for
+                    # host noise and post-loop accounting — stragglers
+                    # defer rather than overshooting the deadline.
+                    if self.clock() + 2.0 * self._converge_budget_s \
+                            >= deadline:
+                        rest = len(self._order) - pos
+                        fanout_deferred += rest
+                        self.deferrals_total += rest
+                        break
+                    a_i = unpack_action_row(
+                        packed_np[i, :-1], self.ctrl._action_shapes,
+                        self.ctrl._action_sizes)
+                    is_peak = packed_np[i, -1] > 0.5
+                    patches = render_nodepool_patches(
+                        a_i, self.cfg.cluster,
+                        op="add" if is_peak else "replace")
+                    outcome = self._reconcilers[i].converge(patches)
+                    if outcome.converged:
+                        applied += 1
+                        # A probe (or a plain tick) closes the breaker
+                        # only when scrape AND actuation both held.
+                        if scraped_ok[i]:
+                            br.record_success()
+                    else:
+                        self.actuation_giveups_total += 1
+                        br.record_failure(t)
+
+            # 8. held rows advance for fresh lanes; accounting.
+            if admitted:
+                idx = np.asarray(admitted, int)
+                self._held[idx] = packed_np[idx, :-1]
+                self.tenant_fresh_ticks[idx] += 1
+            self.tenant_cost_usd += per_np[:, 1].astype(np.float64)
+            self.tenant_slo_ticks += per_np[:, 0].astype(np.float64)
+
+            # 9. cadence degradation: sustained shedding doubles the
+            #    stale-tolerant divisor (bounded); relief halves it.
+            if shed > 0:
+                self._sat_streak += 1
+                if self._sat_streak >= svc.shed_backoff_after:
+                    self._cadence_divisor = min(
+                        self._cadence_divisor * 2, svc.cadence_backoff_max)
+            else:
+                self._sat_streak = 0
+                if self._cadence_divisor > 1:
+                    self._cadence_divisor //= 2
+            self.cadence_skips_total += cadence_skipped
+            self.bulkhead_skips_total += bulkhead_skipped
+
+            latency_ms = (self.clock() - t0) * 1e3
+        self.latencies_ms.append(latency_ms)
+        agg = per_np.sum(axis=0)
+        dt_hr = float(self.ctrl.params.dt_s) / 3600.0
+        report = ServiceTickReport(
+            t=t,
+            n_tenants=self.n,
+            admitted=len(admitted),
+            deferred=deferred,
+            shed=shed,
+            cadence_skipped=cadence_skipped,
+            bulkhead_skipped=bulkhead_skipped,
+            scrape_failed=scrape_failed,
+            probes=probes,
+            applied=applied,
+            fanout_deferred=fanout_deferred,
+            slo_ok=int(agg[0]),
+            cost_usd_hr=float(agg[1]) / dt_hr,
+            carbon_g_hr=float(agg[2]) / dt_hr,
+            pending_pods=float(agg[3]),
+            tick_latency_ms=round(latency_ms, 3),
+            admission_queue_depth=queue_depth,
+            sheds_total=self.sheds_total,
+            deferrals_total=self.deferrals_total,
+            breaker_transitions_total=sum(
+                sum(b.transitions.values()) for b in self.breakers),
+            cadence_divisor=self._cadence_divisor,
+            decide_ms=round(sp_d.dur_ms, 3),
+            fanout_ms=round(sp_f.dur_ms, 3),
+            breaker_states={str(i): b.level
+                            for i, b in enumerate(self.breakers)},
+        )
+        self.log_fn(
+            f"service t={t}: {report.admitted}/{self.n} fresh, "
+            f"{report.shed} shed, {report.deferred} deferred, "
+            f"{report.bulkhead_skipped} bulkheaded, "
+            f"latency {report.tick_latency_ms:.1f}ms")
+        return report
+
+    def run(self, ticks: int, start_tick: int = 0) -> list:
+        """Sequential bounded ticks (the deadline is a per-tick host
+        contract, so the fleet controller's dispatch pipelining does not
+        apply — the dispatch itself is still a single async device
+        round trip under the fan-out)."""
+        return [self.tick(t) for t in range(start_tick,
+                                            start_tick + ticks)]
+
+    # -- board accessors -----------------------------------------------------
+
+    def breaker_transition_counts(self) -> dict:
+        out = {"opened": 0, "half_open": 0, "closed": 0}
+        for b in self.breakers:
+            for k, v in b.transitions.items():
+                out[k] += v
+        return out
+
+    def chaos_injected(self) -> dict:
+        """Summed injected-failure stats over chaos-wrapped tenant
+        sinks (zeros when no tenant profile carries chaos)."""
+        out = {"commands": 0, "timeouts": 0, "transient_exits": 0,
+               "dropped": 0, "rewrites": 0}
+        for snk in self.sinks:
+            stats = getattr(snk, "stats", None)
+            if stats:
+                for k in out:
+                    out[k] += stats.get(k, 0)
+        return out
+
+    def tenant_usd_per_slo_hr(self) -> np.ndarray:
+        """Per-tenant $/SLO-hour over the run so far (the paired-ratio
+        numerator/denominator of the overload board)."""
+        dt_hr = float(self.ctrl.params.dt_s) / 3600.0
+        slo_hr = self.tenant_slo_ticks * dt_hr
+        return self.tenant_cost_usd / np.maximum(slo_hr, 1e-9)
+
+
+def fleet_service_from_config(cfg: FrameworkConfig,
+                              backend: PolicyBackend, n_tenants: int,
+                              *, profiles: Sequence[str] | None = None,
+                              service: ServiceConfig | None = None,
+                              horizon_ticks: int = 2880, seed: int = 0,
+                              clock: VirtualClock | None = None,
+                              log_fn=None) -> FleetService:
+    """Dry-run service wiring: N in-memory sinks over the synthetic
+    source (per-tenant chaos wraps ride the profiles)."""
+    from ccka_tpu.actuation.sink import DryRunSink
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+    source = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                   cfg.signals)
+    sinks = [DryRunSink() for _ in range(n_tenants)]
+    return FleetService(cfg, backend, source, sinks, profiles=profiles,
+                        service=service, horizon_ticks=horizon_ticks,
+                        seed=seed, clock=clock, log_fn=log_fn)

@@ -164,3 +164,20 @@ def test_recovery_only_flag_and_stage_wiring():
 
     src = inspect.getsource(bench.bench_recovery)
     assert "recovery_scoreboard" in src
+
+
+def test_overload_only_flag_and_stage_wiring():
+    """ISSUE 10: the multi-tenant overload scoreboard has a record path
+    (`--overload-only`) and the main sweep carries the stage — argparse
+    contract only (the service itself is exercised in
+    tests/test_service.py and the BENCH_r13 record)."""
+    parser_src = open(bench.__file__, encoding="utf-8").read()
+    assert "--overload-only" in parser_src
+    assert "bench_overload" in parser_src
+    # bench_overload delegates to the shared board module (the CLI's
+    # overload-eval uses the same one — one implementation, two
+    # drivers).
+    import inspect
+
+    src = inspect.getsource(bench.bench_overload)
+    assert "overload_scoreboard" in src

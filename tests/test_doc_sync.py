@@ -300,6 +300,90 @@ class TestRecoveryClaims:
                    - sev["reconcile_retries_mean"]) < 0.1
 
 
+class TestOverloadClaims:
+    """Round 13's multi-tenant overload scoreboard (ISSUE 10 docs
+    satellite): README's service claims are PARSED against the BASELINE
+    round13 record, not hand-synced."""
+
+    def test_round13_record_is_self_describing(self, baseline):
+        r13 = baseline["published"]["round13"]
+        sb = r13["overload_scoreboard"]
+        assert len(sb["cells"]) >= 12
+        assert set(sb["policies"]) >= {"rule", "flagship"}
+        assert 0.0 in sb["slow_fracs"] and "off" in sb["intensities"]
+        inv = sb["invariants"]
+        # The acceptance surface, cell by cell: healthy isolation holds
+        # under every stress mix, and per-cell p99 stays under the
+        # configured deadline — including every slow-frac >= 0.25 cell
+        # at severe chaos (the issue's acceptance criterion).
+        for name, cell in sb["cells"].items():
+            for policy, row in cell["rows"].items():
+                assert row["healthy_usd_ratio_max"] <= 1.05, (name,
+                                                              policy)
+                assert row["healthy_bitwise_frac"] == 1.0, (name, policy)
+                assert row["latency_ms"]["p99"] \
+                    < cell["tick_deadline_ms"], (name, policy)
+        sev = [c for c in sb["cells"].values()
+               if c["intensity"] == "severe" and c["slow_frac"] >= 0.25]
+        assert sev, "no severe slow-frac >= 0.25 acceptance cells"
+        # The stress was real: slow-fraction severe cells opened
+        # breakers and injected kubectl chaos; the grid shed load.
+        for cell in sev:
+            row = cell["rows"]["rule"]
+            assert row["breaker_transitions"]["opened"] > 0
+            assert sum(row["chaos_injected"][k] for k in
+                       ("timeouts", "transient_exits", "dropped",
+                        "rewrites")) > 0
+        assert inv["healthy_usd_ratio_max"] <= 1.05
+        assert inv["null_cell_ratio_max"] == 1.0   # zero-overhead gate
+        assert inv["sheds_total"] > 0
+        assert "byte-identical" in r13["off_preset_gate"]
+        assert "bitwise" in r13["isolation_evidence"]
+        assert r13["bounded_ticks_evidence"][
+            "p99_under_deadline_every_cell"] is True
+
+    def test_readme_overload_claims(self, readme, baseline):
+        r13 = baseline["published"]["round13"]
+        sb = r13["overload_scoreboard"]
+        inv = sb["invariants"]
+        m = re.search(
+            r"(\d+)\s+cells\s+×\s+\{rule,\s+flagship\},\s+(\d+)\s+"
+            r"stressed\s+runs\s+of\s+(\d+)\s+ticks\s+\(BASELINE\s+"
+            r"round13", readme)
+        assert m, ("README's overload claim no longer states the grid "
+                   "shape in the pinned form — update the claim AND "
+                   "this regex together")
+        cells, runs, ticks = map(int, m.groups())
+        assert cells == len(sb["cells"])
+        assert runs == sum(len(c["rows"]) for c in sb["cells"].values())
+        assert ticks == sb["ticks_per_run"]
+        m2 = re.search(
+            r"healthy\s+tenants'\s+paired\s+\$/SLO-hour\s+ratio\s+is\s+"
+            r"exactly\s+([\d.]+)\s+\(bitwise\s+fraction\s+([\d.]+)\)",
+            readme)
+        assert m2, "README's isolation sentence lost its pinned form"
+        assert float(m2.group(1)) == inv["healthy_usd_ratio_max"]
+        assert float(m2.group(2)) == 1.0
+        m3 = re.search(
+            r"under\s+the\s+(\d+)\s?ms\s+deadline:\s+per-cell\s+p99\s+"
+            r"latency\s+tops\s+out\s+at\s+([\d.]+)\s?ms\s+with\s+(\d+)"
+            r"\s+single-tick\s+max\s+overshoots\s+across\s+(\d+)\s+"
+            r"stressed\s+ticks", readme)
+        assert m3, "README's bounded-ticks sentence lost its pinned form"
+        deadline, p99, overshoots, total_ticks = m3.groups()
+        ev = r13["bounded_ticks_evidence"]
+        assert float(deadline) == ev["tick_deadline_ms"]
+        assert abs(float(p99) - ev["latency_p99_max_ms"]) < 0.05
+        assert int(overshoots) == ev["single_tick_max_overshoots"]
+        assert int(total_ticks) == ev["stressed_ticks"]
+        assert float(p99) < float(deadline)
+        m4 = re.search(r"(\d+)\s+decides\s+shed\s+and\s+(\d+)\s+breaker"
+                       r"\s+opens", readme)
+        assert m4, "README's shed/breaker tally lost its pinned form"
+        assert int(m4.group(1)) == inv["sheds_total"]
+        assert int(m4.group(2)) == inv["breakers_opened_total"]
+
+
 class TestWorkloadScenarioClaims:
     """Round 11's per-family scenario scoreboard (ISSUE 6 docs
     satellite): README's workload-scenario claims are PARSED against

@@ -489,17 +489,34 @@ class TestFlagshipRunLog:
 
 def test_fleet_spans_and_watch(cfg):
     """Fleet ticks emit dispatch/harvest/fanout spans and the batched
-    decide is compile-watched (one warmup compile, then cache hits)."""
+    decide is compile-watched. Round 13 made the fleet tick a
+    config-keyed SHARED compile (`fleet._compiled_fleet_tick`,
+    shared_stats like the controller's estimate step), so the pins are
+    deltas — and a second fleet over the same backend must reuse the
+    first one's XLA program with ZERO new compiles (the overload
+    board's paired stressed/calm services depend on exactly this)."""
     from ccka_tpu.harness.fleet import fleet_controller_from_config
     from ccka_tpu.policy import RulePolicy
 
-    ctrl = fleet_controller_from_config(cfg, RulePolicy(cfg.cluster), 3,
-                                        horizon_ticks=8)
+    backend = RulePolicy(cfg.cluster)
+    ctrl = fleet_controller_from_config(cfg, backend, 3, horizon_ticks=8)
+    stats = ctrl._tick_fn.stats
+    calls0, compiles0, hits0 = stats.calls, stats.compiles, \
+        stats.cache_hits
     reports = ctrl.run(3)
     names = [s.name for s in ctrl.tracer.spans()]
     assert names.count("fleet.dispatch") == 3
     assert names.count("fleet.fanout") == 3
-    assert ctrl._fleet_tick.stats.compiles == 1
-    assert ctrl._fleet_tick.stats.cache_hits == 2
+    assert stats.calls - calls0 == 3
+    assert stats.compiles - compiles0 == 1   # one warmup compile
+    assert stats.cache_hits - hits0 == 2     # then cache hits
     assert all(r.decide_ms >= 0 and r.fanout_ms >= 0 for r in reports)
+    # Shared compile: same (cfg, backend, N, horizon) → same program.
+    ctrl2 = fleet_controller_from_config(cfg, backend, 3,
+                                         horizon_ticks=8, seed=9)
+    compiles1 = stats.compiles
+    ctrl2.run(1)
+    assert ctrl2._tick_fn is ctrl._tick_fn
+    assert stats.compiles == compiles1       # zero new compiles
     ctrl.close()
+    ctrl2.close()

@@ -1,0 +1,376 @@
+"""Multi-tenant fleet service (round 13): bounded batched ticks,
+per-tenant bulkheads/circuit breakers, backpressure with load shedding.
+
+The contracts pinned here:
+
+- the "off" `SERVICE_PRESETS` posture is BYTE-IDENTICAL to the
+  pre-service `FleetController` loop (reports and per-sink command
+  streams) — the zero-overhead gate, same idiom as ChaosSink "off";
+- breaker state-machine edges: open after the failure threshold,
+  half-open probe success re-closes, probe failure re-opens with grown
+  (seeded-jitter, capped) delay, renewed chaos re-opens a recovered
+  breaker;
+- bulkhead isolation: a stressed run's HEALTHY tenants accumulate
+  bitwise the same per-tenant $/SLO-hour as the paired calm run;
+- bounded ticks: a hung scrape is abandoned at the budget edge
+  (deferred, never awaited), latency stays under the deadline;
+- backpressure: admission overflow sheds stale-tolerant tenants first,
+  and sustained saturation degrades their cadence (bounded divisor).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ccka_tpu.config import SERVICE_PRESETS, ServiceConfig, default_config
+from ccka_tpu.harness.fleet import fleet_controller_from_config
+from ccka_tpu.harness.service import (LANE_FALLBACK, LANE_FRESH,
+                                      CircuitBreaker, TENANT_PROFILES,
+                                      fleet_service_from_config,
+                                      resolve_profiles)
+from ccka_tpu.policy import RulePolicy
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config().with_overrides(**{"sim.horizon_steps": 16})
+
+
+@pytest.fixture(scope="module")
+def rule(cfg):
+    # ONE backend instance module-wide: the service-tick compile cache
+    # keys on it, so every test below shares a single XLA program.
+    return RulePolicy(cfg.cluster)
+
+
+def _svc(**kw) -> ServiceConfig:
+    base = dict(enabled=True, tick_deadline_ms=200.0)
+    base.update(kw)
+    return ServiceConfig(**base)
+
+
+class TestCircuitBreaker:
+    """The closed→open→half-open machine, edge by edge (host-only)."""
+
+    def test_opens_after_threshold_then_probe_success_recloses(self):
+        svc = _svc(breaker_failures=2, breaker_probe_ticks=3,
+                   breaker_probe_jitter=0.0)
+        br = CircuitBreaker(svc, seed=1)
+        assert br.allow(0) and br.state == "closed"
+        br.record_failure(0)
+        assert br.state == "closed"          # threshold not reached
+        br.record_failure(1)
+        assert br.state == "open" and br.level == 2
+        assert not br.allow(2)               # probe not due: bulkheaded
+        assert not br.allow(3)
+        assert br.allow(4)                   # 1 + 3 ticks: probe due
+        assert br.state == "half-open" and br.level == 1
+        br.record_success()
+        assert br.state == "closed" and br.level == 0
+        assert br.transitions == {"opened": 1, "half_open": 1,
+                                  "closed": 1}
+
+    def test_probe_failure_reopens_with_doubled_delay(self):
+        svc = _svc(breaker_failures=1, breaker_probe_ticks=3,
+                   breaker_probe_jitter=0.0)
+        br = CircuitBreaker(svc, seed=0)
+        br.record_failure(0)                 # open; probe at t=3
+        assert br.allow(3) and br.state == "half-open"
+        br.record_failure(3)                 # half-open probe fails
+        assert br.state == "open"
+        # Backoff doubled: next probe 3 + 2*3 = 9, not 3 + 3.
+        assert not br.allow(8)
+        assert br.allow(9) and br.state == "half-open"
+
+    def test_reopens_under_renewed_chaos_with_reset_backoff(self):
+        svc = _svc(breaker_failures=1, breaker_probe_ticks=4,
+                   breaker_probe_jitter=0.0)
+        br = CircuitBreaker(svc, seed=0)
+        br.record_failure(0)
+        assert br.allow(4)
+        br.record_success()                  # recovered
+        assert br.state == "closed"
+        br.record_failure(10)                # renewed chaos
+        assert br.state == "open"
+        assert br.transitions["opened"] == 2
+        # Recovery reset the consecutive-open counter: the new probe
+        # delay is the BASE again, not the doubled one.
+        assert br.allow(14)
+
+    def test_probe_delay_capped_and_seeded_jitter_deterministic(self):
+        svc = _svc(breaker_failures=1, breaker_probe_ticks=4,
+                   breaker_probe_jitter=0.3, breaker_max_probe_ticks=16)
+        a = CircuitBreaker(svc, seed=7)
+        b = CircuitBreaker(svc, seed=7)
+        t = 0
+        for _ in range(6):                   # exponent would hit 128
+            a.record_failure(t)
+            b.record_failure(t)
+            assert a._probe_at == b._probe_at  # seeded: paired runs agree
+            assert a._probe_at - t <= svc.breaker_max_probe_ticks
+            t = a._probe_at
+            assert a.allow(t) and b.allow(t)   # half-open probe
+
+    def test_open_ticks_drives_hold_to_fallback_escalation(self):
+        svc = _svc(breaker_failures=1, hold_fallback_after=3)
+        br = CircuitBreaker(svc, seed=0)
+        assert br.open_ticks(5) == 0
+        br.record_failure(5)
+        assert br.open_ticks(6) == 1
+        assert br.open_ticks(9) >= svc.hold_fallback_after
+        br.record_success()
+        assert br.open_ticks(12) == 0
+
+
+class TestOffGate:
+    """SERVICE_PRESETS['off'] is pinned byte-identical to the current
+    FleetController behavior — the zero-overhead gate."""
+
+    def test_off_preset_byte_identical_to_fleet_controller(self, cfg,
+                                                           rule):
+        n, ticks = 6, 3
+        svc = fleet_service_from_config(
+            cfg, rule, n, service=SERVICE_PRESETS["off"],
+            horizon_ticks=8, seed=5)
+        ctl = fleet_controller_from_config(
+            cfg, rule, n, horizon_ticks=8, seed=5, fanout_workers=1)
+        r_svc = svc.run(ticks)
+        r_ctl = [ctl.tick(t) for t in range(ticks)]
+        for a, b in zip(r_svc, r_ctl):
+            # Delegated ticks return FleetTickReports with identical
+            # decisions and accounting — bitwise, not approximately.
+            assert (a.t, a.applied, a.slo_ok) == (b.t, b.applied, b.slo_ok)
+            assert a.cost_usd_hr == b.cost_usd_hr
+            assert a.carbon_g_hr == b.carbon_g_hr
+        for sa, sb in zip(svc.sinks, ctl.sinks):
+            assert [(c.name, c.patch_type, c.patch) for c in sa.commands] \
+                == [(c.name, c.patch_type, c.patch) for c in sb.commands]
+        # Zero overhead: the off gate builds NO breaker/queue machinery.
+        assert not hasattr(svc, "breakers")
+        svc.close()
+        ctl.close()
+
+    def test_cli_fleet_service_summary_and_unknown_preset(self, capsys):
+        import json
+
+        from ccka_tpu.cli import main
+
+        assert main(["fleet", "--clusters", "4", "--ticks", "2",
+                     "--service", "default"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["service"] == "default"
+        assert out["admitted_frac"] == 1.0      # all-healthy fleet
+        with pytest.raises(SystemExit, match="unknown service preset"):
+            main(["fleet", "--clusters", "2", "--ticks", "1",
+                  "--service", "nope"])
+        with pytest.raises(SystemExit, match="unknown tenant profiles"):
+            main(["fleet", "--clusters", "2", "--ticks", "1",
+                  "--service", "default", "--profiles", "bogus"])
+
+
+class TestBulkheadIsolation:
+    """One slow/byzantine tenant must cost the OTHER tenants nothing:
+    their decide rows (and therefore their accumulated $/SLO-hour) are
+    bitwise the calm run's."""
+
+    def test_healthy_tenants_bitwise_match_calm_run(self, cfg, rule):
+        n, ticks = 8, 8
+        stress = fleet_service_from_config(
+            cfg, rule, n,
+            profiles=["healthy"] * 5 + ["slow"] * 2 + ["flaky"],
+            service=SERVICE_PRESETS["default"], horizon_ticks=16, seed=3)
+        calm = fleet_service_from_config(
+            cfg, rule, n, profiles=["healthy"] * n,
+            service=SERVICE_PRESETS["default"], horizon_ticks=16, seed=3)
+        stress.warmup()
+        calm.warmup()
+        stress.run(ticks)
+        calm.run(ticks)
+        s = stress.tenant_usd_per_slo_hr()
+        c = calm.tenant_usd_per_slo_hr()
+        np.testing.assert_array_equal(s[:5], c[:5])   # bitwise
+        # The stressed tenants genuinely degraded (held/fallback lanes,
+        # skipped scrapes) — isolation is meaningful, not vacuous.
+        assert stress.tenant_fresh_ticks[:5].min() == ticks
+        assert stress.tenant_fresh_ticks[5:].max() < ticks
+        stress.close()
+        calm.close()
+
+    def test_hung_scrape_deferred_then_breaker_bulkheads(self, cfg, rule):
+        n, ticks = 4, 10
+        svc = fleet_service_from_config(
+            cfg, rule, n, profiles=["healthy"] * 3 + ["slow"],
+            service=_svc(breaker_failures=2, breaker_probe_ticks=4),
+            horizon_ticks=16, seed=11)
+        svc.warmup()
+        reports = svc.run(ticks)
+        # The hung scrape timed out at the budget edge (never awaited),
+        # opened its breaker, and was then bulkheaded outright.
+        assert svc.scrape_timeouts_total >= 2
+        assert svc.breakers[3].transitions["opened"] >= 1
+        assert svc.bulkhead_skips_total > 0
+        # Healthy tenants kept full cadence throughout.
+        assert all(r.admitted >= 3 for r in reports)
+        assert svc.tenant_fresh_ticks[:3].min() == ticks
+        # Bounded ticks: every latency under the deadline; the slow
+        # scrape burned budget only until the breaker opened.
+        assert max(svc.latencies_ms) < svc.svc.tick_deadline_ms
+        assert any(r.tick_latency_ms > 50.0 for r in reports[:3])
+        # Generous bound: the tail tick is breaker-bulkheaded (no slow
+        # scrape), but real dispatch/host time rides the clock too and
+        # a loaded CI machine must not flake this.
+        assert reports[-1].tick_latency_ms < 100.0
+        # The per-tick accounting stays a PARTITION even across breaker
+        # opens: a tenant is bulkheaded OR scrape-failed OR admitted,
+        # never double-counted between the scrape and fan-out phases.
+        assert any(r.scrape_failed > 0 for r in reports)
+        assert any(r.bulkhead_skipped > 0 for r in reports)
+        for r in reports:
+            assert (r.admitted + r.shed + r.cadence_skipped + r.deferred
+                    + r.bulkhead_skipped + r.scrape_failed) == n, r
+        svc.close()
+
+    def test_open_breaker_escalates_hold_to_rule_fallback(self, cfg,
+                                                          rule):
+        n = 3
+        svc = fleet_service_from_config(
+            cfg, rule, n, profiles=["healthy"] * 2 + ["slow"],
+            service=_svc(breaker_failures=1, hold_fallback_after=2,
+                         breaker_probe_ticks=32),
+            horizon_ticks=16, seed=2)
+        svc.warmup()
+        svc.run(6)
+        assert svc.last_lanes[0] == LANE_FRESH
+        assert svc.last_lanes[1] == LANE_FRESH
+        assert svc.last_lanes[2] == LANE_FALLBACK
+        svc.close()
+
+
+class TestBackpressure:
+    """Fixed-capacity admission: overflow sheds stale-tolerant tenants
+    first, every shed is counted, saturation degrades cadence."""
+
+    def test_shed_priority_and_cadence_degradation(self, cfg, rule):
+        n, ticks = 6, 8
+        svc = fleet_service_from_config(
+            cfg, rule, n, profiles=["healthy"] * 3 + ["batch"] * 3,
+            service=_svc(admission_queue_cap=4, shed_backoff_after=2,
+                         cadence_backoff_max=4),
+            horizon_ticks=16, seed=9)
+        svc.warmup()
+        reports = svc.run(ticks)
+        # Overflow shed from the back of the priority order: the
+        # stale-tolerant batch tenants, never the healthy three.
+        assert svc.sheds_total > 0
+        assert svc.tenant_fresh_ticks[:3].min() == ticks
+        assert svc.tenant_fresh_ticks[3:].max() < ticks
+        # Sustained saturation degraded the stale-tolerant cadence
+        # (bounded), and the skips are accounted.
+        assert reports[-1].cadence_divisor > 1
+        assert reports[-1].cadence_divisor <= 4
+        assert svc.cadence_skips_total > 0
+        # Every dropped decide is on the record: shed + cadence-skipped
+        # + admitted + deferred + bulkheaded + scrape-failed covers each
+        # tick's fleet — a partition, not overlapping tallies.
+        for r in reports:
+            assert (r.admitted + r.shed + r.cadence_skipped + r.deferred
+                    + r.bulkhead_skipped + r.scrape_failed) == n
+        svc.close()
+
+    def test_unknown_profiles_rejected_up_front(self, cfg, rule):
+        with pytest.raises(ValueError, match="unknown tenant profiles"):
+            resolve_profiles(["healthy", "bogus"])
+        with pytest.raises(ValueError, match="unknown tenant profiles"):
+            fleet_service_from_config(
+                cfg, rule, 2, profiles=["healthy", "bogus"],
+                service=SERVICE_PRESETS["default"], horizon_ticks=8)
+        # And the registry itself stays the vocabulary: every named
+        # archetype resolves.
+        assert [p.name for p in resolve_profiles(list(TENANT_PROFILES))] \
+            == list(TENANT_PROFILES)
+
+
+class TestOverloadScoreboard:
+    """The paired stressed/calm board: isolation + bounded latency on
+    the record; unknown names rejected up front (satellite 6)."""
+
+    def test_small_grid_invariants(self, cfg):
+        from ccka_tpu.harness.overload import overload_scoreboard
+
+        board = overload_scoreboard(
+            cfg, policies=("rule",), tenants=(6,),
+            intensities=("off", "severe"), slow_fracs=(0.0, 0.5),
+            ticks=8, seed=5)
+        inv = board["invariants"]
+        # The acceptance surface: healthy isolation holds exactly, the
+        # null cell pins zero service overhead, and no tick ran past
+        # its deadline.
+        assert inv["healthy_usd_ratio_max"] == 1.0
+        assert inv["null_cell_ratio_max"] == 1.0
+        # Latencies include real host time: allow one stray tick on a
+        # loaded CI machine rather than flaking (the committed BENCH
+        # record, not this mini-grid, is the bounded-ticks evidence).
+        assert inv["deadline_violations_total"] <= 1
+        cell = board["cells"]["n6/severe/slow0.5"]["rows"]["rule"]
+        assert cell["healthy_bitwise_frac"] == 1.0
+        assert cell["breaker_transitions"]["opened"] > 0
+        assert cell["sheds_total"] > 0
+        assert cell["latency_ms"]["p99"] < cell["latency_ms"]["max"] + 1
+        assert board["cells"]["n6/severe/slow0.5"]["tick_deadline_ms"] \
+            > cell["latency_ms"]["p99"]
+        # The stress was real: chaos injected on the stressed edge.
+        assert sum(cell["chaos_injected"][k] for k in
+                   ("timeouts", "transient_exits", "dropped",
+                    "rewrites")) > 0
+
+    def test_unknown_names_rejected_up_front(self, cfg):
+        from ccka_tpu.harness.overload import overload_scoreboard
+
+        with pytest.raises(ValueError, match="unknown chaos"):
+            overload_scoreboard(cfg, intensities=("off", "nope"),
+                                policies=("rule",))
+        with pytest.raises(ValueError, match="unknown tenant profile"):
+            overload_scoreboard(cfg, slow_profile="nope",
+                                policies=("rule",))
+        with pytest.raises(ValueError, match="unknown service preset"):
+            overload_scoreboard(cfg, service_preset="nope",
+                                policies=("rule",))
+        with pytest.raises(ValueError, match="unknown policies"):
+            overload_scoreboard(cfg, policies=("rule", "nope"))
+        with pytest.raises(ValueError, match="off gate"):
+            overload_scoreboard(cfg, service_preset="off",
+                                policies=("rule",))
+        with pytest.raises(ValueError, match="empty grid axis"):
+            overload_scoreboard(cfg, tenants=(), policies=("rule",))
+        # Flagship-only without a committed checkpoint for this
+        # topology must fail BEFORE the grid runs, not after it.
+        with pytest.raises(ValueError, match="no runnable policy"):
+            overload_scoreboard(cfg, policies=("flagship",),
+                                tenants=(2,), intensities=("off",),
+                                slow_fracs=(0.0,), ticks=4)
+
+    def test_cli_overload_eval_rejects_unknown_names(self):
+        from ccka_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="unknown chaos"):
+            main(["overload-eval", "--intensities", "off,bogus",
+                  "--policies", "rule", "--ticks", "4"])
+        with pytest.raises(SystemExit, match="unknown tenant profile"):
+            main(["overload-eval", "--profile", "bogus",
+                  "--policies", "rule", "--ticks", "4"])
+        with pytest.raises(SystemExit, match="unknown service preset"):
+            main(["overload-eval", "--service", "bogus",
+                  "--policies", "rule", "--ticks", "4"])
+
+    def test_cli_overload_eval_small_board(self, capsys):
+        import json
+
+        from ccka_tpu.cli import main
+
+        assert main(["overload-eval", "--tenants", "4",
+                     "--intensities", "off", "--slow-fracs", "0",
+                     "--policies", "rule", "--ticks", "4"]) == 0
+        board = json.loads(capsys.readouterr().out)
+        assert board["cells"]["n4/off/slow0"]["rows"]["rule"][
+            "healthy_usd_ratio_max"] == 1.0

@@ -455,6 +455,22 @@ class TestDashboard:
         assert "secret preserved" in capsys.readouterr().err
 
 
+def _service_report():
+    """A representative fleet-service tick record (round 13): the
+    service-only gauge tests resolve against this."""
+    from ccka_tpu.harness.service import ServiceTickReport
+
+    return ServiceTickReport(
+        t=5, n_tenants=8, admitted=4, deferred=1, shed=2,
+        cadence_skipped=0, bulkhead_skipped=1, scrape_failed=1,
+        probes=1, applied=6,
+        fanout_deferred=0, slo_ok=7, cost_usd_hr=1.5, carbon_g_hr=300.0,
+        pending_pods=2.0, tick_latency_ms=112.5, admission_queue_depth=8,
+        sheds_total=24, deferrals_total=9, breaker_transitions_total=3,
+        cadence_divisor=2, decide_ms=2.1, fanout_ms=4.2,
+        breaker_states={"0": 0, "1": 2, "2": 1})
+
+
 class TestPromExport:
     """VERDICT r2 missing #3: the dashboards queried ccka_* series that
     nothing exported. The exporter closes the fabric; these tests pin
@@ -465,22 +481,31 @@ class TestPromExport:
 
         from ccka_tpu.harness.controller import TickReport
         from ccka_tpu.harness.dashboard import _PANEL_DEFS
-        from ccka_tpu.harness.promexport import (SERIES, referenced_series)
+        from ccka_tpu.harness.promexport import (SERIES,
+                                                 SERVICE_ONLY_SERIES,
+                                                 referenced_series)
+        from ccka_tpu.harness.service import ServiceTickReport
 
         exported = set(SERIES)
-        fields = {f.name for f in dataclasses.fields(TickReport)}
+        tick_fields = {f.name for f in dataclasses.fields(TickReport)}
+        service_fields = {f.name
+                          for f in dataclasses.fields(ServiceTickReport)}
         for _title, expr, _unit in _PANEL_DEFS:
             refs = referenced_series(expr)
             assert refs, f"panel expr references no ccka_* series: {expr}"
             missing = refs - exported
             assert not missing, (f"panel queries unexported series "
                                  f"{missing}: {expr}")
-        # And every exported series maps to a real TickReport field —
-        # dotted specs (the span-sourced tick timing gauges) resolve
-        # against their base field.
+        # And every exported series maps to a real report field — the
+        # controller's TickReport, or (for the declared service-only
+        # set) the fleet service's ServiceTickReport. Dotted specs (the
+        # span-sourced tick timing gauges, the per-tenant breaker dict)
+        # resolve against their base field.
         for name, (field, _help) in SERIES.items():
             base = field.split(".", 1)[0]
-            assert base in fields, f"{name} maps to unknown field {field}"
+            want = (service_fields if name in SERVICE_ONLY_SERIES
+                    else tick_fields)
+            assert base in want, f"{name} maps to unknown field {field}"
 
     def test_tick_timing_gauges_cover_the_span_phases(self):
         """The per-stage gauges (satellite of the obs PR) must stay in
@@ -545,16 +570,65 @@ class TestPromExport:
             rec, SERIES["ccka_snapshot_age_ticks"][0]) == 0
         assert resolve_field(rec, SERIES["ccka_resumes_total"][0]) == 2
 
+    def test_service_gauges_cover_both_directions(self):
+        """Round-13 satellite: the multi-tenant service series (breaker
+        pressure, shed counter, admission depth, tick latency) must be
+        exported, panel-referenced, AND resolve from a real
+        ServiceTickReport — both directions of the parity contract. The
+        breaker gauge sums the per-tenant level dict via the dotted
+        spec, so one open (2) + one half-open (1) tenant reads 3."""
+        import dataclasses
+
+        from ccka_tpu.harness.dashboard import _PANEL_DEFS
+        from ccka_tpu.harness.promexport import (SERIES,
+                                                 SERVICE_ONLY_SERIES,
+                                                 referenced_series,
+                                                 render_exposition,
+                                                 resolve_field)
+
+        gauges = {"ccka_tenant_breaker_state", "ccka_ticks_shed_total",
+                  "ccka_admission_queue_depth", "ccka_tick_latency_ms"}
+        assert gauges <= set(SERIES)
+        assert gauges == set(SERVICE_ONLY_SERIES)
+        paneled = set()
+        for _t, expr, _u in _PANEL_DEFS:
+            paneled |= referenced_series(expr)
+        assert gauges <= paneled, "service gauges missing from dashboard"
+
+        rec = dataclasses.asdict(_service_report())
+        assert resolve_field(
+            rec, SERIES["ccka_tenant_breaker_state"][0]) == 3.0
+        assert resolve_field(rec, SERIES["ccka_ticks_shed_total"][0]) == 24
+        assert resolve_field(
+            rec, SERIES["ccka_admission_queue_depth"][0]) == 8
+        assert resolve_field(
+            rec, SERIES["ccka_tick_latency_ms"][0]) == 112.5
+        text = render_exposition(rec)
+        assert "ccka_tenant_breaker_state 3" in text
+        assert "ccka_ticks_shed_total 24" in text
+        # A controller TickReport (no service fields) SKIPS the service
+        # series rather than exporting fake zeros.
+        assert resolve_field(
+            {"t": 1}, SERIES["ccka_tenant_breaker_state"][0]) is None
+        assert "ccka_tenant_breaker_state" not in render_exposition(
+            {"t": 1})
+
     def test_live_scrape_serves_all_panel_series(self):
         """Drive two controller ticks with an exporter on a real socket
-        and scrape /metrics — every panel series must come back."""
+        and scrape /metrics — every panel series must come back (the
+        declared service-only set is asserted against a service tick
+        exposition instead: a single-cluster scrape legitimately omits
+        it, but it must never silently vanish from BOTH surfaces)."""
         from urllib.request import urlopen
 
         from ccka_tpu.actuation import DryRunSink
         from ccka_tpu.harness.controller import Controller
         from ccka_tpu.harness.dashboard import _PANEL_DEFS
         from ccka_tpu.harness.promexport import (MetricsExporter,
-                                                 referenced_series)
+                                                 SERVICE_ONLY_SERIES,
+                                                 referenced_series,
+                                                 render_exposition)
+        from ccka_tpu.harness.service import ServiceTickReport
         from ccka_tpu.policy import RulePolicy
         from ccka_tpu.signals.synthetic import SyntheticSignalSource
 
@@ -574,8 +648,14 @@ class TestPromExport:
             exporter.close()
         for _t, expr, _u in _PANEL_DEFS:
             for series in referenced_series(expr):
+                if series in SERVICE_ONLY_SERIES:
+                    continue
                 assert f"{series}{{" in body, f"scrape missing {series}"
         assert 'cluster="demo1"' in body
+        service_text = render_exposition(_service_report())
+        for series in SERVICE_ONLY_SERIES:
+            assert f"\n{series} " in service_text, (
+                f"service exposition missing {series}")
         # Gauge values are parseable floats.
         import math
         for line in body.splitlines():

@@ -30,7 +30,13 @@ SCAN_TARGETS = (os.path.join(ROOT, "ccka_tpu"),
 # legitimately holds bare perf_counter next to jax references.
 EXEMPT = {os.path.join(ROOT, "ccka_tpu", "obs", "trace.py")}
 
-_TIMING_FNS = {("time", "perf_counter"), ("time", "time")}
+# Round 13 added time.monotonic: the multi-tenant service's deadline
+# arithmetic (`harness/service.py`) reads a monotonic clock in the SAME
+# hot loop that dispatches device work, so un-fenced monotonic timing
+# next to jax code is exactly the footgun this guard exists for — the
+# service loop must carry its timing inside tracer spans.
+_TIMING_FNS = {("time", "perf_counter"), ("time", "time"),
+               ("time", "monotonic")}
 _FENCE_MARKERS = ("block_until_ready", ".span(", "device_span(",
                   "StageTimer")
 _DEVICE_MARKERS = ("jax.", "jnp.")
@@ -118,6 +124,9 @@ def test_guard_scans_a_nontrivial_tree():
     assert len(files) > 40
     assert any(p.endswith("bench.py") for p in files)
     assert any(os.path.join("harness", "fleet.py") in p for p in files)
+    # The round-13 service hot loop is inside the scanned tree (its
+    # deadline clock reads are the newest instance of the footgun).
+    assert any(os.path.join("harness", "service.py") in p for p in files)
 
 
 _HARNESS_DIR = os.path.join(ROOT, "ccka_tpu", "harness")
@@ -196,3 +205,21 @@ def test_guard_catches_the_footgun_pattern(tmp_path):
 
     assert violations_of(bad), "guard missed the canonical footgun"
     assert not violations_of(good), "guard flagged the fenced fix"
+
+    # Round-13 variant: an un-fenced monotonic deadline check around a
+    # device dispatch (the service hot-loop shape) must be flagged; the
+    # span-fenced service form must pass.
+    bad_mono = (
+        "import time\n"
+        "import jax.numpy as jnp\n"
+        "def tick(f, x, deadline):\n"
+        "    if time.monotonic() > deadline:\n"
+        "        return None\n"
+        "    return f(jnp.asarray(x))\n")
+    good_mono = bad_mono.replace(
+        "def tick(f, x, deadline):\n",
+        "def tick(self, f, x, deadline):\n"
+        "    with self.tracer.span('service.tick'):\n"
+        "        pass\n")
+    assert violations_of(bad_mono), "guard missed un-fenced monotonic"
+    assert not violations_of(good_mono), "guard flagged the span form"
