@@ -73,6 +73,16 @@ class Reconciler:
     [1-jitter, 1+jitter) from a seeded RNG (deterministic for paired
     runs; thundering-herd-safe for fleet fan-outs). ``sleep_fn``/``clock``
     are injectable for tests.
+
+    ``on_giveup`` (round 14) is the incident hook: called ONCE per
+    give-up — a converge that returns with pools still diverged — with
+    the :class:`ReconcileOutcome`, AFTER the outcome is fully built and
+    the session counters are updated, so the observer sees exactly what
+    the caller will. The give-up trigger lives HERE, at the layer that
+    defines "gave up", rather than being re-derived at every call site
+    (`obs/incidents.py` stamps the record; the hook must never raise
+    into the control loop — a broken observer is logged by its owner,
+    not allowed to kill actuation).
     """
 
     def __init__(self, sink: ActuationSink, *,
@@ -82,7 +92,9 @@ class Reconciler:
                  jitter: float = 0.5,
                  seed: int = 0,
                  sleep_fn: Callable[[float], None] = time.sleep,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 on_giveup: "Callable[[ReconcileOutcome], None] | None"
+                 = None):
         if max_rounds < 1:
             raise ValueError("reconciler: max_rounds must be >= 1")
         self.sink = sink
@@ -93,9 +105,12 @@ class Reconciler:
         self._rng = random.Random(seed)
         self.sleep_fn = sleep_fn
         self.clock = clock
+        self.on_giveup = on_giveup
         # Session counters (the promexport _total sources).
         self.retries_total = 0
         self.failures_total = 0
+        self.giveups_total = 0
+        self.hook_errors = 0
 
     def converge(self, patchsets: Sequence[NodePoolPatchSet]
                  ) -> ReconcileOutcome:
@@ -131,7 +146,7 @@ class Reconciler:
                 break
         self.retries_total += retries
         self.failures_total += failures
-        return ReconcileOutcome(
+        outcome = ReconcileOutcome(
             results=[results[p] for p in order],
             converged=not pending,
             rounds=rounds,
@@ -140,3 +155,20 @@ class Reconciler:
             diverged=tuple(pending),
             divergence=divergence,
         )
+        if pending:
+            self.giveups_total += 1
+            if self.on_giveup is not None:
+                # Enforced here, not merely documented: a broken
+                # observer (full disk under the incident log, a buggy
+                # hook) must never abort the actuation it observes.
+                try:
+                    self.on_giveup(outcome)
+                except Exception as e:  # noqa: BLE001 — backstop
+                    self.hook_errors += 1
+                    if self.hook_errors == 1:
+                        import sys
+                        print(f"# reconciler on_giveup hook raised "
+                              f"({e!r}); suppressed — further hook "
+                              "errors counted in hook_errors",
+                              file=sys.stderr)
+        return outcome

@@ -149,6 +149,15 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="with --service: comma list of tenant profile "
                          "archetypes (service.TENANT_PROFILES), cycled "
                          "over the fleet")
+    sf.add_argument("--obs", default="",
+                    help="with --service: run the incident-grade obs "
+                         "layer at this config.OBS_PRESETS posture "
+                         "('' = cfg.obs, usually off)")
+    sf.add_argument("--incidents-out", default="",
+                    help="with --service + obs: append structured "
+                         "incident records (JSONL) here and write "
+                         "recorder dumps next to it — inspect with "
+                         "`ccka incidents`")
 
     swatch = sub.add_parser(
         "watch", help="the demo_40 observe session: port-forward Grafana/"
@@ -408,6 +417,50 @@ def _build_parser() -> argparse.ArgumentParser:
                                   "runs/flagship.jsonl)")
     sob.add_argument("-n", "--lines", type=int, default=10,
                      help="tail: records to show (default 10)")
+
+    sinc = sub.add_parser(
+        "incidents", help="inspect structured incident records "
+                          "(obs/incidents JSONL from a service/"
+                          "controller run): list them, show one with "
+                          "its verified recorder dump, or reconstruct "
+                          "the causal timeline around it by joining "
+                          "RunLog records and trace spans on tick keys")
+    sinc.add_argument("action", choices=("list", "show", "timeline"))
+    sinc.add_argument("path", help="incident JSONL (IncidentLog output)")
+    sinc.add_argument("--id", type=int, default=0,
+                      help="show/timeline: incident id (default: show "
+                           "requires one; timeline centers on it, or "
+                           "covers every tick when omitted)")
+    sinc.add_argument("--runlog", default="",
+                      help="timeline: RunLog JSONL to join on tick keys")
+    sinc.add_argument("--trace", default="",
+                      help="timeline: span JSONL (SpanTracer "
+                           "jsonl_path output) to join on tick keys")
+    sinc.add_argument("--window", type=int, default=8,
+                      help="timeline --id: ticks of context around the "
+                           "incident (default 8)")
+
+    sbd = sub.add_parser(
+        "bench-diff", help="bench-history regression sentinel "
+                           "(obs/bench_history): load every "
+                           "BENCH_r*.json + data/lane_times.json into "
+                           "one series and diff consecutive rounds — "
+                           "exits non-zero on a threshold regression "
+                           "(CI-friendly)")
+    sbd.add_argument("--root", default=".",
+                     help="repo root holding BENCH_r*.json and data/ "
+                          "(default: cwd)")
+    sbd.add_argument("--max-lane-slowdown", type=float, default=1.5,
+                     help="tier-1 lane best-wall ratio between "
+                          "consecutive same-platform rounds that "
+                          "counts as a regression (default 1.5)")
+    sbd.add_argument("--max-headline-drop", type=float, default=0.5,
+                     help="fractional same-platform throughput-"
+                          "headline drop that counts as a regression "
+                          "(default 0.5)")
+    sbd.add_argument("--history-only", action="store_true",
+                     help="print the loaded series without diffing "
+                          "(always exits 0)")
 
     sd = sub.add_parser(
         "dashboard", help="render/apply the demo_40 observability stage: "
@@ -895,6 +948,107 @@ def _cmd_capture(cfg: FrameworkConfig, out: str, steps: int,
     return 0
 
 
+def _cmd_incidents(args) -> int:
+    """`ccka incidents list|show|timeline` — the incident JSONL plus
+    (for show) the checksum-verified recorder dump and (for timeline)
+    the causal join against RunLog records and trace spans."""
+    from ccka_tpu.obs.incidents import (attach_dump_entries,
+                                        build_timeline, read_incidents)
+
+    try:
+        incidents = read_incidents(args.path)
+    except OSError as e:
+        raise SystemExit(f"ccka: cannot read incidents: {e}")
+    except json.JSONDecodeError as e:
+        raise SystemExit(f"ccka: corrupt incident log {args.path}: {e}")
+    if args.action == "list":
+        for rec in incidents:
+            print(json.dumps(rec, sort_keys=True))
+        counts: dict = {}
+        for rec in incidents:
+            counts[rec.get("trigger", "?")] = \
+                counts.get(rec.get("trigger", "?"), 0) + 1
+        print(f"# {len(incidents)} incident(s): "
+              + ", ".join(f"{k}={v}" for k, v in sorted(counts.items())),
+              file=sys.stderr)
+        return 0
+    by_id = {int(rec.get("id", 0)): rec for rec in incidents}
+    if args.action == "show":
+        if not args.id:
+            raise SystemExit("ccka: incidents show needs --id N "
+                             "(see `ccka incidents list`)")
+        rec = by_id.get(args.id)
+        if rec is None:
+            raise SystemExit(f"ccka: no incident with id {args.id} in "
+                             f"{args.path}")
+        from ccka_tpu.harness.snapshot import SnapshotError
+        try:
+            print(json.dumps(attach_dump_entries(rec), indent=2))
+        except SnapshotError as e:
+            raise SystemExit(f"ccka: recorder dump failed verification "
+                             f"— refusing to render it: {e}")
+        return 0
+    # timeline
+    runlog = spans = ()
+    if args.runlog:
+        from ccka_tpu.obs.runlog import read_runlog
+        try:
+            runlog = read_runlog(args.runlog)
+        except OSError as e:
+            raise SystemExit(f"ccka: cannot read run log: {e}")
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"ccka: corrupt run log {args.runlog}: {e}")
+    if args.trace:
+        try:
+            with open(args.trace, encoding="utf-8") as fh:
+                spans = [json.loads(line) for line in fh if line.strip()]
+        except OSError as e:
+            raise SystemExit(f"ccka: cannot read span JSONL: {e}")
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"ccka: corrupt span JSONL {args.trace}: "
+                             f"{e}")
+    around = None
+    if args.id:
+        rec = by_id.get(args.id)
+        if rec is None:
+            raise SystemExit(f"ccka: no incident with id {args.id} in "
+                             f"{args.path}")
+        around = int(rec.get("t", 0))
+    timeline = build_timeline(incidents, runlog=runlog, spans=spans,
+                              around=around, window=args.window)
+    for row in timeline:
+        print(json.dumps(row, sort_keys=True))
+    print(f"# {len(timeline)} timeline event(s)"
+          + (f" around tick {around} ±{args.window}"
+             if around is not None else ""), file=sys.stderr)
+    return 0
+
+
+def _cmd_bench_diff(args) -> int:
+    """`ccka bench-diff` — the regression sentinel: exit 0 on a clean
+    history, 1 on any threshold regression (the CI contract)."""
+    from ccka_tpu.obs.bench_history import bench_diff, load_bench_history
+
+    history = load_bench_history(args.root)
+    if not history["records"] and not history["lane"]:
+        raise SystemExit(f"ccka: no BENCH_r*.json or lane rows under "
+                         f"{args.root!r} — wrong --root?")
+    if args.history_only:
+        print(json.dumps(history, indent=2))
+        return 0
+    diff = bench_diff(history,
+                      max_lane_slowdown=args.max_lane_slowdown,
+                      max_headline_drop=args.max_headline_drop)
+    print(json.dumps(diff, indent=2))
+    if diff["regressions"]:
+        print(f"# REGRESSION: {len(diff['regressions'])} gate(s) "
+              "tripped (see regressions above)", file=sys.stderr)
+        return 1
+    print(f"# bench history clean: {len(diff['comparisons'])} "
+          "comparison(s), 0 regressions", file=sys.stderr)
+    return 0
+
+
 def _cmd_train(cfg: FrameworkConfig, backend_name: str, iterations: int,
                checkpoint_dir: str, seed: int | None,
                log_every: int, runlog_path: str = "") -> int:
@@ -1181,16 +1335,28 @@ def main(argv: list[str] | None = None) -> int:
             from ccka_tpu.obs.runlog import read_runlog, summarize_runlog
             try:
                 # Non-strict read: a LIVE run's last line may be
-                # mid-write; tail/summarize must still work on it.
-                records = read_runlog(args.path)
+                # mid-write — tolerated as a COUNTED torn tail (never
+                # silently swallowed; interior corruption still raises).
+                records, stats = read_runlog(args.path, with_stats=True)
             except OSError as e:
                 raise SystemExit(f"ccka: cannot read run log: {e}")
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"ccka: corrupt run log {args.path}: "
+                                 f"{e}")
+            if stats["torn_tail"]:
+                print("# note: final line torn (crash or live writer "
+                      "mid-write) — showing the intact prefix",
+                      file=sys.stderr)
             if args.action == "tail":
                 for rec in records[-max(args.lines, 1):]:
                     print(json.dumps(rec, sort_keys=True))
                 return 0
             print(json.dumps(summarize_runlog(records), indent=2))
             return 0
+        if args.command == "incidents":
+            return _cmd_incidents(args)
+        if args.command == "bench-diff":
+            return _cmd_bench_diff(args)
         if args.command == "train":
             return _cmd_train(cfg, args.backend, args.iterations,
                               args.checkpoint_dir, args.seed,
@@ -1353,6 +1519,15 @@ def main(argv: list[str] | None = None) -> int:
             if args.clusters < 1 or args.ticks < 1:
                 raise SystemExit("ccka: fleet needs --clusters >= 1 and "
                                  "--ticks >= 1")
+            if (args.obs or args.incidents_out) and (
+                    not args.service or args.service == "off"):
+                # The obs layer rides the service loop; letting these
+                # flags silently no-op would leave the operator
+                # believing incidents were being recorded.
+                raise SystemExit(
+                    "ccka: --obs/--incidents-out need an ENABLED "
+                    "--service posture (the obs layer rides the "
+                    "service loop; 'off' delegates to the bare fleet)")
             backend = make_backend(cfg, args.backend, args.checkpoint)
             if args.service:
                 from ccka_tpu.config import SERVICE_PRESETS
@@ -1373,12 +1548,42 @@ def main(argv: list[str] | None = None) -> int:
                     raise SystemExit(f"ccka: {e}")
                 profiles = [names[i % len(names)]
                             for i in range(args.clusters)]
-                service = fleet_service_from_config(
-                    cfg, backend, args.clusters, profiles=profiles,
-                    service=SERVICE_PRESETS[args.service],
-                    horizon_ticks=max(args.ticks + 2, 8),
-                    seed=args.seed,
-                    log_fn=lambda s: print(s, file=sys.stderr))
+                obs = None
+                if args.obs or args.incidents_out:
+                    import dataclasses
+                    import os
+
+                    from ccka_tpu.config import OBS_PRESETS
+                    preset = args.obs or "default"
+                    if preset not in OBS_PRESETS:
+                        raise SystemExit(
+                            f"ccka: unknown obs preset {preset!r}; "
+                            f"presets: {sorted(OBS_PRESETS)}")
+                    obs = OBS_PRESETS[preset]
+                    if args.incidents_out:
+                        if args.obs and not obs.enabled:
+                            # An explicit off posture must not be
+                            # silently inverted by the output flag.
+                            raise SystemExit(
+                                f"ccka: --obs {args.obs} is the off "
+                                "posture but --incidents-out needs "
+                                "the obs layer running — drop one")
+                        out_dir = os.path.dirname(
+                            os.path.abspath(args.incidents_out)) or "."
+                        obs = dataclasses.replace(
+                            obs, enabled=True,
+                            incident_log_path=args.incidents_out,
+                            dump_dir=os.path.join(out_dir,
+                                                  "recorder-dumps"))
+                try:
+                    service = fleet_service_from_config(
+                        cfg, backend, args.clusters, profiles=profiles,
+                        service=SERVICE_PRESETS[args.service], obs=obs,
+                        horizon_ticks=max(args.ticks + 2, 8),
+                        seed=args.seed,
+                        log_fn=lambda s: print(s, file=sys.stderr))
+                except ValueError as e:  # e.g. corrupt incident log
+                    raise SystemExit(f"ccka: {e}")
                 service.warmup()
                 sreports = service.run(args.ticks)
                 if SERVICE_PRESETS[args.service].enabled:
@@ -1397,6 +1602,15 @@ def main(argv: list[str] | None = None) -> int:
                         "fleet_cost_usd_hr_last":
                             sreports[-1].cost_usd_hr,
                     }
+                    if service.incidents is not None:
+                        summary["incidents_total"] = \
+                            service.incidents.total
+                        summary["incident_counts"] = \
+                            service.incidents.counts()
+                        summary["recorder_dumps_total"] = \
+                            service.recorder.dumps_total
+                        summary["slo_burn_rate_last"] = \
+                            sreports[-1].slo_burn_rate
                     service.close()
                     print(json.dumps(summary, indent=2))
                     return 0

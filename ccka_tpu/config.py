@@ -746,6 +746,88 @@ class ChaosConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Incident-grade observability layer (`ccka_tpu/obs/`, round 14).
+
+    Rounds 10–13 made the system *survive* chaos (fault lanes,
+    crash-safe resume, overload-safe service) but left it unable to
+    *explain* an incident: breaker opens, degraded transitions,
+    reconcile give-ups and deadline overshoots were scattered across
+    RunLog lines and Prometheus gauges with no pre-incident state
+    capture and no burn-rate view. This block configures the three
+    pieces that close the gap:
+
+    - **flight recorder** (`obs/recorder.py`): a fixed-size per-tenant
+      ring buffer (``ring_size`` recent ticks of lane/breaker/scrape/
+      apply state) dumped as an atomic, SHA-256-checksummed capture
+      (the `harness/snapshot.py` disk discipline) into ``dump_dir``
+      when a trigger fires; "" keeps incidents dump-less.
+    - **incident triggers** (`obs/incidents.py`): breaker open,
+      hold→rule-fallback escalation, reconcile give-up, tick-deadline
+      overshoot, and shed-rate spikes (``shed_spike_frac`` of the
+      fleet shed in one tick) each stamp ONE structured incident
+      record, appended to ``incident_log_path`` ("" = in-memory only).
+    - **burn-rate engine** (`obs/burnrate.py`): fast+slow windows
+      (``burn_fast_window``/``burn_slow_window`` ticks) over the
+      per-tenant SLO-violation/deadline/shed counters, exported as
+      `ccka_slo_burn_rate`/`ccka_incident_active` gauges.
+
+    ``enabled=False`` (the default, preset "off") is a hard gate in
+    the established idiom: no recorder, no triggers, no burn engine —
+    and the ENABLED path is proven bitwise non-interfering anyway
+    (paired recorder-on/recorder-off runs pin identical decisions and
+    patch streams, `tests/test_incidents.py`): all observation is
+    host-side, off the device hot path, after the tick's decisions.
+    """
+
+    enabled: bool = False
+    # Recorder ring entries retained per tenant (and for the fleet
+    # loop itself) — the pre-incident state a dump captures.
+    ring_size: int = 64
+    # Directory for checksummed recorder dumps; "" disables dumping
+    # (incidents still stamp, with dump_path null).
+    dump_dir: str = ""
+    # Structured incident JSONL ("" = in-memory only; `ccka incidents`
+    # reads this file).
+    incident_log_path: str = ""
+    # Multi-window burn rate: violating tenant-ticks per tick over a
+    # fast and a slow trailing window (ticks). The classic two-window
+    # discipline: fast catches a new fire, slow stops flapping.
+    burn_fast_window: int = 8
+    burn_slow_window: int = 64
+    # Both windows above this rate => the SLO budget is burning
+    # (feeds ccka_incident_active alongside fresh incidents).
+    burn_threshold: float = 0.5
+    # Shed-rate spike trigger: a single tick shedding at least this
+    # fraction of the fleet stamps a shed_spike incident.
+    shed_spike_frac: float = 0.5
+
+    def validate(self) -> None:
+        if self.ring_size < 1:
+            raise ConfigError("obs: ring_size must be >= 1")
+        if self.burn_fast_window < 1 or self.burn_slow_window < 1:
+            raise ConfigError("obs: burn windows must be >= 1 tick")
+        if self.burn_fast_window > self.burn_slow_window:
+            raise ConfigError("obs: burn_fast_window must not exceed "
+                              "burn_slow_window — the fast window is "
+                              "the short fuse, the slow one the "
+                              "flap damper")
+        if not 0.0 < self.burn_threshold <= 1.0:
+            raise ConfigError("obs: burn_threshold out of (0, 1]")
+        if not 0.0 < self.shed_spike_frac <= 1.0:
+            raise ConfigError("obs: shed_spike_frac out of (0, 1]")
+
+
+# The flight-recorder postures (`bench.py bench_obs`, `ccka fleet
+# --obs`): "off" is the hard gate (no recorder/triggers/burn engine);
+# "default" is the incident-grade posture the r14 board runs.
+OBS_PRESETS: dict[str, ObsConfig] = {
+    "off": ObsConfig(),
+    "default": ObsConfig(enabled=True),
+}
+
+
+@dataclass(frozen=True)
 class ServiceConfig:
     """Multi-tenant fleet service layer (`ccka_tpu/harness/service.py`).
 
@@ -940,6 +1022,7 @@ class FrameworkConfig:
     workloads: WorkloadsConfig = field(default_factory=WorkloadsConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     service: ServiceConfig = field(default_factory=ServiceConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     def validate(self) -> "FrameworkConfig":
         self.cluster.validate()
@@ -952,6 +1035,7 @@ class FrameworkConfig:
         self.workloads.validate()
         self.chaos.validate()
         self.service.validate()
+        self.obs.validate()
         # Cross-section: a live multi-region fleet must name each region's
         # grid zone — silently falling back to the global carbon_zone would
         # price one region's zones by another region's grid, flattening the
@@ -1102,6 +1186,7 @@ _NESTED_TYPES = {
     "workloads": WorkloadsConfig,
     "chaos": ChaosConfig,
     "service": ServiceConfig,
+    "obs": ObsConfig,
 }
 
 

@@ -268,6 +268,7 @@ class Controller:
                  exporter=None,
                  tracer=None,
                  interruption_feed=None,
+                 incident_log=None,
                  log_fn: Callable[[str], None] | None = None,
                  sleep_fn: Callable[[float], None] = time.sleep):
         self.cfg = cfg
@@ -330,6 +331,23 @@ class Controller:
                     deadline_s=reconcile_deadline_s,
                     seed=seed ^ 0x5EC0)
             self._reconcilers[region] = rec
+        # Incident log (round 14, `obs/incidents.py`; None disables):
+        # the degraded machine's hold→rule-fallback escalation and
+        # every reconciler give-up stamp ONE structured incident each,
+        # joined to RunLog lines and trace spans on the tick key by
+        # `ccka incidents timeline`. The give-up trigger rides the
+        # reconciler's OWN hook (`actuation/reconcile.on_giveup`), at
+        # the layer that defines "gave up".
+        self.incident_log = incident_log
+        self._obs_tick = 0
+        # Regions may SHARE a reconciler (one per distinct sink), so
+        # the give-up's region is stamped from the converge call site
+        # (`self._obs_region`, set by the apply loop), not baked into
+        # the hook.
+        self._obs_region = ""
+        if incident_log is not None:
+            for rec in by_sink.values():
+                rec.on_giveup = self._stamp_giveup
         self.interval_s = (cfg.signals.scrape_interval_s
                            if interval_s is None else interval_s)
         self.apply_hpa = apply_hpa
@@ -548,11 +566,23 @@ class Controller:
             self._drained_instances.pop(
                 next(iter(self._drained_instances)))
 
+    # -- incident stamps (round 14; no-ops without an incident_log) --------
+
+    def _stamp_giveup(self, outcome) -> None:
+        """`actuation/reconcile.on_giveup` hook: one incident per
+        give-up, keyed on the tick/region the apply loop is in."""
+        self.incident_log.stamp(
+            "reconcile_giveup", t=self._obs_tick,
+            region=self._obs_region,
+            diverged=list(outcome.diverged),
+            retries=int(outcome.retries))
+
     # -- one tick ----------------------------------------------------------
 
     def tick(self, t: int) -> TickReport:
         from ccka_tpu.harness.telemetry import StageTimer
 
+        self._obs_tick = t
         timer = StageTimer(self.tracer)
         # 1. scrape the latest signals (the 30s AMP pipeline analog).
         with timer.stage("scrape"):
@@ -586,6 +616,15 @@ class Controller:
                         f"{self._degraded} (stale streak "
                         f"{self._stale_streak}, diverge streak "
                         f"{self._diverge_streak})")
+            if self._degraded == "fallback" and \
+                    self.incident_log is not None:
+                # The single-cluster analog of the service's lane
+                # escalation: the loop stopped trusting fresh intent
+                # entirely — an incident, not just a log line.
+                self.incident_log.stamp(
+                    "hold_fallback", t=t, prev_mode=prev_mode,
+                    stale_streak=int(self._stale_streak),
+                    diverge_streak=int(self._diverge_streak))
 
         # 1b. spot interruption warnings → cordon+drain BEFORE the decide,
         #     so displaced pods go Pending under the profile this tick is
@@ -670,6 +709,7 @@ class Controller:
             tick_retries = tick_failures = diverged_pools = 0
             pools_converged = True
             for region, patches in per_region.items():
+                self._obs_region = region
                 outcome = self._reconcilers[region].converge(patches)
                 results += outcome.results
                 tick_retries += outcome.retries

@@ -78,6 +78,13 @@ _PANEL_DEFS = (
     ("Decides shed (session)", "ccka_ticks_shed_total", "short"),
     ("Admission queue depth", "ccka_admission_queue_depth", "short"),
     ("Service tick latency", "ccka_tick_latency_ms", "ms"),
+    # Incident panels (round 14; ccka_tpu/obs): the burn-rate view and
+    # the incident/recorder state — the operator sees "SLO budget
+    # burning, incident active, 3 captures taken" on the SAME board as
+    # the breaker pressure that explains it.
+    ("SLO burn rate", "ccka_slo_burn_rate", "percentunit"),
+    ("Incident active", "ccka_incident_active", "short"),
+    ("Recorder dumps (session)", "ccka_recorder_dumps_total", "short"),
     # Workload-family panels (ccka_tpu/workloads): per-family queue
     # pressure and the session's SLO accounting, on the same board as
     # the fleet cost/SLO panels the families trade against.

@@ -132,6 +132,23 @@ SERIES: dict[str, tuple[str, str]] = {
     "ccka_tick_latency_ms": (
         "tick_latency_ms",
         "Service tick latency (admission+decide+fanout), milliseconds"),
+    # Incident-grade obs series (round 14; `ccka_tpu/obs`): the SLO
+    # burn-rate engine's fast window, the incident-active flag
+    # (two-window burn OR a fresh trigger stamp), and the flight
+    # recorder's session dump counter. Service-only: the fleet service
+    # carries the burn engine; a single-cluster controller's scrape
+    # legitimately omits them.
+    "ccka_slo_burn_rate": (
+        "slo_burn_rate",
+        "Fast-window fleet SLO burn rate (violating tenant-ticks per "
+        "tenant-tick)"),
+    "ccka_incident_active": (
+        "incident_active",
+        "1 while the burn-rate engine is burning or an incident "
+        "trigger fired within the fast window"),
+    "ccka_recorder_dumps_total": (
+        "recorder_dumps_total",
+        "Cumulative checksummed flight-recorder dumps this session"),
     "ccka_applied": ("applied", "1 if every patch applied this tick"),
     "ccka_verified": ("verified", "1 if read-back matched intent"),
     "ccka_tick": ("t", "Controller tick counter"),
@@ -158,6 +175,8 @@ SERIES: dict[str, tuple[str, str]] = {
 SERVICE_ONLY_SERIES = frozenset({
     "ccka_tenant_breaker_state", "ccka_ticks_shed_total",
     "ccka_admission_queue_depth", "ccka_tick_latency_ms",
+    "ccka_slo_burn_rate", "ccka_incident_active",
+    "ccka_recorder_dumps_total",
 })
 
 _LABEL = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")

@@ -318,6 +318,14 @@ class ServiceTickReport:
     # sums this dict ("breaker_states.*") into the fleet's aggregate
     # breaker-pressure gauge.
     breaker_states: dict = dataclasses.field(default_factory=dict)
+    # Incident-grade observability surfaces (round 14, `ccka_tpu/obs`;
+    # all 0 with the obs layer off — the gauges then read as a quiet
+    # fleet, exactly like the degraded/fault gauges on a calm run).
+    slo_burn_rate: float = 0.0        # fast-window fleet SLO burn
+    slo_burn_rate_slow: float = 0.0   # slow-window (the flap damper)
+    incident_active: int = 0          # burning OR a fresh incident
+    incidents_total: int = 0          # session incident stamps
+    recorder_dumps_total: int = 0     # session checksummed captures
 
 
 class FleetService:
@@ -340,6 +348,7 @@ class FleetService:
                  source: SignalSource, sinks: Sequence[ActuationSink],
                  *, profiles: Sequence[str] | None = None,
                  service: ServiceConfig | None = None,
+                 obs=None,
                  horizon_ticks: int = 2880, seed: int = 0,
                  clock: VirtualClock | None = None, tracer=None,
                  log_fn: Callable[[str], None] | None = None):
@@ -433,6 +442,44 @@ class FleetService:
         self.latencies_ms: "deque[float]" = deque(maxlen=4096)
         self._sat_streak = 0
         self._cadence_divisor = 1
+        # Incident-grade observability (round 14, `ccka_tpu/obs`):
+        # flight recorder + trigger stamps + burn-rate engine, all
+        # host-side and all AFTER each tick's decisions — the paired
+        # recorder-on/recorder-off run in tests/test_incidents.py pins
+        # that enabling this changes no decision and no patch byte.
+        ob = cfg.obs if obs is None else obs
+        ob.validate()
+        self.obs = ob
+        self.recorder = None
+        self.incidents = None
+        self.burn = None
+        if ob.enabled:
+            from ccka_tpu.obs.burnrate import BurnRateEngine
+            from ccka_tpu.obs.incidents import IncidentLog
+            from ccka_tpu.obs.recorder import FLEET_KEY, FlightRecorder
+            self._fleet_key = FLEET_KEY
+            self.recorder = FlightRecorder(ob)
+            self.incidents = IncidentLog(ob.incident_log_path,
+                                         recorder=self.recorder)
+            self.burn = BurnRateEngine(ob.burn_fast_window,
+                                       ob.burn_slow_window,
+                                       ob.burn_threshold)
+            # Trigger bookkeeping: breaker opens are counted off the
+            # breakers' own transition tallies (one stamp per open, by
+            # construction), lane escalations off the previous tick's
+            # lane vector, give-ups off the reconciler's OWN hook (the
+            # layer that defines "gave up" — actuation/reconcile.py).
+            self._prev_opened = [0] * n
+            self._prev_lanes = None
+            self._giveups_this_tick: list[int] = []
+            for i, rec in enumerate(self._reconcilers):
+                rec.on_giveup = functools.partial(self._note_giveup, i)
+
+    def _note_giveup(self, tenant: int, _outcome) -> None:
+        """Reconciler give-up hook (`actuation/reconcile.on_giveup`):
+        collected per tick, stamped in the tick's obs block with the
+        tick key the incident timeline joins on."""
+        self._giveups_this_tick.append(tenant)
 
     # -- delegation surface --------------------------------------------------
 
@@ -441,6 +488,8 @@ class FleetService:
         return self.ctrl.states
 
     def close(self) -> None:
+        if getattr(self, "incidents", None) is not None:
+            self.incidents.close()
         self.ctrl.close()
 
     def warmup(self) -> None:
@@ -658,6 +707,23 @@ class FleetService:
             self.cadence_skips_total += cadence_skipped
             self.bulkhead_skips_total += bulkhead_skipped
 
+            # 10. incident-grade observation (round 14, `ccka_tpu/obs`):
+            #     burn windows, ring recording, trigger stamps and
+            #     recorder dumps — host-side, strictly AFTER every
+            #     decision this tick made (bitwise non-interference is
+            #     pinned by the paired recorder-on/off test). Inside
+            #     the span and before the final clock read, so the
+            #     recorder's cost shows up in tick_latency_ms honestly
+            #     instead of hiding between ticks.
+            slo_burn = slo_burn_slow = 0.0
+            incident_active = 0
+            if self.burn is not None:
+                slo_burn, slo_burn_slow, incident_active = \
+                    self._observe_tick(t, t0, lanes, shed, scraped_ok,
+                                       per_np, applied,
+                                       deadline if has_deadline
+                                       else None)
+
             latency_ms = (self.clock() - t0) * 1e3
         self.latencies_ms.append(latency_ms)
         agg = per_np.sum(axis=0)
@@ -689,6 +755,13 @@ class FleetService:
             fanout_ms=round(sp_f.dur_ms, 3),
             breaker_states={str(i): b.level
                             for i, b in enumerate(self.breakers)},
+            slo_burn_rate=round(slo_burn, 6),
+            slo_burn_rate_slow=round(slo_burn_slow, 6),
+            incident_active=int(incident_active),
+            incidents_total=(self.incidents.total
+                             if self.incidents is not None else 0),
+            recorder_dumps_total=(self.recorder.dumps_total
+                                  if self.recorder is not None else 0),
         )
         self.log_fn(
             f"service t={t}: {report.admitted}/{self.n} fresh, "
@@ -696,6 +769,83 @@ class FleetService:
             f"{report.bulkhead_skipped} bulkheaded, "
             f"latency {report.tick_latency_ms:.1f}ms")
         return report
+
+    def _observe_tick(self, t: int, t0: float, lanes, shed: int,
+                      scraped_ok, per_np, applied: int,
+                      deadline: "float | None"):
+        """The tick's obs pass: update burn windows, append ring rows,
+        stamp one incident per trigger occurrence (breaker open, lane
+        escalation, reconcile give-up, deadline overshoot, shed spike)
+        and return the (fast burn, slow burn, incident_active) report
+        surfaces. Every value recorded is a native host scalar — the
+        recorder must never force a device transfer, and the dump codec
+        (canonical JSON) would refuse numpy scalars anyway."""
+        ob = self.obs
+        n = self.n
+        lat_pre_ms = (self.clock() - t0) * 1e3
+        slo_ok_n = float(per_np[:, 0].sum())
+        overshoot = deadline is not None and self.clock() > deadline
+        self.burn.update("slo", n - slo_ok_n, n)
+        self.burn.update("deadline", 1.0 if overshoot else 0.0, 1.0)
+        self.burn.update("shed", float(shed), float(n))
+
+        # Ring rows: one fleet-loop row + one per-tenant row per tick.
+        # Flat scalars only — the rows are serialized 3x per dump
+        # (canonical digest + envelope), so nesting here is dump cost.
+        self.recorder.record(self._fleet_key, {
+            "t": int(t), "shed": int(shed), "applied": int(applied),
+            "latency_ms": round(lat_pre_ms, 3),
+            "burn_slo_fast": round(self.burn.rate("slo", "fast"), 4),
+            "burn_slo_slow": round(self.burn.rate("slo", "slow"), 4),
+        })
+        for i in range(n):
+            self.recorder.record(i, {
+                "t": int(t), "lane": int(lanes[i]),
+                "breaker": int(self.breakers[i].level),
+                "scraped": bool(scraped_ok[i]),
+            })
+
+        # Triggers — exactly ONE stamp per occurrence (the
+        # tests/test_incidents.py counting contract). Breaker opens
+        # come off the breakers' own transition tallies; both the
+        # scrape phase and the fan-out phase already happened, so the
+        # tallies are final for this tick.
+        for i, br in enumerate(self.breakers):
+            while self._prev_opened[i] < br.transitions["opened"]:
+                self._prev_opened[i] += 1
+                self.incidents.stamp(
+                    "breaker_open", t=t, tenant=i,
+                    open_number=self._prev_opened[i], state=br.state,
+                    profile=self.profile_names[i])
+        prev = self._prev_lanes
+        for i in range(n):
+            if lanes[i] == LANE_FALLBACK and (
+                    prev is None or prev[i] != LANE_FALLBACK):
+                self.incidents.stamp(
+                    "hold_fallback", t=t, tenant=i,
+                    open_ticks=int(self.breakers[i].open_ticks(t)),
+                    profile=self.profile_names[i])
+        self._prev_lanes = lanes.copy()
+        for i in self._giveups_this_tick:
+            self.incidents.stamp("reconcile_giveup", t=t, tenant=i,
+                                 profile=self.profile_names[i])
+        self._giveups_this_tick.clear()
+        if overshoot:
+            self.incidents.stamp(
+                "deadline_overshoot", t=t,
+                latency_ms=round(lat_pre_ms, 3),
+                deadline_ms=float(self.svc.tick_deadline_ms))
+        if shed >= max(1, math.ceil(ob.shed_spike_frac * n)):
+            self.incidents.stamp("shed_spike", t=t, shed=int(shed),
+                                 n_tenants=n)
+
+        slo_burn = self.burn.rate("slo", "fast")
+        slo_burn_slow = self.burn.rate("slo", "slow")
+        last = self.incidents.last_tick()
+        incident_active = int(
+            self.burn.any_burning
+            or (last is not None and t - last < ob.burn_fast_window))
+        return slo_burn, slo_burn_slow, incident_active
 
     def run(self, ticks: int, start_tick: int = 0) -> list:
         """Sequential bounded ticks (the deadline is a per-tick host
@@ -738,6 +888,7 @@ def fleet_service_from_config(cfg: FrameworkConfig,
                               backend: PolicyBackend, n_tenants: int,
                               *, profiles: Sequence[str] | None = None,
                               service: ServiceConfig | None = None,
+                              obs=None,
                               horizon_ticks: int = 2880, seed: int = 0,
                               clock: VirtualClock | None = None,
                               log_fn=None) -> FleetService:
@@ -750,5 +901,6 @@ def fleet_service_from_config(cfg: FrameworkConfig,
                                    cfg.signals)
     sinks = [DryRunSink() for _ in range(n_tenants)]
     return FleetService(cfg, backend, source, sinks, profiles=profiles,
-                        service=service, horizon_ticks=horizon_ticks,
+                        service=service, obs=obs,
+                        horizon_ticks=horizon_ticks,
                         seed=seed, clock=clock, log_fn=log_fn)

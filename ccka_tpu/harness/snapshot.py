@@ -109,10 +109,19 @@ def _canonical(body: dict) -> str:
 
 def save_snapshot(path: str, body: dict) -> str:
     """Atomically write ``body`` with integrity envelope; returns path."""
+    return save_snapshot_with_digest(path, body)[0]
+
+
+def save_snapshot_with_digest(path: str, body: dict) -> tuple[str, str]:
+    """:func:`save_snapshot`, also returning the envelope's SHA-256 —
+    for writers that record the digest next to a reference to the file
+    (the flight recorder's incident records); recomputing it would
+    re-serialize the whole body."""
+    sha = hashlib.sha256(_canonical(body).encode()).hexdigest()
     doc = {
         "format": SNAPSHOT_FORMAT,
         "version": SNAPSHOT_VERSION,
-        "sha256": hashlib.sha256(_canonical(body).encode()).hexdigest(),
+        "sha256": sha,
         "body": body,
     }
     path = os.path.abspath(path)
@@ -129,7 +138,7 @@ def save_snapshot(path: str, body: dict) -> str:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
-    return path
+    return path, sha
 
 
 def load_snapshot(path: str) -> dict:

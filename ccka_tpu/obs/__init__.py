@@ -1,4 +1,4 @@
-"""ccka_tpu.obs — unified run-trace observability.
+"""ccka_tpu.obs — unified run-trace + incident observability.
 
 One subsystem spanning host and device (the instrumentation the reference
 configured a metrics fabric for but never applied to itself):
@@ -9,16 +9,46 @@ configured a metrics fabric for but never applied to itself):
   (megakernel launches, MPC replans, fleet decides), with hot-path
   recompile warnings.
 - `obs.runlog` — structured JSONL run logs for the training drivers and
-  the `ccka obs tail|summarize` CLI.
+  the `ccka obs tail|summarize` CLI, with a declared event-name registry
+  (`RUNLOG_EVENTS`) the incident timeline can trust.
+- `obs.recorder` — the per-tenant flight recorder: bounded ring buffers
+  of recent control-surface rows, dumped as atomic checksummed captures
+  when an incident trigger fires (round 14).
+- `obs.incidents` — the trigger vocabulary, structured incident records,
+  and the causal timeline join (`ccka incidents list|show|timeline`).
+- `obs.burnrate` — fast+slow-window SLO burn-rate engine behind the
+  `ccka_slo_burn_rate` / `ccka_incident_active` gauges.
+- `obs.bench_history` — BENCH_r*.json + lane_times.json as one schema'd
+  series with a CI-friendly regression diff (`ccka bench-diff`).
 """
 
+from ccka_tpu.obs.bench_history import (  # noqa: F401
+    bench_diff,
+    load_bench_history,
+)
+from ccka_tpu.obs.burnrate import (  # noqa: F401
+    BurnRate,
+    BurnRateEngine,
+)
 from ccka_tpu.obs.compile import (  # noqa: F401
     CompileStats,
     compile_report,
     stats_for,
     watch_jit,
 )
+from ccka_tpu.obs.incidents import (  # noqa: F401
+    TRIGGERS,
+    Incident,
+    IncidentLog,
+    build_timeline,
+    read_incidents,
+)
+from ccka_tpu.obs.recorder import (  # noqa: F401
+    FlightRecorder,
+    verify_dump,
+)
 from ccka_tpu.obs.runlog import (  # noqa: F401
+    RUNLOG_EVENTS,
     RunLog,
     read_runlog,
     summarize_runlog,

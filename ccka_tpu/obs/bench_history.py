@@ -1,0 +1,279 @@
+"""Bench-history regression sentinel: the perf trajectory as ONE series.
+
+The repo's measured record is scattered across `BENCH_r*.json` (whose
+shape changed by round: r01–r05 are driver wrappers with a truncated
+`tail` string, r08+ are stage records, r10+ carry provenance) and
+`data/lane_times.json` (the tier-1 wall-clock rows the conftest hook
+appends) — readable by a human with patience, unreadable by tooling.
+This module loads ALL of it into one schema'd series and diffs
+consecutive rounds with explicit thresholds, so "did round N regress
+round N-1?" is a CI exit code (`ccka bench-diff`) instead of an
+archaeology session.
+
+Two regression classes:
+
+- **trend gates** — consecutive-round comparisons on the same
+  platform: tier-1 lane best wall-clock slowing by more than
+  ``max_lane_slowdown``x, or a same-platform throughput headline
+  dropping by more than ``max_headline_drop``. Cross-platform rows
+  (the r5 TPU lane vs the r6 CPU lane) are never compared — a
+  platform change is not a regression.
+- **invariant gates** — absolute bounds a record carries about
+  itself: the round-12 recovery invariants (zero duplicate/lost
+  patches, bitwise resume), the round-13 overload isolation ratio
+  (<= ``max_healthy_ratio``), the round-14 recorder overhead
+  (< ``max_recorder_overhead`` of p50 tick latency), and the lane
+  budget (the round's BEST complete run must be under
+  `tests/conftest._LANE_BUDGET_S` — single noisy re-runs don't fail
+  the gate, a round that cannot get under it does.)
+
+Host-side, stdlib-only (no jax): the sentinel must run in any CI
+context, including one with no accelerator stack at all.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+# A "complete" lane row: the session hook also records interrupted
+# development runs (e.g. a 4.8s row with passed=0 in round 11); rows
+# below this pass-count cannot be full tier-1 lanes and are excluded
+# from the trend series. Rows with passed=None (the hand-seeded r5/r6
+# rows predate the field) are KEPT and marked `passed_unknown` — a
+# legacy row is not an interrupted run, and silently dropping the
+# repo's only TPU lane evidence would contradict the never-silent
+# contract.
+_LANE_MIN_PASSED = 100
+
+# Fallback lane budget for rows predating the over_budget stamp. The
+# AUTHORITATIVE budget is tests/conftest._LANE_BUDGET_S — its session
+# hook stamps `over_budget`/`budget_s` onto the rows it writes, and the
+# gate below trusts the row's own stamp first, so a conftest budget
+# change cannot silently diverge from this constant for stamped rows.
+_LANE_BUDGET_S = 840.0
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_bench_history(root: str) -> dict:
+    """All BENCH_r*.json + data/lane_times.json as one schema'd series.
+
+    Returns {"records": [...], "lane": [...]} where each record row is
+    {round, file, raw_keys, ...extracted metrics} and each lane row is
+    {round, platform, best_wall_s, runs, best_over_budget}. Extraction
+    is tolerant by design — the record shape changed every few rounds —
+    but NEVER silent: a file that fails to parse lands in the series as
+    {"round": n, "error": ...} so the diff can refuse to call a broken
+    history clean."""
+    records = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        row: dict = {"round": rnd, "file": os.path.basename(path)}
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            row["error"] = f"unreadable: {e}"
+            records.append(row)
+            continue
+        row["raw_keys"] = sorted(doc)
+        row.update(_extract_metrics(doc))
+        records.append(row)
+
+    lane = []
+    lane_path = os.path.join(root, "data", "lane_times.json")
+    try:
+        with open(lane_path, encoding="utf-8") as fh:
+            lane_rows = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        lane_rows = []
+    by_round: dict[tuple, list] = {}
+    for r in lane_rows:
+        passed = r.get("passed")
+        if passed is not None and passed < _LANE_MIN_PASSED:
+            continue  # interrupted development run, not a full lane
+        by_round.setdefault((r.get("round"), r.get("platform")),
+                            []).append(r)
+    for (rnd, platform), rows in sorted(by_round.items(),
+                                        key=lambda kv: kv[0][0] or 0):
+        best = min(rows, key=lambda r: r["wall_clock_s"])
+        known = [int(r["passed"]) for r in rows
+                 if r.get("passed") is not None]
+        lane.append({
+            "round": rnd,
+            "platform": platform,
+            "best_wall_s": float(best["wall_clock_s"]),
+            "runs": len(rows),
+            "best_over_budget": bool(best.get("over_budget", False)),
+            # The budget the hook stamped (over-budget rows only) —
+            # authoritative over this module's fallback constant.
+            "budget_s": best.get("budget_s"),
+            "passed_max": max(known) if known else None,
+            "passed_unknown": not known,
+            # Any row of the round recorded without CCKA_ROUND set:
+            # the round label was inferred by the conftest hook, not
+            # stated — surfaced so a guessed attribution can never
+            # masquerade as a measured one (the stamp's whole point).
+            "round_inferred": any(r.get("round_inferred")
+                                  for r in rows),
+        })
+    return {"records": records, "lane": lane}
+
+
+def _extract_metrics(doc: dict) -> dict:
+    """Pull the comparable metrics a record carries, whatever its
+    round-era shape. Unknown shapes extract nothing (the diff then has
+    nothing to compare — recorded, not asserted)."""
+    out: dict = {}
+    prov = doc.get("provenance") or {}
+    if prov.get("platform"):
+        out["platform"] = prov["platform"]
+    # Full-bench headline (the r01-era metric, whenever present).
+    if doc.get("metric") == "sim_cluster_days_per_sec_per_chip" \
+            and isinstance(doc.get("value"), (int, float)):
+        out["headline_cluster_days_per_sec"] = float(doc["value"])
+    # Round-12 recovery invariants.
+    inv = doc.get("invariants")
+    if isinstance(inv, dict):
+        for k in ("duplicate_patches_total", "lost_patches_total",
+                  "resume_bitwise_frac", "healthy_usd_ratio_max",
+                  "latency_p99_max_ms", "null_cell_ratio_max"):
+            if k in inv:
+                out[k] = inv[k]
+    # Round-14 obs stage (also nested under "obs" in a full record).
+    obs = doc if "recorder_overhead_frac" in doc else doc.get("obs", {})
+    if isinstance(obs, dict) and "recorder_overhead_frac" in obs:
+        out["recorder_overhead_frac"] = obs["recorder_overhead_frac"]
+        if "bitwise_identical" in obs:
+            out["obs_bitwise_identical"] = obs["bitwise_identical"]
+    return out
+
+
+def bench_diff(history: dict, *,
+               max_lane_slowdown: float = 1.5,
+               lane_budget_s: float = _LANE_BUDGET_S,
+               max_headline_drop: float = 0.5,
+               max_healthy_ratio: float = 1.05,
+               max_recorder_overhead: float = 0.05) -> dict:
+    """Diff the history; returns {"comparisons": [...], "regressions":
+    [...], "ok": bool}. Empty regressions = exit 0 for the CLI.
+
+    ``max_lane_slowdown`` is deliberately loose (1.5x): it exists to
+    catch STRUCTURAL regressions (a new test doubling the lane), not
+    host-speed noise between container generations — the budget gate
+    is the hard wall."""
+    comparisons: list[dict] = []
+    regressions: list[dict] = []
+
+    # Unreadable records are themselves a regression: a sentinel that
+    # shrugs at a corrupt history would pass exactly when it matters.
+    for rec in history.get("records", []):
+        if "error" in rec:
+            regressions.append({
+                "kind": "unreadable_record", "round": rec["round"],
+                "detail": rec["error"]})
+
+    # Lane trend + budget gates: consecutive rounds WITHIN each
+    # platform's own series (zipping the mixed list and skipping
+    # cross-platform pairs would silently drop genuine same-platform
+    # comparisons whenever platforms interleave — e.g. one TPU round
+    # between two CPU rounds would disconnect the CPU trend).
+    lane = [r for r in history.get("lane", []) if r.get("round")]
+    by_platform: dict[str, list] = {}
+    for r in lane:
+        by_platform.setdefault(r["platform"], []).append(r)
+    for series in by_platform.values():
+        for prev, cur in zip(series, series[1:]):
+            ratio = cur["best_wall_s"] / max(prev["best_wall_s"], 1e-9)
+            comp = {"kind": "lane_wall_s",
+                    "platform": cur["platform"],
+                    "rounds": [prev["round"], cur["round"]],
+                    "prev": prev["best_wall_s"],
+                    "cur": cur["best_wall_s"],
+                    "ratio": round(ratio, 3)}
+            comparisons.append(comp)
+            if ratio > max_lane_slowdown:
+                regressions.append(dict(
+                    comp, threshold=max_lane_slowdown,
+                    detail="tier-1 lane slowed past the trend gate"))
+    for r in lane:
+        # The row's own over_budget stamp (written by the conftest
+        # hook against the AUTHORITATIVE budget) decides; a numeric
+        # fallback covers hook-era rows that somehow lost the stamp.
+        # Rows predating BOTH the hook and the budget (the hand-seeded
+        # r5 TPU row, 1050s on a pre-budget round) are in the series
+        # but not budget-gated: judging them against a budget that did
+        # not exist would fail the real history retroactively.
+        budget = r.get("budget_s") or lane_budget_s
+        if r["best_over_budget"] or (
+                not r["passed_unknown"] and r["best_wall_s"] > budget):
+            regressions.append({
+                "kind": "lane_over_budget", "round": r["round"],
+                "best_wall_s": r["best_wall_s"],
+                "budget_s": budget,
+                "detail": "the round's BEST complete lane run exceeds "
+                          "the pinned budget — mark duplicative tests "
+                          "slow (ROADMAP lane-time rule)"})
+
+    # Headline trend: same grouping discipline — consecutive records
+    # within each platform's own series.
+    heads_by_platform: dict[str, list] = {}
+    for r in history.get("records", []):
+        if "headline_cluster_days_per_sec" in r:
+            heads_by_platform.setdefault(
+                r.get("platform", "?"), []).append(r)
+    for series in heads_by_platform.values():
+        for prev, cur in zip(series, series[1:]):
+            ratio = (cur["headline_cluster_days_per_sec"]
+                     / max(prev["headline_cluster_days_per_sec"], 1e-9))
+            comp = {"kind": "headline",
+                    "platform": cur.get("platform", "?"),
+                    "rounds": [prev["round"], cur["round"]],
+                    "prev": prev["headline_cluster_days_per_sec"],
+                    "cur": cur["headline_cluster_days_per_sec"],
+                    "ratio": round(ratio, 3)}
+            comparisons.append(comp)
+            if ratio < 1.0 - max_headline_drop:
+                regressions.append(dict(
+                    comp, threshold=1.0 - max_headline_drop,
+                    detail="throughput headline dropped past the gate"))
+
+    # Invariant gates: absolute bounds the records state about
+    # themselves — these ARE the acceptance criteria of their rounds,
+    # so a later record violating one is a regression by definition.
+    for rec in history.get("records", []):
+        rnd = rec["round"]
+        if rec.get("duplicate_patches_total", 0) != 0 \
+                or rec.get("lost_patches_total", 0) != 0:
+            regressions.append({
+                "kind": "recovery_invariant", "round": rnd,
+                "detail": "duplicate/lost patches non-zero"})
+        if rec.get("resume_bitwise_frac", 1.0) != 1.0:
+            regressions.append({
+                "kind": "recovery_invariant", "round": rnd,
+                "detail": "resume no longer bitwise"})
+        if rec.get("healthy_usd_ratio_max", 0.0) > max_healthy_ratio:
+            regressions.append({
+                "kind": "overload_invariant", "round": rnd,
+                "value": rec["healthy_usd_ratio_max"],
+                "threshold": max_healthy_ratio,
+                "detail": "healthy-tenant isolation ratio exceeded"})
+        if rec.get("recorder_overhead_frac", 0.0) > max_recorder_overhead:
+            regressions.append({
+                "kind": "obs_invariant", "round": rnd,
+                "value": rec["recorder_overhead_frac"],
+                "threshold": max_recorder_overhead,
+                "detail": "flight-recorder overhead exceeded the "
+                          "5%-of-p50 bound"})
+        if rec.get("obs_bitwise_identical") is False:
+            regressions.append({
+                "kind": "obs_invariant", "round": rnd,
+                "detail": "recorder-on/off runs no longer bitwise"})
+    return {"comparisons": comparisons, "regressions": regressions,
+            "ok": not regressions}

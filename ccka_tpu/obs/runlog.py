@@ -34,6 +34,29 @@ from typing import Callable, Mapping
 # per-field numeric summary in summarize_runlog.
 _META_EVENTS = ("start", "end", "note")
 
+# The declared event vocabulary (round 14). The incident timeline
+# (`obs/incidents.py`) joins RunLog records with trace spans and
+# recorder dumps on tick/tenant keys, which only works if event names
+# are a schema, not free text: every `.event("name", ...)` in the tree
+# must name a registered event (the AST guard in
+# `tests/test_timing_guard.py` enforces this statically, and
+# :meth:`RunLog.event` enforces it at write time). Add new names HERE,
+# next to the writer that emits them.
+RUNLOG_EVENTS = frozenset({
+    # RunLog's own bookkeeping schema (start/end envelope + notes).
+    "start", "end", "note",
+    # Training drivers: flagship/replay-flagship selection evaluations
+    # and distill provenance, PPO iterations, CEM generations, the MPC
+    # warm-start plan record (`ccka train`).
+    "eval", "distill", "iter", "gen", "mpc_plan",
+    # RESERVED for mirroring incident records into a RunLog stream —
+    # no writer yet: `obs/incidents.py`'s IncidentLog writes its own
+    # JSONL (with t/trigger/id keys) directly. Registered up front so
+    # the name cannot be claimed by an unrelated schema in the
+    # meantime.
+    "incident",
+})
+
 
 class RunLog:
     """Append-only JSONL run record + optional human echo.
@@ -70,7 +93,14 @@ class RunLog:
 
     def event(self, event: str, _echo: str | None = None, **fields) -> dict:
         """Record one structured event; ``_echo`` additionally prints a
-        human line (it is NOT written — the fields are the record)."""
+        human line (it is NOT written — the fields are the record).
+        ``event`` must come from :data:`RUNLOG_EVENTS` — the timeline
+        join treats event names as schema identifiers."""
+        if event not in RUNLOG_EVENTS:
+            raise ValueError(
+                f"unregistered RunLog event {event!r} — add it to "
+                "obs.runlog.RUNLOG_EVENTS next to the writer that "
+                f"emits it (registered: {sorted(RUNLOG_EVENTS)})")
         rec = {"event": event,
                "elapsed_s": round(time.perf_counter() - self._t0, 3),
                **fields}
@@ -105,21 +135,46 @@ class RunLog:
             self.close(status="error", error=repr(exc)[:200])
 
 
-def read_runlog(path: str, *, strict: bool = False) -> list[dict]:
-    """Load a run log. Non-strict (default) skips malformed lines — a
-    LIVE run's last line may be mid-write, and `ccka obs tail` must work
-    on it; strict raises like telemetry's reader."""
-    out: list[dict] = []
+def read_runlog(path: str, *, strict: bool = False,
+                with_stats: bool = False):
+    """Load a run log; returns the records (or ``(records, stats)``
+    with ``with_stats=True``).
+
+    Non-strict (default) tolerates exactly ONE malformation: a torn/
+    truncated FINAL line — what a crash (or a live writer) mid-write
+    leaves behind. The intact prefix is returned and the torn tail is
+    COUNTED (``stats["torn_tail"]``), never silently swallowed: before
+    round 14 every malformed line anywhere in the file was skipped
+    without a trace, so mid-file corruption mis-parsed into a
+    plausible-looking shorter log. Now an interior malformed line
+    raises even non-strict (corruption must fail loudly); only the
+    final line may be torn. ``strict=True`` raises on any malformed
+    line, the telemetry reader's discipline."""
+    raw: list[tuple[int, str]] = []
     with open(path, encoding="utf-8") as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, start=1):
             line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                if strict:
-                    raise
+            if line:
+                raw.append((lineno, line))
+    out: list[dict] = []
+    stats = {"torn_tail": 0}
+    for i, (lineno, line) in enumerate(raw):
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            if strict:
+                raise
+            if i == len(raw) - 1:
+                # The expected crash/live artifact: count it, keep the
+                # intact prefix.
+                stats["torn_tail"] = 1
+                break
+            raise json.JSONDecodeError(
+                f"malformed run-log line {lineno} of {path!r} (not the "
+                "final line, so this is file corruption, not a "
+                f"mid-write tear): {e.msg}", e.doc, e.pos)
+    if with_stats:
+        return out, stats
     return out
 
 

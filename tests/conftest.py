@@ -85,9 +85,15 @@ def pytest_sessionfinish(session, exitstatus):
     # Without CCKA_ROUND, re-runs record the CURRENT (last-seen) round —
     # repeated tier-1 runs inside one round append measurements of that
     # round rather than fabricating new round numbers; a new round
-    # announces itself via CCKA_ROUND=<n>.
+    # announces itself via CCKA_ROUND=<n>. The inference is a footgun
+    # when the operator FORGOT the env var at a round boundary, so the
+    # row self-describes (`round_inferred`) and a one-line warning says
+    # which round the measurement was attributed to (ISSUE 11
+    # satellite — the bench-history sentinel must be able to tell a
+    # labeled row from a guessed one).
     last_round = max((r.get("round") or 0 for r in rows), default=0)
     wall = round(time.time() - _SESSION_T0["t"], 1)
+    round_inferred = not env_round.isdigit()
     row = {
         "round": int(env_round) if env_round.isdigit() else max(
             last_round, 1),
@@ -98,6 +104,14 @@ def pytest_sessionfinish(session, exitstatus):
         "platform": ("tpu" if os.environ.get("CCKA_TEST_TPU") == "1"
                      else "cpu"),
     }
+    if round_inferred:
+        import sys
+
+        row["round_inferred"] = True
+        print(f"\n# note: CCKA_ROUND unset — lane row attributed to "
+              f"round {row['round']} (the last recorded round) and "
+              "stamped round_inferred; set CCKA_ROUND=<n> when running "
+              "the lane for a NEW round", file=sys.stderr)
     if wall > _LANE_BUDGET_S:
         import sys
 

@@ -384,6 +384,82 @@ class TestOverloadClaims:
         assert int(m4.group(2)) == inv["breakers_opened_total"]
 
 
+class TestIncidentClaims:
+    """Round 14's incident-grade obs layer (ISSUE 11 docs satellite):
+    README's "Incidents & alerting" claims are PARSED against the
+    BASELINE round14 record, not hand-synced."""
+
+    def test_round14_record_is_self_describing(self, baseline):
+        r14 = baseline["published"]["round14"]
+        obs = r14["obs_stage"]
+        # The acceptance criteria hold on the record itself.
+        assert obs["recorder_overhead_frac"] < 0.05
+        assert obs["overhead_gate_ok"] is True
+        assert obs["bitwise_identical"] is True
+        assert obs["attributable"] is True
+        assert obs["dumps_verified"] == obs["incidents_total"]
+        assert obs["incidents_total"] > 0
+        ev = r14["attribution_evidence"]
+        assert ev["one_incident_per_trigger_occurrence"] is True
+        assert ev["incidents_total"] == obs["incidents_total"]
+        assert (ev["breaker_opens"] + ev["reconcile_giveups"]
+                + ev["hold_fallbacks"]) == ev["incidents_total"]
+        assert "bitwise" in r14["non_interference_gate"]
+        w = r14["burn_rate_windows"]
+        assert 1 <= w["fast_ticks"] <= w["slow_ticks"]
+        assert r14["bench_diff_sentinel"][
+            "exit_zero_on_real_history"] is True
+
+    def test_readme_overhead_claim(self, readme, baseline):
+        obs = baseline["published"]["round14"]["obs_stage"]
+        m = re.search(
+            r"([\d.]+)\s?ms/tick\s+of\s+recorder\s+overhead\s+—\s+"
+            r"([\d.]+)%\s+of\s+the\s+([\d.]+)\s?ms\s+p50\s+tick\s+"
+            r"latency", readme)
+        assert m, ("README's recorder-overhead claim no longer states "
+                   "the numbers in the pinned form — update the claim "
+                   "AND this regex together")
+        ms, pct, p50 = map(float, m.groups())
+        assert abs(ms - obs["recorder_overhead_ms_per_tick"]) < 5e-3
+        assert abs(pct / 100 - obs["recorder_overhead_frac"]) < 5e-3
+        assert abs(p50 - obs["p50_tick_ms_off"]) < 5e-3
+        assert pct / 100 < 0.05
+
+    def test_readme_attribution_claim(self, readme, baseline):
+        ev = (baseline["published"]["round14"]["attribution_evidence"])
+        m = re.search(
+            r"(\d+)\s+incidents\s+\((\d+)\s+breaker\s+opens,\s+(\d+)\s+"
+            r"reconcile\s+give-ups,\s+(\d+)\s+hold→fallback\s+"
+            r"escalations\)", readme)
+        assert m, "README's attribution claim lost its pinned form"
+        total, opens, giveups, fallbacks = map(int, m.groups())
+        assert total == ev["incidents_total"]
+        assert opens == ev["breaker_opens"]
+        assert giveups == ev["reconcile_giveups"]
+        assert fallbacks == ev["hold_fallbacks"]
+        m2 = re.search(r"\((\d+)/(\d+)\s+checksums\s+pass,\s+(\d+)\s+"
+                       r"shared\s+capture\s+files\)", readme)
+        assert m2, "README's dump-verification claim lost its form"
+        verified, of, files = map(int, m2.groups())
+        assert verified == of == ev["dumps_verified"]
+        assert files == ev["dumps_files"]
+
+    def test_readme_burn_windows(self, readme, baseline):
+        w = baseline["published"]["round14"]["burn_rate_windows"]
+        m = re.search(r"(\d+)/(\d+)-tick\s+fast/slow\s+windows", readme)
+        assert m, "README's burn-window claim lost its pinned form"
+        assert int(m.group(1)) == w["fast_ticks"]
+        assert int(m.group(2)) == w["slow_ticks"]
+
+    def test_architecture_has_section_16(self):
+        arch = _read("ARCHITECTURE.md")
+        assert "## 16. Incident-grade observability" in arch
+        for phrase in ("Flight recorder", "burn-rate", "bench-diff",
+                       "on_giveup", "RUNLOG_EVENTS",
+                       "round_inferred"):
+            assert phrase in arch, phrase
+
+
 class TestWorkloadScenarioClaims:
     """Round 11's per-family scenario scoreboard (ISSUE 6 docs
     satellite): README's workload-scenario claims are PARSED against

@@ -1,0 +1,751 @@
+"""Incident-grade observability (round 14, `ccka_tpu/obs`).
+
+The contracts pinned here:
+
+- **bitwise non-interference**: a paired recorder-on/recorder-off
+  FleetService run on a deterministic clock is bitwise identical in
+  decisions (per-tenant $/SLO-hr and SLO-tick accumulators) AND patch
+  streams (per-sink command lists) — observation never steers;
+- **trigger attribution** (ISSUE 11 satellite): under a seeded
+  ChaosSink + slow-tenant run, every breaker open, reconcile give-up
+  and deadline overshoot produces EXACTLY ONE incident record, each
+  with a recorder dump whose checksum verifies;
+- **burn-rate engine**: fast/slow window arithmetic and the two-window
+  AND that stops flapping;
+- **recorder integrity**: dumps reuse the snapshot codec — a corrupt
+  capture is refused at load, never half-trusted;
+- **bench-history sentinel**: `ccka bench-diff` exits non-zero on an
+  injected synthetic regression and zero on the repo's real history.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ccka_tpu.config import (OBS_PRESETS, SERVICE_PRESETS, ConfigError,
+                             ObsConfig, ServiceConfig, default_config)
+from ccka_tpu.harness.service import (VirtualClock,
+                                      fleet_service_from_config)
+from ccka_tpu.obs.burnrate import BurnRate, BurnRateEngine
+from ccka_tpu.obs.incidents import (TRIGGERS, IncidentLog,
+                                    attach_dump_entries, build_timeline,
+                                    read_incidents)
+from ccka_tpu.obs.recorder import FLEET_KEY, FlightRecorder, verify_dump
+from ccka_tpu.policy import RulePolicy
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config().with_overrides(**{"sim.horizon_steps": 16})
+
+
+@pytest.fixture(scope="module")
+def rule(cfg):
+    # ONE backend instance module-wide: the service-tick compile cache
+    # keys on it (the test_service idiom).
+    return RulePolicy(cfg.cluster)
+
+
+def det_clock() -> VirtualClock:
+    """Deterministic base clock: +0.1 virtual ms per read, fresh per
+    run — paired runs see identical clock sequences, so decisions
+    cannot be steered by host timing noise."""
+    state = {"s": 0.0}
+
+    def base():
+        state["s"] += 1e-4
+        return state["s"]
+    return VirtualClock(base=base)
+
+
+def _obs(tmp_path, **kw) -> ObsConfig:
+    base = dict(enabled=True, dump_dir=str(tmp_path / "dumps"),
+                incident_log_path=str(tmp_path / "incidents.jsonl"))
+    base.update(kw)
+    return ObsConfig(**base)
+
+
+class TestBurnRate:
+    def test_window_rates_and_two_window_and(self):
+        br = BurnRate(fast_ticks=2, slow_ticks=8, threshold=0.5)
+        assert br.fast_rate == 0.0 and not br.burning
+        for _ in range(2):
+            br.update(1.0, 1.0)        # two fully-bad ticks
+        assert br.fast_rate == 1.0
+        # Slow window still diluted by nothing-yet: 2 bad of 2 seen.
+        assert br.slow_rate == 1.0 and br.burning
+        for _ in range(6):
+            br.update(0.0, 1.0)        # recovery
+        # Fast window clean immediately; slow remembers the fire.
+        assert br.fast_rate == 0.0
+        assert br.slow_rate == pytest.approx(2.0 / 8.0)
+        assert not br.burning          # the AND stops the flap
+
+    def test_single_blip_never_alerts(self):
+        br = BurnRate(fast_ticks=1, slow_ticks=8, threshold=0.5)
+        br.update(0.0, 1.0)
+        for _ in range(7):
+            br.update(0.0, 1.0)
+        br.update(1.0, 1.0)            # one bad tick
+        assert br.fast_rate == 1.0
+        assert br.slow_rate == pytest.approx(1.0 / 8.0)
+        assert not br.burning
+
+    def test_engine_series_and_any_burning(self):
+        eng = BurnRateEngine(2, 4, threshold=0.5)
+        eng.update("slo", 0.0, 4.0)
+        assert not eng.any_burning
+        for _ in range(4):
+            eng.update("shed", 4.0, 4.0)
+        assert eng.any_burning
+        rates = eng.rates()
+        assert rates["shed"]["burning"] is True
+        assert rates["slo"]["burning"] is False
+
+    def test_fast_window_must_not_exceed_slow(self):
+        with pytest.raises(ValueError, match="fast window"):
+            BurnRate(fast_ticks=8, slow_ticks=2)
+        with pytest.raises(ConfigError, match="burn_fast_window"):
+            ObsConfig(burn_fast_window=65).validate()
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_and_dump_verifies(self, tmp_path):
+        ob = ObsConfig(enabled=True, ring_size=4,
+                       dump_dir=str(tmp_path))
+        rec = FlightRecorder(ob)
+        for t in range(10):
+            rec.record(FLEET_KEY, {"t": t})
+            rec.record(3, {"t": t, "lane": 1})
+        assert [r["t"] for r in rec.ring(FLEET_KEY)] == [6, 7, 8, 9]
+        path, sha = rec.dump(trigger="breaker_open", t=9, tenant=3,
+                             incident_id=1)
+        assert rec.dumps_total == 1
+        body = verify_dump(path)
+        assert body["trigger"] == "breaker_open"
+        assert body["rings"]["3"][-1] == {"t": 9, "lane": 1}
+        assert len(sha) == 64
+
+    def test_same_tick_same_tenant_shares_one_dump(self, tmp_path):
+        ob = ObsConfig(enabled=True, dump_dir=str(tmp_path))
+        rec = FlightRecorder(ob)
+        rec.record(2, {"t": 5})
+        a = rec.dump(trigger="breaker_open", t=5, tenant=2,
+                     incident_id=1)
+        b = rec.dump(trigger="hold_fallback", t=5, tenant=2,
+                     incident_id=2)
+        c = rec.dump(trigger="breaker_open", t=6, tenant=2,
+                     incident_id=3)
+        assert a == b                  # shared capture, one file
+        assert c != a
+        assert rec.dumps_total == 2
+
+    def test_corrupt_dump_refused(self, tmp_path):
+        from ccka_tpu.harness.snapshot import SnapshotError
+
+        ob = ObsConfig(enabled=True, dump_dir=str(tmp_path))
+        rec = FlightRecorder(ob)
+        rec.record(0, {"t": 1})
+        path, _sha = rec.dump(trigger="shed_spike", t=1, tenant=0,
+                              incident_id=1)
+        doc = json.load(open(path))
+        doc["body"]["t"] = 999         # hand-edit: checksum must trip
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        with pytest.raises(SnapshotError, match="checksum"):
+            verify_dump(path)
+        # And a NON-dump snapshot is refused by kind, not rendered.
+        from ccka_tpu.harness.snapshot import save_snapshot
+        other = str(tmp_path / "ctrl.snap")
+        save_snapshot(other, {"kind": "controller", "next_tick": 3})
+        with pytest.raises(SnapshotError, match="not a recorder-dump"):
+            verify_dump(other)
+
+    def test_dumpless_posture_returns_none(self):
+        rec = FlightRecorder(ObsConfig(enabled=True))
+        rec.record(0, {"t": 0})
+        assert rec.dump(trigger="breaker_open", t=0, tenant=0) is None
+        assert rec.dumps_total == 0
+
+
+class TestIncidentLog:
+    def test_unknown_trigger_rejected(self):
+        log = IncidentLog()
+        with pytest.raises(ValueError, match="unknown incident trigger"):
+            log.stamp("novel_trigger", t=0)
+
+    def test_jsonl_roundtrip_and_counts(self, tmp_path):
+        path = str(tmp_path / "inc.jsonl")
+        log = IncidentLog(path)
+        log.stamp("breaker_open", t=3, tenant=1, state="open")
+        log.stamp("shed_spike", t=4, shed=5)
+        log.close()
+        recs = read_incidents(path)
+        assert [r["trigger"] for r in recs] == ["breaker_open",
+                                               "shed_spike"]
+        assert recs[0]["tenant"] == 1 and recs[1]["tenant"] is None
+        assert log.counts() == {"breaker_open": 1, "shed_spike": 1}
+
+    def test_appending_session_continues_the_id_sequence(self,
+                                                         tmp_path):
+        """A second session appending to an existing incident log must
+        continue its ids — restarting at 1 would collide `show --id`
+        lookups AND overwrite the previous session's dump files (their
+        names carry the incident id) while the old records still
+        reference the old checksums."""
+        path = str(tmp_path / "inc.jsonl")
+        dumps = ObsConfig(enabled=True, dump_dir=str(tmp_path / "d"))
+        rec1 = FlightRecorder(dumps)
+        rec1.record(0, {"t": 1})
+        log = IncidentLog(path, recorder=rec1)
+        first = log.stamp("breaker_open", t=1, tenant=0)
+        log.close()
+        rec2 = FlightRecorder(dumps)
+        rec2.record(0, {"t": 2})
+        log2 = IncidentLog(path, recorder=rec2)
+        second = log2.stamp("breaker_open", t=2, tenant=0)
+        log2.close()
+        assert second.id == first.id + 1
+        assert second.dump_path != first.dump_path
+        recs = read_incidents(path)
+        assert [r["id"] for r in recs] == [first.id, second.id]
+        # Both sessions' dumps still verify against their records.
+        for r in recs:
+            assert verify_dump(r["dump_path"])["t"] == r["t"]
+
+    def test_reopen_after_torn_tail_repairs_before_appending(
+            self, tmp_path):
+        """A crash mid-stamp leaves a torn final line; the next session
+        must TRIM it before appending or the first new record would
+        concatenate onto the partial line and corrupt the log for
+        every later reader."""
+        path = str(tmp_path / "inc.jsonl")
+        log = IncidentLog(path)
+        log.stamp("breaker_open", t=1, tenant=0)
+        log.stamp("shed_spike", t=2, shed=4)
+        log.close()
+        raw = open(path, "rb").read()
+        with open(path, "wb") as fh:       # tear the final record
+            fh.write(raw[:-10])
+        log2 = IncidentLog(path)
+        third = log2.stamp("breaker_open", t=3, tenant=1)
+        log2.close()
+        recs = read_incidents(path)        # fully parseable again
+        assert [r["t"] for r in recs] == [1, 3]
+        # Ids still continue past the (intact) prior records.
+        assert third.id == 2
+        # The NEWLINE-TERMINATED malformed final line (partial write
+        # whose trailing block landed): must be trimmed too, or the
+        # append strands an interior malformed line the reader
+        # refuses forever.
+        raw2 = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(raw2[:-10] + b"\n")
+        log3 = IncidentLog(path)
+        log3.stamp("shed_spike", t=9, shed=2)
+        log3.close()
+        recs = read_incidents(path)
+        assert [r["t"] for r in recs] == [1, 9]
+
+    def test_corrupt_prior_log_refused_diagnosably(self, tmp_path):
+        path = str(tmp_path / "inc.jsonl")
+        with open(path, "w") as fh:
+            fh.write('{"id": 1, "t": 0}\n')
+            fh.write("GARBAGE\n")
+            fh.write('{"id": 2, "t": 1}\n')
+        with pytest.raises(ValueError, match="corrupt incident log"):
+            IncidentLog(path)
+        from ccka_tpu.cli import main
+        with pytest.raises(SystemExit, match="corrupt incident log"):
+            main(["fleet", "--clusters", "2", "--ticks", "1",
+                  "--service", "default", "--incidents-out", path])
+        # And an explicit off posture must not be silently inverted
+        # by the output flag — the contradiction is rejected.
+        with pytest.raises(SystemExit, match="off posture"):
+            main(["fleet", "--clusters", "2", "--ticks", "1",
+                  "--service", "default", "--obs", "off",
+                  "--incidents-out", str(tmp_path / "x.jsonl")])
+
+    def test_io_failure_degrades_record_not_control_loop(self,
+                                                         tmp_path,
+                                                         capsys):
+        """The observer must never kill the loop it observes: a dump
+        or append that hits an OSError is counted, the incident stays
+        in-memory, and nothing raises — plus the reconciler backstops
+        a hook that raises anyway."""
+        class FailingRecorder:
+            def dump(self, **_kw):
+                raise OSError(28, "No space left on device")
+
+        log = IncidentLog(str(tmp_path / "inc.jsonl"),
+                          recorder=FailingRecorder())
+        log._fh.close()                    # appends now fail too
+        inc = log.stamp("breaker_open", t=1, tenant=0)
+        assert inc.dump_path is None and log.total == 1
+        assert log.io_errors == 2          # dump + append, no raise
+        assert "incident-log" in capsys.readouterr().err
+        log._fh = None
+        log.close()
+
+        # Reconciler backstop: a hook raising must not abort converge.
+        from ccka_tpu.actuation.chaos import ChaosSink
+        from ccka_tpu.actuation.patches import render_nodepool_patches
+        from ccka_tpu.actuation.reconcile import Reconciler
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.config import ChaosConfig, default_config
+        from ccka_tpu.policy.rule import offpeak_action
+
+        cfg = default_config()
+        sink = ChaosSink(DryRunSink(),
+                         ChaosConfig(enabled=True, drop_prob=1.0),
+                         seed=1)
+        def bad_hook(_outcome):
+            raise RuntimeError("broken observer")
+        rec = Reconciler(sink, max_rounds=1, on_giveup=bad_hook)
+        patches = render_nodepool_patches(offpeak_action(cfg.cluster),
+                                          cfg.cluster, op="replace")
+        outcome = rec.converge(patches)    # must NOT raise
+        assert not outcome.converged
+        assert rec.hook_errors == 1
+
+    def test_timeline_joins_and_orders_sources(self):
+        log = IncidentLog()
+        log.stamp("breaker_open", t=5, tenant=0)
+        runlog = [{"event": "iter", "t": 4, "loss": 1.0},
+                  {"event": "iter", "t": 5, "loss": 2.0},
+                  {"event": "note", "msg": "no tick key"}]
+        spans = [{"name": "service.tick", "args": {"t": 5},
+                  "dur_us": 1500.0},
+                 {"name": "service.tick", "args": {"t": 99},
+                  "dur_us": 1.0}]
+        tl = build_timeline(log.incidents, runlog=runlog, spans=spans,
+                            around=5, window=1)
+        # Un-keyed rows and out-of-window ticks are dropped; within a
+        # tick the incident sorts LAST (after the state explaining it).
+        assert [(r["t"], r["source"]) for r in tl] == [
+            (4, "runlog"), (5, "span"), (5, "runlog"), (5, "incident")]
+        assert tl[1]["dur_ms"] == 1.5
+
+
+class TestServiceTriggersUnderChaos:
+    """The ISSUE 11 satellite: a seeded ChaosSink + slow-tenant run
+    must leave a FULLY ATTRIBUTABLE incident record — one incident per
+    breaker open / give-up / overshoot, each with a verifying dump."""
+
+    @pytest.fixture(scope="class")
+    def chaos_run(self, cfg, rule, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("chaos-incidents")
+        obs = ObsConfig(enabled=True,
+                        dump_dir=str(tmp / "dumps"),
+                        incident_log_path=str(tmp / "incidents.jsonl"))
+        svc = fleet_service_from_config(
+            cfg, rule, 6,
+            profiles=["healthy"] * 3 + ["slow", "flaky", "flaky"],
+            service=SERVICE_PRESETS["default"], obs=obs,
+            horizon_ticks=16, seed=3, clock=det_clock())
+        svc.warmup()
+        reports = svc.run(12)
+        yield svc, reports, obs
+        svc.close()
+
+    def test_every_breaker_open_has_exactly_one_incident(self,
+                                                         chaos_run):
+        svc, _reports, _obs = chaos_run
+        opens = sum(b.transitions["opened"] for b in svc.breakers)
+        assert opens > 0, "stress fleet opened no breakers — vacuous"
+        assert svc.incidents.counts().get("breaker_open", 0) == opens
+
+    def test_every_giveup_has_exactly_one_incident(self, chaos_run):
+        svc, _reports, _obs = chaos_run
+        assert svc.actuation_giveups_total > 0, "no give-ups — vacuous"
+        assert svc.incidents.counts().get("reconcile_giveup", 0) \
+            == svc.actuation_giveups_total
+
+    def test_every_incident_dump_checksum_verifies(self, chaos_run):
+        svc, _reports, _obs = chaos_run
+        assert svc.incidents.total > 0
+        for inc in svc.incidents.incidents:
+            assert inc.dump_path is not None
+            body = verify_dump(inc.dump_path)
+            assert body["t"] == inc.t
+            # The capture's per-tenant ring covers the incident tick's
+            # recent history for the RIGHT tenant.
+            if inc.tenant is not None:
+                ring = body["rings"][str(inc.tenant)]
+                assert ring and ring[-1]["t"] == inc.t
+
+    def test_timeline_nonempty_and_attributes_each_incident(
+            self, chaos_run):
+        svc, _reports, obs = chaos_run
+        recs = read_incidents(obs.incident_log_path)
+        spans = [s.to_record() for s in svc.tracer.spans()]
+        tl = build_timeline(recs, spans=spans)
+        incidents = [r for r in tl if r["source"] == "incident"]
+        assert len(incidents) == svc.incidents.total
+        # Every incident row sits next to span rows of its own tick.
+        span_ticks = {r["t"] for r in tl if r["source"] == "span"}
+        for inc in incidents:
+            assert inc["t"] in span_ticks
+        assert all(r["trigger"] in TRIGGERS for r in incidents)
+
+    def test_report_gauges_reflect_the_obs_layer(self, chaos_run):
+        svc, reports, _obs = chaos_run
+        last = reports[-1]
+        assert last.incidents_total == svc.incidents.total
+        assert last.recorder_dumps_total == svc.recorder.dumps_total
+        assert 0.0 <= last.slo_burn_rate <= 1.0
+        # Incidents fired within the fast window of the last tick OR
+        # the burn engine is burning -> the active flag is honest.
+        lastinc = svc.incidents.last_tick()
+        expect = int(svc.burn.any_burning
+                     or (lastinc is not None
+                         and last.t - lastinc < svc.obs.burn_fast_window))
+        assert last.incident_active == expect
+
+    def test_deadline_overshoot_stamps_one_incident_per_tick(
+            self, cfg, rule, tmp_path):
+        svc = fleet_service_from_config(
+            cfg, rule, 3, profiles=["healthy"] * 3,
+            service=SERVICE_PRESETS["default"], obs=_obs(tmp_path),
+            horizon_ticks=16, seed=7, clock=det_clock())
+        svc.warmup()
+        orig = svc._tick_fn
+
+        def slow_dispatch(*a, **kw):
+            # The real overshoot cause: an un-preemptible device
+            # dispatch running past the deadline, modeled as virtual
+            # clock advance (deterministic, unlike a real stall).
+            out = orig(*a, **kw)
+            svc.clock.advance(0.3)     # 300ms > the 250ms deadline
+            return out
+
+        svc._tick_fn = slow_dispatch
+        reports = svc.run(4)
+        overshoots = [r for r in reports
+                      if r.tick_latency_ms > svc.svc.tick_deadline_ms]
+        assert len(overshoots) == 4
+        assert svc.incidents.counts()["deadline_overshoot"] == 4
+        for inc in svc.incidents.incidents:
+            if inc.trigger == "deadline_overshoot":
+                assert inc.details["latency_ms"] \
+                    > inc.details["deadline_ms"]
+        svc.close()
+
+    def test_shed_spike_and_hold_fallback_stamped_once_each(
+            self, cfg, rule, tmp_path):
+        # Cap 3 over {2 healthy, 1 slow, 3 batch}: the back-of-order
+        # batch tenants shed 3/tick >= the 50% spike bar while the
+        # slow tenant still scrapes (priority 1), times out, opens its
+        # breaker, and escalates hold -> rule-fallback ONCE.
+        import math
+
+        n = 6
+        svc = fleet_service_from_config(
+            cfg, rule, n,
+            profiles=["healthy", "healthy", "slow"] + ["batch"] * 3,
+            service=ServiceConfig(
+                enabled=True, tick_deadline_ms=200.0,
+                admission_queue_cap=3, breaker_failures=1,
+                hold_fallback_after=2, breaker_probe_ticks=32),
+            obs=_obs(tmp_path), horizon_ticks=16, seed=5,
+            clock=det_clock())
+        svc.warmup()
+        reports = svc.run(8)
+        counts = svc.incidents.counts()
+        bar = max(1, math.ceil(svc.obs.shed_spike_frac * n))
+        spike_ticks = sum(1 for r in reports if r.shed >= bar)
+        assert spike_ticks > 0
+        assert counts["shed_spike"] == spike_ticks
+        # The slow tenant escalated hold -> rule-fallback exactly once.
+        assert counts.get("hold_fallback", 0) == 1
+        fallback = [i for i in svc.incidents.incidents
+                    if i.trigger == "hold_fallback"]
+        assert fallback[0].tenant == 2
+        svc.close()
+
+
+class TestNonInterference:
+    """The round-13 zero-overhead-control idiom applied to the obs
+    layer: recorder-on and recorder-off runs over one seeded world on
+    a deterministic clock are BITWISE identical in decisions and patch
+    streams."""
+
+    def _run(self, cfg, rule, obs, tmp_path=None):
+        svc = fleet_service_from_config(
+            cfg, rule, 5, profiles=["healthy"] * 4 + ["slow"],
+            service=SERVICE_PRESETS["default"], obs=obs,
+            horizon_ticks=16, seed=11, clock=det_clock())
+        svc.warmup()
+        svc.run(10)
+        out = {
+            "usd": svc.tenant_usd_per_slo_hr().copy(),
+            "slo": svc.tenant_slo_ticks.copy(),
+            "fresh": svc.tenant_fresh_ticks.copy(),
+            "commands": [[(c.name, c.patch_type, json.dumps(
+                c.patch, sort_keys=True))
+                for c in getattr(s, "inner", s).commands]
+                for s in svc.sinks],
+            "incidents": (svc.incidents.total
+                          if svc.incidents is not None else 0),
+        }
+        svc.close()
+        return out
+
+    def test_recorder_on_off_bitwise_identical(self, cfg, rule,
+                                               tmp_path):
+        off = self._run(cfg, rule, None)
+        on = self._run(cfg, rule, _obs(tmp_path))
+        np.testing.assert_array_equal(off["usd"], on["usd"])
+        np.testing.assert_array_equal(off["slo"], on["slo"])
+        np.testing.assert_array_equal(off["fresh"], on["fresh"])
+        assert off["commands"] == on["commands"]
+        # Non-vacuous: the observed run genuinely stamped incidents
+        # (the slow tenant opened its breaker) while changing nothing.
+        assert on["incidents"] > 0
+
+    def test_obs_off_builds_no_machinery(self, cfg, rule):
+        svc = fleet_service_from_config(
+            cfg, rule, 2, service=SERVICE_PRESETS["default"],
+            horizon_ticks=16, seed=1)
+        assert svc.recorder is None and svc.incidents is None \
+            and svc.burn is None
+        assert OBS_PRESETS["off"].enabled is False
+        svc.close()
+
+
+class TestControllerIncidents:
+    """The single-cluster wiring: the degraded machine's fallback
+    escalation and the reconciler's give-up hook stamp incidents."""
+
+    def test_stale_source_fallback_stamps_hold_fallback(self, cfg):
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        class StaleSource(SyntheticSignalSource):
+            last_scrape_stale = True
+
+        src = StaleSource(cfg.cluster, cfg.workload, cfg.sim,
+                          cfg.signals)
+        log = IncidentLog()
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src,
+                          DryRunSink(), interval_s=0.0,
+                          degraded_fallback_after=1, incident_log=log,
+                          log_fn=lambda _l: None)
+        ctrl.run(ticks=3)
+        ctrl.close()
+        # ONE escalation incident (the machine stays in fallback), at
+        # the tick the transition happened.
+        assert log.counts() == {"hold_fallback": 1}
+        assert log.incidents[0].t == 0
+        assert log.incidents[0].details["stale_streak"] >= 1
+
+    def test_reconcile_giveup_stamps_via_the_hook(self, cfg):
+        from ccka_tpu.actuation.chaos import ChaosSink
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.config import ChaosConfig
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        # Every write silently dropped: read-back always diverges, so
+        # each tick's converge gives up (1 round -> no retry sleeps).
+        sink = ChaosSink(DryRunSink(),
+                         ChaosConfig(enabled=True, drop_prob=1.0),
+                         seed=2)
+        log = IncidentLog()
+        ctrl = Controller(cfg, RulePolicy(cfg.cluster), src, sink,
+                          interval_s=0.0, reconcile_rounds=1,
+                          incident_log=log, log_fn=lambda _l: None)
+        ctrl.run(ticks=2)
+        ctrl.close()
+        giveups = [i for i in log.incidents
+                   if i.trigger == "reconcile_giveup"]
+        assert len(giveups) == 2
+        assert giveups[0].t == 0 and giveups[1].t == 1
+        assert giveups[0].details["region"] == cfg.cluster.region
+        assert giveups[0].details["diverged"]
+
+
+class TestIncidentsCLI:
+    @pytest.fixture(scope="class")
+    def cli_artifacts(self, cfg, rule, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("cli-incidents")
+        obs = ObsConfig(enabled=True,
+                        dump_dir=str(tmp / "dumps"),
+                        incident_log_path=str(tmp / "incidents.jsonl"))
+        svc = fleet_service_from_config(
+            cfg, rule, 4, profiles=["healthy"] * 3 + ["slow"],
+            service=SERVICE_PRESETS["default"], obs=obs,
+            horizon_ticks=16, seed=13, clock=det_clock())
+        svc.warmup()
+        svc.run(8)
+        spans_path = str(tmp / "spans.jsonl")
+        with open(spans_path, "w") as fh:
+            for s in svc.tracer.spans():
+                fh.write(json.dumps(s.to_record()) + "\n")
+        svc.close()
+        return obs.incident_log_path, spans_path
+
+    def test_list_show_timeline(self, cli_artifacts, capsys):
+        from ccka_tpu.cli import main
+
+        inc_path, spans_path = cli_artifacts
+        assert main(["incidents", "list", inc_path]) == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert lines and all("trigger" in json.loads(l) for l in lines)
+
+        first = json.loads(lines[0])
+        assert main(["incidents", "show", inc_path,
+                     "--id", str(first["id"])]) == 0
+        shown = json.loads(capsys.readouterr().out)
+        assert shown["dump_verified"] is True
+        assert shown["dump"]["kind"] == "recorder-dump"
+
+        assert main(["incidents", "timeline", inc_path,
+                     "--trace", spans_path,
+                     "--id", str(first["id"])]) == 0
+        rows = [json.loads(l) for l in
+                capsys.readouterr().out.strip().splitlines()]
+        assert any(r["source"] == "incident" for r in rows)
+        assert any(r["source"] == "span" for r in rows)
+
+    def test_show_refuses_corrupt_dump(self, cli_artifacts, capsys):
+        from ccka_tpu.cli import main
+
+        inc_path, _spans = cli_artifacts
+        recs = read_incidents(inc_path)
+        doc = json.load(open(recs[0]["dump_path"]))
+        doc["body"]["t"] = 777
+        with open(recs[0]["dump_path"], "w") as fh:
+            json.dump(doc, fh)
+        with pytest.raises(SystemExit, match="failed verification"):
+            main(["incidents", "show", inc_path,
+                  "--id", str(recs[0]["id"])])
+        # attach_dump_entries is the same refusal, library-side.
+        from ccka_tpu.harness.snapshot import SnapshotError
+        with pytest.raises(SnapshotError):
+            attach_dump_entries(recs[0])
+
+    def test_show_without_id_and_unknown_id(self, cli_artifacts):
+        from ccka_tpu.cli import main
+
+        inc_path, _spans = cli_artifacts
+        with pytest.raises(SystemExit, match="needs --id"):
+            main(["incidents", "show", inc_path])
+        with pytest.raises(SystemExit, match="no incident with id"):
+            main(["incidents", "show", inc_path, "--id", "9999"])
+
+
+class TestBenchHistorySentinel:
+    def test_real_history_loads_and_is_clean(self):
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        history = load_bench_history(_ROOT)
+        rounds = {r["round"] for r in history["records"]}
+        assert {1, 13}.issubset(rounds)
+        assert any(r["round"] >= 13 for r in history["lane"])
+        # Partial/interrupted lane rows (e.g. round 11's 4.8s
+        # passed-0 row) are excluded from the trend series, while the
+        # legacy hand-seeded rows (passed=None — incl. the r5 TPU
+        # lane, the repo's only TPU evidence) stay, marked unknown.
+        for r in history["lane"]:
+            assert r["passed_unknown"] or r["passed_max"] >= 100
+        assert any(r["platform"] == "tpu" and r["passed_unknown"]
+                   for r in history["lane"])
+        diff = bench_diff(history)
+        assert diff["ok"], diff["regressions"]
+        assert diff["comparisons"]
+
+    def test_injected_regressions_trip_each_gate(self):
+        from ccka_tpu.obs.bench_history import bench_diff
+
+        clean = {
+            "records": [
+                {"round": 13, "file": "BENCH_r13.json",
+                 "platform": "cpu", "healthy_usd_ratio_max": 1.0},
+                {"round": 14, "file": "BENCH_r14.json",
+                 "platform": "cpu", "recorder_overhead_frac": 0.03,
+                 "obs_bitwise_identical": True},
+            ],
+            "lane": [
+                {"round": 13, "platform": "cpu", "best_wall_s": 630.0,
+                 "runs": 2, "best_over_budget": False,
+                 "passed_max": 500, "passed_unknown": False},
+                {"round": 14, "platform": "cpu", "best_wall_s": 660.0,
+                 "runs": 1, "best_over_budget": False,
+                 "passed_max": 520, "passed_unknown": False},
+            ],
+        }
+        assert bench_diff(clean)["ok"]
+
+        import copy
+
+        def mutate(fn):
+            h = copy.deepcopy(clean)
+            fn(h)
+            d = bench_diff(h)
+            assert not d["ok"]
+            return d["regressions"]
+
+        regs = mutate(lambda h: h["lane"][1].update(best_wall_s=1300.0))
+        assert any(r["kind"] == "lane_wall_s" for r in regs)
+        assert any(r["kind"] == "lane_over_budget" for r in regs)
+        regs = mutate(lambda h: h["records"][1].update(
+            recorder_overhead_frac=0.12))
+        assert any(r["kind"] == "obs_invariant" for r in regs)
+        regs = mutate(lambda h: h["records"][1].update(
+            obs_bitwise_identical=False))
+        assert any("bitwise" in r["detail"] for r in regs)
+        regs = mutate(lambda h: h["records"][0].update(
+            healthy_usd_ratio_max=1.31))
+        assert any(r["kind"] == "overload_invariant" for r in regs)
+        regs = mutate(lambda h: h["records"][1].update(
+            error="unreadable: boom"))
+        assert any(r["kind"] == "unreadable_record" for r in regs)
+
+    def test_headline_gate_same_platform_only(self):
+        from ccka_tpu.obs.bench_history import bench_diff
+
+        h = {"records": [
+            {"round": 4, "platform": "tpu",
+             "headline_cluster_days_per_sec": 1.8e6},
+            {"round": 14, "platform": "cpu",
+             "headline_cluster_days_per_sec": 5.0e4},
+        ], "lane": []}
+        # A platform change is not a regression.
+        assert bench_diff(h)["ok"]
+        h["records"][1]["platform"] = "tpu"
+        d = bench_diff(h)
+        assert not d["ok"]
+        assert d["regressions"][0]["kind"] == "headline"
+
+    def test_cli_bench_diff_real_and_doctored(self, tmp_path, capsys):
+        from ccka_tpu.cli import main
+
+        assert main(["bench-diff", "--root", _ROOT]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] is True
+
+        # Doctored root: a synthetic r14 record violating the obs
+        # invariant must flip the exit code.
+        os.makedirs(tmp_path / "data", exist_ok=True)
+        with open(tmp_path / "BENCH_r14.json", "w") as fh:
+            json.dump({"recorder_overhead_frac": 0.5,
+                       "provenance": {"platform": "cpu"}}, fh)
+        with open(tmp_path / "data" / "lane_times.json", "w") as fh:
+            json.dump([], fh)
+        assert main(["bench-diff", "--root", str(tmp_path)]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["regressions"][0]["kind"] == "obs_invariant"
+
+        with pytest.raises(SystemExit, match="wrong --root"):
+            main(["bench-diff", "--root", str(tmp_path / "empty")])

@@ -328,6 +328,59 @@ class TestRunLog:
         with pytest.raises(json.JSONDecodeError):
             read_runlog(path, strict=True)
 
+    def test_torn_final_line_counted_not_swallowed(self, tmp_path):
+        """ISSUE 11 satellite: a crash mid-write truncates the FINAL
+        record — the reader returns the intact prefix and COUNTS the
+        torn tail (pre-round-14 it silently skipped any malformed
+        line, so the log just looked shorter)."""
+        path = str(tmp_path / "crash.jsonl")
+        with RunLog(path, kind="t", echo=lambda s: None) as rl:
+            for g in range(4):
+                rl.event("gen", generation=g)
+        # Truncate mid-way through the final record's bytes.
+        full = open(path, "rb").read()
+        last_start = full.rstrip(b"\n").rfind(b"\n") + 1
+        cut = last_start + (len(full) - last_start) // 2
+        with open(path, "wb") as fh:
+            fh.write(full[:cut])
+        records, stats = read_runlog(path, with_stats=True)
+        assert stats == {"torn_tail": 1}
+        # The intact prefix: start + 4 gens minus whatever the cut ate
+        # (here the "end" record), in order, fully parsed.
+        assert [r["event"] for r in records] == ["start"] + ["gen"] * 4
+        assert records[-1]["generation"] == 3
+        # A clean file counts zero torn tails.
+        clean = str(tmp_path / "clean.jsonl")
+        with RunLog(clean, kind="t", echo=lambda s: None):
+            pass
+        _recs, stats = read_runlog(clean, with_stats=True)
+        assert stats == {"torn_tail": 0}
+
+    def test_interior_corruption_raises_even_nonstrict(self, tmp_path):
+        """Mid-file garbage is corruption, not a mid-write tear — it
+        must fail loudly instead of mis-parsing into a plausible
+        shorter log (the pre-round-14 behavior)."""
+        path = str(tmp_path / "corrupt.jsonl")
+        with open(path, "w") as fh:
+            fh.write('{"event": "start", "kind": "x"}\n')
+            fh.write('NOT JSON\n')
+            fh.write('{"event": "end", "status": "ok"}\n')
+        with pytest.raises(json.JSONDecodeError, match="corruption"):
+            read_runlog(path)
+
+    def test_unregistered_event_rejected_at_write(self, tmp_path):
+        """The event registry (round 14): names are schema identifiers
+        the incident-timeline join trusts, enforced at write time AND
+        statically (tests/test_timing_guard.py)."""
+        from ccka_tpu.obs import RUNLOG_EVENTS
+
+        rl = RunLog(str(tmp_path / "r.jsonl"), echo=lambda s: None)
+        with pytest.raises(ValueError, match="unregistered RunLog"):
+            rl.event("my_novel_event", x=1)
+        for name in ("eval", "gen", "iter", "incident"):
+            assert name in RUNLOG_EVENTS
+        rl.close()
+
     def test_error_exit_records_status(self, tmp_path):
         path = str(tmp_path / "err.jsonl")
         with pytest.raises(RuntimeError):

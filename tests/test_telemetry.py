@@ -468,7 +468,9 @@ def _service_report():
         pending_pods=2.0, tick_latency_ms=112.5, admission_queue_depth=8,
         sheds_total=24, deferrals_total=9, breaker_transitions_total=3,
         cadence_divisor=2, decide_ms=2.1, fanout_ms=4.2,
-        breaker_states={"0": 0, "1": 2, "2": 1})
+        breaker_states={"0": 0, "1": 2, "2": 1},
+        slo_burn_rate=0.25, slo_burn_rate_slow=0.125,
+        incident_active=1, incidents_total=3, recorder_dumps_total=2)
 
 
 class TestPromExport:
@@ -589,7 +591,10 @@ class TestPromExport:
         gauges = {"ccka_tenant_breaker_state", "ccka_ticks_shed_total",
                   "ccka_admission_queue_depth", "ccka_tick_latency_ms"}
         assert gauges <= set(SERIES)
-        assert gauges == set(SERVICE_ONLY_SERIES)
+        # Round 14 grew the service-only set by the obs gauges (their
+        # own both-direction test below); this one keeps pinning the
+        # round-13 members.
+        assert gauges <= set(SERVICE_ONLY_SERIES)
         paneled = set()
         for _t, expr, _u in _PANEL_DEFS:
             paneled |= referenced_series(expr)
@@ -612,6 +617,45 @@ class TestPromExport:
             {"t": 1}, SERIES["ccka_tenant_breaker_state"][0]) is None
         assert "ccka_tenant_breaker_state" not in render_exposition(
             {"t": 1})
+
+    def test_obs_gauges_cover_both_directions(self):
+        """Round-14 satellite: the incident-grade obs series (SLO burn
+        rate, incident-active flag, recorder dump counter) must be
+        exported, panel-referenced, AND resolve from a real
+        ServiceTickReport — both directions of the parity contract —
+        while a controller TickReport (no obs fields) SKIPS them
+        rather than exporting fake zeros."""
+        import dataclasses
+
+        from ccka_tpu.harness.dashboard import _PANEL_DEFS
+        from ccka_tpu.harness.promexport import (SERIES,
+                                                 SERVICE_ONLY_SERIES,
+                                                 referenced_series,
+                                                 render_exposition,
+                                                 resolve_field)
+
+        gauges = {"ccka_slo_burn_rate", "ccka_incident_active",
+                  "ccka_recorder_dumps_total"}
+        assert gauges <= set(SERIES)
+        assert gauges <= set(SERVICE_ONLY_SERIES)
+        paneled = set()
+        for _t, expr, _u in _PANEL_DEFS:
+            paneled |= referenced_series(expr)
+        assert gauges <= paneled, "obs gauges missing from the dashboard"
+
+        rec = dataclasses.asdict(_service_report())
+        assert resolve_field(rec, SERIES["ccka_slo_burn_rate"][0]) == 0.25
+        assert resolve_field(
+            rec, SERIES["ccka_incident_active"][0]) == 1
+        assert resolve_field(
+            rec, SERIES["ccka_recorder_dumps_total"][0]) == 2
+        text = render_exposition(rec)
+        assert "ccka_slo_burn_rate 0.25" in text
+        assert "ccka_incident_active 1" in text
+        assert "ccka_recorder_dumps_total 2" in text
+        for series in gauges:
+            assert resolve_field({"t": 1}, SERIES[series][0]) is None
+            assert series not in render_exposition({"t": 1})
 
     def test_live_scrape_serves_all_panel_series(self):
         """Drive two controller ticks with an exporter on a real socket

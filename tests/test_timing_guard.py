@@ -177,6 +177,93 @@ def test_apply_all_guard_catches_the_pattern():
     assert not _apply_all_calls(ast.parse(good))
 
 
+# -- RunLog event-name registry guard (round 14) ----------------------------
+#
+# The incident timeline (`obs/incidents.py`) joins RunLog records with
+# trace spans and recorder dumps on tick keys and TRUSTS event names as
+# schema identifiers. Free-text names would silently fork the schema,
+# so every `.event("name", ...)` literal in the tree must come from the
+# declared registry (`obs.runlog.RUNLOG_EVENTS` — RunLog.event also
+# enforces this at write time; the static guard catches call sites that
+# never run in CI).
+
+_RUNLOG_SCAN_TARGETS = SCAN_TARGETS + (os.path.join(ROOT, "scripts"),)
+
+
+def _event_name_literals(tree: ast.AST) -> list[tuple[int, str]]:
+    """(lineno, name) for every ``<expr>.event("literal", ...)`` call.
+    Non-literal first args can't be checked statically — the runtime
+    check in RunLog.event covers those."""
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "event"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.append((node.lineno, node.args[0].value))
+    return out
+
+
+def test_runlog_event_names_registered():
+    from ccka_tpu.obs.runlog import RUNLOG_EVENTS
+
+    violations = []
+    for target in _RUNLOG_SCAN_TARGETS:
+        paths = [target] if os.path.isfile(target) else [
+            os.path.join(dirpath, f)
+            for dirpath, _dirs, files in os.walk(target)
+            if "__pycache__" not in dirpath
+            for f in sorted(files) if f.endswith(".py")]
+        for path in paths:
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+            for lineno, name in _event_name_literals(tree):
+                if name not in RUNLOG_EVENTS:
+                    violations.append(
+                        f"{os.path.relpath(path, ROOT)}:{lineno} "
+                        f"event {name!r}")
+    assert not violations, (
+        "unregistered RunLog event name(s) — add them to "
+        "obs.runlog.RUNLOG_EVENTS next to the writer (the incident "
+        "timeline join trusts event names as schema):\n  "
+        + "\n  ".join(violations))
+
+
+def test_runlog_guard_scans_the_writers():
+    """The registry guard is only worth its pass if it sees the files
+    that actually write run logs — the training drivers, the CLI, and
+    the scripts tree."""
+    paths = []
+    for target in _RUNLOG_SCAN_TARGETS:
+        if os.path.isfile(target):
+            paths.append(target)
+        else:
+            for dirpath, _dirs, files in os.walk(target):
+                paths += [os.path.join(dirpath, f) for f in files
+                          if f.endswith(".py")]
+    assert any(p.endswith(os.path.join("train", "flagship.py"))
+               for p in paths)
+    assert any(p.endswith("train_replay_flagship.py") for p in paths)
+    assert any(p.endswith("cli.py") for p in paths)
+
+
+def test_runlog_guard_catches_the_pattern():
+    """Self-test: an unregistered literal is flagged; registered and
+    non-literal (runtime-checked) forms pass."""
+    from ccka_tpu.obs.runlog import RUNLOG_EVENTS
+
+    bad = ast.parse('rl.event("totally_new_event", x=1)\n')
+    hits = _event_name_literals(bad)
+    assert hits and hits[0][1] not in RUNLOG_EVENTS
+    good = ast.parse('rl.event("gen", x=1)\n')
+    assert all(name in RUNLOG_EVENTS
+               for _ln, name in _event_name_literals(good))
+    dynamic = ast.parse("rl.event(name, x=1)\n")
+    assert not _event_name_literals(dynamic)
+
+
 def test_guard_catches_the_footgun_pattern(tmp_path):
     """Self-test on a synthetic violation: the exact VERDICT weak-#2
     pattern must be flagged, and its fenced fix must pass."""
