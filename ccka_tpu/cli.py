@@ -462,6 +462,46 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="print the loaded series without diffing "
                           "(always exits 0)")
 
+    sperf = sub.add_parser(
+        "perf", help="device-time performance observatory (obs/"
+                     "costmodel + obs/occupancy): run a small packed "
+                     "generate->rollout->summary pipeline on this host "
+                     "and print the compiled-program table (dispatches, "
+                     "FLOPs, bytes accessed, peak memory, achieved "
+                     "roofline fraction) plus the pipeline occupancy "
+                     "ledger")
+    sperf.add_argument("--steps", type=int, default=32,
+                       help="rollout horizon of the probe pipeline "
+                            "(default 32 — CI-sized)")
+    sperf.add_argument("--batch", type=int, default=128,
+                       help="cluster batch of the probe pipeline "
+                            "(default 128)")
+    sperf.add_argument("--modes", default="rule",
+                       help="comma list of megakernel policy modes to "
+                            "probe, out of rule,carbon,neural,plan "
+                            "(default: rule)")
+    sperf.add_argument("--repeats", type=int, default=2,
+                       help="measured pipeline repeats per mode "
+                            "(fresh world each — default 2)")
+    sperf.add_argument("--json", action="store_true",
+                       help="print the full JSON record instead of "
+                            "the rendered table")
+
+    ssca = sub.add_parser(
+        "scaling-curve",
+        help="render the measured BENCH_r*.json + MULTICHIP_r*.json "
+             "history into the weak-scaling curve artifact (ROADMAP "
+             "item 1): a CSV of every multichip point plus the "
+             "per-round cluster-days/sec-per-chip table")
+    ssca.add_argument("--root", default=".",
+                      help="repo root holding the records (default: "
+                           "cwd)")
+    ssca.add_argument("--out", default="scaling_curve.csv",
+                      help="CSV artifact path (default: "
+                           "scaling_curve.csv)")
+    ssca.add_argument("--json", action="store_true",
+                      help="also print the curve as JSON")
+
     sd = sub.add_parser(
         "dashboard", help="render/apply the demo_40 observability stage: "
                           "Grafana Deployment/Service/admin-Secret plus "
@@ -1049,6 +1089,170 @@ def _cmd_bench_diff(args) -> int:
     return 0
 
 
+def _cmd_perf(cfg: FrameworkConfig, args) -> int:
+    """`ccka perf` — the device-time observatory's interactive probe:
+    a small packed generate→rollout→summary pipeline per requested
+    mode, fenced through the span tracer, attributed through the XLA
+    cost model, rendered as the program table + occupancy ledger.
+    Rows where the backend reports no cost analysis render with '-'
+    (attributed-but-unavailable), never crash."""
+    import jax
+
+    from ccka_tpu.obs import costmodel
+    from ccka_tpu.obs import occupancy as occ
+    from ccka_tpu.obs.trace import SpanTracer
+    from ccka_tpu.sim import SimParams
+    from ccka_tpu.sim.megakernel import packed_mode_summary_fn
+    from ccka_tpu.signals.live import make_signal_source
+
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    unknown = [m for m in modes
+               if m not in ("rule", "carbon", "neural", "plan")]
+    if unknown or not modes:
+        raise SystemExit(f"ccka: unknown perf mode(s) {unknown or '?'} "
+                         "— have rule,carbon,neural,plan")
+    steps, batch = max(args.steps, 16), max(args.batch, 32)
+    b_block = min(batch, 128)
+    if batch % b_block:
+        raise SystemExit(f"ccka: --batch {batch} must be a {b_block} "
+                         "multiple")
+    t_chunk = 16
+    platform = jax.devices()[0].platform
+    virtual = platform == "cpu"
+    params = SimParams.from_config(cfg)
+    src = make_signal_source(cfg.cluster, cfg.workload, cfg.sim,
+                             cfg.signals, faults=cfg.faults,
+                             workloads=cfg.workloads)
+    if not hasattr(src, "packed_generate_fn"):
+        raise SystemExit("ccka: the configured signal source has no "
+                         "packed-layout generator — `ccka perf` probes "
+                         "the synthetic/replay pipeline")
+    tracer = SpanTracer()
+    from ccka_tpu.obs.compile import watch_jit
+    gen_jit = watch_jit(jax.jit(src.packed_generate_fn(
+        steps, batch, t_chunk=t_chunk)), "perf.packed_generation",
+        shared_stats=True)
+    stream0 = gen_jit(jax.random.key(7))
+    jax.block_until_ready(stream0)  # compile = setup
+    costmodel.attribute("perf.packed_generation", gen_jit,
+                        jax.random.key(7))
+    bw = costmodel.measured_stream_bandwidth()
+
+    net = None
+    if "neural" in modes:
+        from ccka_tpu.models import ActorCritic, latent_dim
+        from ccka_tpu.sim.megakernel import _obs_dim
+
+        import jax.numpy as jnp
+
+        nnet = ActorCritic(act_dim=latent_dim(cfg.cluster))
+        net = nnet.init(jax.random.key(3), jnp.zeros(
+            (_obs_dim(cfg.cluster.n_pools, cfg.cluster.n_zones),)))
+
+    out_modes = {}
+    achieved_by_name = {}
+    for mode in modes:
+        kfn = packed_mode_summary_fn(
+            params, cfg.cluster, mode, T=steps, b_block=b_block,
+            t_chunk=t_chunk, interpret=virtual, stochastic=not virtual,
+            net_params=net if mode == "neural" else None)
+        warm = kfn(stream0, 0)
+        jax.block_until_ready(warm)  # compile = setup
+        rec = costmodel.attribute(f"megakernel.mode.{mode}", kfn,
+                                  stream0, 0)
+
+        import numpy as np
+
+        def host_i(summary):
+            # The same host stage bench_perf measures (batch-mean KPI
+            # pulls) — omitting it here would make this ledger's host
+            # fraction systematically smaller than the recorded
+            # baseline the same instrument publishes.
+            return {f: float(np.asarray(getattr(summary, f)).mean())
+                    for f in summary._fields}
+
+        ledger, _ = occ.measure_packed_pipeline(
+            lambda i: gen_jit(jax.random.key(100 + i)),
+            lambda s, i: kfn(s, i + 1), host_i,
+            repeats=max(args.repeats, 1), tracer=tracer,
+            label=f"perf.{mode}")
+        kernel_s = (ledger.seconds["kernel"]
+                    / max(ledger.repeats, 1))
+        ach = costmodel.achieved_roofline_fraction(
+            kernel_s,
+            bytes_accessed=rec.bytes_accessed or float(stream0.size * 4),
+            bandwidth_bytes_per_s=bw)
+        achieved_by_name[f"megakernel.mode.{mode}"] = ach
+        out_modes[mode] = {
+            "occupancy": ledger.to_dict(),
+            "kernel_seconds": round(kernel_s, 6),
+            "achieved_roofline_fraction": (round(ach, 6)
+                                           if ach is not None else None),
+        }
+    # Registered-but-idle watch entries (fused kernels that inline
+    # under the mode closures, unrelated subsystems' hot paths) would
+    # drown the table in all-dash rows — show what ran or was analyzed.
+    rows = [r for r in costmodel.program_table()
+            if r["analysis"] != "unattributed"
+            or (r["dispatches"] or 0) > 0]
+    for r in rows:
+        if r["name"] in achieved_by_name:
+            r["achieved_roofline_fraction"] = achieved_by_name[r["name"]]
+    first = out_modes[modes[0]]
+    costmodel.publish_pipeline_snapshot(
+        occupancy=first["occupancy"]["fractions"],
+        achieved_fraction=first["achieved_roofline_fraction"])
+    doc = {"platform": platform, "virtual": virtual, "steps": steps,
+           "batch": batch, "b_block": b_block, "t_chunk": t_chunk,
+           "bandwidth_bytes_per_s": round(bw, 1),
+           "modes": out_modes, "programs": rows}
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    print(costmodel.render_program_table(rows))
+    for mode, m in out_modes.items():
+        print(f"# {mode}: occupancy "
+              + " ".join(f"{k}={v:.3f}" for k, v
+                         in m["occupancy"]["fractions"].items())
+              + f" | kernel {m['kernel_seconds'] * 1e3:.2f}ms | "
+              f"achieved {m['achieved_roofline_fraction']}")
+    if virtual:
+        print("# note: CPU host — interpret-mode deterministic kernel; "
+              "the instrument is the result, not absolute speed",
+              file=sys.stderr)
+    return 0
+
+
+def _cmd_scaling_curve(args) -> int:
+    """`ccka scaling-curve` — the weak-scaling curve artifact: CSV +
+    per-round table from the committed BENCH/MULTICHIP history."""
+    from ccka_tpu.obs.bench_history import scaling_curve, write_scaling_csv
+
+    curve = scaling_curve(args.root)
+    if not curve["points"] and not curve["per_round"]:
+        raise SystemExit(f"ccka: no BENCH_r*.json or MULTICHIP_r*.json "
+                         f"records under {args.root!r} — wrong --root?")
+    path = write_scaling_csv(curve, args.out)
+    if args.json:
+        print(json.dumps(curve, indent=2))
+    else:
+        for p in curve["points"]:
+            rate = p.get("cluster_days_per_sec_per_device")
+            print(f"r{p['round']:02d} {p.get('source', '?'):28s} "
+                  f"dev={p.get('devices', '-')!s:>2s} "
+                  + (f"{rate:,.1f} cd/s/dev "
+                     f"(eff {p.get('weak_scaling_efficiency', '-')})"
+                     if isinstance(rate, (int, float))
+                     else p.get("note", "-")))
+        for r in curve["per_round"]:
+            print(f"r{r['round']:02d} {r['source']:28s} per-chip "
+                  f"{r['cluster_days_per_sec_per_chip']:,.1f} cd/s "
+                  f"[{r.get('platform', '?')}]")
+    print(f"# scaling curve -> {path} ({len(curve['points'])} points, "
+          f"{len(curve['per_round'])} per-round rows)", file=sys.stderr)
+    return 0
+
+
 def _cmd_train(cfg: FrameworkConfig, backend_name: str, iterations: int,
                checkpoint_dir: str, seed: int | None,
                log_every: int, runlog_path: str = "") -> int:
@@ -1357,6 +1561,10 @@ def main(argv: list[str] | None = None) -> int:
             return _cmd_incidents(args)
         if args.command == "bench-diff":
             return _cmd_bench_diff(args)
+        if args.command == "perf":
+            return _cmd_perf(cfg, args)
+        if args.command == "scaling-curve":
+            return _cmd_scaling_curve(args)
         if args.command == "train":
             return _cmd_train(cfg, args.backend, args.iterations,
                               args.checkpoint_dir, args.seed,

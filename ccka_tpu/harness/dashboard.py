@@ -85,6 +85,17 @@ _PANEL_DEFS = (
     ("SLO burn rate", "ccka_slo_burn_rate", "percentunit"),
     ("Incident active", "ccka_incident_active", "short"),
     ("Recorder dumps (session)", "ccka_recorder_dumps_total", "short"),
+    # Device-time observatory panels (round 15; obs/costmodel +
+    # obs/occupancy): where device time goes and how close to the
+    # roofline the measured kernel stage runs — the operator sees
+    # "kernel 60% occupied, 0.9 of roofline, shard 3 lagging" on the
+    # SAME board as the fleet KPIs that throughput serves.
+    ("Program dispatches (session)", "ccka_program_dispatches_total",
+     "short"),
+    ("Achieved roofline", "ccka_achieved_roofline_fraction",
+     "percentunit"),
+    ("Kernel occupancy", "ccka_pipeline_occupancy", "percentunit"),
+    ("Shard imbalance", "ccka_shard_imbalance", "short"),
     # Workload-family panels (ccka_tpu/workloads): per-family queue
     # pressure and the session's SLO accounting, on the same board as
     # the fleet cost/SLO panels the families trade against.

@@ -149,6 +149,29 @@ SERIES: dict[str, tuple[str, str]] = {
     "ccka_recorder_dumps_total": (
         "recorder_dumps_total",
         "Cumulative checksummed flight-recorder dumps this session"),
+    # Device-time observatory series (round 15; obs/costmodel +
+    # obs/occupancy): the compile registry's dispatch counter and the
+    # observatory's last published pipeline measurement — achieved
+    # roofline fraction, kernel-stage occupancy, and the mesh's
+    # max/mean shard imbalance. Service-only: the fleet service's obs
+    # block fills them; a single-cluster controller's scrape
+    # legitimately omits them, and absent measurements SKIP rather
+    # than export fake zeros.
+    "ccka_program_dispatches_total": (
+        "program_dispatches_total",
+        "Cumulative watched-program device dispatches this session"),
+    "ccka_achieved_roofline_fraction": (
+        "achieved_roofline_fraction",
+        "Achieved fraction of the memory roofline for the last "
+        "attributed kernel-stage measurement"),
+    "ccka_pipeline_occupancy": (
+        "pipeline_occupancy.kernel",
+        "Kernel-stage fraction of the last measured packed-pipeline "
+        "occupancy ledger"),
+    "ccka_shard_imbalance": (
+        "shard_imbalance",
+        "Max/mean per-shard kernel time across the mesh "
+        "(1.0 = perfectly balanced)"),
     "ccka_applied": ("applied", "1 if every patch applied this tick"),
     "ccka_verified": ("verified", "1 if read-back matched intent"),
     "ccka_tick": ("t", "Controller tick counter"),
@@ -177,6 +200,8 @@ SERVICE_ONLY_SERIES = frozenset({
     "ccka_admission_queue_depth", "ccka_tick_latency_ms",
     "ccka_slo_burn_rate", "ccka_incident_active",
     "ccka_recorder_dumps_total",
+    "ccka_program_dispatches_total", "ccka_achieved_roofline_fraction",
+    "ccka_pipeline_occupancy", "ccka_shard_imbalance",
 })
 
 _LABEL = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")

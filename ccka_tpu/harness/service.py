@@ -326,6 +326,16 @@ class ServiceTickReport:
     incident_active: int = 0          # burning OR a fresh incident
     incidents_total: int = 0          # session incident stamps
     recorder_dumps_total: int = 0     # session checksummed captures
+    # Device-time observatory surfaces (round 15; obs/costmodel +
+    # obs/occupancy): the compile registry's session dispatch count,
+    # plus whatever the observatory last PUBLISHED (`ccka perf` /
+    # bench --perf-only writes a pipeline snapshot). None/{} means no
+    # measurement exists — the exporter then SKIPS the series, the
+    # established never-fake-zeros contract.
+    program_dispatches_total: "int | None" = None
+    achieved_roofline_fraction: "float | None" = None
+    pipeline_occupancy: dict = dataclasses.field(default_factory=dict)
+    shard_imbalance: "float | None" = None
 
 
 class FleetService:
@@ -762,6 +772,7 @@ class FleetService:
                              if self.incidents is not None else 0),
             recorder_dumps_total=(self.recorder.dumps_total
                                   if self.recorder is not None else 0),
+            **self._perf_surfaces(),
         )
         self.log_fn(
             f"service t={t}: {report.admitted}/{self.n} fresh, "
@@ -769,6 +780,23 @@ class FleetService:
             f"{report.bulkhead_skipped} bulkheaded, "
             f"latency {report.tick_latency_ms:.1f}ms")
         return report
+
+    def _perf_surfaces(self) -> dict:
+        """The round-15 observatory gauges' tick fields: dict lookups
+        only (no device work, no probes) — the obs layer's budget rules
+        here exactly as they rule the recorder. With the obs layer off
+        every field stays at its skip value."""
+        if self.burn is None:  # the obs layer's hard "off" gate
+            return {}
+        from ccka_tpu.obs import costmodel
+
+        snap = costmodel.pipeline_snapshot() or {}
+        return {
+            "program_dispatches_total": costmodel.total_dispatches(),
+            "achieved_roofline_fraction": snap.get("achieved_fraction"),
+            "pipeline_occupancy": snap.get("occupancy") or {},
+            "shard_imbalance": snap.get("shard_imbalance"),
+        }
 
     def _observe_tick(self, t: int, t0: float, lanes, shed: int,
                       scraped_ok, per_np, applied: int,

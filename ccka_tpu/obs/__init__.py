@@ -19,12 +19,23 @@ configured a metrics fabric for but never applied to itself):
 - `obs.burnrate` — fast+slow-window SLO burn-rate engine behind the
   `ccka_slo_burn_rate` / `ccka_incident_active` gauges.
 - `obs.bench_history` — BENCH_r*.json + lane_times.json as one schema'd
-  series with a CI-friendly regression diff (`ccka bench-diff`).
+  series with a CI-friendly regression diff (`ccka bench-diff`) and the
+  weak-scaling curve artifact (`ccka scaling-curve`).
+- `obs.costmodel` — XLA cost-model attribution: compiled-program
+  registry (FLOPs / bytes accessed / peak memory from
+  `Compiled.cost_analysis()`/`memory_analysis()`), achieved-roofline
+  fractions, and the hand-count vs XLA byte cross-check behind
+  `ccka perf` (round 15).
+- `obs.occupancy` — the pipeline occupancy ledger: fenced per-stage
+  (generation/kernel/host) and per-shard timings for the packed
+  megakernel pipeline, with the max/mean shard-imbalance metric.
 """
 
 from ccka_tpu.obs.bench_history import (  # noqa: F401
     bench_diff,
     load_bench_history,
+    scaling_curve,
+    write_scaling_csv,
 )
 from ccka_tpu.obs.burnrate import (  # noqa: F401
     BurnRate,
@@ -35,6 +46,23 @@ from ccka_tpu.obs.compile import (  # noqa: F401
     compile_report,
     stats_for,
     watch_jit,
+)
+from ccka_tpu.obs.costmodel import (  # noqa: F401
+    ProgramRecord,
+    achieved_roofline_fraction,
+    attribute,
+    crosscheck_bytes,
+    pipeline_snapshot,
+    program_table,
+    publish_pipeline_snapshot,
+    total_dispatches,
+)
+from ccka_tpu.obs.occupancy import (  # noqa: F401
+    PIPELINE_STAGES,
+    OccupancyLedger,
+    measure_packed_pipeline,
+    measure_shard_times,
+    shard_imbalance,
 )
 from ccka_tpu.obs.incidents import (  # noqa: F401
     TRIGGERS,

@@ -22,10 +22,23 @@ Two regression classes:
   itself: the round-12 recovery invariants (zero duplicate/lost
   patches, bitwise resume), the round-13 overload isolation ratio
   (<= ``max_healthy_ratio``), the round-14 recorder overhead
-  (< ``max_recorder_overhead`` of p50 tick latency), and the lane
-  budget (the round's BEST complete run must be under
-  `tests/conftest._LANE_BUDGET_S` — single noisy re-runs don't fail
-  the gate, a round that cannot get under it does.)
+  (< ``max_recorder_overhead`` of p50 tick latency), the round-15
+  device-time observatory invariants (achieved roofline fraction in
+  (0, ``max_achieved_fraction``], occupancy fractions summing to ~1,
+  shard imbalance >= 1, observatory-on/off bitwise, measurement
+  overhead within ``max_perf_overhead`` — and a PARTIAL perf record,
+  one missing a declared mode's occupancy or attribution, is itself a
+  regression), and the lane budget (the round's BEST complete run
+  must be under `tests/conftest._LANE_BUDGET_S` — single noisy
+  re-runs don't fail the gate, a round that cannot get under it
+  does.)
+
+This module also renders the measured record as the weak-scaling
+artifact ROADMAP item 1 promises (:func:`scaling_curve` /
+:func:`write_scaling_csv`, behind `ccka scaling-curve`): every
+multichip row across BENCH_r08+ plus the legacy MULTICHIP_r0x driver
+wrappers as one curve, with a per-round cluster-days/sec-per-chip
+table beside it.
 
 Host-side, stdlib-only (no jax): the sentinel must run in any CI
 context, including one with no accelerator stack at all.
@@ -134,10 +147,20 @@ def _extract_metrics(doc: dict) -> dict:
     prov = doc.get("provenance") or {}
     if prov.get("platform"):
         out["platform"] = prov["platform"]
-    # Full-bench headline (the r01-era metric, whenever present).
-    if doc.get("metric") == "sim_cluster_days_per_sec_per_chip" \
-            and isinstance(doc.get("value"), (int, float)):
-        out["headline_cluster_days_per_sec"] = float(doc["value"])
+    # Full-bench headline. The r01–r05 driver wrappers nest the bench
+    # JSON line under "parsed" (None when the run failed) — unwrap it,
+    # or the repo's only measured TPU headline (r02) silently vanishes
+    # from the series.
+    head = doc
+    if doc.get("metric") != "sim_cluster_days_per_sec_per_chip" \
+            and isinstance(doc.get("parsed"), dict):
+        head = doc["parsed"]
+        dev = head.get("device")
+        if "platform" not in out and isinstance(dev, str) and "/" in dev:
+            out["platform"] = dev.rsplit("/", 1)[1]
+    if head.get("metric") == "sim_cluster_days_per_sec_per_chip" \
+            and isinstance(head.get("value"), (int, float)):
+        out["headline_cluster_days_per_sec"] = float(head["value"])
     # Round-12 recovery invariants.
     inv = doc.get("invariants")
     if isinstance(inv, dict):
@@ -152,6 +175,65 @@ def _extract_metrics(doc: dict) -> dict:
         out["recorder_overhead_frac"] = obs["recorder_overhead_frac"]
         if "bitwise_identical" in obs:
             out["obs_bitwise_identical"] = obs["bitwise_identical"]
+    # Round-15 device-time observatory (stage record or nested "perf").
+    perf = doc if isinstance(doc.get("modes"), dict) else doc.get("perf")
+    if isinstance(perf, dict) and isinstance(perf.get("modes"), dict):
+        out.update(_extract_perf(perf,
+                                 full_stage=doc.get("stage")
+                                 == "--perf-only"))
+    return out
+
+
+def _extract_perf(perf: dict, *, full_stage: bool) -> dict:
+    """The round-15 perf-observatory invariants a record states about
+    itself. ``full_stage`` (a dedicated ``--perf-only`` record, the
+    BENCH_r15 path) additionally requires ALL FOUR megakernel modes and
+    the 8-shard mesh section — a record that silently dropped a mode
+    would otherwise pass every per-mode gate."""
+    out: dict = {"perf_achieved": {}, "perf_occupancy_sum": {},
+                 "perf_partial": []}
+    modes = perf["modes"]
+    if full_stage:
+        for required in ("rule", "carbon", "neural", "plan"):
+            if required not in modes:
+                out["perf_partial"].append(f"mode {required!r} missing")
+    bitwise_all = True
+    for name, m in sorted(modes.items()):
+        if not isinstance(m, dict):
+            out["perf_partial"].append(f"mode {name!r} not a record")
+            continue
+        frac = m.get("achieved_roofline_fraction")
+        occ = (m.get("occupancy") or {}).get("fractions")
+        if frac is None:
+            out["perf_partial"].append(
+                f"mode {name!r} missing achieved_roofline_fraction")
+        else:
+            out["perf_achieved"][name] = float(frac)
+        if not isinstance(occ, dict) or not occ:
+            out["perf_partial"].append(
+                f"mode {name!r} missing occupancy fractions")
+        else:
+            out["perf_occupancy_sum"][name] = float(sum(occ.values()))
+        if m.get("bitwise_identical") is False:
+            bitwise_all = False
+    mesh = perf.get("mesh8")
+    if isinstance(mesh, dict):
+        if mesh.get("shard_imbalance") is None:
+            out["perf_partial"].append("mesh8 missing shard_imbalance")
+        else:
+            out["perf_imbalance"] = float(mesh["shard_imbalance"])
+        occ = (mesh.get("occupancy") or {}).get("fractions")
+        if isinstance(occ, dict) and occ:
+            out["perf_occupancy_sum"]["mesh8"] = float(sum(occ.values()))
+    elif full_stage:
+        out["perf_partial"].append("mesh8 section missing")
+    obs = perf.get("observatory")
+    if isinstance(obs, dict):
+        if obs.get("overhead_frac") is not None:
+            out["perf_overhead_frac"] = float(obs["overhead_frac"])
+        if obs.get("bitwise_all") is False:
+            bitwise_all = False
+    out["perf_bitwise_all"] = bitwise_all
     return out
 
 
@@ -160,7 +242,10 @@ def bench_diff(history: dict, *,
                lane_budget_s: float = _LANE_BUDGET_S,
                max_headline_drop: float = 0.5,
                max_healthy_ratio: float = 1.05,
-               max_recorder_overhead: float = 0.05) -> dict:
+               max_recorder_overhead: float = 0.05,
+               max_achieved_fraction: float = 1.25,
+               max_occupancy_sum_err: float = 0.02,
+               max_perf_overhead: float = 0.05) -> dict:
     """Diff the history; returns {"comparisons": [...], "regressions":
     [...], "ok": bool}. Empty regressions = exit 0 for the CLI.
 
@@ -275,5 +360,233 @@ def bench_diff(history: dict, *,
             regressions.append({
                 "kind": "obs_invariant", "round": rnd,
                 "detail": "recorder-on/off runs no longer bitwise"})
+        # Round-15 device-time observatory invariants: achieved
+        # roofline fractions must be physically plausible, occupancy
+        # fractions must account for the measured pipeline, shard
+        # imbalance is >= 1 by definition, and the observatory must
+        # neither steer decisions nor cost more than its budget. A
+        # PARTIAL record (a declared mode with no occupancy or no
+        # attribution) is itself a regression — the measurement
+        # substrate existing is the round's acceptance criterion.
+        for what in rec.get("perf_partial", []):
+            regressions.append({
+                "kind": "perf_invariant", "round": rnd,
+                "detail": f"partial perf record: {what}"})
+        for mode, frac in rec.get("perf_achieved", {}).items():
+            if not 0.0 < frac <= max_achieved_fraction:
+                regressions.append({
+                    "kind": "perf_invariant", "round": rnd,
+                    "mode": mode, "value": frac,
+                    "threshold": max_achieved_fraction,
+                    "detail": "achieved roofline fraction outside "
+                              f"(0, {max_achieved_fraction}] — the byte "
+                              "count or bandwidth probe is wrong"})
+        for mode, total in rec.get("perf_occupancy_sum", {}).items():
+            if abs(total - 1.0) > max_occupancy_sum_err:
+                regressions.append({
+                    "kind": "perf_invariant", "round": rnd,
+                    "mode": mode, "value": total,
+                    "threshold": max_occupancy_sum_err,
+                    "detail": "occupancy fractions do not sum to ~1 — "
+                              "a stage went unmeasured or the record "
+                              "is corrupt"})
+        if rec.get("perf_imbalance") is not None \
+                and rec["perf_imbalance"] < 1.0 - 1e-6:
+            regressions.append({
+                "kind": "perf_invariant", "round": rnd,
+                "value": rec["perf_imbalance"],
+                "detail": "shard imbalance below 1 (max/mean cannot "
+                          "be) — the record is corrupt"})
+        if rec.get("perf_bitwise_all") is False:
+            regressions.append({
+                "kind": "perf_invariant", "round": rnd,
+                "detail": "observatory-on/off decision streams no "
+                          "longer bitwise identical"})
+        if rec.get("perf_overhead_frac", 0.0) > max_perf_overhead:
+            regressions.append({
+                "kind": "perf_invariant", "round": rnd,
+                "value": rec["perf_overhead_frac"],
+                "threshold": max_perf_overhead,
+                "detail": "observatory measurement overhead exceeded "
+                          "the 5%-of-kernel-stage bound"})
     return {"comparisons": comparisons, "regressions": regressions,
             "ok": not regressions}
+
+
+# ---- the weak-scaling curve artifact (ROADMAP item 1) ---------------------
+
+
+_MULTI_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")
+
+# CSV column order for write_scaling_csv — one row per measured (or
+# legacy-skipped) multichip point plus the per-round headline rows.
+SCALING_CSV_COLUMNS = (
+    "round", "file", "source", "platform", "virtual", "devices",
+    "per_device_batch", "steps", "cluster_days_per_sec_per_device",
+    "cluster_days_per_sec_aggregate", "weak_scaling_efficiency",
+    "engine", "note",
+)
+
+
+def _multichip_points(rnd: int, fname: str, section: dict) -> list[dict]:
+    rows = []
+    prov = section.get("provenance") or {}
+    base = {
+        "round": rnd, "file": fname, "source": "multichip",
+        "platform": section.get("platform") or prov.get("platform"),
+        "virtual": bool(section.get("virtual_cpu_mesh",
+                                    section.get("virtual", False))),
+        "steps": section.get("steps"),
+        "engine": section.get("engine"),
+    }
+    for _key, r in sorted((section.get("weak_scaling") or {}).items()):
+        rows.append(dict(
+            base, devices=r.get("devices"),
+            per_device_batch=r.get("per_device_batch"),
+            cluster_days_per_sec_per_device=r.get(
+                "cluster_days_per_sec_per_device"),
+            cluster_days_per_sec_aggregate=r.get(
+                "cluster_days_per_sec_aggregate"),
+            weak_scaling_efficiency=r.get("weak_scaling_efficiency")))
+    pb = section.get("plan_playback")
+    if isinstance(pb, dict):
+        rows.append(dict(
+            base, source="multichip_plan_playback",
+            engine=pb.get("engine", base["engine"]),
+            devices=pb.get("devices"),
+            per_device_batch=pb.get("per_device_batch"),
+            steps=pb.get("steps", base["steps"]),
+            cluster_days_per_sec_per_device=pb.get(
+                "cluster_days_per_sec_per_device"),
+            cluster_days_per_sec_aggregate=pb.get(
+                "cluster_days_per_sec_aggregate")))
+    return rows
+
+
+def scaling_curve(root: str) -> dict:
+    """The measured multichip record as ONE weak-scaling series:
+    {"points": [...], "per_round": [...]}.
+
+    Points come from every BENCH_r*.json multichip section (the r08+
+    weak-scaling sweeps and plan-playback rows, whether the record is a
+    stage record or a full sweep) plus the legacy MULTICHIP_r0x driver
+    wrappers (rounds 1–5 recorded only a skip marker — included as
+    explicitly-skipped rows, because a curve that silently starts at
+    round 8 would hide that the first five rounds measured nothing).
+    The per-round table is cluster-days/sec-per-chip per round from the
+    headline records and the round-15 perf stage's single-chip row,
+    platform-labeled so a CPU row can never masquerade as a TPU one."""
+    points: list[dict] = []
+    per_round: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json"))):
+        m = _MULTI_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            points.append({"round": rnd,
+                           "file": os.path.basename(path),
+                           "source": "multichip_legacy",
+                           "note": f"unreadable: {e}"})
+            continue
+        points.append({
+            "round": rnd, "file": os.path.basename(path),
+            "source": "multichip_legacy",
+            "devices": doc.get("n_devices"),
+            "note": ("driver wrapper, stage skipped — no measured rate"
+                     if doc.get("skipped") else "driver wrapper"),
+        })
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        fname = os.path.basename(path)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue  # load_bench_history already reports unreadables
+        section = doc.get("multichip")
+        if isinstance(section, dict):
+            points.extend(_multichip_points(rnd, fname, section))
+        pb9 = doc.get("multichip_plan_playback")
+        if isinstance(pb9, dict):
+            # The r09 stage record nests the row under "row" with mesh/
+            # provenance beside it; later records inline the row.
+            row = pb9.get("row") if isinstance(pb9.get("row"), dict) \
+                else pb9
+            points.extend(_multichip_points(
+                rnd, fname,
+                {"plan_playback": row,
+                 "virtual": pb9.get("virtual_cpu_mesh", False),
+                 "steps": row.get("steps"),
+                 "platform": (pb9.get("provenance") or {})
+                 .get("platform")}))
+        prov = doc.get("provenance") or {}
+        # Same legacy unwrap as _extract_metrics: the r01–r05 wrappers
+        # nest the headline under "parsed".
+        head = doc
+        platform = prov.get("platform")
+        if doc.get("metric") != "sim_cluster_days_per_sec_per_chip" \
+                and isinstance(doc.get("parsed"), dict):
+            head = doc["parsed"]
+            dev = head.get("device")
+            if platform is None and isinstance(dev, str) and "/" in dev:
+                platform = dev.rsplit("/", 1)[1]
+        if head.get("metric") == "sim_cluster_days_per_sec_per_chip" \
+                and isinstance(head.get("value"), (int, float)):
+            per_round.append({
+                "round": rnd, "file": fname, "source": "headline",
+                "platform": platform,
+                "cluster_days_per_sec_per_chip": float(head["value"]),
+                "best_batch": head.get("best_batch"),
+                "best_mode": head.get("best_mode"),
+            })
+        perf = doc if isinstance(doc.get("modes"), dict) \
+            else doc.get("perf")
+        if isinstance(perf, dict) \
+                and isinstance(perf.get("single_chip"), dict):
+            sc = perf["single_chip"]
+            if isinstance(sc.get("cluster_days_per_sec"), (int, float)):
+                per_round.append({
+                    "round": rnd, "file": fname,
+                    "source": "perf_single_chip",
+                    "platform": perf.get("platform"),
+                    "virtual": perf.get("virtual"),
+                    "cluster_days_per_sec_per_chip": float(
+                        sc["cluster_days_per_sec"]),
+                    "engine": sc.get("engine"),
+                })
+    points.sort(key=lambda r: (r["round"], r.get("devices") or 0,
+                               r.get("source", "")))
+    per_round.sort(key=lambda r: (r["round"], r["source"]))
+    return {"points": points, "per_round": per_round}
+
+
+def write_scaling_csv(curve: dict, path: str) -> str:
+    """The curve as a flat CSV (the publishable artifact): multichip
+    points first, then the per-round headline rows with
+    ``source=headline``/``perf_single_chip`` and the per-chip rate in
+    the per-device column."""
+    import csv
+
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w", newline="", encoding="utf-8") as fh:
+        w = csv.DictWriter(fh, fieldnames=SCALING_CSV_COLUMNS,
+                           extrasaction="ignore")
+        w.writeheader()
+        for row in curve.get("points", []):
+            w.writerow(row)
+        for row in curve.get("per_round", []):
+            w.writerow(dict(
+                row, devices=1,
+                cluster_days_per_sec_per_device=row.get(
+                    "cluster_days_per_sec_per_chip"),
+                engine=row.get("engine"),
+                note=row.get("best_mode")))
+    return path

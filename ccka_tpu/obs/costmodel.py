@@ -1,0 +1,338 @@
+"""XLA cost-model attribution — what a compiled program SAYS it costs.
+
+Every speed claim in this repo ultimately reduces to "program X moved
+Y bytes / did Z FLOPs in T seconds". Until now only T was measured:
+bench's roofline floors hand-count the bytes a stage *must* stream, and
+nothing reads what XLA itself reports for the programs it compiled. This
+module closes that loop (the honest-measurement prerequisite for
+ROADMAP item 1's ≥100k cluster-days/sec claim):
+
+- :func:`attribute` — AOT-lower a jitted entry point with concrete
+  arguments, compile it, and record ``Compiled.cost_analysis()`` (FLOPs,
+  bytes accessed) + ``Compiled.memory_analysis()`` (argument/output/temp
+  sizes → peak bytes) under a registry name. Backends where either call
+  raises or returns nothing (the CPU *interpret* path reports per-op
+  garbage for Pallas emulation on some versions; TPU tunnels may
+  return None) degrade to an attributed row with ``flops=None`` —
+  recorded as unavailable, never invented.
+- :func:`program_table` — the registry joined with `obs/compile.py`'s
+  dispatch counters: every watched entry point becomes one row
+  {name, dispatches, compiles, flops, bytes, peak memory, analysis
+  source}. `ccka perf` prints exactly this table.
+- :func:`achieved_roofline_fraction` — a measured span's achieved
+  fraction of the memory roofline: ``(bytes / seconds) / measured
+  streaming bandwidth`` (and the compute fraction when a peak FLOP rate
+  is stated; the max of the two is the binding one). The bench-diff
+  invariant gate holds this to (0, 1.25] — fractions materially above 1
+  mean the byte count or the bandwidth probe is wrong, which is a
+  measurement bug, not a fast kernel.
+- :func:`crosscheck_bytes` — bench's hand-counted byte floors vs the
+  XLA-reported bytes for the same program: both are recorded, and a
+  >2x disagreement warns (the hand count is a *lower bound* — XLA
+  counting LESS than the hand count, or more than 2x it, means one of
+  the two models is wrong).
+- :func:`publish_pipeline_snapshot` / :func:`pipeline_snapshot` — the
+  latest measured occupancy/imbalance/achieved-fraction triple, for
+  promexport's ``ccka_pipeline_occupancy`` / ``ccka_shard_imbalance`` /
+  ``ccka_achieved_roofline_fraction`` gauges (a fleet service exports
+  what the observatory last measured; absent = series skipped, never a
+  fake 0).
+
+Host-side and allocation-free on the hot path: attribution lowers a
+program ONCE (outside any timed region), and the per-tick gauge reads
+are dict lookups.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+import time
+from typing import Callable, Mapping
+
+from ccka_tpu.obs.compile import compile_report, stats_for
+
+_REGISTRY: dict[str, "ProgramRecord"] = {}
+_LOCK = threading.Lock()
+
+# The observatory's latest pipeline measurement (occupancy fractions,
+# shard imbalance, achieved fraction) — published by bench_perf /
+# `ccka perf` / any occupancy measurement, read by the fleet service's
+# obs block at export time.
+_PIPELINE_SNAPSHOT: dict = {}
+
+
+@dataclasses.dataclass
+class ProgramRecord:
+    """One attributed compiled program (see module docstring)."""
+
+    name: str
+    flops: float | None = None
+    bytes_accessed: float | None = None
+    peak_memory_bytes: float | None = None
+    # "xla" when cost_analysis returned numbers; "unavailable" when the
+    # backend raised or returned nothing (the row still exists — an
+    # absent row and an unattributable program are different facts).
+    analysis: str = "unavailable"
+    error: str | None = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _unwrap(fn: Callable) -> Callable:
+    """The lowerable callable behind a `watch_jit` wrapper (WatchedJit
+    delegates unknown attributes, but unwrapping keeps the attribution
+    call itself out of the wrapper's dispatch counters)."""
+    return getattr(fn, "_fn", fn)
+
+
+def _cost_numbers(compiled) -> tuple[float | None, float | None]:
+    """(flops, bytes_accessed) from a Compiled's cost analysis. JAX has
+    returned both a bare dict and a single-element list of dicts across
+    versions; both are accepted. Missing keys resolve to None."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, Mapping):
+        return None, None
+    flops = ca.get("flops")
+    nbytes = ca.get("bytes accessed")
+    return (float(flops) if flops is not None else None,
+            float(nbytes) if nbytes is not None else None)
+
+
+def _memory_peak(compiled) -> float | None:
+    """Peak live bytes from memory_analysis(): arguments + outputs +
+    temps (the program's resident footprint while it runs)."""
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return None
+    total = 0.0
+    for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes"):
+        v = getattr(ma, attr, None)
+        if v is None:
+            return None
+        total += float(v)
+    return total
+
+
+def attribute(name: str, fn: Callable, *args, **kwargs) -> ProgramRecord:
+    """Lower+compile ``fn`` with these concrete arguments and register
+    its XLA-reported cost under ``name`` (the `watch_jit` registry name,
+    so :func:`program_table` can join dispatch counts). A backend where
+    lowering, compiling, or either analysis raises — or where the
+    analysis returns nothing — yields an attributed row with
+    ``flops=None`` and ``analysis="unavailable"`` rather than an error:
+    attribution must never take down the pipeline it measures."""
+    rec = ProgramRecord(name=name)
+    try:
+        lowered = _unwrap(fn).lower(*args, **kwargs)
+        compiled = lowered.compile()
+    except Exception as e:  # noqa: BLE001 — recorded, not raised
+        rec.error = f"lower/compile: {repr(e)[:160]}"
+        with _LOCK:
+            _REGISTRY[name] = rec
+        return rec
+    try:
+        rec.flops, rec.bytes_accessed = _cost_numbers(compiled)
+    except Exception as e:  # noqa: BLE001 — graceful None path
+        rec.error = f"cost_analysis: {repr(e)[:160]}"
+    try:
+        rec.peak_memory_bytes = _memory_peak(compiled)
+    except Exception as e:  # noqa: BLE001 — graceful None path
+        rec.error = ((rec.error + "; ") if rec.error else "") + \
+            f"memory_analysis: {repr(e)[:160]}"
+    if rec.flops is not None or rec.bytes_accessed is not None:
+        rec.analysis = "xla"
+    with _LOCK:
+        _REGISTRY[name] = rec
+    return rec
+
+
+def registered(name: str) -> ProgramRecord | None:
+    with _LOCK:
+        return _REGISTRY.get(name)
+
+
+def clear_registry() -> None:
+    """Tests only — the registry is process-global like obs/compile's."""
+    with _LOCK:
+        _REGISTRY.clear()
+    _PIPELINE_SNAPSHOT.clear()
+
+
+def program_table() -> list[dict]:
+    """One row per known program: the attribution registry joined with
+    the compile watch's dispatch counters. Programs that were watched
+    but never attributed still appear (flops=None, "unattributed") —
+    the table answers "what ran", not only "what was analyzed"."""
+    with _LOCK:
+        attributed = dict(_REGISTRY)
+    names = sorted(set(attributed) | set(compile_report()))
+    rows = []
+    for name in names:
+        rec = attributed.get(name)
+        stats = stats_for(name)
+        rows.append({
+            "name": name,
+            "dispatches": stats.calls if stats is not None else None,
+            "compiles": stats.compiles if stats is not None else None,
+            "flops": rec.flops if rec else None,
+            "bytes_accessed": rec.bytes_accessed if rec else None,
+            "peak_memory_bytes": rec.peak_memory_bytes if rec else None,
+            "analysis": rec.analysis if rec else "unattributed",
+            **({"error": rec.error} if rec and rec.error else {}),
+        })
+    return rows
+
+
+def total_dispatches() -> int:
+    """Sum of calls across every watched entry point this session (the
+    ``ccka_program_dispatches_total`` gauge)."""
+    return sum(s.get("calls", 0) for s in compile_report().values())
+
+
+def render_program_table(rows: list[dict]) -> str:
+    """The `ccka perf` table: fixed columns, ``-`` for unavailable."""
+
+    def num(v, unit=""):
+        if v is None:
+            return "-"
+        if abs(v) >= 1e12:
+            return f"{v / 1e12:.2f}T{unit}"
+        if abs(v) >= 1e9:
+            return f"{v / 1e9:.2f}G{unit}"
+        if abs(v) >= 1e6:
+            return f"{v / 1e6:.2f}M{unit}"
+        if abs(v) >= 1e3:
+            return f"{v / 1e3:.1f}k{unit}"
+        return f"{v:.3g}{unit}" if isinstance(v, float) else f"{v}{unit}"
+
+    header = (f"{'program':44s} {'disp':>6s} {'flops':>9s} "
+              f"{'bytes':>9s} {'peak mem':>9s} {'achieved':>9s}  analysis")
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        ach = r.get("achieved_roofline_fraction")
+        lines.append(
+            f"{r['name'][:44]:44s} "
+            f"{r['dispatches'] if r['dispatches'] is not None else '-':>6} "
+            f"{num(r['flops']):>9s} {num(r['bytes_accessed']):>9s} "
+            f"{num(r['peak_memory_bytes']):>9s} "
+            f"{(f'{ach:.4f}' if ach is not None else '-'):>9s}  "
+            f"{r['analysis']}")
+    return "\n".join(lines)
+
+
+# ---- roofline arithmetic --------------------------------------------------
+
+
+_BW_CACHE: dict = {}
+
+
+def measured_stream_bandwidth() -> float:
+    """Achievable streaming bandwidth (bytes/s) of the default device —
+    the same best-of-5 distinct-scalar saxpy probe bench.py uses, AT
+    THE SAME 128 MB operand size (reads x, writes y → 2x the buffer),
+    cached per process. The size parity matters: a small probe can land
+    largely in cache and report a several-fold higher "streaming" rate,
+    which would make `ccka perf` and `bench.py --perf-only` disagree on
+    the achieved fraction of the identical kernel on the identical
+    host. The distinct scalars defeat backends that short-circuit
+    byte-identical repeats; an implausible ~0s best falls back to a
+    generous 2 TB/s ceiling so the achieved fractions stay meaningful
+    instead of exploding."""
+    if "bytes_per_s" not in _BW_CACHE:
+        import jax
+        import jax.numpy as jnp
+
+        n = 1 << 25  # 32M f32 = 128 MB — bench.py's probe size
+        x = jnp.zeros((n,), jnp.float32)
+        f = jax.jit(lambda v, c: v + c)
+        jax.block_until_ready(f(x, 0.0))  # compile
+        best = float("inf")
+        for i in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(x, float(i + 1)))
+            best = min(best, time.perf_counter() - t0)
+        bw = 2.0 * 4.0 * n / max(best, 1e-9)
+        if best < 1e-4:
+            print("# [obs] bandwidth probe implausible — using 2 TB/s "
+                  "ceiling", file=sys.stderr)
+            bw = 2e12
+        _BW_CACHE["bytes_per_s"] = bw
+    return _BW_CACHE["bytes_per_s"]
+
+
+def achieved_roofline_fraction(seconds: float, *,
+                               bytes_accessed: float | None,
+                               bandwidth_bytes_per_s: float | None = None,
+                               flops: float | None = None,
+                               peak_flops_per_s: float | None = None
+                               ) -> float | None:
+    """Fraction of the roofline a measured span achieved: the max of
+    the memory fraction (``bytes/s over streaming bandwidth``) and the
+    compute fraction (``flops/s over peak``, when a peak is stated).
+    None when neither resource is quantified — an unknowable fraction
+    is not 0."""
+    if seconds <= 0.0:
+        return None
+    fracs = []
+    if bytes_accessed is not None and bytes_accessed > 0:
+        bw = bandwidth_bytes_per_s or measured_stream_bandwidth()
+        fracs.append((bytes_accessed / seconds) / max(bw, 1e-9))
+    if flops is not None and flops > 0 and peak_flops_per_s:
+        fracs.append((flops / seconds) / max(peak_flops_per_s, 1e-9))
+    return max(fracs) if fracs else None
+
+
+def crosscheck_bytes(name: str, hand_bytes: float,
+                     xla_bytes: float | None, *,
+                     tolerance: float = 2.0,
+                     warn: Callable[[str], None] | None = None) -> dict:
+    """Bench's hand-counted byte floor vs the XLA-reported bytes for the
+    same program. Both land on the record; a ratio outside
+    [1/tolerance, tolerance] warns — the hand count is the program's
+    irreducible traffic, so XLA reporting LESS means one model is wrong,
+    and >2x more means the floor badly understates real traffic."""
+    out = {"hand_bytes": float(hand_bytes), "xla_bytes": xla_bytes,
+           "ratio": None, "agree": None}
+    if xla_bytes is None or hand_bytes <= 0:
+        return out
+    ratio = xla_bytes / hand_bytes
+    out["ratio"] = round(ratio, 4)
+    out["agree"] = bool(1.0 / tolerance <= ratio <= tolerance)
+    if not out["agree"]:
+        (warn or (lambda m: print(m, file=sys.stderr)))(
+            f"# [obs] byte-count disagreement for {name!r}: hand-counted "
+            f"{hand_bytes:.3g} vs XLA-reported {xla_bytes:.3g} "
+            f"({ratio:.2f}x — outside the {tolerance:.0f}x band); "
+            "recording both")
+    return out
+
+
+# ---- pipeline snapshot (promexport bridge) --------------------------------
+
+
+def publish_pipeline_snapshot(*, occupancy: Mapping[str, float],
+                              shard_imbalance: float | None = None,
+                              achieved_fraction: float | None = None
+                              ) -> None:
+    """Publish the observatory's latest pipeline measurement for the
+    exporter gauges. Occupancy is the stage-fraction dict (generation/
+    kernel/host, summing to ~1)."""
+    _PIPELINE_SNAPSHOT.clear()
+    _PIPELINE_SNAPSHOT.update({
+        "occupancy": {k: float(v) for k, v in occupancy.items()},
+        "shard_imbalance": (float(shard_imbalance)
+                            if shard_imbalance is not None else None),
+        "achieved_fraction": (float(achieved_fraction)
+                              if achieved_fraction is not None else None),
+    })
+
+
+def pipeline_snapshot() -> dict | None:
+    """The latest published measurement, or None (gauges then skip)."""
+    return dict(_PIPELINE_SNAPSHOT) if _PIPELINE_SNAPSHOT else None

@@ -1,0 +1,158 @@
+"""Pipeline occupancy ledger + per-shard imbalance — where device time goes.
+
+The packed generate→rollout→summary pipeline is the throughput headline
+(ARCHITECTURE §6), and ROADMAP item 1's next lever — double-buffering
+generation under the kernel — is a claim about *overlap*: it only means
+anything against a measured baseline of how the synchronous pipeline's
+wall time splits between generation, the kernel launch, and host work.
+This module is that baseline's instrument:
+
+- :class:`OccupancyLedger` — per-stage seconds accumulated from FENCED
+  spans (every stage closes through `obs/trace.SpanTracer` with a
+  device fence where device work ran — the AST guard in
+  `tests/test_timing_guard.py` holds this file to the same rule as
+  everyone else). ``fractions()`` normalizes over the measured stages,
+  so the fractions sum to 1.0 by construction and `ccka bench-diff`'s
+  invariant gate can hold |sum - 1| to rounding error.
+- :func:`measure_packed_pipeline` — drive the three stages
+  (``generate_fn`` → ``kernel_fn`` → ``host_fn``) for N repeats under
+  one tracer and return (ledger, last kernel output). The callables
+  own their arguments; this function owns only the fencing and the
+  bookkeeping, so every megakernel mode and the sharded wrappers
+  instrument identically.
+- :func:`measure_shard_times` — per-shard kernel seconds: shard ``i``'s
+  lane block run through the single-device entry with the SAME
+  `parallel.sharded_kernel.shard_seed` offset the mesh launch gives it,
+  each fenced individually. The mesh launch itself can only expose the
+  *max* shard time (one fence covers the slowest chip); timing the
+  per-shard programs sequentially is what makes the imbalance
+  attributable to a shard rather than inferred.
+- :func:`shard_imbalance` — max/mean of those per-shard times (>= 1 by
+  construction on any real measurement; the bench-diff gate rejects
+  records claiming otherwise).
+
+Decision non-interference is structural: the instruments never touch
+the computation's inputs or seeds — the same (stream, seed) runs with
+or without the ledger, and `bench.py --perf-only` re-proves the outputs
+bitwise identical on every record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+from ccka_tpu.obs.trace import SpanTracer
+
+# The canonical stage vocabulary. "generation": packed exo-stream
+# synthesis; "kernel": the fused megakernel launch; "host": everything
+# after the fence (finalize reads, numpy reductions, bookkeeping).
+PIPELINE_STAGES = ("generation", "kernel", "host")
+
+
+@dataclasses.dataclass
+class OccupancyLedger:
+    """Accumulated per-stage seconds for one measured pipeline."""
+
+    seconds: dict = dataclasses.field(
+        default_factory=lambda: {s: 0.0 for s in PIPELINE_STAGES})
+    repeats: int = 0
+
+    def add(self, stage: str, dur_s: float) -> None:
+        if stage not in self.seconds:
+            raise ValueError(f"unknown pipeline stage {stage!r} — the "
+                             f"ledger vocabulary is {PIPELINE_STAGES}")
+        self.seconds[stage] += float(dur_s)
+
+    @property
+    def total_s(self) -> float:
+        return sum(self.seconds.values())
+
+    def fractions(self) -> dict:
+        """Stage fractions over the measured total — sums to 1.0 by
+        construction (the bench-diff invariant), or {} before any
+        measurement (never fake zeros)."""
+        total = self.total_s
+        if total <= 0.0:
+            return {}
+        return {k: v / total for k, v in self.seconds.items()}
+
+    def to_dict(self) -> dict:
+        return {
+            "seconds": {k: round(v, 6) for k, v in self.seconds.items()},
+            "fractions": {k: round(v, 6)
+                          for k, v in self.fractions().items()},
+            "repeats": self.repeats,
+        }
+
+
+def measure_packed_pipeline(generate_fn: Callable[[int], object],
+                            kernel_fn: Callable[[object, int], object],
+                            host_fn: Callable[[object], object]
+                            | None = None,
+                            *, repeats: int = 1,
+                            tracer: SpanTracer | None = None,
+                            label: str = "pipeline"
+                            ) -> tuple[OccupancyLedger, object]:
+    """Measure the packed generate→rollout→summary pipeline.
+
+    ``generate_fn(i)`` returns the packed stream for repeat ``i`` (a
+    fresh world per repeat — byte-identical repeat work can be
+    short-circuited by tunneled backends, the bench's long-standing
+    pathology); ``kernel_fn(stream, i)`` launches the fused kernel and
+    returns its summary pytree; ``host_fn(summary)`` is the host-side
+    stage (finalize reads / reductions), timed un-fenced because by
+    contract the kernel stage's fence already drained the device.
+
+    Both device stages are fenced via ``device_span`` — the recorded
+    durations cover execution, not dispatch.
+    """
+    tr = tracer or SpanTracer()
+    ledger = OccupancyLedger()
+    out = host_out = None
+    for i in range(max(repeats, 1)):
+        with tr.device_span(f"{label}.generation", repeat=i) as sp:
+            stream = generate_fn(i)
+            sp.fence(stream)
+        ledger.add("generation", sp.dur_s)
+        with tr.device_span(f"{label}.kernel", repeat=i) as sp:
+            out = kernel_fn(stream, i)
+            sp.fence(out)
+        ledger.add("kernel", sp.dur_s)
+        with tr.span(f"{label}.host", repeat=i) as sp:
+            host_out = host_fn(out) if host_fn is not None else out
+        ledger.add("host", sp.dur_s)
+        ledger.repeats += 1
+    return ledger, host_out
+
+
+def measure_shard_times(shard_fn: Callable[[int], object],
+                        n_shards: int, *,
+                        tracer: SpanTracer | None = None,
+                        label: str = "shard") -> list[float]:
+    """Per-shard kernel seconds: ``shard_fn(i)`` runs shard ``i``'s lane
+    block (with its `shard_seed` offset) and returns the device outputs
+    to fence on. Shards run SEQUENTIALLY so each measurement is that
+    shard's own compute, not the mesh barrier's max."""
+    tr = tracer or SpanTracer()
+    times = []
+    for i in range(n_shards):
+        with tr.device_span(f"{label}.{i}", shard=i) as sp:
+            out = shard_fn(i)
+            sp.fence(out)
+        times.append(sp.dur_s)
+    return times
+
+
+def shard_imbalance(per_shard_s: Sequence[float]) -> float | None:
+    """Max/mean shard time across the mesh — 1.0 is a perfectly
+    balanced sweep, and any real measurement is >= 1 by construction
+    (the bench-diff invariant). None on an empty or degenerate
+    measurement."""
+    ts = [float(t) for t in per_shard_s]
+    if not ts:
+        return None
+    mean = sum(ts) / len(ts)
+    if mean <= 0.0:
+        return None
+    return max(ts) / mean

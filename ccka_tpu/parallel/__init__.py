@@ -32,6 +32,7 @@ from ccka_tpu.parallel.sharded import (  # noqa: F401
     sharded_batched_rollout_summary,
 )
 from ccka_tpu.parallel.sharded_kernel import (  # noqa: F401
+    shard_lane_blocks,
     shard_plan_stream,
     shard_seed,
     sharded_carbon_megakernel_rollout_summary,

@@ -112,6 +112,21 @@ def _split_batch(B: int, n: int, b_block: int, what: str) -> int:
     return b_loc
 
 
+def shard_lane_blocks(exo_packed, n_shards: int) -> list:
+    """Per-shard lane blocks of a packed ``[T_pad, rows, B]`` stream —
+    the exact contiguous batch blocks the ``data``-axis sharding hands
+    each chip, in shard order. The device-time observatory
+    (`obs/occupancy.measure_shard_times`) replays block ``i`` through
+    the single-device kernel with ``shard_seed(seed, i, blocks)`` to
+    time each shard's OWN compute (a mesh launch's one fence covers
+    only the slowest shard); the same slicing+seed arithmetic is what
+    makes those sequential replays bitwise the mesh shards' work."""
+    _T_pad, _rows, B = exo_packed.shape
+    b_loc = _split_batch(B, n_shards, 1, "stream")
+    return [exo_packed[:, :, i * b_loc:(i + 1) * b_loc]
+            for i in range(n_shards)]
+
+
 # ---- shard-local packed synthesis ----------------------------------------
 
 

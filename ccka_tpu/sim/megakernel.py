@@ -1799,6 +1799,99 @@ _fused_plan_packed_donate = watch_jit(
     _fused_plan_packed_donate, "megakernel.plan_packed_summary_donate",
     hot=True, warmup_compiles=6)
 
+# The four packed policy modes the device-time observatory sweeps
+# (`bench.py --perf-only`, `ccka perf`, `obs/occupancy.py`): mode name →
+# the fused packed entry's compile-watch name, so attribution rows and
+# dispatch counters join on one vocabulary. "rule" and "carbon" share a
+# fused entry (the carbon statics re-key the same program family) —
+# the observatory's per-mode attribution names disambiguate them.
+PACKED_MODE_WATCH_NAMES = {
+    "rule": "megakernel.packed_summary",
+    "carbon": "megakernel.packed_summary",
+    "neural": "megakernel.neural_packed_summary",
+    "plan": "megakernel.plan_packed_summary",
+}
+
+
+def packed_mode_summary_fn(params: SimParams, cluster, mode: str, *,
+                           T: int, b_block: int = 512,
+                           t_chunk: int = 64, interpret: bool = False,
+                           stochastic: bool = True, net_params=None):
+    """One JITTED ``(stream, seed) -> EpisodeSummary`` closure per packed
+    policy mode — the device-time observatory's unit of timing and XLA
+    attribution (`obs/costmodel.attribute` lowers exactly this callable,
+    `bench.py --perf-only` and `ccka perf` both drive it, so the program
+    the table names is the program the pipeline dispatches). All four
+    modes consume the SAME packed stream layout, making their occupancy
+    ledgers directly comparable.
+
+    "rule"/"carbon" close over the profile actions; "plan" plays a
+    broadcast neutral-action plan (playback throughput is
+    content-independent — the stream layout is what's measured);
+    "neural" requires ``net_params`` and hoists the wrapper's host-side
+    prep (slo mask via numpy, population detection) OUT of the closure
+    so the whole thing stays traceable under an outer jit."""
+    from ccka_tpu.policy.rule import (neutral_action, offpeak_action,
+                                      peak_action)
+
+    kw = dict(stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+              interpret=interpret)
+    if mode == "rule":
+        off, peak = offpeak_action(cluster), peak_action(cluster)
+
+        def fn(stream, seed):
+            return megakernel_summary_from_packed(
+                params, off, peak, stream, T, seed, **kw)
+    elif mode == "carbon":
+        off, peak = offpeak_action(cluster), peak_action(cluster)
+
+        def fn(stream, seed):
+            return carbon_megakernel_summary_from_packed(
+                params, off, peak, stream, T, seed, **kw)
+    elif mode == "neural":
+        if net_params is None:
+            raise ValueError("packed_mode_summary_fn: mode 'neural' "
+                             "needs net_params")
+        from ccka_tpu.policy.constraints import slo_pool_mask
+
+        P, Z = cluster.n_pools, cluster.n_zones
+        dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
+        if was_single:
+            net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                      net_params)
+        slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
+        nkw = dict(T=T, P=P, Z=Z, K=int(params.provision_pipeline_k),
+                   WD=int(params.wl_batch_deadline_ticks),
+                   stochastic=stochastic, b_block=b_block,
+                   t_chunk=t_chunk, slo_mask=slo, mlp_dims=dims,
+                   interpret=interpret)
+
+        def fn(stream, seed):
+            s = _fused_neural_packed_summary(params, net_params, stream,
+                                             jnp.int32(seed), **nkw)
+            return (jax.tree.map(lambda x: x[0], s) if was_single
+                    else s)
+    elif mode == "plan":
+        T_pad = math.ceil(T / t_chunk) * t_chunk
+        base = neutral_action(cluster)
+        actions = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (T_pad,) + x.shape), base)
+        plan2d = pack_plan(actions, T_pad)
+
+        def fn(stream, seed):
+            return plan_megakernel_summary_from_packed(
+                params, cluster, plan2d, stream, T, seed, **kw)
+    else:
+        raise ValueError(
+            f"unknown packed mode {mode!r} — have "
+            f"{tuple(PACKED_MODE_WATCH_NAMES)}")
+    # Watched under the MODE's name (shared_stats: one closure per
+    # geometry, one hot path to the reader) so `ccka perf`'s program
+    # table joins dispatch counters and cost attribution on one row —
+    # the inner fused entries inline under this jit and count nothing.
+    return watch_jit(jax.jit(fn), f"megakernel.mode.{mode}", hot=True,
+                     warmup_compiles=4, shared_stats=True)
+
 
 def unpack_exo(exo_packed: jnp.ndarray, T: int, Z: int) -> ExogenousTrace:
     """Inverse of `_pack_exo` — [T_pad, rows, B] → [B, T, ...] traces.

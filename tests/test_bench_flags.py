@@ -181,3 +181,26 @@ def test_overload_only_flag_and_stage_wiring():
 
     src = inspect.getsource(bench.bench_overload)
     assert "overload_scoreboard" in src
+
+
+def test_perf_only_flag_and_stage_wiring():
+    """Round 15: the device-time observatory has a record path
+    (`--perf-only`, with `--perf-mesh-only` as its virtual-mesh child)
+    and the main sweep carries the stage — argparse contract only (the
+    observatory itself is exercised in tests/test_perf_obs.py and the
+    BENCH_r15 record)."""
+    parser_src = open(bench.__file__, encoding="utf-8").read()
+    assert "--perf-only" in parser_src
+    assert "--perf-mesh-only" in parser_src
+    assert "bench_perf" in parser_src
+    # bench_perf delegates to the shared observatory modules (ccka perf
+    # drives the same ones — one implementation, two drivers) and the
+    # shared per-mode closure builder.
+    import inspect
+
+    src = inspect.getsource(bench.bench_perf)
+    assert "costmodel" in src and "occupancy" in src
+    src_k = inspect.getsource(bench._perf_kernel_fn)
+    assert "packed_mode_summary_fn" in src_k
+    src_m = inspect.getsource(bench.bench_perf_mesh)
+    assert "shard_lane_blocks" in src_m and "measure_shard_times" in src_m

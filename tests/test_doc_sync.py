@@ -526,3 +526,93 @@ class TestWorkloadScenarioClaims:
         m3 = re.search(r"n≥(\d+)\s+kernel-paired\s+traces\s+\(BASELINE"
                        r"\s+round11", readme)
         assert m3 and int(m3.group(1)) <= sb["n_traces"]
+
+
+class TestPerfObservatoryClaims:
+    """Round 15's device-time observatory (ISSUE 12 docs satellite):
+    README's "Performance observatory" section is PARSED against the
+    BASELINE round15 record — including the refreshed single-chip
+    headline the section exists to keep honest."""
+
+    def test_round15_record_is_self_describing(self, baseline):
+        r15 = baseline["published"]["round15"]["perf_stage"]
+        # The acceptance criteria hold on the record itself: achieved
+        # fractions physically plausible, occupancy accounts for the
+        # pipeline, imbalance is a real max/mean, the observatory
+        # neither steers nor overspends.
+        for mode, frac in r15["achieved_roofline_fraction"].items():
+            assert 0.0 < frac <= 1.25, mode
+        assert set(r15["achieved_roofline_fraction"]) == {
+            "rule", "carbon", "neural", "plan"}
+        for occ in (r15["occupancy_rule"], r15["occupancy_mesh8"]):
+            assert abs(sum(occ.values()) - 1.0) < 0.02
+            assert set(occ) == {"generation", "kernel", "host"}
+        assert r15["shard_imbalance"] >= 1.0
+        obs = r15["observatory"]
+        assert obs["bitwise_all"] is True
+        assert obs["overhead_frac"] <= obs["overhead_gate_frac"]
+        assert obs["overhead_gate_ok"] is True
+        cross = r15["bytes_crosscheck_rule"]
+        assert cross["hand_bytes"] > 0 and cross["xla_bytes"] > 0
+        assert cross["ratio"] is not None
+        assert r15["single_chip"]["cluster_days_per_sec"] > 0
+        # A CPU record must say so — the virtual label is load-bearing.
+        assert r15["virtual"] is True and r15["platform"] == "cpu"
+
+    def test_readme_occupancy_claim(self, readme, baseline):
+        occ = (baseline["published"]["round15"]["perf_stage"]
+               ["occupancy_rule"])
+        m = re.search(r"generation\s+([\d.]+)%\s*/\s*kernel\s+([\d.]+)%"
+                      r"\s*/\s*host\s+([\d.]+)%", readme)
+        assert m, ("README's rule-mode occupancy claim no longer "
+                   "states the split in the pinned form — update the "
+                   "claim AND this regex together")
+        gen, ker, host = (float(g) / 100 for g in m.groups())
+        assert abs(gen - occ["generation"]) < 5e-3
+        assert abs(ker - occ["kernel"]) < 5e-3
+        assert abs(host - occ["host"]) < 5e-3
+
+    def test_readme_imbalance_claim(self, readme, baseline):
+        r15 = baseline["published"]["round15"]["perf_stage"]
+        m = re.search(r"shard\s+imbalance\s+of\s+([\d.]+)", readme)
+        assert m, "README's shard-imbalance claim lost its pinned form"
+        assert abs(float(m.group(1)) - r15["shard_imbalance"]) < 5e-3
+
+    def test_readme_overhead_and_crosscheck_claims(self, readme,
+                                                   baseline):
+        r15 = baseline["published"]["round15"]["perf_stage"]
+        m = re.search(r"span\s+cost\s+is\s+([\d.]+)%\s+of", readme)
+        assert m, "README's observatory-overhead claim lost its form"
+        assert abs(float(m.group(1)) / 100
+                   - r15["observatory"]["overhead_frac"]) < 5e-4
+        assert float(m.group(1)) / 100 < 0.05
+        m2 = re.search(r"XLA\s+reports\s+([\d.]+)×\s+the\s+hand-counted",
+                       readme)
+        assert m2, "README's byte-crosscheck claim lost its form"
+        assert abs(float(m2.group(1))
+                   - r15["bytes_crosscheck_rule"]["ratio"]) < 5e-3
+
+    def test_readme_refreshed_single_chip_headline(self, readme,
+                                                   baseline):
+        sc = (baseline["published"]["round15"]["perf_stage"]
+              ["single_chip"])
+        m = re.search(r"\*\*([\d.]+)\s*\ncluster-days/sec\*\*\s+"
+                      r"\(B=(\d+)\s+×\s+(\d+)\s+steps,\s+CPU\s+"
+                      r"interpret", readme)
+        assert m, ("README's refreshed single-chip headline lost its "
+                   "pinned form (the number must stay labeled CPU "
+                   "interpret)")
+        assert abs(float(m.group(1)) - sc["cluster_days_per_sec"]) < 0.05
+        assert int(m.group(2)) == sc["batch"]
+        assert int(m.group(3)) == sc["steps"]
+
+    def test_architecture_has_section_17(self):
+        arch = _read("ARCHITECTURE.md")
+        assert "## 17. The device-time performance observatory" in arch
+        for phrase in ("Cost-model attribution", "OccupancyLedger",
+                       "shard_lane_blocks", "shard_imbalance",
+                       "packed_mode_summary_fn", "(0, 1.25]",
+                       "historical", "scaling-curve"):
+            assert phrase in arch, phrase
+        # §6 carries the staleness pointer the refresh satellite adds.
+        assert "historical; see §17" in arch

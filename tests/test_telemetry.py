@@ -470,7 +470,12 @@ def _service_report():
         cadence_divisor=2, decide_ms=2.1, fanout_ms=4.2,
         breaker_states={"0": 0, "1": 2, "2": 1},
         slo_burn_rate=0.25, slo_burn_rate_slow=0.125,
-        incident_active=1, incidents_total=3, recorder_dumps_total=2)
+        incident_active=1, incidents_total=3, recorder_dumps_total=2,
+        program_dispatches_total=123,
+        achieved_roofline_fraction=0.75,
+        pipeline_occupancy={"generation": 0.3, "kernel": 0.6,
+                            "host": 0.1},
+        shard_imbalance=1.25)
 
 
 class TestPromExport:
@@ -656,6 +661,73 @@ class TestPromExport:
         for series in gauges:
             assert resolve_field({"t": 1}, SERIES[series][0]) is None
             assert series not in render_exposition({"t": 1})
+
+    def test_perf_gauges_cover_both_directions(self):
+        """Round-15 satellite: the device-time observatory series
+        (program dispatches, achieved roofline fraction, kernel-stage
+        occupancy via the dotted dict spec, shard imbalance) must be
+        exported, panel-referenced, AND resolve from a real
+        ServiceTickReport — both directions — while a controller
+        TickReport (no perf fields) SKIPS them rather than exporting
+        fake zeros, and a service tick with NO published measurement
+        (the snapshot-less default) skips the measurement-backed three
+        while still stating its dispatch counter."""
+        import dataclasses
+
+        from ccka_tpu.harness.dashboard import _PANEL_DEFS
+        from ccka_tpu.harness.promexport import (SERIES,
+                                                 SERVICE_ONLY_SERIES,
+                                                 referenced_series,
+                                                 render_exposition,
+                                                 resolve_field)
+        from ccka_tpu.harness.service import ServiceTickReport
+
+        gauges = {"ccka_program_dispatches_total",
+                  "ccka_achieved_roofline_fraction",
+                  "ccka_pipeline_occupancy", "ccka_shard_imbalance"}
+        assert gauges <= set(SERIES)
+        assert gauges <= set(SERVICE_ONLY_SERIES)
+        paneled = set()
+        for _t, expr, _u in _PANEL_DEFS:
+            paneled |= referenced_series(expr)
+        assert gauges <= paneled, "perf gauges missing from dashboard"
+
+        rec = dataclasses.asdict(_service_report())
+        assert resolve_field(
+            rec, SERIES["ccka_program_dispatches_total"][0]) == 123
+        assert resolve_field(
+            rec, SERIES["ccka_achieved_roofline_fraction"][0]) == 0.75
+        assert resolve_field(
+            rec, SERIES["ccka_pipeline_occupancy"][0]) == 0.6
+        assert resolve_field(
+            rec, SERIES["ccka_shard_imbalance"][0]) == 1.25
+        text = render_exposition(rec)
+        assert "ccka_program_dispatches_total 123" in text
+        assert "ccka_achieved_roofline_fraction 0.75" in text
+        assert "ccka_pipeline_occupancy 0.6" in text
+        assert "ccka_shard_imbalance 1.25" in text
+        # Controller-skips contract: a TickReport has none of these.
+        for series in gauges:
+            assert resolve_field({"t": 1}, SERIES[series][0]) is None
+            assert series not in render_exposition({"t": 1})
+        # Measurement-less service tick: the defaulted report states
+        # dispatches only when filled, and the snapshot-backed gauges
+        # skip (None / empty dict) instead of exporting zeros.
+        bare = dataclasses.asdict(ServiceTickReport(
+            t=1, n_tenants=2, admitted=2, deferred=0, shed=0,
+            cadence_skipped=0, bulkhead_skipped=0, scrape_failed=0,
+            probes=0, applied=2, fanout_deferred=0, slo_ok=2,
+            cost_usd_hr=1.0, carbon_g_hr=10.0, pending_pods=0.0,
+            tick_latency_ms=5.0, admission_queue_depth=2,
+            sheds_total=0, deferrals_total=0,
+            breaker_transitions_total=0, cadence_divisor=1,
+            decide_ms=1.0, fanout_ms=1.0))
+        bare_text = render_exposition(bare)
+        for series in ("ccka_achieved_roofline_fraction",
+                       "ccka_pipeline_occupancy",
+                       "ccka_shard_imbalance",
+                       "ccka_program_dispatches_total"):
+            assert series not in bare_text
 
     def test_live_scrape_serves_all_panel_series(self):
         """Drive two controller ticks with an exporter on a real socket

@@ -127,6 +127,14 @@ def test_guard_scans_a_nontrivial_tree():
     # The round-13 service hot loop is inside the scanned tree (its
     # deadline clock reads are the newest instance of the footgun).
     assert any(os.path.join("harness", "service.py") in p for p in files)
+    # Round 15: the device-time observatory's own modules time device
+    # work for a living — they are held to the fenced-span rule like
+    # everyone else (occupancy's ledger, costmodel's bandwidth probe,
+    # the sharded kernel's per-shard observation helpers).
+    assert any(os.path.join("obs", "occupancy.py") in p for p in files)
+    assert any(os.path.join("obs", "costmodel.py") in p for p in files)
+    assert any(os.path.join("parallel", "sharded_kernel.py") in p
+               for p in files)
 
 
 _HARNESS_DIR = os.path.join(ROOT, "ccka_tpu", "harness")
@@ -310,3 +318,57 @@ def test_guard_catches_the_footgun_pattern(tmp_path):
         "        pass\n")
     assert violations_of(bad_mono), "guard missed un-fenced monotonic"
     assert not violations_of(good_mono), "guard flagged the span form"
+
+    # Round-15 variant (the observatory's own shape): a per-stage
+    # pipeline timer that reads perf_counter around a kernel launch
+    # WITHOUT a fence would record dispatch, not execution — flagged;
+    # the device_span form `obs/occupancy.py` actually uses passes.
+    bad_stage = (
+        "import time\n"
+        "import jax\n"
+        "def measure_stage(kernel_fn, stream):\n"
+        "    t0 = time.perf_counter()\n"
+        "    out = kernel_fn(jax.device_put(stream))\n"
+        "    return out, time.perf_counter() - t0\n")
+    good_stage = (
+        "import time\n"
+        "import jax\n"
+        "def measure_stage(tracer, kernel_fn, stream):\n"
+        "    with tracer.device_span('pipeline.kernel') as sp:\n"
+        "        out = kernel_fn(jax.device_put(stream))\n"
+        "        sp.fence(out)\n"
+        "    return out, sp.dur_s\n")
+    assert violations_of(bad_stage), \
+        "guard missed the un-fenced occupancy-timer shape"
+    assert not violations_of(good_stage), \
+        "guard flagged the fenced occupancy ledger form"
+
+
+def test_observatory_modules_time_only_through_spans():
+    """Round-15 satellite self-check: the new observatory modules
+    (obs/occupancy.py, and the per-shard helpers in sharded_kernel.py)
+    contain NO bare timing calls at all — every duration they record
+    comes out of a closed Span (`sp.dur_s`), so the fenced-span rule
+    holds by construction, not just by the scoped heuristic above.
+    costmodel.py's bandwidth probe is the one allowed direct timer —
+    and it must carry its fence in the same scope."""
+    for rel in (os.path.join("ccka_tpu", "obs", "occupancy.py"),
+                os.path.join("ccka_tpu", "parallel",
+                             "sharded_kernel.py")):
+        path = os.path.join(ROOT, rel)
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+        assert not _timing_calls(tree), (
+            f"{rel} reads a wall clock directly — observatory timing "
+            "must come from closed spans (sp.dur_s)")
+    cm = os.path.join(ROOT, "ccka_tpu", "obs", "costmodel.py")
+    with open(cm, encoding="utf-8") as fh:
+        src = fh.read()
+    tree = ast.parse(src)
+    lines = src.splitlines(keepends=True)
+    for call in _timing_calls(tree):
+        fn = _enclosing_function(tree, call)
+        seg = _segment(lines, fn)
+        assert any(m in seg for m in _FENCE_MARKERS), (
+            "costmodel.py times device work without a fence at line "
+            f"{call.lineno}")
