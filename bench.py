@@ -2294,6 +2294,558 @@ def _perf_mesh_virtual_fallback() -> dict | None:
         timeout_s=1200, env=env)
 
 
+# ---- streaming rollout pipeline stage (ISSUE 13, the BENCH_r16 path) -----
+
+# The paired single-chip sweep: (batch, b_block, T, block_T, t_chunk)
+# per row. The small-batch rows are the throughput headline's geometry
+# (best kernel-stage rate on the CPU record); the fleet rows are where
+# the 10^4-cluster chunked path lives and where overlap matters.
+STREAM_SWEEP = (
+    (128, 128, 768, 384, 192),
+    (256, 256, 384, 192, 96),
+    (1024, 256, 384, 192, 96),
+    (2048, 512, 192, 96, 96),
+)
+
+
+def _bare_kernel_rate(cfg, params, src, *, B, BB, T, TC,
+                      repeats: int = 6, label: str) -> dict:
+    """The round-15 headline PROTOCOL at an arbitrary geometry: one
+    resident stream, bare best-of-N kernel calls with distinct seeds
+    (`bench_perf`'s ``dt_bare``). The streaming record uses it twice —
+    once at the r15 geometry (the same-session replication of the
+    554.66 headline) and once at the streaming headline geometry — so
+    its "improves over round 15" claim is one protocol at two
+    geometries, not two protocols."""
+    from ccka_tpu.sim.megakernel import packed_mode_summary_fn
+
+    platform = jax.devices()[0].platform
+    virtual = platform == "cpu"
+    gen = jax.jit(src.packed_generate_fn(T, B, t_chunk=TC))
+    stream0 = gen(jax.random.key(7))
+    jax.block_until_ready(stream0)
+    kfn = packed_mode_summary_fn(params, cfg.cluster, "rule", T=T,
+                                 b_block=BB, t_chunk=TC,
+                                 interpret=virtual,
+                                 stochastic=not virtual)
+    jax.block_until_ready(kfn(stream0, 0).cost_usd)   # compile = setup
+    call_i = [100]
+
+    def once():
+        call_i[0] += 1
+        jax.block_until_ready(kfn(stream0, call_i[0]).cost_usd)
+
+    dt = _time_best(once, repeats,
+                    bytes_touched=float(stream0.size * 4),
+                    label=label)
+    days = T * cfg.sim.dt_s / 86400.0
+    return {
+        "batch": B, "b_block": BB, "steps": T, "t_chunk": TC,
+        "seconds": round(dt, 6) if dt else None,
+        "cluster_days_per_sec": (round(B * days / dt, 2) if dt
+                                 else None),
+    }
+
+
+def _r15_replication(cfg, params, src, *, repeats: int = 6) -> dict:
+    """The round-15 headline, REPLICATED by its own protocol in THIS
+    session: the r15 geometry (B=256, b_block=128, T=96, t_chunk=32)
+    timed exactly as `bench_perf` timed it. Hosts drift between
+    sessions, so the streaming record's "improves over round 15"
+    comparison is made against THIS row, with the historical 554.66
+    quoted beside it — a cross-session absolute would attribute host
+    weather to the code."""
+    row = _bare_kernel_rate(cfg, params, src, B=256, BB=128, T=96,
+                            TC=32, repeats=repeats,
+                            label="stream.r15_replication")
+    row["engine"] = ("megakernel packed rule (single device) — the "
+                     "round-15 protocol re-measured this session")
+    row["historical_round15_cluster_days_per_sec"] = 554.66
+    return row
+
+
+def _stream_sync_baseline(cfg, params, src, *, B, BB, T, TC,
+                          repeats: int, label: str,
+                          interpret: bool, stochastic: bool) -> dict:
+    """The SYNCHRONOUS baseline of the streaming comparison — exactly
+    the round-15 pipeline unit (`obs.occupancy.measure_packed_pipeline`
+    shape): full-horizon packed generation, one kernel launch, host KPI
+    reads, every stage fenced. Best-of-N wall + that run's stage
+    split."""
+    from ccka_tpu.sim.megakernel import packed_mode_summary_fn
+
+    gen_full = jax.jit(src.packed_generate_fn(T, B, t_chunk=TC))
+    kfn = packed_mode_summary_fn(params, cfg.cluster, "rule", T=T,
+                                 b_block=BB, t_chunk=TC,
+                                 interpret=interpret,
+                                 stochastic=stochastic)
+    s0 = gen_full(jax.random.key(7))
+    jax.block_until_ready(s0)
+    jax.block_until_ready(kfn(s0, 0).cost_usd)   # compile = setup
+    stream_bytes = float(s0.size * 4)
+    walls, gens, kerns, hosts = [], [], [], []
+    for i in range(max(repeats, 1)):
+        with _TRACER.device_span(f"{label}.sync.generation",
+                                 repeat=i) as sp:
+            stream = gen_full(jax.random.key(300 + i))
+            sp.fence(stream)
+        g = sp.dur_s
+        with _TRACER.device_span(f"{label}.sync.kernel", repeat=i) as sp:
+            out = kfn(stream, i)
+            sp.fence(out.cost_usd)
+        k = sp.dur_s
+        with _TRACER.span(f"{label}.sync.host", repeat=i) as sp:
+            {f: float(np.asarray(getattr(out, f)).mean())
+             for f in out._fields}
+        h = sp.dur_s
+        walls.append(g + k + h)
+        gens.append(g)
+        kerns.append(k)
+        hosts.append(h)
+    best = int(np.argmin(walls))
+    wall = walls[best]
+    fr = {"generation": gens[best] / wall, "kernel": kerns[best] / wall,
+          "host": hosts[best] / wall}
+    return {
+        "engine": "unblocked synchronous pipeline (round-15 unit: "
+                  "full-stream generation -> one launch -> host reads, "
+                  "fenced per stage)",
+        "wall_s": round(wall, 6),
+        "kernel_s": round(kerns[best], 6),
+        "occupancy_fractions": {s: round(v, 6) for s, v in fr.items()},
+        "repeats": len(walls),
+        "stream_bytes": stream_bytes,
+        "roofline_floor_s": round(_roofline_floor_s(stream_bytes), 6),
+    }
+
+
+def bench_stream(cfg, *, sweep=STREAM_SWEEP, repeats: int = 4,
+                 chunked_batch: int = 10240,
+                 chunked_chunk: int = 1024) -> dict:
+    """Streaming rollout pipeline stage (ISSUE 13): for each sweep row,
+    the SYNCHRONOUS unblocked baseline (the round-15 pipeline unit,
+    fenced per stage) against the DOUBLE-BUFFERED blocked drive
+    (`sim/streaming.py` — one fence around the whole block loop), plus
+    the bitwise gates the record carries about itself:
+
+    - blocked-vs-unblocked: the pipelined summary equals the
+      single-launch carry rollout on the concatenated blocks, bitwise;
+    - pipelined-vs-sync(blocked): the overlap machinery reorders
+      dispatch only — same blocks, same seeds, bitwise;
+    - the donation chain holds exactly TWO stream buffers per chip.
+
+    Rates come in two honest flavors per row: ``cluster_days_per_sec``
+    (end-to-end wall, generation included) and the KERNEL-STAGE rate
+    (the round-15 single-chip metric — its 554.7 CPU-interpret headline
+    is the comparison target). ``overlap_capable`` labels whether this
+    host can physically overlap two device programs (a single-core CPU
+    cannot — its ratio row validates the instrument, not the overlap);
+    `ccka bench-diff` gates ratio >= 1.0 only on overlap-capable
+    records and holds a 0.9 non-regression floor otherwise."""
+    from ccka_tpu.sim import SimParams
+    from ccka_tpu.sim import streaming as streaming_mod
+
+    platform = jax.devices()[0].platform
+    virtual = platform == "cpu"
+    params = SimParams.from_config(cfg)
+    src = _make_src(cfg)
+    overlap_capable = (os.cpu_count() or 1) > 1
+    rows = []
+    bitwise_all = True
+    for (B, BB, T, BT, TC) in sweep:
+        days = T * cfg.sim.dt_s / 86400.0
+        label = f"stream.{B}x{T}"
+        sync = _stream_sync_baseline(cfg, params, src, B=B, BB=BB, T=T,
+                                     TC=TC, repeats=repeats, label=label,
+                                     interpret=virtual,
+                                     stochastic=not virtual)
+        kw = dict(T=T, block_T=BT, t_chunk=TC, b_block=BB,
+                  interpret=virtual, stochastic=not virtual)
+        # Warm (compile = setup), then best-of-N fresh-world repeats.
+        streaming_mod.streaming_rollout_summary(
+            src, params, cfg.cluster, "rule", key=jax.random.key(0),
+            batch=B, pipelined=True, tracer=_TRACER, label=label, **kw)
+        pipe_walls = []
+        for i in range(max(repeats, 1)):
+            _s, rep = streaming_mod.streaming_rollout_summary(
+                src, params, cfg.cluster, "rule",
+                key=jax.random.key(100 + i), batch=B, pipelined=True,
+                tracer=_TRACER, label=label, **kw)
+            pipe_walls.append(rep["wall_s"])
+        pipe_wall = float(min(pipe_walls))
+        # Bitwise gates on a dedicated (untimed) key: pipelined vs the
+        # blocked-sync drive, and vs the unblocked single-launch
+        # reference on the same concatenated blocks.
+        gate_key = jax.random.key(42)
+        s_pipe, rep_b = streaming_mod.streaming_rollout_summary(
+            src, params, cfg.cluster, "rule", key=gate_key, batch=B,
+            seed=9, pipelined=True, count_buffers=True, tracer=_TRACER,
+            label=label, **kw)
+        s_sync, _ = streaming_mod.streaming_rollout_summary(
+            src, params, cfg.cluster, "rule", key=gate_key, batch=B,
+            seed=9, pipelined=False, tracer=_TRACER, label=label, **kw)
+        s_ref = streaming_mod.unblocked_reference_summary(
+            src, params, cfg.cluster, "rule", key=gate_key, batch=B,
+            seed=9, **kw)
+        bit_sync = _summaries_bitwise_equal(s_pipe, s_sync)
+        bit_unblocked = _summaries_bitwise_equal(s_pipe, s_ref)
+        bitwise_all = bitwise_all and bit_sync and bit_unblocked
+        ratio = sync["wall_s"] / pipe_wall if pipe_wall else None
+        kocc_pipe = (sync["kernel_s"] / pipe_wall if pipe_wall else None)
+        row = {
+            "batch": B, "b_block": BB, "steps": T, "block_T": BT,
+            "t_chunk": TC, "n_blocks": rep["n_blocks"],
+            "sync": dict(
+                sync,
+                cluster_days_per_sec=round(B * days / sync["wall_s"], 2),
+                cluster_days_per_sec_kernel_stage=round(
+                    B * days / sync["kernel_s"], 2)),
+            "pipelined": {
+                "engine": "double-buffered blocked drive "
+                          "(sim/streaming.py; 2 stream blocks/chip)",
+                "wall_s": round(pipe_wall, 6),
+                "cluster_days_per_sec": round(B * days / pipe_wall, 2),
+                # Attributed: the sync-measured kernel seconds over the
+                # pipelined wall — what fraction of the pipelined wall
+                # the kernel's own work accounts for.
+                "kernel_occupancy_fraction": (round(kocc_pipe, 6)
+                                              if kocc_pipe else None),
+                "stream_buffers": rep_b.get("stream_buffers"),
+                "repeats": len(pipe_walls),
+            },
+            "throughput_ratio": round(ratio, 4) if ratio else None,
+            "bitwise_pipelined_vs_sync": bool(bit_sync),
+            "bitwise_blocked_vs_unblocked": bool(bit_unblocked),
+        }
+        rows.append(row)
+        print(f"# stream[{B}x{T}]: sync "
+              f"{row['sync']['cluster_days_per_sec']:,} cd/s "
+              f"(kernel-stage "
+              f"{row['sync']['cluster_days_per_sec_kernel_stage']:,}), "
+              f"pipe {row['pipelined']['cluster_days_per_sec']:,} cd/s, "
+              f"ratio {row['throughput_ratio']}, "
+              f"bitwise={bit_sync and bit_unblocked}, "
+              f"buffers={rep_b.get('stream_buffers')}", file=sys.stderr)
+
+    # 10^4-cluster chunked row: bounded memory (2 blocks x lanes x
+    # chunk live), with its own sync-drive occupancy ledger and the
+    # roofline floor of the bytes one chunk's blocks stream.
+    _cb, _cc = chunked_batch, chunked_chunk
+    _ck = dict(T=192, block_T=96, t_chunk=96, b_block=min(_cc, 256),
+               interpret=virtual, stochastic=not virtual)
+    days_c = _ck["T"] * cfg.sim.dt_s / 86400.0
+    streaming_mod.chunked_streaming_summary(
+        src, params, cfg.cluster, "rule", key=jax.random.key(1),
+        batch=_cc, chunk=_cc, pipelined=True, tracer=_TRACER, **_ck)
+    _s, rep_c = streaming_mod.chunked_streaming_summary(
+        src, params, cfg.cluster, "rule", key=jax.random.key(2),
+        batch=_cb, chunk=_cc, pipelined=True, tracer=_TRACER, **_ck)
+    _s2, rep_cs = streaming_mod.chunked_streaming_summary(
+        src, params, cfg.cluster, "rule", key=jax.random.key(2),
+        batch=_cb, chunk=_cc, pipelined=False, tracer=_TRACER, **_ck)
+    bit_chunk = _summaries_bitwise_equal(_s, _s2)
+    bitwise_all = bitwise_all and bit_chunk
+    chunk_block_bytes = rep_c["live_block_bytes"]
+    chunked = {
+        "engine": "cluster-axis chunked double-buffered streaming "
+                  "(sim/streaming.chunked_streaming_summary)",
+        "batch": _cb, "chunk": _cc, "chunks": rep_c["chunks"],
+        "steps": _ck["T"], "block_T": _ck["block_T"],
+        "b_block": _ck["b_block"], "n_blocks": rep_c["n_blocks"],
+        "wall_s": round(rep_c["wall_s"], 6),
+        "cluster_days_per_sec_aggregate": round(
+            _cb * days_c / rep_c["wall_s"], 2),
+        "live_block_bytes": chunk_block_bytes,
+        "live_block_mib": round(chunk_block_bytes / 2**20, 3),
+        "sync_wall_s": round(rep_cs["wall_s"], 6),
+        "occupancy": rep_cs["occupancy"],
+        "throughput_ratio": round(rep_cs["wall_s"] / rep_c["wall_s"], 4),
+        "bitwise_pipelined_vs_sync": bool(bit_chunk),
+        "roofline_floor_s": round(_roofline_floor_s(
+            chunk_block_bytes / 2 * rep_c["chunks"]
+            * rep_c["n_blocks"]), 6),
+    }
+    print(f"# stream chunked {_cb} clusters ({_cc}/chunk): "
+          f"{chunked['cluster_days_per_sec_aggregate']:,} cd/s agg, "
+          f"{chunked['live_block_mib']} MiB live blocks, ratio "
+          f"{chunked['throughput_ratio']}, bitwise={bit_chunk}",
+          file=sys.stderr)
+
+    r15 = _r15_replication(cfg, params, src, repeats=max(repeats, 6))
+    print(f"# stream r15 replication: "
+          f"{r15['cluster_days_per_sec']} cd/s this session "
+          f"(historical record 554.66)", file=sys.stderr)
+    head = max(rows, key=lambda r: r["sync"]
+               ["cluster_days_per_sec_kernel_stage"])
+    # The headline: the r15 bare protocol swept over every row's
+    # geometry, best kept — one protocol everywhere, so the
+    # vs-replication ratio measures the code/geometry freedom the
+    # blocked engine opened (large t_chunk/b_block), not cache
+    # temperature or host weather.
+    bare_sweep = []
+    bare_geoms = [(B, BB, T, TC) for (B, BB, T, _BT, TC) in sweep]
+    # Plus the whole-block single-chunk geometries the blocked engine
+    # makes natural (t_chunk = block span): fastest on the CPU record.
+    bare_geoms += [(256, 256, 192, 192), (256, 256, 96, 96)]
+    for (B, BB, T, TC) in bare_geoms:
+        b = _bare_kernel_rate(cfg, params, src, B=B, BB=BB, T=T, TC=TC,
+                              repeats=max(repeats, 6),
+                              label=f"stream.bare.{B}x{T}")
+        if b.get("cluster_days_per_sec"):
+            bare_sweep.append(b)
+        print(f"# stream bare[{B}x{T} tc{TC}]: "
+              f"{b['cluster_days_per_sec']} cd/s", file=sys.stderr)
+    if bare_sweep:
+        head_bare = max(bare_sweep,
+                        key=lambda b: b["cluster_days_per_sec"])
+    else:
+        # Every bare sample fell under the roofline implausibility
+        # guard (contended host): fall back to the headline row's
+        # fenced fresh-world kernel stage so the stage still emits a
+        # record — weaker evidence beats an aborted run.
+        print("# stream: every bare kernel sample was implausible — "
+              "falling back to the fenced kernel stage",
+              file=sys.stderr)
+        head_bare = {
+            "batch": head["batch"], "b_block": head["b_block"],
+            "steps": head["steps"], "t_chunk": head["t_chunk"],
+            "seconds": head["sync"]["kernel_s"],
+            "cluster_days_per_sec": head["sync"]
+            ["cluster_days_per_sec_kernel_stage"],
+        }
+    paired = max(rows, key=lambda r: r["throughput_ratio"] or 0.0)
+    out = {
+        "metric": "streaming rollout pipeline: blocked double-buffered "
+                  "drive vs the synchronous round-15 pipeline unit, "
+                  "paired + bitwise-gated",
+        "engine": "sim/streaming.py over the carried-state megakernel "
+                  "block entries",
+        "platform": platform, "virtual": virtual,
+        "interpret": virtual, "stochastic": not virtual,
+        "overlap_capable": bool(overlap_capable),
+        "host_cpu_count": os.cpu_count(),
+        "repeats": repeats,
+        "rows": rows,
+        "chunked": chunked,
+        "bitwise_all": bool(bitwise_all),
+        # The round-15-comparable single-chip row: kernel-stage rate at
+        # the headline geometry, set against the SAME-SESSION
+        # replication of the r15 headline (hosts drift between
+        # sessions; the historical 554.66 rides the replication row).
+        "r15_replication": r15,
+        "kernel_bare_sweep": bare_sweep,
+        "single_chip": {
+            "engine": "megakernel packed rule at the streaming "
+                      "headline geometry (kernel stage, r15 bare "
+                      "protocol — blocked launches are bitwise this "
+                      "kernel's work)",
+            "batch": head_bare["batch"], "steps": head_bare["steps"],
+            "b_block": head_bare["b_block"],
+            "t_chunk": head_bare["t_chunk"],
+            "seconds": head_bare["seconds"],
+            "cluster_days_per_sec": head_bare["cluster_days_per_sec"],
+            "kernel_stage_fresh_world_cluster_days_per_sec": head[
+                "sync"]["cluster_days_per_sec_kernel_stage"],
+            "vs_r15_replication": (round(
+                head_bare["cluster_days_per_sec"]
+                / r15["cluster_days_per_sec"], 4)
+                if r15.get("cluster_days_per_sec")
+                and head_bare.get("cluster_days_per_sec") else None),
+            "note": ("interpret-mode deterministic on a CPU host — "
+                     "validates the instrument, not absolute speed"
+                     if virtual else "Mosaic kernel, stochastic"),
+        },
+        "best_paired": {
+            "batch": paired["batch"], "steps": paired["steps"],
+            "throughput_ratio": paired["throughput_ratio"],
+            "sync_kernel_occupancy": paired["sync"]
+            ["occupancy_fractions"]["kernel"],
+            "pipelined_kernel_occupancy": paired["pipelined"]
+            ["kernel_occupancy_fraction"],
+        },
+    }
+    if virtual:
+        out["note"] = ("CPU host: interpret-mode deterministic kernel; "
+                       "a single-core host cannot physically overlap "
+                       "generation with the kernel — the bitwise gates "
+                       "and the bounded-memory chunked row are the "
+                       "result, real overlap rates come from a "
+                       "multi-core/TPU host")
+    return out
+
+
+def bench_stream_mesh(cfg, *, shards: int = 8,
+                      per_shard_batch: int = 256, T: int = 384,
+                      block_T: int = 192, t_chunk: int = 192,
+                      repeats: int = 3) -> dict | None:
+    """The streaming stage's 8-shard section: the SAME double-buffered
+    block loop over the mesh ``data`` axis — shard-local blocked
+    generation, lane-sharded carried state — against the synchronous
+    sharded baseline (full-stream shard-local generation + one mesh
+    launch, fenced). Also pins the mesh-vs-single-chip pairing: the
+    mesh pipelined summary must be bitwise the single-chip
+    cluster-chunked run of the same (key, seed)."""
+    from ccka_tpu.config import MeshConfig
+    from ccka_tpu.parallel import (make_mesh, sharded_packed_trace,
+                                   sharded_megakernel_summary_from_packed)
+    from ccka_tpu.policy.rule import offpeak_action, peak_action
+    from ccka_tpu.sim import SimParams
+    from ccka_tpu.sim import streaming as streaming_mod
+
+    if len(jax.devices()) < shards:
+        print(f"# stream-mesh: {len(jax.devices())} device(s) < "
+              f"{shards} shards — skipped (virtual-mesh child carries "
+              "the section)", file=sys.stderr)
+        return None
+    platform = jax.devices()[0].platform
+    virtual = platform == "cpu"
+    params = SimParams.from_config(cfg)
+    src = _make_src(cfg)
+    off, peak = offpeak_action(cfg.cluster), peak_action(cfg.cluster)
+    B = shards * per_shard_batch
+    BB = min(per_shard_batch, 256)
+    days = T * cfg.sim.dt_s / 86400.0
+    mesh = make_mesh(MeshConfig(data_parallel=shards),
+                     devices=jax.devices()[:shards])
+    kw = dict(stochastic=not virtual, b_block=BB, t_chunk=t_chunk,
+              interpret=virtual)
+
+    # Synchronous sharded baseline (round-15 mesh unit), fenced stages.
+    stream0 = sharded_packed_trace(mesh, src, T, jax.random.key(7), B,
+                                   t_chunk=t_chunk)
+    s0 = sharded_megakernel_summary_from_packed(
+        mesh, params, off, peak, stream0, T, seed=0, **kw)
+    jax.block_until_ready(s0.cost_usd)     # compile = setup
+    walls, kerns = [], []
+    gens = []
+    for i in range(max(repeats, 1)):
+        with _TRACER.device_span("stream.mesh8.sync.generation",
+                                 repeat=i) as sp:
+            stream = sharded_packed_trace(mesh, src, T,
+                                          jax.random.key(300 + i), B,
+                                          t_chunk=t_chunk)
+            sp.fence(stream)
+        g = sp.dur_s
+        with _TRACER.device_span("stream.mesh8.sync.kernel",
+                                 repeat=i) as sp:
+            out = sharded_megakernel_summary_from_packed(
+                mesh, params, off, peak, stream, T, seed=i + 1, **kw)
+            sp.fence(out.cost_usd)
+        k = sp.dur_s
+        with _TRACER.span("stream.mesh8.sync.host", repeat=i) as sp:
+            {f: float(np.asarray(getattr(out, f)).mean())
+             for f in out._fields}
+        walls.append(g + k + sp.dur_s)
+        gens.append(g)
+        kerns.append(k)
+    best = int(np.argmin(walls))
+    sync_wall, sync_kernel = walls[best], kerns[best]
+    occ = {"generation": gens[best] / sync_wall,
+           "kernel": sync_kernel / sync_wall,
+           "host": 1.0 - (gens[best] + sync_kernel) / sync_wall}
+
+    # The r15 bare protocol on the mesh: resident stream, best-of-N
+    # sharded kernel launches — the aggregate the parent compares
+    # against the same-session r15 replication (one protocol, both
+    # sides).
+    call_i = [100]
+
+    def bare_once():
+        call_i[0] += 1
+        s = sharded_megakernel_summary_from_packed(
+            mesh, params, off, peak, stream0, T, seed=call_i[0], **kw)
+        jax.block_until_ready(s.cost_usd)
+
+    dt_bare = _time_best(bare_once, max(repeats, 5),
+                         bytes_touched=float(stream0.size * 4),
+                         label="stream.mesh8.kernel_bare")
+
+    # Double-buffered sharded drive, best-of-N fresh worlds.
+    skw = dict(T=T, block_T=block_T, t_chunk=t_chunk, b_block=BB,
+               interpret=virtual, stochastic=not virtual)
+    streaming_mod.streaming_rollout_summary(
+        src, params, cfg.cluster, "rule", key=jax.random.key(0),
+        batch=B, mesh=mesh, pipelined=True, tracer=_TRACER,
+        label="stream.mesh8", **skw)
+    pipe_walls = []
+    for i in range(max(repeats, 1)):
+        _s, rep = streaming_mod.streaming_rollout_summary(
+            src, params, cfg.cluster, "rule", key=jax.random.key(100 + i),
+            batch=B, mesh=mesh, pipelined=True, tracer=_TRACER,
+            label="stream.mesh8", **skw)
+        pipe_walls.append(rep["wall_s"])
+    pipe_wall = float(min(pipe_walls))
+
+    # Pairing gate: mesh pipelined == single-chip cluster-chunked,
+    # bitwise, same (key, seed).
+    gate_key = jax.random.key(42)
+    s_mesh, _ = streaming_mod.streaming_rollout_summary(
+        src, params, cfg.cluster, "rule", key=gate_key, batch=B, seed=9,
+        mesh=mesh, pipelined=True, tracer=_TRACER,
+        label="stream.mesh8", **skw)
+    s_chunk, _ = streaming_mod.chunked_streaming_summary(
+        src, params, cfg.cluster, "rule", key=gate_key, batch=B,
+        chunk=per_shard_batch, seed=9, pipelined=True, tracer=_TRACER,
+        **skw)
+    bitwise = _summaries_bitwise_equal(
+        jax.tree.map(np.asarray, s_mesh), s_chunk)
+    ratio = sync_wall / pipe_wall if pipe_wall else None
+    out = {
+        "engine": "sharded double-buffered streaming (shard-local "
+                  "blocked generation, lane-sharded carried state) vs "
+                  "the synchronous sharded pipeline",
+        "shards": shards, "per_shard_batch": per_shard_batch,
+        "batch": B, "steps": T, "block_T": block_T,
+        "b_block": BB, "t_chunk": t_chunk,
+        "platform": platform, "virtual": virtual, "interpret": virtual,
+        "sync": {
+            "wall_s": round(sync_wall, 6),
+            "kernel_s": round(sync_kernel, 6),
+            "occupancy_fractions": {s: round(v, 6)
+                                    for s, v in occ.items()},
+            "cluster_days_per_sec_aggregate": round(
+                B * days / sync_wall, 2),
+            "cluster_days_per_sec_kernel_stage": round(
+                B * days / sync_kernel, 2),
+            "kernel_bare_s": (round(dt_bare, 6) if dt_bare else None),
+            "cluster_days_per_sec_kernel_bare": (round(
+                B * days / dt_bare, 2) if dt_bare else None),
+        },
+        "pipelined": {
+            "wall_s": round(pipe_wall, 6),
+            "cluster_days_per_sec_aggregate": round(
+                B * days / pipe_wall, 2),
+            "kernel_occupancy_fraction": round(
+                sync_kernel / pipe_wall, 6),
+            "repeats": len(pipe_walls),
+        },
+        "throughput_ratio": round(ratio, 4) if ratio else None,
+        "bitwise_mesh_vs_chunked": bool(bitwise),
+        "mesh": bench_provenance(mesh=mesh)["mesh"],
+    }
+    print(f"# stream-mesh {shards}x{platform}: sync "
+          f"{out['sync']['cluster_days_per_sec_aggregate']:,} cd/s agg "
+          f"(kernel-stage "
+          f"{out['sync']['cluster_days_per_sec_kernel_stage']:,}), "
+          f"pipe {out['pipelined']['cluster_days_per_sec_aggregate']:,}"
+          f" cd/s, ratio {out['throughput_ratio']}, bitwise={bitwise}"
+          + (" (VIRTUAL+INTERPRET)" if virtual else ""), file=sys.stderr)
+    return out
+
+
+def _stream_mesh_virtual_fallback() -> dict | None:
+    """Single-device host: run the streaming stage's 8-shard section on
+    the 8-device CPU-virtual mesh in a child process (labeled)."""
+    env = dict(os.environ)
+    env["CCKA_BENCH_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    return _run_child(
+        [sys.executable, os.path.abspath(__file__), "--stream-mesh-only"],
+        timeout_s=1800, env=env)
+
+
 def _run_child(argv, timeout_s=1800, env=None) -> dict | None:
     """Run a bench child phase; relay its narration; parse its JSON."""
     try:
@@ -2412,6 +2964,19 @@ def main(argv=None) -> int:
                          "occupancy/imbalance section on the CPU-"
                          "virtual mesh (run with "
                          "--xla_force_host_platform_device_count=8)")
+    ap.add_argument("--stream-only", action="store_true",
+                    help="run ONLY the streaming rollout pipeline stage "
+                         "(bench_stream: blocked double-buffered drive "
+                         "vs the synchronous round-15 pipeline unit, "
+                         "bitwise-gated, + the 10^4-cluster chunked row "
+                         "and the 8-shard mesh section) and print its "
+                         "JSON — the BENCH_r16 record path; interpret-"
+                         "mode deterministic off-TPU")
+    ap.add_argument("--stream-mesh-only", action="store_true",
+                    help="child phase of --stream-only: the 8-shard "
+                         "streaming section on the CPU-virtual mesh "
+                         "(run with "
+                         "--xla_force_host_platform_device_count=8)")
     ap.add_argument("--workloads-only", action="store_true",
                     help="run ONLY the per-family workload scenario "
                          "scoreboard (bench_workloads) and print its "
@@ -2500,6 +3065,41 @@ def main(argv=None) -> int:
             pm = bench_perf_mesh(default_config())
         print(json.dumps(pm))
         return 0 if pm is not None else 1
+
+    if args.stream_mesh_only:
+        from ccka_tpu.config import default_config
+        with _TRACER.span("bench.stream_mesh_stage"):
+            sm = bench_stream_mesh(default_config())
+        print(json.dumps(sm))
+        return 0 if sm is not None else 1
+
+    if args.stream_only:
+        from ccka_tpu.config import default_config
+        cfg = default_config()
+        with _TRACER.span("bench.stream_stage"):
+            stream = bench_stream(cfg)
+            mesh8 = (bench_stream_mesh(cfg)
+                     if len(jax.devices()) >= 8
+                     else _stream_mesh_virtual_fallback())
+        if mesh8 is not None:
+            stream["mesh8"] = mesh8
+            r15_rate = (stream.get("r15_replication") or {}).get(
+                "cluster_days_per_sec")
+            mesh_rate = ((mesh8.get("sync") or {}).get(
+                "cluster_days_per_sec_kernel_bare")
+                or (mesh8.get("sync") or {}).get(
+                    "cluster_days_per_sec_kernel_stage"))
+            if r15_rate and mesh_rate:
+                mesh8["vs_r15_replication"] = round(
+                    mesh_rate / r15_rate, 4)
+        # Record-path stamp (see --perf-only): a raw redirect into
+        # BENCH_rNN.json arms the bench-diff streaming gates.
+        stream["stage"] = "--stream-only"
+        stream["provenance"] = bench_provenance()
+        from ccka_tpu.obs.compile import compile_report
+        stream["compile_report"] = compile_report()
+        print(json.dumps(stream))
+        return 0
 
     if args.perf_only:
         from ccka_tpu.config import default_config

@@ -181,6 +181,14 @@ def _extract_metrics(doc: dict) -> dict:
         out.update(_extract_perf(perf,
                                  full_stage=doc.get("stage")
                                  == "--perf-only"))
+    # Round-16 streaming pipeline (stage record or nested "stream").
+    stream = (doc if isinstance(doc.get("rows"), list)
+              and doc.get("stage") == "--stream-only"
+              else doc.get("stream"))
+    if isinstance(stream, dict) and isinstance(stream.get("rows"), list):
+        out.update(_extract_stream(stream,
+                                   full_stage=doc.get("stage")
+                                   == "--stream-only"))
     return out
 
 
@@ -235,6 +243,100 @@ def _extract_perf(perf: dict, *, full_stage: bool) -> dict:
             bitwise_all = False
     out["perf_bitwise_all"] = bitwise_all
     return out
+
+
+def _extract_stream(stream: dict, *, full_stage: bool) -> dict:
+    """The round-16 streaming-pipeline invariants a record states about
+    itself (ISSUE 13 satellite): blocked-vs-sync summaries bitwise, the
+    double-buffered drive's attributed kernel-stage occupancy at least
+    the synchronous baseline's, per-chip throughput ratio >= 1.0 (on an
+    overlap-capable host — a single-core virtual host CANNOT overlap
+    two device programs, so it is held to a non-regression floor
+    instead, `_STREAM_RATIO_FLOOR`), the donation chain's two-buffer
+    bound, and the chunked 10^4-cluster row's bounded-memory evidence.
+    ``full_stage`` records additionally require the chunked row and the
+    mesh section — a record that silently dropped either would pass
+    every remaining gate."""
+    out: dict = {"stream_partial": []}
+    bitwise = bool(stream.get("bitwise_all", True))
+    ratios = []
+    kocc_pairs = []
+    buffers = []
+    for row in stream.get("rows", []):
+        if not isinstance(row, dict):
+            out["stream_partial"].append("row is not a record")
+            continue
+        for key in ("bitwise_pipelined_vs_sync",
+                    "bitwise_blocked_vs_unblocked"):
+            if row.get(key) is False:
+                bitwise = False
+            elif key not in row:
+                # An ABSENT gate is not a passed gate: a record that
+                # silently dropped its bitwise fields must read as
+                # partial, not green (the same discipline as the
+                # missing-occupancy check below).
+                out["stream_partial"].append(
+                    f"row batch={row.get('batch')} missing {key}")
+        if row.get("throughput_ratio") is not None:
+            ratios.append(float(row["throughput_ratio"]))
+        sync_occ = (row.get("sync") or {}).get("occupancy_fractions")
+        pipe_occ = (row.get("pipelined") or {}).get(
+            "kernel_occupancy_fraction")
+        if isinstance(sync_occ, dict) and pipe_occ is not None:
+            kocc_pairs.append((float(sync_occ.get("kernel", 0.0)),
+                               float(pipe_occ)))
+        bufs = (row.get("pipelined") or {}).get("stream_buffers")
+        if bufs is not None:
+            buffers.append(int(bufs))
+        if not isinstance(sync_occ, dict) or not sync_occ:
+            out["stream_partial"].append(
+                f"row batch={row.get('batch')} missing sync occupancy")
+    if not stream.get("rows"):
+        out["stream_partial"].append("no paired sweep rows")
+    out["stream_bitwise_all"] = bitwise
+    if ratios:
+        out["stream_ratio_best"] = max(ratios)
+    if kocc_pairs:
+        # The best paired row decides the occupancy-gain gate (the
+        # record reports every row, including hosts/geometries where
+        # overlap cannot win — silent row-dropping is the failure mode
+        # the partial gate catches).
+        sync_k, pipe_k = max(kocc_pairs, key=lambda p: p[1] - p[0])
+        out["stream_kocc_sync"] = sync_k
+        out["stream_kocc_pipelined"] = pipe_k
+    if buffers:
+        out["stream_buffers_max"] = max(buffers)
+    out["stream_overlap_capable"] = bool(
+        stream.get("overlap_capable", True))
+    chunked = stream.get("chunked")
+    if isinstance(chunked, dict):
+        if not chunked.get("live_block_bytes"):
+            out["stream_partial"].append(
+                "chunked row missing its live-block memory bound")
+        if chunked.get("roofline_floor_s") is None:
+            out["stream_partial"].append(
+                "chunked row missing its roofline floor")
+        if chunked.get("bitwise_pipelined_vs_sync") is False:
+            out["stream_bitwise_all"] = False
+        if chunked.get("batch"):
+            out["stream_chunked_batch"] = int(chunked["batch"])
+    elif full_stage:
+        out["stream_partial"].append("chunked 10^4-cluster row missing")
+    mesh = stream.get("mesh8")
+    if isinstance(mesh, dict):
+        if mesh.get("bitwise_mesh_vs_chunked") is False:
+            out["stream_bitwise_all"] = False
+        if mesh.get("throughput_ratio") is not None:
+            out["stream_mesh_ratio"] = float(mesh["throughput_ratio"])
+    elif full_stage:
+        out["stream_partial"].append("mesh8 streaming section missing")
+    return out
+
+
+# A single-core virtual host cannot overlap generation with the kernel
+# (there is no second core to run it on): its pipelined drive is held
+# to this non-regression floor instead of the >= 1.0 overlap gate.
+_STREAM_RATIO_FLOOR = 0.85
 
 
 def bench_diff(history: dict, *,
@@ -409,6 +511,48 @@ def bench_diff(history: dict, *,
                 "threshold": max_perf_overhead,
                 "detail": "observatory measurement overhead exceeded "
                           "the 5%-of-kernel-stage bound"})
+        # Round-16 streaming-pipeline invariants (ISSUE 13): bitwise
+        # gates are unconditional; the throughput/occupancy gates hold
+        # at >= 1.0 (and pipelined kernel occupancy >= sync) only when
+        # the host could physically overlap — a single-core virtual
+        # host is held to the non-regression floor.
+        for what in rec.get("stream_partial", []):
+            regressions.append({
+                "kind": "stream_invariant", "round": rnd,
+                "detail": f"partial streaming record: {what}"})
+        if rec.get("stream_bitwise_all") is False:
+            regressions.append({
+                "kind": "stream_invariant", "round": rnd,
+                "detail": "blocked/pipelined/sync streaming summaries "
+                          "no longer bitwise identical"})
+        if rec.get("stream_buffers_max", 0) > 2:
+            regressions.append({
+                "kind": "stream_invariant", "round": rnd,
+                "value": rec["stream_buffers_max"],
+                "detail": "streaming donation chain held more than the "
+                          "two stream buffers per chip it promises"})
+        ratio = rec.get("stream_ratio_best")
+        if ratio is not None:
+            capable = rec.get("stream_overlap_capable", True)
+            floor = 1.0 if capable else _STREAM_RATIO_FLOOR
+            if ratio < floor:
+                regressions.append({
+                    "kind": "stream_invariant", "round": rnd,
+                    "value": ratio, "threshold": floor,
+                    "detail": ("double-buffered drive slower than the "
+                               "synchronous baseline"
+                               + ("" if capable else
+                                  " past the single-core floor"))})
+        if rec.get("stream_kocc_pipelined") is not None \
+                and rec.get("stream_overlap_capable", True) \
+                and rec["stream_kocc_pipelined"] \
+                < rec.get("stream_kocc_sync", 0.0):
+            regressions.append({
+                "kind": "stream_invariant", "round": rnd,
+                "value": rec["stream_kocc_pipelined"],
+                "threshold": rec.get("stream_kocc_sync"),
+                "detail": "pipelined kernel-stage occupancy fell below "
+                          "the synchronous baseline's"})
     return {"comparisons": comparisons, "regressions": regressions,
             "ok": not regressions}
 
@@ -424,7 +568,7 @@ SCALING_CSV_COLUMNS = (
     "round", "file", "source", "platform", "virtual", "devices",
     "per_device_batch", "steps", "cluster_days_per_sec_per_device",
     "cluster_days_per_sec_aggregate", "weak_scaling_efficiency",
-    "engine", "note",
+    "pipeline", "engine", "note",
 )
 
 
@@ -461,6 +605,71 @@ def _multichip_points(rnd: int, fname: str, section: dict) -> list[dict]:
             cluster_days_per_sec_aggregate=pb.get(
                 "cluster_days_per_sec_aggregate")))
     return rows
+
+
+def _stream_points(rnd: int, fname: str, stream: dict) -> list[dict]:
+    """Round-16 streaming rows as curve points — BLOCKED rows labeled
+    (the ``pipeline`` column distinguishes the synchronous baseline
+    from the double-buffered drive on every paired sweep row), never
+    skipped: a curve that hid the sync side would hide exactly the
+    comparison the streaming record exists to make."""
+    base = {
+        "round": rnd, "file": fname,
+        "platform": stream.get("platform"),
+        "virtual": bool(stream.get("virtual", False)),
+    }
+    points = []
+    for row in stream.get("rows", []):
+        if not isinstance(row, dict):
+            continue
+        for pipeline, side in (("sync", row.get("sync")),
+                               ("double-buffered",
+                                row.get("pipelined"))):
+            if not isinstance(side, dict):
+                continue
+            points.append(dict(
+                base, source="stream_single_chip", devices=1,
+                per_device_batch=row.get("batch"),
+                steps=row.get("steps"), pipeline=pipeline,
+                engine=side.get("engine"),
+                cluster_days_per_sec_per_device=side.get(
+                    "cluster_days_per_sec"),
+                cluster_days_per_sec_aggregate=side.get(
+                    "cluster_days_per_sec")))
+    mesh = stream.get("mesh8")
+    if isinstance(mesh, dict):
+        for pipeline, side in (("sync", mesh.get("sync")),
+                               ("double-buffered",
+                                mesh.get("pipelined"))):
+            if not isinstance(side, dict):
+                continue
+            agg = side.get("cluster_days_per_sec_aggregate")
+            n = mesh.get("shards") or 8
+            points.append(dict(
+                base, source="stream_mesh", devices=n,
+                platform=mesh.get("platform", base["platform"]),
+                virtual=bool(mesh.get("virtual", base["virtual"])),
+                per_device_batch=mesh.get("per_shard_batch"),
+                steps=mesh.get("steps"), pipeline=pipeline,
+                engine=mesh.get("engine"),
+                cluster_days_per_sec_per_device=(
+                    round(agg / n, 2) if agg else None),
+                cluster_days_per_sec_aggregate=agg))
+    chunked = stream.get("chunked")
+    if isinstance(chunked, dict):
+        points.append(dict(
+            base, source="stream_chunked", devices=1,
+            per_device_batch=chunked.get("batch"),
+            steps=chunked.get("steps"), pipeline="double-buffered",
+            engine=chunked.get("engine"),
+            cluster_days_per_sec_per_device=chunked.get(
+                "cluster_days_per_sec_aggregate"),
+            cluster_days_per_sec_aggregate=chunked.get(
+                "cluster_days_per_sec_aggregate"),
+            note=(f"{chunked.get('chunks')} chunks x "
+                  f"{chunked.get('chunk')} clusters, "
+                  f"{chunked.get('live_block_mib')} MiB live blocks")))
+    return points
 
 
 def scaling_curve(root: str) -> dict:
@@ -557,6 +766,23 @@ def scaling_curve(root: str) -> dict:
                     "source": "perf_single_chip",
                     "platform": perf.get("platform"),
                     "virtual": perf.get("virtual"),
+                    "cluster_days_per_sec_per_chip": float(
+                        sc["cluster_days_per_sec"]),
+                    "engine": sc.get("engine"),
+                })
+        stream = (doc if doc.get("stage") == "--stream-only"
+                  else doc.get("stream"))
+        if isinstance(stream, dict) \
+                and isinstance(stream.get("rows"), list):
+            points.extend(_stream_points(rnd, fname, stream))
+            sc = stream.get("single_chip")
+            if isinstance(sc, dict) and isinstance(
+                    sc.get("cluster_days_per_sec"), (int, float)):
+                per_round.append({
+                    "round": rnd, "file": fname,
+                    "source": "stream_single_chip",
+                    "platform": stream.get("platform"),
+                    "virtual": stream.get("virtual"),
                     "cluster_days_per_sec_per_chip": float(
                         sc["cluster_days_per_sec"]),
                     "engine": sc.get("engine"),

@@ -35,6 +35,8 @@ from ccka_tpu.parallel.sharded_kernel import (  # noqa: F401
     shard_lane_blocks,
     shard_plan_stream,
     shard_seed,
+    sharded_block_packed_trace,
+    sharded_packed_mode_block_summary_fn,
     sharded_carbon_megakernel_rollout_summary,
     sharded_carbon_summary_from_packed,
     sharded_megakernel_rollout_summary,

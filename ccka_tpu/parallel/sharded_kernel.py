@@ -62,15 +62,25 @@ from jax.sharding import Mesh, PartitionSpec
 from ccka_tpu.config import ConfigError
 from ccka_tpu.faults.process import has_fault_lanes
 from ccka_tpu.obs.compile import watch_jit
+from ccka_tpu.sim import lanes
 from ccka_tpu.sim.megakernel import (
     SEED_BLOCK_STRIDE,
+    BlockSummaryFns,
     _check_chunking,
     _check_plan,
+    _finalize,
+    _fused_neural_block,
     _fused_neural_packed_summary,
+    _fused_packed_block,
     _fused_packed_summary,
+    _fused_plan_block,
     _fused_plan_packed_summary,
     _fused_profile_summary,
     _mlp_dims,
+    _pack_mlp_tensors,
+    _plan_rows,
+    block_state_rows,
+    pack_plan,
 )
 from ccka_tpu.sim.types import Action, SimParams
 
@@ -537,3 +547,292 @@ def sharded_neural_megakernel_rollout_summary(
         mesh, params, cluster, net_params, exo_packed, T, seed,
         stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
         interpret=interpret)
+
+
+# ---- streaming over the mesh (ISSUE 13) -----------------------------------
+#
+# The same double-buffered block loop `sim/streaming.py` drives on one
+# chip, over the ``data`` axis: block generation runs SHARD-LOCALLY
+# (each chip synthesizes its own lane block of block j, keyed
+# ``fold_in(block_key, shard)`` on top of the per-block fold — bitwise
+# what the single-chip cluster-chunking path generates for chunk
+# ``shard``), the carried state stays lane-sharded across blocks, and
+# the kernel seeds reuse `shard_seed`'s SEED_BLOCK_STRIDE arithmetic so
+# blocked sharded runs stay bitwise paired with single-chip blocked runs
+# on the concatenated batch (and, transitively, with unblocked runs —
+# `sim.megakernel.block_chunk_seed` composes additively with the shard
+# offset).
+
+
+def sharded_block_packed_trace(mesh: Mesh, source, block_T: int, key,
+                               batch: int, block_index, *,
+                               t_chunk: int = 64, recycle=None):
+    """One ``[block_T, exo_rows(Z), B]`` stream BLOCK with ``B`` split
+    over the mesh's ``data`` axis, each shard's lane block synthesized
+    locally (the blocked analog of `sharded_packed_trace`). ``recycle``
+    donates a dead same-shape block buffer — the streaming loop's
+    double-buffer holds exactly two blocks per chip."""
+    n = data_shards(mesh)
+    b_loc = _split_batch(batch, n, 1, "trace")
+    cache = getattr(source, "_sharded_packed_fns", None)
+    if cache is None:
+        cache = source._sharded_packed_fns = {}
+    ckey = ("block", mesh, block_T, b_loc, t_chunk, recycle is not None)
+    fn = cache.get(ckey)
+    if fn is None:
+        generate = source.packed_block_generate_fn(block_T, b_loc,
+                                                   t_chunk=t_chunk)
+        data = mesh.axis_names[0]
+        stream_spec = PartitionSpec(None, None, data)
+
+        def body(k, j, *recycle_arg):
+            kj = jax.random.fold_in(
+                jax.random.fold_in(k, lanes.BLOCK_KEY_TAG), j)
+            kj = jax.random.fold_in(kj, jax.lax.axis_index(data))
+            return generate(kj, j * jnp.int32(block_T))
+
+        if recycle is not None:
+            sfn = shard_map(body, mesh=mesh,
+                            in_specs=(PartitionSpec(), PartitionSpec(),
+                                      stream_spec),
+                            out_specs=stream_spec, check_rep=False)
+            fn = jax.jit(sfn, donate_argnums=(2,), keep_unused=True)
+        else:
+            sfn = shard_map(body, mesh=mesh,
+                            in_specs=(PartitionSpec(), PartitionSpec()),
+                            out_specs=stream_spec, check_rep=False)
+            fn = jax.jit(sfn)
+        cache[ckey] = fn
+    j = jnp.int32(block_index)
+    return fn(key, j, recycle) if recycle is not None else fn(key, j)
+
+
+@functools.lru_cache(maxsize=64)
+def _packed_block_call(mesh: Mesh, T, block_T, P, Z, K, WD, stochastic,
+                       b_block, t_chunk, interpret, carbon,
+                       blocks_per_shard):
+    data = mesh.axis_names[0]
+    stream_spec = PartitionSpec(None, None, data)
+    state_spec = PartitionSpec(None, data)
+
+    def body(params, off_a, peak_a, exo, state, seed, j):
+        local = shard_seed(seed, jax.lax.axis_index(data),
+                           blocks_per_shard)
+        return _fused_packed_block(
+            params, off_a, peak_a, exo, state, local, j, T=T,
+            block_T=block_T, P=P, Z=Z, K=K, WD=WD, stochastic=stochastic,
+            b_block=b_block, t_chunk=t_chunk, interpret=interpret,
+            carbon=carbon)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(PartitionSpec(), PartitionSpec(),
+                             PartitionSpec(), stream_spec, state_spec,
+                             PartitionSpec(), PartitionSpec()),
+                   out_specs=(PartitionSpec(None, data), state_spec,
+                              stream_spec),
+                   check_rep=False)
+    name = ("sharded_kernel.packed_block"
+            + ("_carbon" if carbon is not None else ""))
+    return watch_jit(jax.jit(fn, donate_argnums=(3, 4)), name, hot=True,
+                     warmup_compiles=_WARMUP_COMPILES, shared_stats=True)
+
+
+@functools.lru_cache(maxsize=64)
+def _neural_block_call(mesh: Mesh, T, block_T, P, Z, K, WD, stochastic,
+                       b_block, t_chunk, interpret, slo_mask, mlp_dims,
+                       blocks_per_shard):
+    data = mesh.axis_names[0]
+    stream_spec = PartitionSpec(None, None, data)
+    state_spec = PartitionSpec(None, None, data)   # [NP, s_rows, B]
+
+    def body(params, weights, exo, state, seed, j):
+        local = shard_seed(seed, jax.lax.axis_index(data),
+                           blocks_per_shard)
+        return _fused_neural_block(
+            params, weights, exo, state, local, j, T=T, block_T=block_T,
+            P=P, Z=Z, K=K, WD=WD, stochastic=stochastic, b_block=b_block,
+            t_chunk=t_chunk, slo_mask=slo_mask, mlp_dims=mlp_dims,
+            interpret=interpret)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(PartitionSpec(), PartitionSpec(),
+                             stream_spec, state_spec, PartitionSpec(),
+                             PartitionSpec()),
+                   out_specs=(PartitionSpec(None, None, data), state_spec,
+                              stream_spec),
+                   check_rep=False)
+    return watch_jit(jax.jit(fn, donate_argnums=(2, 3)),
+                     "sharded_kernel.neural_block", hot=True,
+                     warmup_compiles=_WARMUP_COMPILES, shared_stats=True)
+
+
+@functools.lru_cache(maxsize=64)
+def _plan_block_call(mesh: Mesh, T, block_T, P, Z, K, WD, stochastic,
+                     b_block, t_chunk, interpret, plan_batched,
+                     blocks_per_shard):
+    data = mesh.axis_names[0]
+    stream_spec = PartitionSpec(None, None, data)
+    state_spec = PartitionSpec(None, data)
+    plan_spec = stream_spec if plan_batched else PartitionSpec()
+
+    def body(params, plan, exo, state, seed, j):
+        local = shard_seed(seed, jax.lax.axis_index(data),
+                           blocks_per_shard)
+        return _fused_plan_block(
+            params, plan, exo, state, local, j, T=T, block_T=block_T,
+            P=P, Z=Z, K=K, WD=WD, stochastic=stochastic, b_block=b_block,
+            t_chunk=t_chunk, interpret=interpret,
+            plan_batched=plan_batched)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(PartitionSpec(), plan_spec, stream_spec,
+                             state_spec, PartitionSpec(),
+                             PartitionSpec()),
+                   out_specs=(PartitionSpec(None, data), state_spec,
+                              stream_spec),
+                   check_rep=False)
+    return watch_jit(jax.jit(fn, donate_argnums=(2, 3)),
+                     "sharded_kernel.plan_block", hot=True,
+                     warmup_compiles=_WARMUP_COMPILES, shared_stats=True)
+
+
+def sharded_packed_mode_block_summary_fn(mesh: Mesh, params: SimParams,
+                                         cluster, mode: str, *, T: int,
+                                         block_T: int, b_block: int = 512,
+                                         t_chunk: int = 64,
+                                         interpret: bool = False,
+                                         stochastic: bool = True,
+                                         net_params=None,
+                                         plan_packed=None,
+                                         carbon: tuple | None = None
+                                         ) -> BlockSummaryFns:
+    """The mesh analog of
+    `sim.megakernel.packed_mode_block_summary_fn`: the same
+    ``(step, init_state, finalize, n_blocks, T_pad)`` closure bundle,
+    with the stream/state lane axes split over the ``data`` axis and
+    the per-shard kernel seeds offset by `shard_seed` — blocked sharded
+    rollouts are bitwise the single-chip blocked rollout on the
+    concatenated batch (pinned in `tests/test_streaming.py`).
+    ``batch`` is implied by the stream/state the caller threads; the
+    per-shard batch must divide into ``b_block`` like every sharded
+    entry's."""
+    import numpy as np
+
+    n_blocks, T_pad = lanes.block_layout(T, block_T, t_chunk)
+    n = data_shards(mesh)
+    P, Z = cluster.n_pools, cluster.n_zones
+    K = int(params.provision_pipeline_k)
+    WD = int(params.wl_batch_deadline_ticks)
+    data = mesh.axis_names[0]
+
+    def _blocks_per_shard(stream_block):
+        # Same contract as the single-chip bundle's check_block: a
+        # wrong-length block would silently misalign the valid gate,
+        # the tod clock and the PRNG chunk seeds (meta t0 assumes
+        # exactly block_T ticks per block).
+        if stream_block.shape[0] != block_T:
+            raise ValueError(
+                f"stream block covers {stream_block.shape[0]} ticks, "
+                f"the blocked layout needs exactly block_T={block_T} — "
+                "generate with sharded_block_packed_trace")
+        return _split_batch(stream_block.shape[-1], n, b_block,
+                            "stream") // b_block
+
+    def _state_sharding(ndim):
+        spec = (PartitionSpec(None, None, data) if ndim == 3
+                else PartitionSpec(None, data))
+        return jax.sharding.NamedSharding(mesh, spec)
+
+    if mode in ("rule", "carbon"):
+        from ccka_tpu.policy.rule import offpeak_action, peak_action
+
+        off, peak = offpeak_action(cluster), peak_action(cluster)
+        if mode == "carbon" and carbon is None:
+            carbon = (10.0, 0.05, 1.0)
+        cstat = carbon if mode == "carbon" else None
+
+        def step(stream_block, state, j, seed):
+            fn = _packed_block_call(
+                mesh, T, block_T, P, Z, K, WD, stochastic, b_block,
+                t_chunk, interpret, cstat,
+                _blocks_per_shard(stream_block))
+            return fn(params, off, peak, stream_block, state,
+                      jnp.int32(seed), jnp.int32(j))
+
+        def init_state(stream_rows, batch):
+            s_rows = block_state_rows(params, cluster, mode, stream_rows)
+            return jax.device_put(jnp.zeros((s_rows, batch), jnp.float32),
+                                  _state_sharding(2))
+
+        def finalize(out):
+            return _finalize(params, out, T)
+
+    elif mode == "neural":
+        if net_params is None:
+            raise ValueError("sharded block summary: mode 'neural' "
+                             "needs net_params")
+        from ccka_tpu.policy.constraints import slo_pool_mask
+
+        dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
+        if was_single:
+            net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                      net_params)
+        slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
+        weights = _pack_mlp_tensors(net_params, dims, b_block)
+        n_pop = int(weights[0].shape[0])
+
+        def step(stream_block, state, j, seed):
+            fn = _neural_block_call(
+                mesh, T, block_T, P, Z, K, WD, stochastic, b_block,
+                t_chunk, interpret, slo, dims,
+                _blocks_per_shard(stream_block))
+            return fn(params, weights, stream_block, state,
+                      jnp.int32(seed), jnp.int32(j))
+
+        def init_state(stream_rows, batch):
+            s_rows = block_state_rows(params, cluster, mode, stream_rows)
+            return jax.device_put(
+                jnp.zeros((n_pop, s_rows, batch), jnp.float32),
+                _state_sharding(3))
+
+        def finalize(out):
+            s = jax.vmap(lambda o: _finalize(params, o, T))(out)
+            return jax.tree.map(lambda x: x[0], s) if was_single else s
+
+    elif mode == "plan":
+        if plan_packed is None:
+            from ccka_tpu.policy.rule import neutral_action
+
+            base = neutral_action(cluster)
+            actions = jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (T_pad,) + x.shape), base)
+            plan_packed = pack_plan(actions, T_pad)
+        pr = _plan_rows(P, Z)
+        if plan_packed.shape[0] != T_pad or plan_packed.shape[1] != pr:
+            raise ValueError(
+                f"plan stream shape {tuple(plan_packed.shape)} does not "
+                f"match T_pad={T_pad} / plan_rows={pr} — pack with "
+                "pack_plan(actions, T_pad)")
+        plan_dev = shard_plan_stream(mesh, plan_packed)
+        plan_batched = plan_packed.ndim == 3
+
+        def step(stream_block, state, j, seed):
+            fn = _plan_block_call(
+                mesh, T, block_T, P, Z, K, WD, stochastic, b_block,
+                t_chunk, interpret, plan_batched,
+                _blocks_per_shard(stream_block))
+            return fn(params, plan_dev, stream_block, state,
+                      jnp.int32(seed), jnp.int32(j))
+
+        def init_state(stream_rows, batch):
+            s_rows = block_state_rows(params, cluster, mode, stream_rows)
+            return jax.device_put(jnp.zeros((s_rows, batch), jnp.float32),
+                                  _state_sharding(2))
+
+        def finalize(out):
+            return _finalize(params, out, T)
+
+    else:
+        raise ValueError(f"unknown packed mode {mode!r}")
+
+    return BlockSummaryFns(step, init_state, finalize, n_blocks, T_pad)

@@ -284,6 +284,102 @@ class ReplaySignalSource(SignalSource):
         trace = self.batch_trace_device(steps, key, n)
         return fn(trace, key, recycle) if recycled else fn(trace, key)
 
+    def packed_block_trace_device(self, block_T: int, key, n: int,
+                                  block_index, *, total_steps: int,
+                                  t_chunk: int = 64, recycle=None,
+                                  shard=None):
+        """One ``[block_T, exo_rows(Z), n]`` stream BLOCK of replayed
+        windows — the replay analog of the synthetic backend's
+        :meth:`~ccka_tpu.signals.synthetic.SyntheticSignalSource.packed_block_trace_device`
+        (ISSUE 13). Window offsets are drawn ONCE from ``key`` (the same
+        `_window_offsets` draw every block of that key consumes), so
+        block ``j`` replays ticks ``[j*block_T, (j+1)*block_T)`` of the
+        exact windows the unblocked ``packed_trace_device(total_steps,
+        key, n)`` replays — the exo rows of a blocked run concatenate
+        bitwise to the unblocked stream's. Fault/workload lanes key off
+        the per-block fold (``fold_in(fold_in(key, BLOCK_KEY_TAG), j)``
+        via their own tags), the same blocked-lane family the synthetic
+        backend emits. ``total_steps`` names the full horizon (for the
+        periodic extension's length and the blocked-layout check);
+        ``block_index`` is traced — one compiled program serves every
+        block. ``recycle``: donate a dead same-shape block buffer.
+        ``shard``: optional cluster-chunk index folded into the caller
+        key (each chunk samples its own windows — replay supports no
+        device mesh, so there is no mesh realization to pair with)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ccka_tpu.sim import lanes as _lanes
+        from ccka_tpu.sim.megakernel import _pack_exo
+
+        _lanes.block_layout(block_T, block_T, t_chunk)  # divisibility
+        stored = self._trace.steps
+        if getattr(self, "_blk_ext_steps", None) != (total_steps, block_T):
+            # + block_T of slack: the final block covers the PADDED
+            # horizon, which can run past total_steps by up to a block
+            # (the kernel's valid gate masks those ticks; the extension
+            # just has to keep the slice in bounds).
+            self._blk_ext = jax.tree.map(
+                jnp.asarray,
+                self._trace_at(0, stored + total_steps + block_T))
+            self._blk_ext_steps = (total_steps, block_T)
+        recycled = recycle is not None
+        if not hasattr(self, "_packed_fns"):
+            self._packed_fns = {}
+        ckey = ("block", block_T, n, t_chunk, recycled)
+        fn = self._packed_fns.get(ckey)
+        if fn is None:
+            faults = self.faults
+            workloads = self.workloads
+            Z = self._trace.n_zones
+            dt_s = self._meta.dt_s or 30.0
+            start_s = self._meta.start_unix_s
+
+            def block(ext, k, j):
+                offs = self._window_offsets(k, n)            # [n]
+                t0 = offs + j * jnp.int32(block_T)
+
+                def window(o):
+                    def sl(a):
+                        if a.ndim == 2:                      # [T, k]
+                            return jax.lax.dynamic_slice(
+                                a, (o, 0), (block_T, a.shape[1]))
+                        return jax.lax.dynamic_slice(a, (o,), (block_T,))
+                    return jax.tree.map(sl, ext)
+
+                tr = jax.vmap(window)(t0)                    # [n, bT, ..]
+                packed = _pack_exo(tr, block_T)
+                if faults is None and workloads is None:
+                    return packed
+                kj = jax.random.fold_in(
+                    jax.random.fold_in(k, _lanes.BLOCK_KEY_TAG), j)
+                parts = [packed]
+                if faults is not None:
+                    from ccka_tpu.faults.process import packed_fault_lanes
+                    parts.append(packed_fault_lanes(
+                        faults, kj, block_T, block_T, Z, n))
+                if workloads is not None:
+                    from ccka_tpu.workloads.process import (
+                        packed_workload_lanes)
+                    parts.append(packed_workload_lanes(
+                        workloads, kj, block_T, block_T, Z, n,
+                        dt_s=dt_s, start_unix_s=start_s,
+                        start_offset_s=t0.astype(jnp.float32) * dt_s,
+                        wrap_period_s=stored * dt_s))
+                return jnp.concatenate(parts, axis=1)
+
+            if recycled:
+                fn = jax.jit(lambda ext, k, j, buf: block(ext, k, j),
+                             donate_argnums=(3,), keep_unused=True)
+            else:
+                fn = jax.jit(block)
+            self._packed_fns[ckey] = fn
+        if shard is not None:
+            key = jax.random.fold_in(key, shard)
+        j = jnp.int32(block_index)
+        return (fn(self._blk_ext, key, j, recycle) if recycled
+                else fn(self._blk_ext, key, j))
+
 
 def trace_from_arrays(arrays: Mapping[str, np.ndarray], dt_s: float,
                       zones: tuple[str, ...]) -> tuple[ExogenousTrace, TraceMeta]:

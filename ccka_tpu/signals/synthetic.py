@@ -326,21 +326,157 @@ class SyntheticSignalSource(SignalSource):
             self._device_fns[cache_key] = fn
         return fn(key, recycle) if recycled else fn(key)
 
-    def _assemble_packed(self, steps: int, t_pad: int, noise: tuple):
+    def packed_block_generate_fn(self, block_T: int, batch: int,
+                                 *, t_chunk: int = 64):
+        """Un-jitted ``(key, t0_ticks) -> [block_T, exo_rows(Z), B]``
+        BLOCK-wise packed synthesis — the streaming pipeline's
+        generation unit (`sim/streaming.py`, ISSUE 13). ``key`` is the
+        per-block world key (already folded by
+        ``fold_in(fold_in(caller_key, lanes.BLOCK_KEY_TAG), j)`` — the
+        caller owns the fold so sharded wrappers can fold the shard
+        index on top, keeping blocked sharded generation bitwise the
+        single-chip chunked one). ``t0_ticks`` is the block's traced
+        global tick offset: diurnal/peak/workload phases anchor to the
+        same wall clock the unblocked stream uses, and ONE compiled
+        program serves every block.
+
+        Each block is an independent same-family world segment (the
+        AR(1) latents restart from their stationary draw at block
+        boundaries — a new generative variant, statistically identical
+        marginals, different stream; use blocked or unblocked within
+        one experiment, the repo's standing RNG-family rule). Fault and
+        workload lanes key off the BLOCK key via their own tags, so
+        widening a blocked stream changes neither the exo nor the fault
+        rows bitwise — per block, exactly the unblocked invariant."""
+        import jax
+        import jax.numpy as jnp
+
+        from ccka_tpu.sim import lanes as _lanes
+
+        _lanes.block_layout(block_T, block_T, t_chunk)  # divisibility
+        z = self.cluster.n_zones
+        faults = self.faults
+        workloads = self.workloads
+        dt_s, start_s = self.sim.dt_s, self.start_unix_s
+
+        def generate(k, t0_ticks):
+            ks, kc, kd = jax.random.split(k, 3)
+            noise = (
+                _ar1_device(ks, (block_T, z, batch), rho=0.97,
+                            sigma=0.04, axis=0),
+                _ar1_device(kc, (block_T, z, batch), rho=0.95,
+                            sigma=0.03, axis=0),
+                _ar1_device(kd, (block_T, batch), rho=0.9, sigma=0.5,
+                            axis=0),
+            )
+            packed = self._assemble_packed(block_T, block_T, noise,
+                                           t0_ticks=t0_ticks)
+            if faults is None and workloads is None:
+                return packed
+            parts = [packed]
+            if faults is not None:
+                from ccka_tpu.faults.process import packed_fault_lanes
+                parts.append(packed_fault_lanes(faults, k, block_T,
+                                                block_T, z, batch,
+                                                price_dev=noise[0]))
+            if workloads is not None:
+                from ccka_tpu.workloads.process import (
+                    packed_workload_lanes)
+                off_s = jnp.full(
+                    (batch,), jnp.asarray(t0_ticks, jnp.float32) * dt_s)
+                parts.append(packed_workload_lanes(
+                    workloads, k, block_T, block_T, z, batch,
+                    dt_s=dt_s, start_unix_s=start_s,
+                    start_offset_s=off_s))
+            return jnp.concatenate(parts, axis=1)
+
+        return generate
+
+    def packed_block_trace_device(self, block_T: int, key, batch: int,
+                                  block_index, *, t_chunk: int = 64,
+                                  recycle=None, shard=None,
+                                  total_steps: int | None = None):
+        """One ``[block_T, exo_rows(Z), B]`` stream BLOCK on device:
+        block ``block_index`` of the blocked stream family keyed by
+        ``key`` (see :meth:`packed_block_generate_fn` — the per-block
+        fold and the ``j * block_T`` tick offset are applied here, so
+        callers hand the SAME caller key for every block). One compiled
+        program serves all blocks: ``block_index`` is traced.
+        ``recycle``: donate a dead same-shape block buffer (the aliased
+        return of a ``donate_stream=True`` block launch) so the
+        double-buffer holds exactly two blocks per chip. ``shard``:
+        optional shard/cluster-chunk index folded AFTER the block fold
+        — the cluster-axis chunking path generates chunk ``c``'s block
+        bitwise as mesh shard ``c`` would (the sharded wrapper folds
+        `lax.axis_index` at the same position). ``total_steps`` is
+        accepted for signature uniformity with the replay backend
+        (synthetic worlds need no horizon-length extension)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ccka_tpu.sim import lanes as _lanes
+
+        del total_steps  # uniform signature; unused by synthesis
+        recycled = recycle is not None
+        sharded = shard is not None
+        cache_key = ("packed_block", block_T, batch, t_chunk, recycled,
+                     sharded)
+        fn = self._device_fns.get(cache_key)
+        if fn is None:
+            generate = self.packed_block_generate_fn(block_T, batch,
+                                                     t_chunk=t_chunk)
+
+            def block(k, j, *shard_arg):
+                kj = jax.random.fold_in(
+                    jax.random.fold_in(k, _lanes.BLOCK_KEY_TAG), j)
+                if shard_arg:
+                    kj = jax.random.fold_in(kj, shard_arg[0])
+                return generate(kj, j * jnp.int32(block_T))
+
+            if recycled:
+                fn = jax.jit(lambda k, j, *rest: block(k, j, *rest[:-1]),
+                             donate_argnums=(2 + sharded,),
+                             keep_unused=True)
+            else:
+                fn = jax.jit(block)
+            self._device_fns[cache_key] = fn
+        j = jnp.int32(block_index)
+        args = (key, j) + ((jnp.int32(shard),) if sharded else ())
+        return fn(*args, recycle) if recycled else fn(*args)
+
+    def _assemble_packed(self, steps: int, t_pad: int, noise: tuple,
+                         t0_ticks=None):
         """The `_assemble` formulas in time-major packed form: noise
         [T, Z, B]/[T, B] → [T_pad, exo_rows(Z), B] with the row order
         `sim.megakernel._pack_exo` defines (spot, od, carbon, demand,
         is_peak; zero padding). `tests/test_megakernel.py` pins this
         against `_assemble` on identical noise so the two layouts cannot
-        drift."""
+        drift.
+
+        ``t0_ticks``: optional (traced) global tick offset of this
+        stream's first row — the streaming pipeline generates block j
+        at offset ``j * block_T`` so the diurnal/peak phases stay
+        anchored to the SAME wall clock the unblocked stream uses. The
+        day reduction of ``start_unix_s`` happens on host in float64
+        BEFORE the f32 tick arithmetic (at unix-epoch scale the f32 ulp
+        is 128 s — the workload lanes pin the same pitfall). ``None``
+        keeps the exact host-numpy path existing callers compile."""
         import jax.numpy as jnp
 
         xp = jnp
         spot_noise, carbon_noise, demand_noise = noise
         B = demand_noise.shape[-1]
         dt = self.sim.dt_s
-        t = self.start_unix_s + np.arange(steps) * dt           # [T]
-        tod = xp.asarray((t % _DAY_S) / _DAY_S, dtype=xp.float32)
+        if t0_ticks is None:
+            t = self.start_unix_s + np.arange(steps) * dt       # [T]
+            tod = xp.asarray((t % _DAY_S) / _DAY_S, dtype=xp.float32)
+        else:
+            base = np.float32(self.start_unix_s % _DAY_S)
+            ticks = (xp.asarray(t0_ticks, xp.float32)
+                     + xp.arange(steps, dtype=xp.float32))      # [T]
+            tod = xp.mod(base + xp.mod(ticks * np.float32(dt),
+                                       np.float32(_DAY_S)),
+                         np.float32(_DAY_S)) / np.float32(_DAY_S)
         tod_zb = tod[:, None, None]                              # [T,1,1]
         nt = self.cluster.node_type
         zp = {k: xp.asarray(v)[None, :, None] for k, v in self._zp.items()}

@@ -78,3 +78,68 @@ def workload_base(rows: int, Z: int) -> int:
     if not has_wl:
         raise ValueError("stream carries no workload lanes")
     return exo_rows(Z) + (fault_rows(Z) if has_faults else 0)
+
+
+# ---- time-axis block layout (ISSUE 13: the streaming pipeline) ------------
+#
+# The streaming rollout engine (`sim/streaming.py`) splits the packed
+# stream's TIME axis into fixed blocks so generation of block k+1 can
+# overlap kernel consumption of block k. The arithmetic lives here for
+# the same reason the row arithmetic does: the generators (`signals/`),
+# the kernel's carried-state entries (`sim/megakernel.py`), the sharded
+# wrappers and bench's memory-bound bookkeeping must all agree on block
+# boundaries, and a half-agreed split would silently misalign lanes.
+# Per-block worlds are keyed ``fold_in(fold_in(key, BLOCK_KEY_TAG), j)``
+# — the folding itself lives with the jax-importing generators, but the
+# tag is declared here so every backend folds the SAME stream family.
+# Fault/workload lanes then key off the BLOCK key exactly as they key
+# off the whole-stream key today (fold_in(FAULT/WORKLOAD_KEY_TAG)), so
+# widening a blocked stream with lanes changes neither the exo nor the
+# fault rows bitwise — per block, the same invariant the unblocked
+# layouts pin.
+
+BLOCK_KEY_TAG = 0x5B10C  # per-block world fold tag (see above)
+
+
+def block_layout(T: int, block_T: int, t_chunk: int) -> tuple[int, int]:
+    """``(n_blocks, T_pad)`` of a time-blocked stream covering ``T``
+    true ticks in fixed ``block_T``-tick blocks of ``t_chunk``-sized
+    kernel chunks. Rejects any split the kernel grid cannot honor:
+    a block must be a whole number of time chunks, and the padded
+    horizon must be a whole number of blocks (a ragged tail block would
+    need its own compiled program AND its own buffer shape — the
+    double-buffer holds exactly two same-shape blocks per chip)."""
+    if block_T <= 0 or t_chunk <= 0:
+        raise ValueError(f"block_T={block_T} / t_chunk={t_chunk} must "
+                         "be positive")
+    if block_T % t_chunk:
+        raise ValueError(
+            f"block_T={block_T} is not a t_chunk={t_chunk} multiple — "
+            "the kernel grid advances whole time chunks")
+    T_pad = math.ceil(T / t_chunk) * t_chunk
+    if T_pad % block_T:
+        raise ValueError(
+            f"block_T={block_T} does not divide the padded horizon "
+            f"T_pad={T_pad} (T={T}, t_chunk={t_chunk}) — streaming "
+            "blocks must tile the horizon exactly")
+    return T_pad // block_T, T_pad
+
+
+def chunk_layout(batch: int, chunk: int) -> int:
+    """Number of cluster-axis chunks when a ``batch``-wide fleet streams
+    through the mesh ``chunk`` clusters at a time (bench's 10^4–10^5
+    rows). Rejects a chunk that does not tile the batch — a ragged tail
+    chunk would silently change the per-launch geometry mid-sweep."""
+    if chunk <= 0:
+        raise ValueError(f"cluster chunk={chunk} must be positive")
+    if batch % chunk:
+        raise ValueError(
+            f"cluster chunk={chunk} does not divide batch={batch} — "
+            "cluster-axis chunking needs equal-width chunks")
+    return batch // chunk
+
+
+def block_bytes(block_T: int, rows: int, batch: int) -> int:
+    """f32 bytes of ONE stream block — the unit of the streaming
+    pipeline's memory bound (2 blocks x lanes x chunk live per chip)."""
+    return 4 * block_T * rows * batch

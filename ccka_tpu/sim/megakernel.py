@@ -263,7 +263,8 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
                  mlp_dims: tuple | None = None,
                  plan_batched: bool = False,
                  faults: bool = False,
-                 workloads: int = 0):
+                 workloads: int = 0,
+                 carry: bool = False):
     """``policy``: "profiles" | "carbon" | "mlp" | "plan" (module
     docstring; "plan" executes a precomputed per-tick action stream —
     the diff-MPC playback entry — instead of deciding in-kernel).
@@ -289,6 +290,20 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
     from the post-step fleet's headroom exactly as `dynamics.step`'s
     workload path does. 0 is the pre-workload program, untouched
     (zero-workload gate).
+
+    ``carry``: the CARRIED-STATE variant (ISSUE 13, the streaming
+    pipeline): the launch covers one time BLOCK of a longer rollout —
+    the packed state loads from a ``state_in`` input at the block's
+    first chunk (instead of zeroing) and writes back to a ``state_out``
+    output at its last, so a rollout resumes bitwise across block
+    boundaries (the state rows carry the SummaryAcc accumulators, the
+    held-signal policy rows and the workload queues — everything a
+    resume needs). The block's global tick offset rides ``meta[0, 3]``
+    (the ``valid`` horizon gate and the tod clock stay global); the
+    PRNG needs no new plumbing because the caller folds the block's
+    first chunk index into the seed (`block_chunk_seed`), making the
+    per-(block, chunk) streams globally identical to one unblocked
+    launch. False is the pre-streaming program, untouched.
     """
     ROWS = _state_rows(P, Z, K,
                        fault_obs=faults and policy in ("carbon", "mlp"),
@@ -307,24 +322,35 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
         return state[lo:hi]
 
     def kernel(meta_ref, params_ref, *rest):
+        rest = list(rest)
         if policy == "mlp":
-            w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref, \
-                exo_ref, out_ref, s_ref = rest
+            w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref = rest[:6]
+            rest = rest[6:]
             # Grid (pop, batch, time): weights per population member.
             b_idx = pl.program_id(1)
             t_idx = pl.program_id(2)
         elif policy == "plan":
-            plan_ref, exo_ref, out_ref, s_ref = rest
+            plan_ref = rest.pop(0)
             b_idx = pl.program_id(0)
             t_idx = pl.program_id(1)
         else:
-            actions_ref, exo_ref, out_ref, s_ref = rest
+            actions_ref = rest.pop(0)
             b_idx = pl.program_id(0)
             t_idx = pl.program_id(1)
+        if carry:
+            state_in_ref, exo_ref, out_ref, state_out_ref, s_ref = rest
+        else:
+            exo_ref, out_ref, s_ref = rest
 
         @pl.when(t_idx == 0)
         def _init():
-            s_ref[:] = jnp.zeros_like(s_ref)
+            if carry:
+                # Resume: the previous block's carried state (the mlp
+                # grid's state block carries a leading pop axis).
+                s_ref[:] = (state_in_ref[0] if policy == "mlp"
+                            else state_in_ref[:])
+            else:
+                s_ref[:] = jnp.zeros_like(s_ref)
 
         # Independent stream per (batch block, time chunk) — deliberately
         # NOT per policy/population member, so same-seed runs are paired
@@ -338,6 +364,10 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
         p = {n: params_ref[0, i] for n, i in _PI.items()}
         dt_hr = p["dt_s"] / 3600.0
         T_total = meta_ref[0, 0]
+        # Global tick of this launch's first row (nonzero only for
+        # carried-state block launches): the valid gate and the tod
+        # clock stay anchored to the FULL horizon, not the block's.
+        t_base = meta_ref[0, 3]
 
         if policy == "mlp":
             # Hoisted out of the time loop: one VMEM read per weight per
@@ -355,7 +385,7 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
 
         def tick(i, state):
             exo = exo_ref[i]                       # [exo_rows, B]
-            tglob = t_idx * T_CHUNK + i
+            tglob = t_base + t_idx * T_CHUNK + i
             valid = (tglob < T_total).astype(jnp.float32)
 
             is_peak = exo[3 * Z + 2] > 0.5         # [B] bool
@@ -863,6 +893,14 @@ def _make_kernel(P: int, Z: int, K: int, T_CHUNK: int, n_chunks: int,
                 out_ref[0] = out
             else:
                 out_ref[:] = out
+            if carry:
+                # Hand the block's final packed state back for the next
+                # block's resume (aliased onto state_in by the donating
+                # launchers — one state buffer per chip).
+                if policy == "mlp":
+                    state_out_ref[0] = state
+                else:
+                    state_out_ref[:] = state
 
     return kernel, ROWS
 
@@ -969,44 +1007,70 @@ def _pack_exo(traces: ExogenousTrace, T_pad: int) -> jnp.ndarray:
                                              "stochastic", "b_block",
                                              "t_chunk", "interpret",
                                              "carbon"))
-def _run(params_packed, actions_packed, exo_packed, meta, *, P, Z, K, WD,
-         stochastic, b_block, t_chunk, interpret=False, carbon=None):
+def _run(params_packed, actions_packed, exo_packed, meta, state_in=None,
+         *, P, Z, K, WD, stochastic, b_block, t_chunk, interpret=False,
+         carbon=None):
     # Lane auto-detect: widened streams (`ccka_tpu/faults` /
     # `ccka_tpu/workloads`) carry extra row blocks past _exo_rows(Z),
     # resolved purely from the static row count. Shapes are static at
     # trace time, so this is a compile-time switch — the plain-stream
     # program is the pre-fault/pre-workload kernel, untouched.
+    # ``state_in`` (the streaming pipeline's carried state, [s_rows, B])
+    # selects the carry variant: the launch then ALSO returns the
+    # block's final state (see `_make_kernel`'s ``carry``).
     T_pad, exo_rows_total, B = exo_packed.shape
     faults, wl = lanes.stream_layout(exo_rows_total, Z)
+    carry = state_in is not None
     n_b = B // b_block
     n_t = T_pad // t_chunk
     kernel, ROWS = _make_kernel(
         P, Z, K, t_chunk, n_t, stochastic,
         policy="carbon" if carbon is not None else "profiles",
-        carbon=carbon, faults=faults, workloads=WD if wl else 0)
+        carbon=carbon, faults=faults, workloads=WD if wl else 0,
+        carry=carry)
     s_rows = math.ceil(ROWS["_total"][1] / 8) * 8
+    if carry and tuple(state_in.shape) != (s_rows, B):
+        raise ValueError(
+            f"carried state shape {tuple(state_in.shape)} does not "
+            f"match this mode/layout's ({s_rows}, {B}) — build it with "
+            "init_block_state for the SAME stream layout")
 
-    out = pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((1, 4), lambda b, t: (0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, len(_PARAM_NAMES)), lambda b, t: (0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((2, _act_rows(P, Z)), lambda b, t: (0, 0),
+                     memory_space=pltpu.SMEM),
+    ]
+    state_spec = pl.BlockSpec((s_rows, b_block), lambda b, t: (0, b),
+                              memory_space=pltpu.VMEM)
+    if carry:
+        in_specs.append(state_spec)
+    in_specs.append(
+        pl.BlockSpec((t_chunk, exo_rows_total, b_block),
+                     lambda b, t: (t, 0, b), memory_space=pltpu.VMEM))
+    out_spec = pl.BlockSpec((_OUT_ROWS, b_block), lambda b, t: (0, b),
+                            memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((_OUT_ROWS, B), jnp.float32)
+    if carry:
+        out_specs = (out_spec, state_spec)
+        out_shape = (out_shape,
+                     jax.ShapeDtypeStruct((s_rows, B), jnp.float32))
+    else:
+        out_specs = out_spec
+    args = ((meta, params_packed, actions_packed, state_in, exo_packed)
+            if carry else
+            (meta, params_packed, actions_packed, exo_packed))
+    return pl.pallas_call(
         kernel,
         interpret=interpret,
         grid=(n_b, n_t),
-        in_specs=[
-            pl.BlockSpec((1, 3), lambda b, t: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, len(_PARAM_NAMES)), lambda b, t: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((2, _act_rows(P, Z)), lambda b, t: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((t_chunk, exo_rows_total, b_block),
-                         lambda b, t: (t, 0, b),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((_OUT_ROWS, b_block), lambda b, t: (0, b),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((_OUT_ROWS, B), jnp.float32),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((s_rows, b_block), jnp.float32)],
-    )(meta, params_packed, actions_packed, exo_packed)
-    return out
+    )(*args)
 
 
 def _obs_dim(P: int, Z: int) -> int:
@@ -1077,11 +1141,12 @@ def _pack_mlp_tensors(net_params, dims, b_block: int):
 @functools.partial(jax.jit, static_argnames=(
     "P", "Z", "K", "WD", "stochastic", "b_block", "t_chunk", "interpret",
     "slo_mask", "mlp_dims"))
-def _run_mlp(params_packed, weights, exo_packed, meta, *, P, Z, K, WD,
-             stochastic, b_block, t_chunk, slo_mask, mlp_dims,
-             interpret=False):
+def _run_mlp(params_packed, weights, exo_packed, meta, state_in=None,
+             *, P, Z, K, WD, stochastic, b_block, t_chunk, slo_mask,
+             mlp_dims, interpret=False):
     T_pad, exo_rows_total, B = exo_packed.shape
     faults, wl = lanes.stream_layout(exo_rows_total, Z)   # see _run
+    carry = state_in is not None
     n_b = B // b_block
     n_t = T_pad // t_chunk
     NP = weights[0].shape[0]
@@ -1090,36 +1155,56 @@ def _run_mlp(params_packed, weights, exo_packed, meta, *, P, Z, K, WD,
     kernel, ROWS = _make_kernel(P, Z, K, t_chunk, n_t, stochastic,
                                 policy="mlp", slo_mask=slo_mask,
                                 mlp_dims=mlp_dims, faults=faults,
-                                workloads=WD if wl else 0)
+                                workloads=WD if wl else 0, carry=carry)
     s_rows = math.ceil(ROWS["_total"][1] / 8) * 8
+    if carry and tuple(state_in.shape) != (NP, s_rows, B):
+        raise ValueError(
+            f"carried state shape {tuple(state_in.shape)} does not "
+            f"match the population kernel's ({NP}, {s_rows}, {B}) — "
+            "build it with init_block_state for the SAME stream layout")
 
     def wspec(rows, cols):
         return pl.BlockSpec((1, rows, cols), lambda n, b, t: (n, 0, 0),
                             memory_space=pltpu.VMEM)
 
-    out = pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((1, 4), lambda n, b, t: (0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, len(_PARAM_NAMES)), lambda n, b, t: (0, 0),
+                     memory_space=pltpu.SMEM),
+        wspec(F_pad, H), wspec(H, b_block),      # w1, b1
+        wspec(H, H), wspec(H, b_block),          # w2, b2
+        wspec(H, A_pad), wspec(A_pad, b_block),  # w3, b3
+    ]
+    state_spec = pl.BlockSpec((1, s_rows, b_block),
+                              lambda n, b, t: (n, 0, b),
+                              memory_space=pltpu.VMEM)
+    if carry:
+        in_specs.append(state_spec)
+    in_specs.append(
+        pl.BlockSpec((t_chunk, exo_rows_total, b_block),
+                     lambda n, b, t: (t, 0, b), memory_space=pltpu.VMEM))
+    out_spec = pl.BlockSpec((1, _OUT_ROWS, b_block),
+                            lambda n, b, t: (n, 0, b),
+                            memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((NP, _OUT_ROWS, B), jnp.float32)
+    if carry:
+        out_specs = (out_spec, state_spec)
+        out_shape = (out_shape,
+                     jax.ShapeDtypeStruct((NP, s_rows, B), jnp.float32))
+    else:
+        out_specs = out_spec
+    args = ((meta, params_packed, *weights, state_in, exo_packed)
+            if carry else (meta, params_packed, *weights, exo_packed))
+    return pl.pallas_call(
         kernel,
         interpret=interpret,
         grid=(NP, n_b, n_t),
-        in_specs=[
-            pl.BlockSpec((1, 3), lambda n, b, t: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, len(_PARAM_NAMES)), lambda n, b, t: (0, 0),
-                         memory_space=pltpu.SMEM),
-            wspec(F_pad, H), wspec(H, b_block),      # w1, b1
-            wspec(H, H), wspec(H, b_block),          # w2, b2
-            wspec(H, A_pad), wspec(A_pad, b_block),  # w3, b3
-            pl.BlockSpec((t_chunk, exo_rows_total, b_block),
-                         lambda n, b, t: (t, 0, b),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, _OUT_ROWS, b_block),
-                               lambda n, b, t: (n, 0, b),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((NP, _OUT_ROWS, B), jnp.float32),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((s_rows, b_block), jnp.float32)],
-    )(meta, params_packed, *weights, exo_packed)
-    return out
+    )(*args)
 
 
 def megakernel_rollout_summary(params: SimParams,
@@ -1174,10 +1259,14 @@ def _fused_profile_summary(params, off_action, peak_action, traces, seed,
         carbon=carbon)
 
 
-def _meta(T: int, stochastic: bool, seed) -> jnp.ndarray:
-    meta = jnp.asarray([[T, 0, 0]], jnp.int32)
+def _meta(T: int, stochastic: bool, seed, t0=0) -> jnp.ndarray:
+    """[1, 4] SMEM scalars: total horizon, stochastic flag, seed, and
+    the launch's global tick offset (``t0`` — nonzero only for the
+    streaming pipeline's carried-state block launches)."""
+    meta = jnp.asarray([[T, 0, 0, 0]], jnp.int32)
     meta = meta.at[0, 1].set(int(stochastic))
-    return meta.at[0, 2].set(jnp.int32(seed))
+    meta = meta.at[0, 2].set(jnp.int32(seed))
+    return meta.at[0, 3].set(jnp.int32(t0))
 
 
 def _finalize(params: SimParams, out: jnp.ndarray, T: int):
@@ -1565,17 +1654,24 @@ def pack_plan(actions: Action, T_pad: int) -> jnp.ndarray:
 @functools.partial(jax.jit, static_argnames=(
     "P", "Z", "K", "WD", "stochastic", "b_block", "t_chunk", "interpret",
     "plan_batched"))
-def _run_plan(params_packed, plan_packed, exo_packed, meta, *, P, Z, K,
-              WD, stochastic, b_block, t_chunk, plan_batched,
-              interpret=False):
+def _run_plan(params_packed, plan_packed, exo_packed, meta,
+              state_in=None, *, P, Z, K, WD, stochastic, b_block,
+              t_chunk, plan_batched, interpret=False):
     T_pad, exo_rows_total, B = exo_packed.shape
     faults, wl = lanes.stream_layout(exo_rows_total, Z)   # see _run
+    carry = state_in is not None
     n_b = B // b_block
     n_t = T_pad // t_chunk
     kernel, ROWS = _make_kernel(P, Z, K, t_chunk, n_t, stochastic,
                                 policy="plan", plan_batched=plan_batched,
-                                faults=faults, workloads=WD if wl else 0)
+                                faults=faults, workloads=WD if wl else 0,
+                                carry=carry)
     s_rows = math.ceil(ROWS["_total"][1] / 8) * 8
+    if carry and tuple(state_in.shape) != (s_rows, B):
+        raise ValueError(
+            f"carried state shape {tuple(state_in.shape)} does not "
+            f"match this mode/layout's ({s_rows}, {B}) — build it with "
+            "init_block_state for the SAME stream layout")
     pr = _plan_rows(P, Z)
     if plan_batched:
         # Per-cluster plans stream through VMEM exactly like the exo
@@ -1589,26 +1685,41 @@ def _run_plan(params_packed, plan_packed, exo_packed, meta, *, P, Z, K,
         plan_spec = pl.BlockSpec((t_chunk, pr), lambda b, t: (t, 0),
                                  memory_space=pltpu.SMEM)
 
-    out = pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((1, 4), lambda b, t: (0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, len(_PARAM_NAMES)), lambda b, t: (0, 0),
+                     memory_space=pltpu.SMEM),
+        plan_spec,
+    ]
+    state_spec = pl.BlockSpec((s_rows, b_block), lambda b, t: (0, b),
+                              memory_space=pltpu.VMEM)
+    if carry:
+        in_specs.append(state_spec)
+    in_specs.append(
+        pl.BlockSpec((t_chunk, exo_rows_total, b_block),
+                     lambda b, t: (t, 0, b), memory_space=pltpu.VMEM))
+    out_spec = pl.BlockSpec((_OUT_ROWS, b_block), lambda b, t: (0, b),
+                            memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((_OUT_ROWS, B), jnp.float32)
+    if carry:
+        out_specs = (out_spec, state_spec)
+        out_shape = (out_shape,
+                     jax.ShapeDtypeStruct((s_rows, B), jnp.float32))
+    else:
+        out_specs = out_spec
+    args = ((meta, params_packed, plan_packed, state_in, exo_packed)
+            if carry else
+            (meta, params_packed, plan_packed, exo_packed))
+    return pl.pallas_call(
         kernel,
         interpret=interpret,
         grid=(n_b, n_t),
-        in_specs=[
-            pl.BlockSpec((1, 3), lambda b, t: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, len(_PARAM_NAMES)), lambda b, t: (0, 0),
-                         memory_space=pltpu.SMEM),
-            plan_spec,
-            pl.BlockSpec((t_chunk, exo_rows_total, b_block),
-                         lambda b, t: (t, 0, b),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((_OUT_ROWS, b_block), lambda b, t: (0, b),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((_OUT_ROWS, B), jnp.float32),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((s_rows, b_block), jnp.float32)],
-    )(meta, params_packed, plan_packed, exo_packed)
-    return out
+    )(*args)
 
 
 def _check_plan(plan_packed, exo_packed, P: int, Z: int) -> bool:
@@ -1762,6 +1873,309 @@ def plan_megakernel_summary_from_packed(params: SimParams,
               interpret=interpret, plan_batched=plan_batched)
 
 
+# ---- carried-state block entries (ISSUE 13: the streaming pipeline) ------
+#
+# One time BLOCK of a longer rollout per launch: the packed state enters
+# and leaves the kernel (`_make_kernel`'s ``carry``), the block's global
+# tick offset rides meta[0, 3], and the per-(batch block, time chunk)
+# PRNG streams stay GLOBAL via `block_chunk_seed` — so a blocked rollout
+# is bitwise the unblocked launch on the concatenated stream, in both
+# deterministic and stochastic modes. The donating fused entries alias
+# BOTH the consumed stream block (recycle it into the next block's
+# synthesis, `packed_block_trace_device(recycle=...)`) and the carried
+# state (ping-pong: one state buffer per chip), which is what bounds the
+# streaming pipeline's memory at two stream blocks + one state.
+
+
+def block_chunk_seed(seed, block_index, block_T: int, t_chunk: int):
+    """Kernel seed for time block ``block_index`` making per-chunk PRNG
+    streams GLOBAL — the time-axis analog of
+    `parallel.sharded_kernel.shard_seed`:
+
+    ``block_chunk_seed(s, j, bT, tc) + t_loc * SEED_CHUNK_STRIDE
+      == s + (j * bT // tc + t_loc) * SEED_CHUNK_STRIDE``
+
+    — i.e. local chunk ``t_loc`` of block ``j`` draws exactly the
+    stream the unblocked kernel gives the same GLOBAL chunk.
+    Traced-arithmetic-safe (``block_index`` is traced in the streaming
+    loop's one compiled step program)."""
+    return seed + block_index * (block_T // t_chunk) * SEED_CHUNK_STRIDE
+
+
+def block_state_rows(params: SimParams, cluster, mode: str,
+                     stream_rows: int) -> int:
+    """Padded row count of the carried state for ``mode`` on a stream
+    with ``stream_rows`` rows — the state layout depends on the lane
+    layout (fault-observing policies carry held-signal rows; workload
+    lanes carry queue rows), so the stream decides."""
+    P, Z = cluster.n_pools, cluster.n_zones
+    faults, wl = lanes.stream_layout(stream_rows, Z)
+    policy = {"rule": "profiles"}.get(mode, mode)
+    if policy == "neural":
+        policy = "mlp"
+    ROWS = _state_rows(P, Z, int(params.provision_pipeline_k),
+                       fault_obs=faults and policy in ("carbon", "mlp"),
+                       wl_D=(int(params.wl_batch_deadline_ticks)
+                             if wl else 0))
+    return math.ceil(ROWS["_total"][1] / 8) * 8
+
+
+def init_block_state(params: SimParams, cluster, mode: str,
+                     stream_rows: int, batch: int, *,
+                     n_pop: int | None = None) -> jnp.ndarray:
+    """Fresh-episode carried state (all zeros — exactly the state the
+    non-carry kernel's ``_init`` builds): ``[s_rows, B]``, or
+    ``[NP, s_rows, B]`` for the population ("neural") kernel."""
+    s_rows = block_state_rows(params, cluster, mode, stream_rows)
+    shape = ((n_pop, s_rows, batch) if n_pop is not None
+             else (s_rows, batch))
+    return jnp.zeros(shape, jnp.float32)
+
+
+def _packed_block_impl(params, off_action, peak_action, exo_block,
+                       state, seed, block_index, *, T, block_T, P, Z, K,
+                       WD, stochastic, b_block, t_chunk, interpret,
+                       carbon=None):
+    t0 = block_index * block_T
+    meta = _meta(T, stochastic,
+                 block_chunk_seed(seed, block_index, block_T, t_chunk),
+                 t0)
+    out, state2 = _run(_pack_params(params),
+                       jnp.stack([_pack_action(off_action),
+                                  _pack_action(peak_action)]),
+                       exo_block, meta, state, P=P, Z=Z, K=K, WD=WD,
+                       stochastic=stochastic, b_block=b_block,
+                       t_chunk=t_chunk, interpret=interpret,
+                       carbon=carbon)
+    # Identity stream return = the donation alias (recycle it).
+    return out, state2, exo_block
+
+
+_BLOCK_STATICS = ("T", "block_T", "P", "Z", "K", "WD", "stochastic",
+                  "b_block", "t_chunk", "interpret", "carbon")
+
+_fused_packed_block = functools.partial(
+    jax.jit, static_argnames=_BLOCK_STATICS,
+    donate_argnums=(3, 4))(_packed_block_impl)
+
+
+def _neural_block_impl(params, weights, exo_block, state, seed,
+                       block_index, *, T, block_T, P, Z, K, WD,
+                       stochastic, b_block, t_chunk, slo_mask, mlp_dims,
+                       interpret):
+    """``weights``: the PRE-PACKED kernel tensors (`_pack_mlp_tensors`
+    — packed once per factory; repacking per block would re-dispatch
+    the pack every block). NOT donated: the same weights score every
+    block of the rollout."""
+    t0 = block_index * block_T
+    meta = _meta(T, stochastic,
+                 block_chunk_seed(seed, block_index, block_T, t_chunk),
+                 t0)
+    out, state2 = _run_mlp(_pack_params(params), weights, exo_block,
+                           meta, state, P=P, Z=Z, K=K, WD=WD,
+                           stochastic=stochastic, b_block=b_block,
+                           t_chunk=t_chunk, slo_mask=slo_mask,
+                           mlp_dims=mlp_dims, interpret=interpret)
+    return out, state2, exo_block
+
+
+_NEURAL_BLOCK_STATICS = ("T", "block_T", "P", "Z", "K", "WD",
+                         "stochastic", "b_block", "t_chunk", "slo_mask",
+                         "mlp_dims", "interpret")
+
+_fused_neural_block = functools.partial(
+    jax.jit, static_argnames=_NEURAL_BLOCK_STATICS,
+    donate_argnums=(2, 3))(_neural_block_impl)
+
+
+def _plan_block_impl(params, plan_packed, exo_block, state, seed,
+                     block_index, *, T, block_T, P, Z, K, WD, stochastic,
+                     b_block, t_chunk, interpret, plan_batched):
+    """``plan_packed`` is the FULL-horizon packed plan; the block's rows
+    slice off here (traced offset, static size) so one program serves
+    every block. The plan is never donated — a plan is scored against
+    many worlds and outlives every block launch by design."""
+    t0 = block_index * block_T
+    plan_block = jax.lax.dynamic_slice_in_dim(plan_packed, t0, block_T,
+                                              axis=0)
+    meta = _meta(T, stochastic,
+                 block_chunk_seed(seed, block_index, block_T, t_chunk),
+                 t0)
+    out, state2 = _run_plan(_pack_params(params), plan_block, exo_block,
+                            meta, state, P=P, Z=Z, K=K, WD=WD,
+                            stochastic=stochastic, b_block=b_block,
+                            t_chunk=t_chunk, plan_batched=plan_batched,
+                            interpret=interpret)
+    return out, state2, exo_block
+
+
+_PLAN_BLOCK_STATICS = ("T", "block_T", "P", "Z", "K", "WD", "stochastic",
+                       "b_block", "t_chunk", "interpret", "plan_batched")
+
+_fused_plan_block = functools.partial(
+    jax.jit, static_argnames=_PLAN_BLOCK_STATICS,
+    donate_argnums=(2, 3))(_plan_block_impl)
+
+
+class BlockSummaryFns(tuple):
+    """(step, init_state, finalize, n_blocks, T_pad) with named access —
+    the per-mode carried-state closure bundle
+    (`packed_mode_block_summary_fn`)."""
+
+    __slots__ = ()
+
+    def __new__(cls, step, init_state, finalize, n_blocks, T_pad):
+        return tuple.__new__(cls, (step, init_state, finalize, n_blocks,
+                                   T_pad))
+
+    step = property(lambda self: self[0])
+    init_state = property(lambda self: self[1])
+    finalize = property(lambda self: self[2])
+    n_blocks = property(lambda self: self[3])
+    T_pad = property(lambda self: self[4])
+
+
+def packed_mode_block_summary_fn(params: SimParams, cluster, mode: str,
+                                 *, T: int, block_T: int,
+                                 b_block: int = 512, t_chunk: int = 64,
+                                 interpret: bool = False,
+                                 stochastic: bool = True,
+                                 net_params=None, plan_packed=None,
+                                 carbon: tuple | None = None
+                                 ) -> BlockSummaryFns:
+    """The per-mode ``*_block_summary`` closures of the streaming
+    pipeline (ISSUE 13): a rollout resumable across time blocks, one
+    closure bundle per packed policy mode (`PACKED_MODE_WATCH_NAMES`
+    vocabulary — the same four modes `packed_mode_summary_fn` serves
+    synchronously).
+
+    - ``step(stream_block, state, j, seed) -> (out, state', stream')``
+      runs block ``j`` ([block_T, rows, B] stream slice) from carried
+      ``state``; the stream block AND the state are DONATED — ``state'``
+      aliases ``state``'s buffer (ping-pong) and ``stream'`` aliases the
+      consumed block (recycle it into the next block's synthesis via
+      ``packed_block_trace_device(recycle=...)``). ``out`` is the raw
+      accumulator row block — meaningful only after the LAST block.
+    - ``init_state(stream_rows, batch)`` → the fresh-episode state for
+      the stream's lane layout.
+    - ``finalize(out)`` → the EpisodeSummary batch (identical reduction
+      to the synchronous entries' — same `_finalize`).
+
+    Blocked == unblocked is bitwise by construction: same per-tick
+    arithmetic, same global valid/tod clocks (meta t0), same global
+    PRNG streams (`block_chunk_seed`), and the carried state crosses
+    blocks through exact f32 HBM round trips. `tests/test_streaming.py`
+    pins it for all four modes with fault+workload lanes on.
+
+    ``plan_packed`` (mode "plan"): the full-horizon packed plan
+    (`pack_plan(actions, T_pad)`); None plays the neutral broadcast
+    plan (bench's content-independent throughput convention).
+    ``carbon`` (mode "carbon"): policy statics, defaulting to
+    CarbonAwarePolicy's. ``net_params`` (mode "neural"): ActorCritic
+    pytree, population axis supported ([NP, B] fields).
+    """
+    from ccka_tpu.policy.rule import (neutral_action, offpeak_action,
+                                      peak_action)
+
+    n_blocks, T_pad = lanes.block_layout(T, block_T, t_chunk)
+    P, Z = cluster.n_pools, cluster.n_zones
+    K = int(params.provision_pipeline_k)
+    WD = int(params.wl_batch_deadline_ticks)
+    kw = dict(T=T, block_T=block_T, P=P, Z=Z, K=K, WD=WD,
+              stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+              interpret=interpret)
+
+    def check_block(stream_block):
+        if stream_block.shape[0] != block_T:
+            raise ValueError(
+                f"stream block covers {stream_block.shape[0]} ticks, "
+                f"the blocked layout needs exactly block_T={block_T} — "
+                "generate with packed_block_trace_device(block_T, ...)")
+
+    if mode in ("rule", "carbon"):
+        off, peak = offpeak_action(cluster), peak_action(cluster)
+        if mode == "carbon" and carbon is None:
+            carbon = (10.0, 0.05, 1.0)   # CarbonAwarePolicy defaults
+        cstat = carbon if mode == "carbon" else None
+
+        def step(stream_block, state, j, seed):
+            check_block(stream_block)
+            return _fused_packed_block(
+                params, off, peak, stream_block, state, jnp.int32(seed),
+                jnp.int32(j), carbon=cstat, **kw)
+
+        def init_state(stream_rows, batch):
+            return init_block_state(params, cluster, mode, stream_rows,
+                                    batch)
+
+        def finalize(out):
+            return _finalize(params, out, T)
+
+    elif mode == "neural":
+        if net_params is None:
+            raise ValueError("packed_mode_block_summary_fn: mode "
+                             "'neural' needs net_params")
+        from ccka_tpu.policy.constraints import slo_pool_mask
+
+        dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
+        if was_single:
+            net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                      net_params)
+        slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
+        weights = _pack_mlp_tensors(net_params, dims, b_block)
+        n_pop = int(weights[0].shape[0])
+        nkw = dict(kw, slo_mask=slo, mlp_dims=dims)
+
+        def step(stream_block, state, j, seed):
+            check_block(stream_block)
+            return _fused_neural_block(
+                params, weights, stream_block, state, jnp.int32(seed),
+                jnp.int32(j), **nkw)
+
+        def init_state(stream_rows, batch):
+            return init_block_state(params, cluster, mode, stream_rows,
+                                    batch, n_pop=n_pop)
+
+        def finalize(out):
+            s = jax.vmap(lambda o: _finalize(params, o, T))(out)
+            return jax.tree.map(lambda x: x[0], s) if was_single else s
+
+    elif mode == "plan":
+        if plan_packed is None:
+            base = neutral_action(cluster)
+            actions = jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (T_pad,) + x.shape), base)
+            plan_packed = pack_plan(actions, T_pad)
+        pr = _plan_rows(P, Z)
+        if plan_packed.shape[0] != T_pad or plan_packed.shape[1] != pr:
+            raise ValueError(
+                f"plan stream shape {tuple(plan_packed.shape)} does not "
+                f"match T_pad={T_pad} / plan_rows={pr} — pack with "
+                "pack_plan(actions, T_pad)")
+        plan_batched = plan_packed.ndim == 3
+        pkw = dict(kw, plan_batched=plan_batched)
+
+        def step(stream_block, state, j, seed):
+            check_block(stream_block)
+            return _fused_plan_block(
+                params, plan_packed, stream_block, state,
+                jnp.int32(seed), jnp.int32(j), **pkw)
+
+        def init_state(stream_rows, batch):
+            return init_block_state(params, cluster, mode, stream_rows,
+                                    batch)
+
+        def finalize(out):
+            return _finalize(params, out, T)
+
+    else:
+        raise ValueError(
+            f"unknown packed mode {mode!r} — have "
+            f"{tuple(PACKED_MODE_WATCH_NAMES)}")
+
+    return BlockSummaryFns(step, init_state, finalize, n_blocks, T_pad)
+
+
 # Dispatch/recompile watch (obs/compile.py) on the fused jit entry
 # points — the only places a megakernel launch actually dispatches
 # (`_run`/`_run_mlp` live inside these traces). A sweep legitimately
@@ -1798,6 +2212,19 @@ _fused_plan_packed_summary = watch_jit(
 _fused_plan_packed_donate = watch_jit(
     _fused_plan_packed_donate, "megakernel.plan_packed_summary_donate",
     hot=True, warmup_compiles=6)
+# Wider warmup than the other fused entries: the streaming bench's
+# paired sweep legitimately compiles TWO programs per geometry (the
+# blocked program and the one-launch unblocked reference) across
+# several geometries plus the chunked row's.
+_fused_packed_block = watch_jit(
+    _fused_packed_block, "megakernel.packed_block", hot=True,
+    warmup_compiles=12)
+_fused_neural_block = watch_jit(
+    _fused_neural_block, "megakernel.neural_packed_block", hot=True,
+    warmup_compiles=12)
+_fused_plan_block = watch_jit(
+    _fused_plan_block, "megakernel.plan_packed_block", hot=True,
+    warmup_compiles=12)
 
 # The four packed policy modes the device-time observatory sweeps
 # (`bench.py --perf-only`, `ccka perf`, `obs/occupancy.py`): mode name →

@@ -616,3 +616,91 @@ class TestPerfObservatoryClaims:
             assert phrase in arch, phrase
         # §6 carries the staleness pointer the refresh satellite adds.
         assert "historical; see §17" in arch
+
+
+class TestStreamingClaims:
+    """Round 16's streaming pipeline (ISSUE 13 docs satellite):
+    README's "Streaming pipeline" section is PARSED against the
+    BASELINE round16 record — the headline, the same-session r15
+    comparison, the two-buffer bound and the chunked memory bound are
+    all record-derived, never hand-synced."""
+
+    def test_round16_record_is_self_describing(self, baseline):
+        r16 = baseline["published"]["round16"]["stream_stage"]
+        # A CPU record must say so, and must say it cannot overlap.
+        assert r16["virtual"] is True and r16["platform"] == "cpu"
+        assert r16["overlap_capable"] is False
+        # The bitwise gates the acceptance criteria name.
+        assert r16["bitwise_all"] is True
+        assert r16["stream_buffers"] == 2
+        sc = r16["single_chip"]
+        assert sc["cluster_days_per_sec"] > 0
+        # One protocol, two geometries: the streaming headline improves
+        # on the SAME-SESSION replication of the round-15 headline.
+        assert sc["vs_r15_replication"] >= 1.0
+        repl = r16["r15_replication"]
+        assert repl["historical_round15_cluster_days_per_sec"] == 554.66
+        assert repl["cluster_days_per_sec"] > 0
+        # The chunked row's stated memory bound is the formula, not a
+        # hand-typed number: 2 blocks x lanes x chunk x 4 bytes.
+        from ccka_tpu.config import default_config
+        from ccka_tpu.sim import lanes
+
+        ch = r16["chunked"]
+        assert ch["batch"] >= 10_000
+        Z = default_config().cluster.n_zones
+        assert ch["live_block_bytes"] == 2 * lanes.block_bytes(
+            ch["block_T"], lanes.exo_rows(Z), ch["chunk"])
+        assert ch["bitwise_pipelined_vs_sync"] is True
+        assert ch["roofline_floor_s"] > 0
+        m = r16["mesh8"]
+        assert m["bitwise_mesh_vs_chunked"] is True
+        assert m["shards"] == 8
+        # Single-core floor: the best paired row must not regress past
+        # the sentinel's non-overlap floor.
+        assert r16["best_paired"]["throughput_ratio"] >= 0.85
+
+    def test_readme_streaming_headline(self, readme, baseline):
+        r16 = baseline["published"]["round16"]["stream_stage"]
+        sc = r16["single_chip"]
+        m = re.search(r"\*\*([\d.,]+)\s*cluster-days/sec\*\*\s+"
+                      r"\(B=(\d+)\s+×\s+(\d+)\s+steps,\s+kernel\s+"
+                      r"stage,\s+CPU\s+interpret", readme)
+        assert m, ("README's streaming headline lost its pinned form "
+                   "(the number must stay labeled kernel-stage + CPU "
+                   "interpret)")
+        assert abs(float(m.group(1).replace(",", ""))
+                   - sc["cluster_days_per_sec"]) < 0.05
+        assert int(m.group(2)) == sc["batch"]
+        assert int(m.group(3)) == sc["steps"]
+        m2 = re.search(r"([\d.]+)×\s+the\s+same-session\s+round-15\s+"
+                       r"replication\s+\(([\d.,]+)\s*cluster-days/sec",
+                       readme)
+        assert m2, "README's r15-comparison claim lost its form"
+        assert abs(float(m2.group(1)) - sc["vs_r15_replication"]) < 5e-3
+        assert abs(float(m2.group(2).replace(",", ""))
+                   - r16["r15_replication"]["cluster_days_per_sec"]) \
+            < 0.05
+
+    def test_readme_chunked_and_buffer_claims(self, readme, baseline):
+        r16 = baseline["published"]["round16"]["stream_stage"]
+        ch = r16["chunked"]
+        m = re.search(r"([\d,]+)\s+clusters\s+stream[^.]*?([\d.]+)\s*"
+                      r"MiB\s+of\s+live\s+stream\s+blocks", readme)
+        assert m, "README's chunked bounded-memory claim lost its form"
+        assert int(m.group(1).replace(",", "")) == ch["batch"]
+        assert abs(float(m.group(2)) - ch["live_block_mib"]) < 0.05
+        assert re.search(r"exactly\s+\*\*two\s+stream\s+blocks\*\*\s+"
+                         r"per\s+chip", readme)
+
+    def test_architecture_has_section_18(self):
+        arch = _read("ARCHITECTURE.md")
+        assert ("## 18. The double-buffered streaming rollout pipeline"
+                in arch)
+        for phrase in ("block_layout", "BLOCK_KEY_TAG",
+                       "block_chunk_seed",
+                       "packed_mode_block_summary_fn",
+                       "2 × block_T × rows × chunk",
+                       "overlap_capable", "r15_replication",
+                       "sharded_block_packed_trace"):
+            assert phrase in arch, phrase

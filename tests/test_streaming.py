@@ -1,0 +1,562 @@
+"""Streaming rollout pipeline (ISSUE 13): carry-state exactness,
+donation-chain bounds, sharded pairing, and misuse rejection.
+
+Contract map:
+
+- **Block-boundary carry exactness**: a blocked rollout (carried state
+  crossing every block boundary through exact f32 HBM round trips) is
+  BITWISE the unblocked single-launch rollout on the concatenated
+  stream — for all four megakernel modes, with fault AND workload lanes
+  on, and whether the blocks are consumed synchronously or
+  double-buffered. The raw accumulator rows are additionally pinned
+  against the LEGACY non-carry kernel program, tying the carry family
+  to the pre-streaming pinned contract.
+- **Donation chain**: the pipelined drive cycles exactly TWO stream
+  buffers per chip, warning-free (an unusable donation warns).
+- **8-shard parity**: the mesh streaming drive (shard-local blocked
+  generation + lane-sharded carried state) is bitwise the single-chip
+  cluster-chunked drive of the same (key, seed) — and, transitively,
+  within the ONE shared tolerance table of the unblocked reference.
+- **Misuse rejection**: block sizes that don't tile the horizon,
+  cluster chunks that don't tile the batch, wrong-layout carried
+  state, and wrong-length stream blocks are all rejected up front.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import FAULT_PRESETS, WorkloadsConfig, default_config
+from ccka_tpu.sim import SimParams, lanes
+from ccka_tpu.sim import streaming as streaming_mod
+from ccka_tpu.sim.megakernel import (
+    SEED_BLOCK_STRIDE,
+    SEED_CHUNK_STRIDE,
+    block_chunk_seed,
+    mean_parity_violations,
+    packed_mode_block_summary_fn,
+)
+from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+# One shared geometry for the whole module (one compile per mode).
+B, T, BLOCK_T, T_CHUNK, B_BLOCK = 32, 64, 32, 16, 16
+KW = dict(T=T, block_T=BLOCK_T, t_chunk=T_CHUNK, b_block=B_BLOCK,
+          interpret=True, stochastic=False)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config()
+
+
+@pytest.fixture(scope="module")
+def setup(cfg):
+    """(params, source) with BOTH lane families on — the carry state
+    then includes the held-signal rows and the workload queues, so the
+    exactness tests cover every row the resume threads."""
+    params = SimParams.from_config(cfg)
+    src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                cfg.signals,
+                                faults=FAULT_PRESETS["moderate"],
+                                workloads=WorkloadsConfig(enabled=True))
+    return params, src
+
+
+@pytest.fixture(scope="module")
+def net_params(cfg):
+    from ccka_tpu.models import ActorCritic, latent_dim
+    from ccka_tpu.sim.megakernel import _obs_dim
+
+    net = ActorCritic(act_dim=latent_dim(cfg.cluster))
+    return net.init(jax.random.key(5), jnp.zeros(
+        (_obs_dim(cfg.cluster.n_pools, cfg.cluster.n_zones),)))
+
+
+def _bitwise_fields(a, b):
+    return {f for f in a._fields
+            if not np.array_equal(np.asarray(getattr(a, f)),
+                                  np.asarray(getattr(b, f)))}
+
+
+class TestBlockLayout:
+    def test_layout_arithmetic(self):
+        assert lanes.block_layout(96, 32, 16) == (3, 96)
+        assert lanes.block_layout(90, 32, 32) == (3, 96)  # padded
+        assert lanes.chunk_layout(1024, 256) == 4
+        assert lanes.block_bytes(32, 40, 16) == 4 * 32 * 40 * 16
+
+    def test_block_not_chunk_multiple_rejected(self):
+        with pytest.raises(ValueError, match="t_chunk"):
+            lanes.block_layout(96, 24, 16)
+
+    def test_block_not_tiling_horizon_rejected(self):
+        with pytest.raises(ValueError, match="tile"):
+            lanes.block_layout(96, 64, 32)
+
+    def test_chunk_not_dividing_batch_rejected(self):
+        with pytest.raises(ValueError, match="chunk"):
+            lanes.chunk_layout(100, 16)
+
+
+class TestCarryExactness:
+    @pytest.mark.parametrize("mode", ["rule", "carbon", "neural",
+                                      "plan"])
+    def test_blocked_equals_unblocked_bitwise(self, cfg, setup,
+                                              net_params, mode):
+        """The tentpole invariant: pipelined blocked == unblocked
+        single launch, bitwise, every EpisodeSummary field — fault +
+        workload lanes on, per mode."""
+        params, src = setup
+        key = jax.random.key(3)
+        np_ = net_params if mode == "neural" else None
+        s_blk, rep = streaming_mod.streaming_rollout_summary(
+            src, params, cfg.cluster, mode, key=key, batch=B, seed=7,
+            net_params=np_, pipelined=True, **KW)
+        assert rep["n_blocks"] == 2
+        s_ref = streaming_mod.unblocked_reference_summary(
+            src, params, cfg.cluster, mode, key=key, batch=B, seed=7,
+            net_params=np_, **KW)
+        assert not _bitwise_fields(s_blk, s_ref), mode
+
+    def test_sync_drive_matches_pipelined_bitwise(self, cfg, setup):
+        """The overlap machinery reorders dispatch only — the fenced
+        synchronous drive and the double-buffered drive produce the
+        SAME summaries on the same (key, seed)."""
+        params, src = setup
+        key = jax.random.key(4)
+        s_sync, rep = streaming_mod.streaming_rollout_summary(
+            src, params, cfg.cluster, "rule", key=key, batch=B, seed=7,
+            pipelined=False, **KW)
+        s_pipe, _ = streaming_mod.streaming_rollout_summary(
+            src, params, cfg.cluster, "rule", key=key, batch=B, seed=7,
+            pipelined=True, **KW)
+        assert not _bitwise_fields(s_sync, s_pipe)
+        # The sync drive measured a real per-stage ledger.
+        occ = rep["occupancy"]["fractions"]
+        assert set(occ) == {"generation", "kernel", "host"}
+        assert abs(sum(occ.values()) - 1.0) < 1e-6
+
+    def test_raw_rows_match_legacy_noncarry_program(self, cfg, setup):
+        """The carry kernel family is tied to the PINNED pre-streaming
+        contract: the blocked chain's final accumulator rows equal the
+        legacy non-carry program's on the concatenated stream,
+        bitwise."""
+        from ccka_tpu.policy.rule import offpeak_action, peak_action
+        from ccka_tpu.sim import megakernel as mk
+
+        params, src = setup
+        key = jax.random.key(5)
+        plan = streaming_mod.plan_stream(T, BLOCK_T, T_CHUNK)
+        gen = streaming_mod._block_gen(src, plan, B)
+        blocks = [gen(j, key) for j in range(plan.n_blocks)]
+        full = jnp.concatenate([jnp.asarray(np.asarray(b))
+                                for b in blocks], axis=0)
+        fns = packed_mode_block_summary_fn(
+            params, cfg.cluster, "rule", **KW)
+        state = fns.init_state(full.shape[1], B)
+        out = None
+        for j, blk in enumerate(blocks):
+            out, state, _dead = fns.step(blk, state, j, 7)
+        off, peak = offpeak_action(cfg.cluster), peak_action(cfg.cluster)
+        legacy = mk._run(
+            mk._pack_params(params),
+            jnp.stack([mk._pack_action(off), mk._pack_action(peak)]),
+            full, mk._meta(T, False, 7), P=cfg.cluster.n_pools,
+            Z=cfg.cluster.n_zones, K=int(params.provision_pipeline_k),
+            WD=int(params.wl_batch_deadline_ticks), stochastic=False,
+            b_block=B_BLOCK, t_chunk=T_CHUNK, interpret=True)
+        assert np.array_equal(np.asarray(out), np.asarray(legacy))
+
+    def test_lanes_stay_bitwise_under_blocking(self, cfg, setup):
+        """Widening a blocked stream with fault/workload lanes changes
+        neither the exo rows nor the fault rows bitwise — per block,
+        the same invariant the unblocked layouts pin."""
+        params, _src = setup
+        plain = SyntheticSignalSource(cfg.cluster, cfg.workload,
+                                      cfg.sim, cfg.signals)
+        faulted = SyntheticSignalSource(
+            cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+            faults=FAULT_PRESETS["moderate"])
+        both = SyntheticSignalSource(
+            cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+            faults=FAULT_PRESETS["moderate"],
+            workloads=WorkloadsConfig(enabled=True))
+        Z = cfg.cluster.n_zones
+        key = jax.random.key(11)
+        for j in range(2):
+            p = np.asarray(plain.packed_block_trace_device(
+                BLOCK_T, key, 8, j, t_chunk=T_CHUNK))
+            f = np.asarray(faulted.packed_block_trace_device(
+                BLOCK_T, key, 8, j, t_chunk=T_CHUNK))
+            w = np.asarray(both.packed_block_trace_device(
+                BLOCK_T, key, 8, j, t_chunk=T_CHUNK))
+            assert np.array_equal(p, f[:, :lanes.exo_rows(Z)])
+            assert np.array_equal(f, w[:, :lanes.exo_rows(Z)
+                                       + lanes.fault_rows(Z)])
+
+
+class TestSeedPairing:
+    def test_block_chunk_seed_arithmetic(self):
+        """Local chunk t of block j draws the GLOBAL chunk's stream —
+        and the time fold composes additively with the shard fold, so
+        blocked+sharded runs stay paired with unblocked single-chip
+        ones."""
+        from ccka_tpu.parallel import shard_seed
+
+        seed = 1234
+        for bT, tc in ((32, 16), (96, 32)):
+            for j in range(4):
+                for t_loc in range(bT // tc):
+                    local = block_chunk_seed(seed, j, bT, tc) \
+                        + t_loc * SEED_CHUNK_STRIDE
+                    global_chunk = j * (bT // tc) + t_loc
+                    assert local == seed + global_chunk * SEED_CHUNK_STRIDE
+        # Additive composition with the batch-axis offset.
+        s = block_chunk_seed(shard_seed(seed, 3, 2), 2, 32, 16)
+        assert s == seed + 3 * 2 * SEED_BLOCK_STRIDE \
+            + 2 * 2 * SEED_CHUNK_STRIDE
+
+    def test_kernel_consumes_exported_strides(self):
+        import inspect
+
+        from ccka_tpu.sim import megakernel as mk
+
+        src = inspect.getsource(mk.block_chunk_seed)
+        assert "SEED_CHUNK_STRIDE" in src
+
+
+class TestDonationChain:
+    def test_two_buffers_warning_free(self, cfg, setup):
+        """The pipelined drive holds exactly TWO stream buffers per
+        chip across the whole block loop, with no 'donated buffers
+        were not usable' warning anywhere in the chain."""
+        params, src = setup
+        kw = dict(KW, T=96)  # 3 blocks: the chain actually cycles
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            _s, rep = streaming_mod.streaming_rollout_summary(
+                src, params, cfg.cluster, "rule", key=jax.random.key(6),
+                batch=B, seed=7, pipelined=True, count_buffers=True,
+                **kw)
+        assert rep["n_blocks"] == 3
+        assert rep["stream_buffers"] == 2
+        donation_msgs = [str(m.message) for m in w
+                         if "donated" in str(m.message).lower()]
+        assert not donation_msgs, donation_msgs
+
+    def test_recycled_generation_is_bitwise_fresh(self, cfg, setup):
+        """One donating generation program serves fresh AND recycled
+        blocks (a dummy is donated when no dead buffer exists), so the
+        bytes are bitwise independent of the chain's warm-up state."""
+        _params, src = setup
+        key = jax.random.key(12)
+        fresh = np.asarray(src.packed_block_trace_device(
+            BLOCK_T, key, B, 1, t_chunk=T_CHUNK,
+            recycle=jnp.zeros((BLOCK_T, streaming_mod._stream_rows(src),
+                               B), jnp.float32)))
+        dead = src.packed_block_trace_device(
+            BLOCK_T, key, B, 0, t_chunk=T_CHUNK,
+            recycle=jnp.zeros((BLOCK_T, streaming_mod._stream_rows(src),
+                               B), jnp.float32))
+        recycled = np.asarray(src.packed_block_trace_device(
+            BLOCK_T, key, B, 1, t_chunk=T_CHUNK, recycle=dead))
+        assert np.array_equal(fresh, recycled)
+
+
+class TestShardedStreaming:
+    def test_mesh_bitwise_chunked_and_tolerance_table(self, cfg, setup):
+        """8-shard interpret streaming: shard-local blocked generation
+        + lane-sharded carried state is BITWISE the single-chip
+        cluster-chunked drive of the same (key, seed) — the sharding
+        machinery adds no noise at all. Against the UNCHUNKED reference
+        the worlds differ (shard-folded generation is its own keyed
+        family, exactly like `sharded_packed_trace` vs a single-device
+        stream), so that comparison holds under the ONE shared
+        tolerance table instead."""
+        from ccka_tpu.parallel import make_mesh
+
+        params, src = setup
+        key = jax.random.key(8)
+        kw = dict(KW, b_block=4)
+        mesh = make_mesh(devices=jax.devices()[:8])
+        s_mesh, rep = streaming_mod.streaming_rollout_summary(
+            src, params, cfg.cluster, "rule", key=key, batch=B, seed=5,
+            mesh=mesh, pipelined=True, **kw)
+        s_chunk, _ = streaming_mod.chunked_streaming_summary(
+            src, params, cfg.cluster, "rule", key=key, batch=B,
+            chunk=B // 8, seed=5, pipelined=True, **kw)
+        assert not _bitwise_fields(s_mesh, s_chunk)
+        ref = streaming_mod.unblocked_reference_summary(
+            src, params, cfg.cluster, "rule", key=key, batch=B, seed=5,
+            **kw)
+        assert not mean_parity_violations(s_mesh, ref)
+        assert rep["pipeline"] == "double-buffered"
+
+
+class TestMisuseRejection:
+    def test_block_not_dividing_horizon(self, cfg, setup):
+        params, src = setup
+        with pytest.raises(ValueError, match="tile"):
+            streaming_mod.streaming_rollout_summary(
+                src, params, cfg.cluster, "rule", key=jax.random.key(0),
+                batch=B, T=96, block_T=64, t_chunk=32,
+                b_block=B_BLOCK, interpret=True, stochastic=False)
+
+    def test_block_not_chunk_multiple(self, cfg, setup):
+        params, src = setup
+        with pytest.raises(ValueError, match="t_chunk"):
+            streaming_mod.plan_stream(96, 24, 16)
+
+    def test_chunk_not_dividing_batch(self, cfg, setup):
+        params, src = setup
+        with pytest.raises(ValueError, match="chunk"):
+            streaming_mod.chunked_streaming_summary(
+                src, params, cfg.cluster, "rule", key=jax.random.key(0),
+                batch=100, chunk=16, **KW)
+
+    def test_chunk_not_b_block_multiple(self, cfg, setup):
+        params, src = setup
+        with pytest.raises(ValueError, match="b_block"):
+            streaming_mod.chunked_streaming_summary(
+                src, params, cfg.cluster, "rule", key=jax.random.key(0),
+                batch=48, chunk=24, **KW)
+
+    def test_wrong_length_stream_block(self, cfg, setup):
+        params, src = setup
+        fns = packed_mode_block_summary_fn(params, cfg.cluster, "rule",
+                                           **KW)
+        short = jnp.zeros((T_CHUNK, streaming_mod._stream_rows(src), B),
+                          jnp.float32)
+        state = fns.init_state(short.shape[1], B)
+        with pytest.raises(ValueError, match="block_T"):
+            fns.step(short, state, 0, 0)
+
+    def test_wrong_length_stream_block_sharded(self, cfg, setup):
+        """The mesh bundle enforces the same block-length contract as
+        the single-chip one (a wrong-length block would silently
+        misalign the valid gate / tod clock / chunk seeds). The raise
+        happens host-side, before any mesh program compiles."""
+        from ccka_tpu.parallel import (
+            make_mesh, sharded_packed_mode_block_summary_fn)
+
+        params, src = setup
+        mesh = make_mesh(devices=jax.devices()[:8])
+        fns = sharded_packed_mode_block_summary_fn(
+            mesh, params, cfg.cluster, "rule", **dict(KW, b_block=4))
+        short = jnp.zeros((T_CHUNK, streaming_mod._stream_rows(src), B),
+                          jnp.float32)
+        state = fns.init_state(short.shape[1], B)
+        with pytest.raises(ValueError, match="block_T"):
+            fns.step(short, state, 0, 0)
+
+    def test_wrong_layout_state(self, cfg, setup):
+        """A carried state built for a different lane layout (plain
+        stream vs fault+workload-widened) is rejected, not misread."""
+        params, src = setup
+        key = jax.random.key(9)
+        fns = packed_mode_block_summary_fn(params, cfg.cluster, "rule",
+                                           **KW)
+        stream = src.packed_block_trace_device(BLOCK_T, key, B, 0,
+                                               t_chunk=T_CHUNK)
+        Z = cfg.cluster.n_zones
+        wrong = fns.init_state(lanes.exo_rows(Z), B)  # plain layout
+        with pytest.raises(ValueError, match="carried state"):
+            fns.step(stream, wrong, 0, 0)
+
+
+class TestReplayBlockSource:
+    def test_blocked_exo_rows_match_unblocked_windows(self, cfg):
+        """Replay blocks: block j of each sampled window replays ticks
+        [j*block_T, (j+1)*block_T) of the exact windows the unblocked
+        packed stream replays — exo rows concatenate bitwise."""
+        from ccka_tpu.signals.base import TraceMeta
+        from ccka_tpu.signals.replay import ReplaySignalSource
+
+        plain = SyntheticSignalSource(cfg.cluster, cfg.workload,
+                                      cfg.sim, cfg.signals)
+        stored = plain.trace(128, seed=3)
+        meta = TraceMeta(source="replay", start_unix_s=0.0, dt_s=30.0,
+                         zones=cfg.cluster.zones)
+        rs = ReplaySignalSource(stored, meta)
+        key = jax.random.key(13)
+        n = 4
+        full = np.asarray(rs.packed_trace_device(T, key, n,
+                                                 t_chunk=T_CHUNK))
+        blocks = [np.asarray(rs.packed_block_trace_device(
+            BLOCK_T, key, n, j, total_steps=T, t_chunk=T_CHUNK))
+            for j in range(T // BLOCK_T)]
+        cat = np.concatenate(blocks, axis=0)
+        assert np.array_equal(full[:T], cat[:T])
+
+    @pytest.mark.slow
+    def test_replay_streaming_end_to_end(self, cfg):
+        """The streaming driver runs a replay source end to end (the
+        recycle path included) and matches its unblocked reference
+        bitwise — the 'both synthetic and replay sources' half of the
+        tentpole. Slow-marked (ROADMAP lane-time rule): its bitwise
+        core — blocked replay rows concatenate to the unblocked
+        stream's — stays fast-lane via
+        `test_blocked_exo_rows_match_unblocked_windows`, and the drive
+        machinery it exercises is the same code the synthetic
+        end-to-end pins run fast-lane."""
+        from ccka_tpu.signals.base import TraceMeta
+        from ccka_tpu.signals.replay import ReplaySignalSource
+
+        params = SimParams.from_config(cfg)
+        plain = SyntheticSignalSource(cfg.cluster, cfg.workload,
+                                      cfg.sim, cfg.signals)
+        stored = plain.trace(128, seed=3)
+        meta = TraceMeta(source="replay", start_unix_s=0.0, dt_s=30.0,
+                         zones=cfg.cluster.zones)
+        rs = ReplaySignalSource(stored, meta,
+                                faults=FAULT_PRESETS["mild"])
+        key = jax.random.key(14)
+        kw = dict(KW, T=96)   # 3 blocks: the recycle path engages
+        s_blk, rep = streaming_mod.streaming_rollout_summary(
+            rs, params, cfg.cluster, "rule", key=key, batch=16, seed=2,
+            pipelined=True, **kw)
+        s_ref = streaming_mod.unblocked_reference_summary(
+            rs, params, cfg.cluster, "rule", key=key, batch=16, seed=2,
+            **kw)
+        assert rep["n_blocks"] == 3
+        assert not _bitwise_fields(s_blk, s_ref)
+
+
+def _good_stream_record(**overrides) -> dict:
+    """A minimal well-formed --stream-only record for the gate tests
+    (mirrors `_good_perf_record`'s role for the round-15 gates)."""
+    def row(ratio=1.1, kocc_sync=0.66, kocc_pipe=0.75):
+        return {
+            "batch": 1024, "steps": 192, "block_T": 96,
+            "sync": {"wall_s": 1.0, "kernel_s": 0.66,
+                     "occupancy_fractions": {"generation": 0.32,
+                                             "kernel": kocc_sync,
+                                             "host": 0.02},
+                     "cluster_days_per_sec": 300.0},
+            "pipelined": {"wall_s": 1.0 / ratio,
+                          "cluster_days_per_sec": 300.0 * ratio,
+                          "kernel_occupancy_fraction": kocc_pipe,
+                          "stream_buffers": 2},
+            "throughput_ratio": ratio,
+            "bitwise_pipelined_vs_sync": True,
+            "bitwise_blocked_vs_unblocked": True,
+        }
+
+    rec = {
+        "metric": "stream", "round": 92, "stage": "--stream-only",
+        "platform": "cpu", "virtual": True,
+        "overlap_capable": True,
+        "rows": [row()],
+        "bitwise_all": True,
+        "chunked": {"batch": 10240, "chunk": 1024,
+                    "live_block_bytes": 2 * 4 * 96 * 40 * 1024,
+                    "roofline_floor_s": 0.01,
+                    "bitwise_pipelined_vs_sync": True},
+        "mesh8": {"shards": 8, "throughput_ratio": 1.05,
+                  "bitwise_mesh_vs_chunked": True,
+                  "sync": {"cluster_days_per_sec_aggregate": 500.0},
+                  "pipelined": {
+                      "cluster_days_per_sec_aggregate": 550.0}},
+        "single_chip": {"cluster_days_per_sec": 600.0},
+        "provenance": {"platform": "cpu"},
+    }
+    rec.update(overrides)
+    return rec
+
+
+class TestBenchDiffStreamGates:
+    """ISSUE 13 satellite: the bench-history sentinel's streaming
+    invariant gates — an injected bad record drives exit 1."""
+
+    def _diff_of(self, tmp_path, rec):
+        import json
+
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        (tmp_path / "BENCH_r92.json").write_text(json.dumps(rec))
+        return bench_diff(load_bench_history(str(tmp_path)))
+
+    def test_good_record_is_clean(self, tmp_path):
+        diff = self._diff_of(tmp_path, _good_stream_record())
+        assert diff["ok"], diff["regressions"]
+
+    def test_bitwise_break_regresses_and_cli_exits_nonzero(
+            self, tmp_path, capsys):
+        rec = _good_stream_record()
+        rec["rows"][0]["bitwise_blocked_vs_unblocked"] = False
+        diff = self._diff_of(tmp_path, rec)
+        assert any(r["kind"] == "stream_invariant"
+                   for r in diff["regressions"])
+        from ccka_tpu.cli import main
+
+        assert main(["bench-diff", "--root", str(tmp_path)]) == 1
+        assert "REGRESSION" in capsys.readouterr().err
+
+    def test_ratio_below_one_on_capable_host(self, tmp_path):
+        rec = _good_stream_record()
+        rec["rows"][0]["throughput_ratio"] = 0.93
+        assert not self._diff_of(tmp_path, rec)["ok"]
+        # A single-core virtual host is held to the floor, not 1.0...
+        rec = _good_stream_record(overlap_capable=False)
+        rec["rows"][0]["throughput_ratio"] = 0.93
+        assert self._diff_of(tmp_path, rec)["ok"]
+        # ...but not past it.
+        rec = _good_stream_record(overlap_capable=False)
+        rec["rows"][0]["throughput_ratio"] = 0.5
+        assert not self._diff_of(tmp_path, rec)["ok"]
+
+    def test_occupancy_below_sync_baseline(self, tmp_path):
+        rec = _good_stream_record()
+        rec["rows"][0]["pipelined"]["kernel_occupancy_fraction"] = 0.5
+        diff = self._diff_of(tmp_path, rec)
+        assert any("occupancy" in r["detail"]
+                   for r in diff["regressions"])
+
+    def test_buffer_bound(self, tmp_path):
+        rec = _good_stream_record()
+        rec["rows"][0]["pipelined"]["stream_buffers"] = 3
+        diff = self._diff_of(tmp_path, rec)
+        assert any("buffers" in r["detail"] for r in diff["regressions"])
+
+    def test_partial_record_is_a_regression(self, tmp_path):
+        rec = _good_stream_record()
+        del rec["chunked"]
+        assert not self._diff_of(tmp_path, rec)["ok"]
+        rec = _good_stream_record()
+        del rec["mesh8"]
+        assert not self._diff_of(tmp_path, rec)["ok"]
+        rec = _good_stream_record()
+        del rec["chunked"]["live_block_bytes"]
+        assert not self._diff_of(tmp_path, rec)["ok"]
+
+    def test_scaling_curve_labels_stream_rows(self, tmp_path):
+        """`ccka scaling-curve` ingests the streaming record: blocked
+        rows labeled with the `pipeline` column, not skipped."""
+        import json
+
+        from ccka_tpu.obs.bench_history import (SCALING_CSV_COLUMNS,
+                                                scaling_curve,
+                                                write_scaling_csv)
+
+        (tmp_path / "BENCH_r92.json").write_text(
+            json.dumps(_good_stream_record()))
+        curve = scaling_curve(str(tmp_path))
+        stream_pts = [p for p in curve["points"]
+                      if p["source"].startswith("stream")]
+        pipelines = {p.get("pipeline") for p in stream_pts}
+        assert {"sync", "double-buffered"} <= pipelines
+        assert any(p["source"] == "stream_chunked" for p in stream_pts)
+        assert any(p["source"] == "stream_mesh" for p in stream_pts)
+        assert any(p["source"] == "stream_single_chip"
+                   for p in curve["per_round"])
+        assert "pipeline" in SCALING_CSV_COLUMNS
+        path = write_scaling_csv(curve, str(tmp_path / "c.csv"))
+        head, *rows = open(path, encoding="utf-8").read().splitlines()
+        assert "pipeline" in head.split(",")
+        assert any(",double-buffered," in r for r in rows)

@@ -135,6 +135,11 @@ def test_guard_scans_a_nontrivial_tree():
     assert any(os.path.join("obs", "costmodel.py") in p for p in files)
     assert any(os.path.join("parallel", "sharded_kernel.py") in p
                for p in files)
+    # Round 16: the streaming pipeline's block loop is one long span of
+    # overlapped async dispatch — the single place a bare clock next to
+    # device code would be MOST tempting and MOST wrong (it would time
+    # dispatch of the whole loop, not its execution).
+    assert any(os.path.join("sim", "streaming.py") in p for p in files)
 
 
 _HARNESS_DIR = os.path.join(ROOT, "ccka_tpu", "harness")
@@ -345,14 +350,15 @@ def test_guard_catches_the_footgun_pattern(tmp_path):
 
 
 def test_observatory_modules_time_only_through_spans():
-    """Round-15 satellite self-check: the new observatory modules
-    (obs/occupancy.py, and the per-shard helpers in sharded_kernel.py)
+    """Round-15 satellite self-check (extended round 16 to the
+    streaming pipeline): the observatory modules and sim/streaming.py
     contain NO bare timing calls at all — every duration they record
     comes out of a closed Span (`sp.dur_s`), so the fenced-span rule
     holds by construction, not just by the scoped heuristic above.
     costmodel.py's bandwidth probe is the one allowed direct timer —
     and it must carry its fence in the same scope."""
     for rel in (os.path.join("ccka_tpu", "obs", "occupancy.py"),
+                os.path.join("ccka_tpu", "sim", "streaming.py"),
                 os.path.join("ccka_tpu", "parallel",
                              "sharded_kernel.py")):
         path = os.path.join(ROOT, rel)
@@ -372,3 +378,34 @@ def test_observatory_modules_time_only_through_spans():
         assert any(m in seg for m in _FENCE_MARKERS), (
             "costmodel.py times device work without a fence at line "
             f"{call.lineno}")
+
+
+def test_streaming_block_loop_is_span_fenced():
+    """Round-16 satellite: the streaming driver's pipelined block loop
+    must live inside a ``device_span`` whose CLOSING fence drains the
+    whole pipeline — a fence inside the loop would serialize exactly
+    the overlap being measured, and no fence at all would time
+    dispatch. Checked structurally: `_run_group`'s pipelined branch
+    opens a device_span, calls ``sp.fence`` on the loop's output, and
+    the loop body itself contains no ``block_until_ready`` or
+    mid-loop ``.fence(`` on intermediate blocks."""
+    path = os.path.join(ROOT, "ccka_tpu", "sim", "streaming.py")
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    tree = ast.parse(src)
+    run_group = next(n for n in ast.walk(tree)
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "_run_group")
+    seg = "".join(src.splitlines(keepends=True)[
+        run_group.lineno - 1:run_group.end_lineno])
+    assert "device_span" in seg and "sp.fence(out)" in seg
+    # The pipelined for-loop body must not fence: find the loop that
+    # calls fns.step inside the device_span `with` and check it.
+    loops = [n for n in ast.walk(run_group) if isinstance(n, ast.For)]
+    assert loops, "streaming block loop disappeared — update this test"
+    for loop in loops:
+        body_src = "".join(src.splitlines(keepends=True)[
+            loop.lineno - 1:loop.end_lineno])
+        assert "block_until_ready" not in body_src, (
+            "a fence inside the streaming block loop serializes the "
+            "overlap the pipeline exists to create")
