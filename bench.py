@@ -2846,6 +2846,181 @@ def _stream_mesh_virtual_fallback() -> dict | None:
         timeout_s=1800, env=env)
 
 
+FACTORY_SCENARIOS = ("diurnal-inference", "batch-backfill")
+FACTORY_INTENSITIES = ("off", "moderate")
+
+
+def bench_factory(cfg, *, scenarios=FACTORY_SCENARIOS,
+                  intensities=FACTORY_INTENSITIES, teacher: str = "mpc",
+                  pairs_per_cell: int = 64, steps: int = 96,
+                  block_T: int = 48, t_chunk: int = 48,
+                  b_block: int = 64, iters: int | None = None,
+                  naive_pairs: int = 4, student_iterations: int = 400,
+                  seed: int = 41) -> dict:
+    """MPC-distillation data factory stage (ISSUE 14): the factory sweep
+    (`train/factory.factory_run` — batched full-window planning →
+    double-buffered streaming plan playback → batched pair collection)
+    across scenario × fault-intensity cells, measured PAIRED against the
+    naive per-pair lax `receding_horizon_rollout` loop
+    (`naive_lax_pair_rate`, the status-quo protocol at
+    cfg.train.mpc_horizon/mpc_iters) in the same record. The headline:
+    factory pairs/sec ≥ 5× the naive loop's, on THIS host (labeled
+    CPU-interpret off-TPU), with the playback roofline floor and the
+    first cell's occupancy ledger attached.
+
+    A full warmup sweep runs first (same shapes, different seeds) so
+    the timed sweep measures warm programs on BOTH sides — the naive
+    loop is likewise timed warm (its first pair compiles untimed).
+
+    The student column closes the loop: the combined dataset distills
+    into a fresh ActorCritic (`imitate(dataset=...)`), and the student
+    is scored by the NEURAL kernel on each cell's exact shared worlds —
+    paired student-vs-teacher / student-vs-rule $/SLO-hr per cell."""
+    from ccka_tpu.sim import SimParams
+    from ccka_tpu.sim.megakernel import packed_mode_summary_fn
+    from ccka_tpu.train import factory as factory_mod
+    from ccka_tpu.train.imitate import imitate
+
+    platform = jax.devices()[0].platform
+    virtual = platform == "cpu"
+    resolved = factory_mod.validate_factory_names(
+        scenarios=scenarios, intensities=intensities, teacher=teacher)
+    params = SimParams.from_config(cfg)
+    if iters is None:
+        iters = factory_mod.FACTORY_ITERS
+    fkw = dict(scenarios=scenarios, intensities=intensities,
+               teacher=teacher, pairs_per_cell=pairs_per_cell,
+               steps=steps, block_T=block_T, t_chunk=t_chunk,
+               b_block=b_block, iters=iters)
+
+    # Warm sweep (compile = setup), then the timed sweep.
+    with _TRACER.span("factory.warmup"):
+        t0 = time.perf_counter()
+        factory_mod.factory_run(cfg, seed=seed + 7, **fkw)
+        warm_s = time.perf_counter() - t0
+    with _TRACER.span("factory.sweep"):
+        dataset, report, cells = factory_mod.factory_run(
+            cfg, seed=seed, with_ledger=True, return_cells=True, **fkw)
+
+    # The paired baseline: per-pair closed-loop lax MPC, timed warm, on
+    # the first cell's trace family.
+    first = next(iter(resolved.values()))
+    with _TRACER.span("factory.naive_baseline"):
+        naive = factory_mod.naive_lax_pair_rate(
+            cfg, first, intensities[0], pairs=naive_pairs, steps=steps,
+            block_T=block_T, t_chunk=t_chunk, seed=seed)
+    ratio = None
+    if report.get("pairs_per_sec") and naive.get("pairs_per_sec"):
+        ratio = round(report["pairs_per_sec"] / naive["pairs_per_sec"],
+                      4)
+    print(f"# factory: {report['pairs_total']} pairs at "
+          f"{report['pairs_per_sec']} pairs/s "
+          f"(plans {report['plans_per_sec']}/s) vs naive "
+          f"{naive['pairs_per_sec']} pairs/s -> ratio {ratio}",
+          file=sys.stderr)
+
+    # Student: distill the combined dataset, score on each cell's exact
+    # shared worlds via the neural kernel (paired with the teacher's
+    # playback labels and the rule column from the same streams). The
+    # first cell's stream doubles as the playback roofline byte count
+    # (every cell's stream has the same shape): exo stream + per-cluster
+    # plan stream both stream through the kernel.
+    from ccka_tpu.sim.megakernel import _plan_rows
+    playback_bytes = None
+    with _TRACER.span("factory.distill"):
+        student_params, hist = imitate(cfg, None, None, dataset=dataset,
+                                       iterations=student_iterations,
+                                       seed=seed)
+    # One jitted program scores every cell — everything but the stream
+    # is loop-invariant.
+    kfn = packed_mode_summary_fn(
+        params, cfg.cluster, "neural", T=steps, b_block=b_block,
+        t_chunk=t_chunk, interpret=virtual, stochastic=not virtual,
+        net_params=student_params)
+    student_rows = []
+    for cell in cells:
+        sc = resolved[cell.scenario]
+        stream = factory_mod._cell_stream(
+            factory_mod._cell_source(cfg, sc, cell.intensity),
+            steps=steps, block_T=block_T, t_chunk=t_chunk,
+            pairs=pairs_per_cell, key=jax.random.key(cell.report["seed"]))
+        if playback_bytes is None:
+            plan_bytes = 4 * stream.shape[0] * _plan_rows(
+                cfg.cluster.n_pools, cfg.cluster.n_zones) * pairs_per_cell
+            playback_bytes = float(stream.size * 4 + plan_bytes)
+        s_student = kfn(stream, cell.report["seed"])
+        row = {
+            "scenario": cell.scenario, "intensity": cell.intensity,
+            "student_vs_teacher_usd_per_slo_hour": round(
+                factory_mod._paired_usd_ratio(s_student,
+                                              cell.teacher_summary), 4),
+            "student_vs_rule_usd_per_slo_hour": round(
+                factory_mod._paired_usd_ratio(s_student,
+                                              cell.rule_summary), 4),
+            "teacher_vs_rule_usd_per_slo_hour": round(
+                factory_mod._paired_usd_ratio(cell.teacher_summary,
+                                              cell.rule_summary), 4),
+        }
+        student_rows.append(row)
+        print(f"# factory student[{cell.scenario}.{cell.intensity}]: "
+              f"vs teacher x"
+              f"{row['student_vs_teacher_usd_per_slo_hour']}, vs rule x"
+              f"{row['student_vs_rule_usd_per_slo_hour']}",
+              file=sys.stderr)
+    s_vs_t = [r["student_vs_teacher_usd_per_slo_hour"]
+              for r in student_rows]
+
+    out = {
+        "metric": "MPC-distillation factory throughput (pairs/sec) vs "
+                  "the naive per-pair lax receding-horizon loop, paired "
+                  "in one record, + student-vs-teacher scoreboard",
+        "engine": report["engine"],
+        "platform": platform, "virtual": virtual,
+        "interpret": virtual, "stochastic": not virtual,
+        "teacher": teacher,
+        "protocol": {
+            "pairs_per_cell": pairs_per_cell, "steps": steps,
+            "block_T": block_T, "t_chunk": t_chunk, "b_block": b_block,
+            "factory_iters": iters,
+            "naive_mpc_horizon": naive["mpc_horizon"],
+            "naive_mpc_iters": naive["mpc_iters"],
+            "note": "factory plans are one-shot full-window "
+                    "quick-distill plans (lr x10); the naive loop is "
+                    "the closed-loop protocol — the plan-quality gap "
+                    "this opens is what the student/teacher columns "
+                    "report, the throughput gap is the headline",
+        },
+        "cells": report["cells"],
+        "pairs_total": report["pairs_total"],
+        "dataset_rows": report["dataset_rows"],
+        "wall_s": report["wall_s"],
+        "pairs_per_sec": report["pairs_per_sec"],
+        "plans_per_sec": report["plans_per_sec"],
+        "warmup_wall_s": round(warm_s, 4),
+        "baseline": naive,
+        "throughput_ratio_vs_baseline": ratio,
+        "gate_min_ratio": 5.0,
+        "playback_stream_bytes": playback_bytes,
+        "playback_roofline_floor_s": round(
+            _roofline_floor_s(playback_bytes), 6),
+        "student": {
+            "iterations": student_iterations,
+            "final_actor_mse": round(hist[-1]["actor_mse"], 5),
+            "dataset_rows": int(dataset.obs.shape[0]),
+            "per_cell": student_rows,
+            "student_vs_teacher_usd_per_slo_hour": round(
+                float(np.mean(s_vs_t)), 4) if s_vs_t else None,
+        },
+    }
+    if virtual:
+        out["note"] = ("CPU host: interpret-mode deterministic kernels "
+                       "and lax planning on one core — the pairs/sec "
+                       "ratio measures batching + kernel playback vs "
+                       "the per-pair loop on this host; real chips "
+                       "widen the kernel-stage gap")
+    return out
+
+
 def _run_child(argv, timeout_s=1800, env=None) -> dict | None:
     """Run a bench child phase; relay its narration; parse its JSON."""
     try:
@@ -2982,6 +3157,14 @@ def main(argv=None) -> int:
                          "scoreboard (bench_workloads) and print its "
                          "JSON — the BENCH_r11 record path; "
                          "interpret-mode deterministic off-TPU")
+    ap.add_argument("--factory-only", action="store_true",
+                    help="run ONLY the MPC-distillation data-factory "
+                         "stage (bench_factory: batched planning + "
+                         "streaming plan-playback labeling vs the "
+                         "naive per-pair lax loop, paired, + the "
+                         "student-vs-teacher scoreboard) and print its "
+                         "JSON — the BENCH_r17 record path; interpret-"
+                         "mode deterministic off-TPU")
     ap.add_argument("--mega-phase", choices=("gate", "time"),
                     help="child phases of the isolated megakernel stage "
                          "(see _mega_subprocess): 'gate' prints the "
@@ -3099,6 +3282,21 @@ def main(argv=None) -> int:
         from ccka_tpu.obs.compile import compile_report
         stream["compile_report"] = compile_report()
         print(json.dumps(stream))
+        return 0
+
+    if args.factory_only:
+        from ccka_tpu.config import default_config
+        cfg = default_config()
+        with _TRACER.span("bench.factory_stage"):
+            fac = bench_factory(cfg)
+        # Record-path stamp (see --perf-only): a raw redirect into
+        # BENCH_rNN.json arms the bench-diff factory gates.
+        fac["stage"] = "--factory-only"
+        fac["provenance"] = bench_provenance(
+            scenarios=list(FACTORY_SCENARIOS))
+        from ccka_tpu.obs.compile import compile_report
+        fac["compile_report"] = compile_report()
+        print(json.dumps(fac))
         return 0
 
     if args.perf_only:

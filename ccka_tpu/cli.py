@@ -392,6 +392,41 @@ def _build_parser() -> argparse.ArgumentParser:
                           "day on TPU, CI-sized interpret off-TPU)")
     ssc.add_argument("--seed", type=int, default=31)
 
+    sdf = sub.add_parser(
+        "distill-factory",
+        help="MPC-distillation data factory (train/factory.py): "
+             "batched full-window planning across scenario x fault-"
+             "intensity cells, plan playback labeled through the "
+             "double-buffered streaming kernel, (obs, plan-latent, "
+             "return) rows emitted as an imitation dataset — "
+             "optionally distilled straight into a fresh policy net")
+    sdf.add_argument("--scenarios",
+                     default="diurnal-inference,batch-backfill",
+                     help="comma list of workload scenario names "
+                          "(see `ccka scenarios`)")
+    sdf.add_argument("--intensities", default="off,moderate",
+                     help="comma list of 'off' + config.FAULT_PRESETS "
+                          "names — the factory's fault axis")
+    sdf.add_argument("--teacher", default="mpc",
+                     help="planner protocol: 'mpc' (one-shot full-"
+                          "window batch planning) or 'mpc-rh' "
+                          "(receding-horizon quick planner)")
+    sdf.add_argument("--pairs", type=int, default=64,
+                     help="(state, plan) pairs per cell (default 64)")
+    sdf.add_argument("--steps", type=int, default=96,
+                     help="ticks per pair window (default 96)")
+    sdf.add_argument("--iters", type=int, default=0,
+                     help="planner gradient steps per window (0 = the "
+                          "factory default protocol)")
+    sdf.add_argument("--student-iterations", type=int, default=0,
+                     help="distill the dataset into a fresh ActorCritic "
+                          "for this many Adam steps (0 = emit the "
+                          "dataset/report only)")
+    sdf.add_argument("--out", default="",
+                     help="write the dataset (obs/target/returns) to "
+                          "this .npz path")
+    sdf.add_argument("--seed", type=int, default=41)
+
     sg = sub.add_parser(
         "capture", help="record exogenous signals from the configured "
                         "source into a replayable .npz trace (the AMP "
@@ -967,6 +1002,53 @@ def _cmd_forecast_eval(cfg: FrameworkConfig, args) -> int:
                     }
         out["forecasters"][name] = row
     print(json.dumps(out, indent=2))
+    return 0
+
+
+def _cmd_distill_factory(cfg: FrameworkConfig, args) -> int:
+    """`ccka distill-factory`: the MPC-distillation data factory
+    (train/factory.py). Unknown scenario/intensity/teacher names are
+    rejected UP FRONT (the standing convention) — a typo must not run
+    a long sweep."""
+    from ccka_tpu.train import factory as factory_mod
+
+    scenarios = tuple(s.strip() for s in args.scenarios.split(",")
+                      if s.strip())
+    intensities = tuple(s.strip() for s in args.intensities.split(",")
+                        if s.strip())
+    try:
+        factory_mod.validate_factory_names(
+            scenarios=scenarios, intensities=intensities,
+            teacher=args.teacher)
+        dataset, report = factory_mod.factory_run(
+            cfg, scenarios=scenarios, intensities=intensities,
+            teacher=args.teacher, pairs_per_cell=args.pairs,
+            steps=args.steps,
+            iters=args.iters or factory_mod.FACTORY_ITERS,
+            seed=args.seed, with_ledger=True)
+    except ValueError as e:
+        raise SystemExit(f"ccka: {e}")
+    if args.out:
+        import numpy as _np
+
+        _np.savez_compressed(
+            args.out, obs=_np.asarray(dataset.obs),
+            target=_np.asarray(dataset.target),
+            returns=_np.asarray(dataset.returns))
+        report = dict(report, dataset_path=args.out)
+    if args.student_iterations > 0:
+        from ccka_tpu.train.imitate import imitate
+
+        _params, hist = imitate(cfg, None, None, dataset=dataset,
+                                iterations=args.student_iterations,
+                                seed=args.seed)
+        report = dict(report,
+                      student={"iterations": args.student_iterations,
+                               "final_actor_mse": round(
+                                   hist[-1]["actor_mse"], 5),
+                               "final_critic_mse": round(
+                                   hist[-1]["critic_mse"], 5)})
+    print(json.dumps(report, indent=2))
     return 0
 
 
@@ -1683,6 +1765,8 @@ def main(argv: list[str] | None = None) -> int:
                 raise SystemExit(f"ccka: {e}")
             print(json.dumps(board, indent=2))
             return 0
+        if args.command == "distill-factory":
+            return _cmd_distill_factory(cfg, args)
         if args.command == "capture":
             return _cmd_capture(cfg, args.out, args.steps, args.seed)
         if args.command == "watch":

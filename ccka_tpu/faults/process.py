@@ -51,8 +51,10 @@ from ccka_tpu.sim import lanes
 # lanes fold this constant into the SAME generation key, so they are
 # paired per (seed, shard) without disturbing the exo streams' draws —
 # the exo rows of a widened stream stay bitwise identical to the
-# un-widened generation).
-FAULT_KEY_TAG = 0xFA117
+# un-widened generation). Canonical value lives in the lane-family
+# registry (`sim/lanes.py` — ISSUE 14); re-exported here for the
+# existing surface.
+FAULT_KEY_TAG = lanes.LANE_FAMILIES["faults"].key_tag
 
 
 # Layout arithmetic lives in the neutral `sim/lanes.py` (the one
@@ -165,3 +167,17 @@ def sample_fault_steps(faults: FaultsConfig, key, steps: int,
         delay_frac=lanes[:steps, Z + 1, 0],
         signal_stale=lanes[:steps, Z + 2, 0],
     )
+
+
+def _registry_generate(cfg: FaultsConfig, key, steps: int, t_pad: int,
+                       z: int, batch: int, *, ctx: dict):
+    """Lane-family registry adapter (`sim/lanes.provide_lane_generator`):
+    the generic synthesis path the signal backends drive for every
+    registered family — exactly :func:`packed_fault_lanes` on the
+    stream key (the tag fold stays inside, so registry-driven and
+    direct synthesis are bitwise identical)."""
+    return packed_fault_lanes(cfg, key, steps, t_pad, z, batch,
+                              price_dev=ctx.get("price_dev"))
+
+
+lanes.provide_lane_generator("faults", _registry_generate)

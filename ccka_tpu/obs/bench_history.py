@@ -189,6 +189,18 @@ def _extract_metrics(doc: dict) -> dict:
         out.update(_extract_stream(stream,
                                    full_stage=doc.get("stage")
                                    == "--stream-only"))
+    # Round-17 distillation-factory stage (stage record or nested
+    # "factory").
+    # A factory record missing its cells entirely must still reach the
+    # gates — _extract_factory flags it partial ("no factory cells");
+    # gating only well-shaped records would wave the most-degraded
+    # record through.
+    fac = (doc if doc.get("stage") == "--factory-only"
+           else doc.get("factory"))
+    if isinstance(fac, dict):
+        out.update(_extract_factory(fac,
+                                    full_stage=doc.get("stage")
+                                    == "--factory-only"))
     return out
 
 
@@ -333,10 +345,93 @@ def _extract_stream(stream: dict, *, full_stage: bool) -> dict:
     return out
 
 
+def _extract_factory(fac: dict, *, full_stage: bool) -> dict:
+    """The round-17 distillation-factory invariants a record states
+    about itself (ISSUE 14 satellite): the pairs/sec throughput ratio
+    vs the naive per-pair lax loop must be RECORDED (a record that
+    dropped its paired baseline would quietly stop making the claim the
+    stage exists to make) and at least 1.0 — a factory slower than the
+    loop it replaces is a regression by definition; the student-vs-
+    teacher $/SLO-hr column must be recorded honestly (present and
+    physically plausible) for every cell; PARTIAL records — a cell
+    missing its throughput or its paired teacher-vs-rule column, a
+    missing baseline, a missing playback roofline floor — are
+    regressions. ``full_stage`` (a dedicated ``--factory-only`` record)
+    additionally requires the student section and the first cell's
+    occupancy ledger."""
+    out: dict = {"factory_partial": []}
+    cells = fac.get("cells") or []
+    if not cells:
+        out["factory_partial"].append("no factory cells")
+    has_ledger = False
+    for cell in cells:
+        if not isinstance(cell, dict):
+            out["factory_partial"].append("cell is not a record")
+            continue
+        tag = f"{cell.get('scenario')}.{cell.get('intensity')}"
+        for key in ("pairs_per_sec", "plans_per_sec",
+                    "playback_cluster_days_per_sec",
+                    "teacher_vs_rule_usd_per_slo_hour"):
+            if cell.get(key) is None:
+                out["factory_partial"].append(
+                    f"cell {tag} missing {key}")
+        if isinstance(cell.get("playback_occupancy"), dict):
+            has_ledger = True
+    if fac.get("pairs_per_sec") is None:
+        out["factory_partial"].append("missing factory pairs_per_sec")
+    else:
+        out["factory_pairs_per_sec"] = float(fac["pairs_per_sec"])
+    baseline = fac.get("baseline")
+    if not isinstance(baseline, dict) \
+            or baseline.get("pairs_per_sec") is None:
+        out["factory_partial"].append(
+            "missing the paired naive-loop baseline")
+    if fac.get("throughput_ratio_vs_baseline") is None:
+        out["factory_partial"].append(
+            "missing throughput_ratio_vs_baseline")
+    else:
+        out["factory_ratio"] = float(fac["throughput_ratio_vs_baseline"])
+    if fac.get("playback_roofline_floor_s") is None:
+        out["factory_partial"].append(
+            "missing the playback roofline floor")
+    student = fac.get("student")
+    if isinstance(student, dict):
+        ratio = student.get("student_vs_teacher_usd_per_slo_hour")
+        if ratio is None:
+            out["factory_partial"].append(
+                "student section missing its vs-teacher ratio")
+        else:
+            out["factory_student_teacher"] = float(ratio)
+        per_cell = student.get("per_cell") or []
+        for row in per_cell:
+            if isinstance(row, dict) and row.get(
+                    "student_vs_teacher_usd_per_slo_hour") is None:
+                out["factory_partial"].append(
+                    f"student cell {row.get('scenario')}."
+                    f"{row.get('intensity')} missing its ratio")
+        # The column is per-CELL: a full-stage record whose student
+        # board covers fewer cells than it ran dropped rows somewhere.
+        if full_stage and len(per_cell) < len(cells):
+            out["factory_partial"].append(
+                f"student per_cell covers {len(per_cell)} of "
+                f"{len(cells)} cells")
+    elif full_stage:
+        out["factory_partial"].append("student section missing")
+    if full_stage and not has_ledger:
+        out["factory_partial"].append(
+            "no cell carries its playback occupancy ledger")
+    return out
+
+
 # A single-core virtual host cannot overlap generation with the kernel
 # (there is no second core to run it on): its pipelined drive is held
 # to this non-regression floor instead of the >= 1.0 overlap gate.
 _STREAM_RATIO_FLOOR = 0.85
+
+# Plausibility bound on the factory's student-vs-teacher $/SLO-hr
+# ratio: a paired ratio orders of magnitude off means a broken pairing
+# or a corrupt record, not a bad student.
+_FACTORY_STUDENT_RATIO_MAX = 100.0
 
 
 def bench_diff(history: dict, *,
@@ -553,6 +648,33 @@ def bench_diff(history: dict, *,
                 "threshold": rec.get("stream_kocc_sync"),
                 "detail": "pipelined kernel-stage occupancy fell below "
                           "the synchronous baseline's"})
+        # Round-17 distillation-factory invariants (ISSUE 14): the
+        # paired throughput ratio must exist and hold >= 1.0 (a factory
+        # slower than the per-pair loop it replaces is a regression by
+        # definition — the >= 5x number is the round's headline, not a
+        # standing gate: future hosts may be slower without the CODE
+        # having regressed), the student-vs-teacher column must be
+        # plausible, and partial records are regressions.
+        for what in rec.get("factory_partial", []):
+            regressions.append({
+                "kind": "factory_invariant", "round": rnd,
+                "detail": f"partial factory record: {what}"})
+        if rec.get("factory_ratio") is not None \
+                and rec["factory_ratio"] < 1.0:
+            regressions.append({
+                "kind": "factory_invariant", "round": rnd,
+                "value": rec["factory_ratio"], "threshold": 1.0,
+                "detail": "factory throughput fell below the naive "
+                          "per-pair lax loop it exists to replace"})
+        st = rec.get("factory_student_teacher")
+        if st is not None and not 0.0 < st <= _FACTORY_STUDENT_RATIO_MAX:
+            regressions.append({
+                "kind": "factory_invariant", "round": rnd,
+                "value": st,
+                "threshold": _FACTORY_STUDENT_RATIO_MAX,
+                "detail": "student-vs-teacher $/SLO-hr ratio outside "
+                          "the plausible band — broken pairing or a "
+                          "corrupt record"})
     return {"comparisons": comparisons, "regressions": regressions,
             "ok": not regressions}
 
@@ -672,6 +794,39 @@ def _stream_points(rnd: int, fname: str, stream: dict) -> list[dict]:
     return points
 
 
+def _factory_points(rnd: int, fname: str, fac: dict) -> list[dict]:
+    """Round-17 factory-throughput rows as curve points: each cell's
+    plan-playback rate (the labeling engine IS the streaming plan
+    kernel, so these extend the playback series), with the pairs/sec
+    and the paired naive-loop baseline in the note — labeled, never
+    folded into the kernel-only series."""
+    base = {
+        "round": rnd, "file": fname, "source": "factory_playback",
+        "platform": fac.get("platform"),
+        "virtual": bool(fac.get("virtual", False)),
+        "devices": 1, "pipeline": "factory double-buffered playback",
+        "engine": fac.get("engine"),
+    }
+    points = []
+    for cell in fac.get("cells", []):
+        if not isinstance(cell, dict):
+            continue
+        points.append(dict(
+            base,
+            per_device_batch=cell.get("pairs"),
+            steps=cell.get("steps"),
+            cluster_days_per_sec_per_device=cell.get(
+                "playback_cluster_days_per_sec"),
+            cluster_days_per_sec_aggregate=cell.get(
+                "playback_cluster_days_per_sec"),
+            note=(f"{cell.get('scenario')}.{cell.get('intensity')}: "
+                  f"{cell.get('pairs_per_sec')} pairs/s "
+                  f"(naive baseline "
+                  f"{(fac.get('baseline') or {}).get('pairs_per_sec')}"
+                  f" pairs/s)")))
+    return points
+
+
 def scaling_curve(root: str) -> dict:
     """The measured multichip record as ONE weak-scaling series:
     {"points": [...], "per_round": [...]}.
@@ -770,6 +925,10 @@ def scaling_curve(root: str) -> dict:
                         sc["cluster_days_per_sec"]),
                     "engine": sc.get("engine"),
                 })
+        fac = (doc if doc.get("stage") == "--factory-only"
+               else doc.get("factory"))
+        if isinstance(fac, dict) and isinstance(fac.get("cells"), list):
+            points.extend(_factory_points(rnd, fname, fac))
         stream = (doc if doc.get("stage") == "--stream-only"
                   else doc.get("stream"))
         if isinstance(stream, dict) \

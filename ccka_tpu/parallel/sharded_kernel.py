@@ -715,9 +715,18 @@ def sharded_packed_mode_block_summary_fn(mesh: Mesh, params: SimParams,
     concatenated batch (pinned in `tests/test_streaming.py`).
     ``batch`` is implied by the stream/state the caller threads; the
     per-shard batch must divide into ``b_block`` like every sharded
-    entry's."""
-    import numpy as np
+    entry's. Since ISSUE 14 a registry dispatcher: the per-mode mesh
+    builders register on the `sim/lanes.py` mode registry's
+    ``sharded_block_summary`` slot at this module's import."""
+    builder = lanes.mode_engine(mode, "sharded_block_summary")
+    return builder(mesh, params, cluster, T=T, block_T=block_T,
+                   b_block=b_block, t_chunk=t_chunk, interpret=interpret,
+                   stochastic=stochastic, net_params=net_params,
+                   plan_packed=plan_packed, carbon=carbon)
 
+
+def _mesh_block_statics(mesh, params, cluster, *, T, block_T, t_chunk,
+                        b_block):
     n_blocks, T_pad = lanes.block_layout(T, block_T, t_chunk)
     n = data_shards(mesh)
     P, Z = cluster.n_pools, cluster.n_zones
@@ -725,7 +734,7 @@ def sharded_packed_mode_block_summary_fn(mesh: Mesh, params: SimParams,
     WD = int(params.wl_batch_deadline_ticks)
     data = mesh.axis_names[0]
 
-    def _blocks_per_shard(stream_block):
+    def blocks_per_shard(stream_block):
         # Same contract as the single-chip bundle's check_block: a
         # wrong-length block would silently misalign the valid gate,
         # the tod clock and the PRNG chunk seeds (meta t0 assumes
@@ -738,101 +747,146 @@ def sharded_packed_mode_block_summary_fn(mesh: Mesh, params: SimParams,
         return _split_batch(stream_block.shape[-1], n, b_block,
                             "stream") // b_block
 
-    def _state_sharding(ndim):
+    def state_sharding(ndim):
         spec = (PartitionSpec(None, None, data) if ndim == 3
                 else PartitionSpec(None, data))
         return jax.sharding.NamedSharding(mesh, spec)
 
-    if mode in ("rule", "carbon"):
-        from ccka_tpu.policy.rule import offpeak_action, peak_action
+    return n_blocks, T_pad, P, Z, K, WD, blocks_per_shard, state_sharding
 
-        off, peak = offpeak_action(cluster), peak_action(cluster)
-        if mode == "carbon" and carbon is None:
-            carbon = (10.0, 0.05, 1.0)
-        cstat = carbon if mode == "carbon" else None
 
-        def step(stream_block, state, j, seed):
-            fn = _packed_block_call(
-                mesh, T, block_T, P, Z, K, WD, stochastic, b_block,
-                t_chunk, interpret, cstat,
-                _blocks_per_shard(stream_block))
-            return fn(params, off, peak, stream_block, state,
-                      jnp.int32(seed), jnp.int32(j))
+def _sharded_profile_block_fns(mode, mesh, params, cluster, *, T,
+                               block_T, b_block, t_chunk, interpret,
+                               stochastic, net_params=None,
+                               plan_packed=None,
+                               carbon=None) -> BlockSummaryFns:
+    """rule/carbon mesh carried-state bundle (registered builder)."""
+    from ccka_tpu.policy.rule import offpeak_action, peak_action
 
-        def init_state(stream_rows, batch):
-            s_rows = block_state_rows(params, cluster, mode, stream_rows)
-            return jax.device_put(jnp.zeros((s_rows, batch), jnp.float32),
-                                  _state_sharding(2))
+    (n_blocks, T_pad, P, Z, K, WD, blocks_per_shard,
+     state_sharding) = _mesh_block_statics(
+        mesh, params, cluster, T=T, block_T=block_T, t_chunk=t_chunk,
+        b_block=b_block)
+    off, peak = offpeak_action(cluster), peak_action(cluster)
+    if mode == "carbon" and carbon is None:
+        carbon = (10.0, 0.05, 1.0)
+    cstat = carbon if mode == "carbon" else None
 
-        def finalize(out):
-            return _finalize(params, out, T)
+    def step(stream_block, state, j, seed):
+        fn = _packed_block_call(
+            mesh, T, block_T, P, Z, K, WD, stochastic, b_block,
+            t_chunk, interpret, cstat, blocks_per_shard(stream_block))
+        return fn(params, off, peak, stream_block, state,
+                  jnp.int32(seed), jnp.int32(j))
 
-    elif mode == "neural":
-        if net_params is None:
-            raise ValueError("sharded block summary: mode 'neural' "
-                             "needs net_params")
-        from ccka_tpu.policy.constraints import slo_pool_mask
+    def init_state(stream_rows, batch):
+        s_rows = block_state_rows(params, cluster, mode, stream_rows)
+        return jax.device_put(jnp.zeros((s_rows, batch), jnp.float32),
+                              state_sharding(2))
 
-        dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
-        if was_single:
-            net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
-                                      net_params)
-        slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
-        weights = _pack_mlp_tensors(net_params, dims, b_block)
-        n_pop = int(weights[0].shape[0])
-
-        def step(stream_block, state, j, seed):
-            fn = _neural_block_call(
-                mesh, T, block_T, P, Z, K, WD, stochastic, b_block,
-                t_chunk, interpret, slo, dims,
-                _blocks_per_shard(stream_block))
-            return fn(params, weights, stream_block, state,
-                      jnp.int32(seed), jnp.int32(j))
-
-        def init_state(stream_rows, batch):
-            s_rows = block_state_rows(params, cluster, mode, stream_rows)
-            return jax.device_put(
-                jnp.zeros((n_pop, s_rows, batch), jnp.float32),
-                _state_sharding(3))
-
-        def finalize(out):
-            s = jax.vmap(lambda o: _finalize(params, o, T))(out)
-            return jax.tree.map(lambda x: x[0], s) if was_single else s
-
-    elif mode == "plan":
-        if plan_packed is None:
-            from ccka_tpu.policy.rule import neutral_action
-
-            base = neutral_action(cluster)
-            actions = jax.tree.map(
-                lambda x: jnp.broadcast_to(x, (T_pad,) + x.shape), base)
-            plan_packed = pack_plan(actions, T_pad)
-        pr = _plan_rows(P, Z)
-        if plan_packed.shape[0] != T_pad or plan_packed.shape[1] != pr:
-            raise ValueError(
-                f"plan stream shape {tuple(plan_packed.shape)} does not "
-                f"match T_pad={T_pad} / plan_rows={pr} — pack with "
-                "pack_plan(actions, T_pad)")
-        plan_dev = shard_plan_stream(mesh, plan_packed)
-        plan_batched = plan_packed.ndim == 3
-
-        def step(stream_block, state, j, seed):
-            fn = _plan_block_call(
-                mesh, T, block_T, P, Z, K, WD, stochastic, b_block,
-                t_chunk, interpret, plan_batched,
-                _blocks_per_shard(stream_block))
-            return fn(params, plan_dev, stream_block, state,
-                      jnp.int32(seed), jnp.int32(j))
-
-        def init_state(stream_rows, batch):
-            s_rows = block_state_rows(params, cluster, mode, stream_rows)
-            return jax.device_put(jnp.zeros((s_rows, batch), jnp.float32),
-                                  _state_sharding(2))
-
-        def finalize(out):
-            return _finalize(params, out, T)
-
-    else:
-        raise ValueError(f"unknown packed mode {mode!r}")
+    def finalize(out):
+        return _finalize(params, out, T)
 
     return BlockSummaryFns(step, init_state, finalize, n_blocks, T_pad)
+
+
+def _sharded_neural_block_fns(mesh, params, cluster, *, T, block_T,
+                              b_block, t_chunk, interpret, stochastic,
+                              net_params=None, plan_packed=None,
+                              carbon=None) -> BlockSummaryFns:
+    """Population-MLP mesh carried-state bundle (registered builder)."""
+    import numpy as np
+
+    if net_params is None:
+        raise ValueError("sharded block summary: mode 'neural' "
+                         "needs net_params")
+    from ccka_tpu.policy.constraints import slo_pool_mask
+
+    (n_blocks, T_pad, P, Z, K, WD, blocks_per_shard,
+     state_sharding) = _mesh_block_statics(
+        mesh, params, cluster, T=T, block_T=block_T, t_chunk=t_chunk,
+        b_block=b_block)
+    dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
+    if was_single:
+        net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                  net_params)
+    slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
+    weights = _pack_mlp_tensors(net_params, dims, b_block)
+    n_pop = int(weights[0].shape[0])
+
+    def step(stream_block, state, j, seed):
+        fn = _neural_block_call(
+            mesh, T, block_T, P, Z, K, WD, stochastic, b_block,
+            t_chunk, interpret, slo, dims,
+            blocks_per_shard(stream_block))
+        return fn(params, weights, stream_block, state,
+                  jnp.int32(seed), jnp.int32(j))
+
+    def init_state(stream_rows, batch):
+        s_rows = block_state_rows(params, cluster, "neural", stream_rows)
+        return jax.device_put(
+            jnp.zeros((n_pop, s_rows, batch), jnp.float32),
+            state_sharding(3))
+
+    def finalize(out):
+        s = jax.vmap(lambda o: _finalize(params, o, T))(out)
+        return jax.tree.map(lambda x: x[0], s) if was_single else s
+
+    return BlockSummaryFns(step, init_state, finalize, n_blocks, T_pad)
+
+
+def _sharded_plan_block_fns(mesh, params, cluster, *, T, block_T,
+                            b_block, t_chunk, interpret, stochastic,
+                            net_params=None, plan_packed=None,
+                            carbon=None) -> BlockSummaryFns:
+    """Plan-playback mesh carried-state bundle (registered builder)."""
+    (n_blocks, T_pad, P, Z, K, WD, blocks_per_shard,
+     state_sharding) = _mesh_block_statics(
+        mesh, params, cluster, T=T, block_T=block_T, t_chunk=t_chunk,
+        b_block=b_block)
+    if plan_packed is None:
+        from ccka_tpu.policy.rule import neutral_action
+
+        base = neutral_action(cluster)
+        actions = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (T_pad,) + x.shape), base)
+        plan_packed = pack_plan(actions, T_pad)
+    pr = _plan_rows(P, Z)
+    if plan_packed.shape[0] != T_pad or plan_packed.shape[1] != pr:
+        raise ValueError(
+            f"plan stream shape {tuple(plan_packed.shape)} does not "
+            f"match T_pad={T_pad} / plan_rows={pr} — pack with "
+            "pack_plan(actions, T_pad)")
+    plan_dev = shard_plan_stream(mesh, plan_packed)
+    plan_batched = plan_packed.ndim == 3
+
+    def step(stream_block, state, j, seed):
+        fn = _plan_block_call(
+            mesh, T, block_T, P, Z, K, WD, stochastic, b_block,
+            t_chunk, interpret, plan_batched,
+            blocks_per_shard(stream_block))
+        return fn(params, plan_dev, stream_block, state,
+                  jnp.int32(seed), jnp.int32(j))
+
+    def init_state(stream_rows, batch):
+        s_rows = block_state_rows(params, cluster, "plan", stream_rows)
+        return jax.device_put(jnp.zeros((s_rows, batch), jnp.float32),
+                              state_sharding(2))
+
+    def finalize(out):
+        return _finalize(params, out, T)
+
+    return BlockSummaryFns(step, init_state, finalize, n_blocks, T_pad)
+
+
+# Mesh engines onto the mode registry (`sim/lanes.py`): the megakernel
+# module registered the modes; this module provides their
+# ``sharded_block_summary`` slot.
+for _m, _fn in (
+        ("rule", functools.partial(_sharded_profile_block_fns, "rule")),
+        ("carbon", functools.partial(_sharded_profile_block_fns,
+                                     "carbon")),
+        ("neural", _sharded_neural_block_fns),
+        ("plan", _sharded_plan_block_fns)):
+    lanes.provide_mode_engine(_m, "sharded_block_summary", _fn)
+del _m, _fn

@@ -47,7 +47,8 @@ class SyntheticSignalSource(SignalSource):
                  *,
                  start_unix_s: float = 0.0,
                  faults=None,
-                 workloads=None):
+                 workloads=None,
+                 extra_lanes: dict | None = None):
         self.cluster = cluster
         self.workload = workload
         self.sim = sim
@@ -68,6 +69,24 @@ class SyntheticSignalSource(SignalSource):
         # source. None/disabled emits the exact pre-workload stream.
         self.workloads = workloads if (workloads is not None
                                        and workloads.enabled) else None
+        # Further registered lane families (`sim/lanes.py` registry,
+        # ISSUE 14): {family name: family config}. Synthesis is fully
+        # generic — a family registered with `register_lane_family` +
+        # `provide_lane_generator` rides the packed stream with ZERO
+        # edits here (the registry contract `tests/test_engine_registry`
+        # pins). Unknown names are rejected up front.
+        from ccka_tpu.sim import lanes as _lanes
+
+        for name in (extra_lanes or {}):
+            if name in ("faults", "workloads"):
+                raise ValueError(
+                    f"extra_lanes[{name!r}]: pass the built-in families "
+                    "via the faults=/workloads= arguments")
+            if name not in _lanes.LANE_FAMILIES:
+                raise ValueError(
+                    f"unknown lane family {name!r}; registered: "
+                    f"{sorted(_lanes.LANE_FAMILIES)}")
+        self.extra_lanes = dict(extra_lanes or {})
         self.start_unix_s = start_unix_s
         self._zp = self._zone_params()
         # Longest trace generated so far, per seed. Generation is
@@ -242,9 +261,7 @@ class SyntheticSignalSource(SignalSource):
 
         z = self.cluster.n_zones
         t_pad = _math.ceil(steps / t_chunk) * t_chunk
-        faults = self.faults
-        workloads = self.workloads
-        dt_s, start_s = self.sim.dt_s, self.start_unix_s
+        lane_gens = self._lane_generators()
 
         def generate(k):
             ks, kc, kd = jax.random.split(k, 3)
@@ -257,36 +274,55 @@ class SyntheticSignalSource(SignalSource):
                             axis=0),
             )
             packed = self._assemble_packed(steps, t_pad, noise)
-            if faults is None and workloads is None:
+            if not lane_gens:
                 return packed
             import jax.numpy as _jnp
 
+            # Registered lane families (ccka_tpu/sim/lanes registry):
+            # appended AFTER the padded exo block in registration order
+            # so existing row offsets never move; each family's
+            # generator folds its OWN key tag off the same generation
+            # key, so the exo streams' draws — and therefore the exo
+            # rows — stay bitwise identical to an un-widened source on
+            # the same key. The spot AR(1) anomaly rides the context
+            # for the faults family's price-correlated hazard.
+            ctx = dict(price_dev=noise[0], dt_s=self.sim.dt_s,
+                       start_unix_s=self.start_unix_s)
             parts = [packed]
-            if faults is not None:
-                # Fault lanes (ccka_tpu/faults): appended AFTER the
-                # padded exo block so existing row offsets are
-                # untouched; keyed by fold_in(k, FAULT_KEY_TAG) so the
-                # exo streams' own draws — and therefore the exo rows —
-                # stay bitwise identical to a no-faults source on the
-                # same key. The spot AR(1) anomaly feeds the optional
-                # price-correlated hazard.
-                from ccka_tpu.faults.process import packed_fault_lanes
-                parts.append(packed_fault_lanes(faults, k, steps, t_pad,
-                                                z, batch,
-                                                price_dev=noise[0]))
-            if workloads is not None:
-                # Workload lanes (ccka_tpu/workloads): appended LAST,
-                # keyed by fold_in(k, WORKLOAD_KEY_TAG) — widening a
-                # stream with families changes neither the exo nor the
-                # fault rows bitwise.
-                from ccka_tpu.workloads.process import (
-                    packed_workload_lanes)
-                parts.append(packed_workload_lanes(
-                    workloads, k, steps, t_pad, z, batch,
-                    dt_s=dt_s, start_unix_s=start_s))
+            for _name, cfg_f, gen_f in lane_gens:
+                parts.append(gen_f(cfg_f, k, steps, t_pad, z, batch,
+                                   ctx=ctx))
             return _jnp.concatenate(parts, axis=1)
 
         return generate
+
+    def _lane_generators(self) -> list:
+        """``(name, config, generate)`` per PRESENT lane family, in
+        registration order — the generic synthesis plan both packed
+        generators share (`sim/lanes.py` registry; generators resolve
+        here, OUTSIDE the jitted trace)."""
+        from ccka_tpu.sim import lanes as _lanes
+
+        configs = {"faults": self.faults, "workloads": self.workloads,
+                   **self.extra_lanes}
+        plan = []
+        for fam in _lanes.lane_families():
+            cfg_f = configs.get(fam.name)
+            if cfg_f is None:
+                continue
+            plan.append((fam.name, cfg_f, _lanes.lane_generator(fam.name)))
+        return plan
+
+    def packed_rows(self) -> int:
+        """Row count of this source's packed stream layout — base exo
+        block plus every present registered lane family's block."""
+        from ccka_tpu.sim import lanes as _lanes
+
+        z = self.cluster.n_zones
+        rows = _lanes.exo_rows(z)
+        for name, _cfg, _gen in self._lane_generators():
+            rows += _lanes.LANE_FAMILIES[name].rows(z)
+        return rows
 
     def packed_trace_device(self, steps: int, key, batch: int,
                             *, t_chunk: int = 64, recycle=None):
@@ -355,9 +391,8 @@ class SyntheticSignalSource(SignalSource):
 
         _lanes.block_layout(block_T, block_T, t_chunk)  # divisibility
         z = self.cluster.n_zones
-        faults = self.faults
-        workloads = self.workloads
         dt_s, start_s = self.sim.dt_s, self.start_unix_s
+        lane_gens = self._lane_generators()
 
         def generate(k, t0_ticks):
             ks, kc, kd = jax.random.split(k, 3)
@@ -371,23 +406,20 @@ class SyntheticSignalSource(SignalSource):
             )
             packed = self._assemble_packed(block_T, block_T, noise,
                                            t0_ticks=t0_ticks)
-            if faults is None and workloads is None:
+            if not lane_gens:
                 return packed
+            # Same generic registry iteration as `packed_generate_fn`;
+            # the block's global tick offset rides the context so
+            # families with a diurnal clock (workloads) stay anchored
+            # to the same wall clock the unblocked stream uses.
+            ctx = dict(
+                price_dev=noise[0], dt_s=dt_s, start_unix_s=start_s,
+                start_offset_s=jnp.full(
+                    (batch,), jnp.asarray(t0_ticks, jnp.float32) * dt_s))
             parts = [packed]
-            if faults is not None:
-                from ccka_tpu.faults.process import packed_fault_lanes
-                parts.append(packed_fault_lanes(faults, k, block_T,
-                                                block_T, z, batch,
-                                                price_dev=noise[0]))
-            if workloads is not None:
-                from ccka_tpu.workloads.process import (
-                    packed_workload_lanes)
-                off_s = jnp.full(
-                    (batch,), jnp.asarray(t0_ticks, jnp.float32) * dt_s)
-                parts.append(packed_workload_lanes(
-                    workloads, k, block_T, block_T, z, batch,
-                    dt_s=dt_s, start_unix_s=start_s,
-                    start_offset_s=off_s))
+            for _name, cfg_f, gen_f in lane_gens:
+                parts.append(gen_f(cfg_f, k, block_T, block_T, z, batch,
+                                   ctx=ctx))
             return jnp.concatenate(parts, axis=1)
 
         return generate

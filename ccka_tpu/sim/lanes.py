@@ -1,35 +1,64 @@
-"""Packed exo-stream lane-layout arithmetic — the ONE layout module.
+"""Packed exo-stream layout + rollout-engine registry — the ONE registry.
 
 Every consumer of the packed ``[T_pad, rows, B]`` exo stream (the
 megakernel's entry points, the fault and workload lane synthesizers,
 the sharded wrappers, bench's roofline byte counts) keys off the same
-row arithmetic: the base exo block, the optional fault block appended
-after it, and the optional workload block appended after that. This
-module is the neutral home for that arithmetic so the subsystems import
-it DOWNWARD — `faults/` and `workloads/` both depend on it, never on
-each other (earlier drafts had `faults.has_fault_lanes` reach up into
+row arithmetic: the base exo block, then one optional row block per
+REGISTERED LANE FAMILY, appended in registration order. This module is
+the neutral home for that arithmetic so the subsystems import it
+DOWNWARD — `faults/` and `workloads/` both depend on it, never on each
+other (earlier drafts had `faults.has_fault_lanes` reach up into
 `workloads.process` for the resolver and everyone lazy-importing
-`megakernel._exo_rows`, inverting or tangling the layering). It imports
-nothing but the stdlib, so it can never join a cycle.
+`megakernel._exo_rows`, inverting or tangling the layering). At module
+level it imports nothing but the stdlib, so it can never join a cycle;
+the engine resolvers below lazily import their provider modules at
+CALL time only.
+
+ISSUE 14 grew this module from row arithmetic into the full registry
+the ROADMAP item 2 refactor promised, with two registries:
+
+- **Lane families** (:func:`register_lane_family`): a family is a named
+  row block (``rows(Z)``), a PRNG key tag, and an optional synthesis
+  closure (registered by the jax-importing provider module,
+  :func:`provide_lane_generator`). The base exo block plus the present
+  families' blocks must resolve UNIQUELY from the total row count
+  (:func:`resolve_layout`) — registration rejects any family whose row
+  arithmetic would make two different lane combinations collide for any
+  plausible zone count, because a half-resolved stream would silently
+  misread lanes as padding. Adding a lane family here is the ONLY edit:
+  the synthetic source synthesizes registered families generically, the
+  layout resolver accepts the widened stream, and every engine (lax,
+  all four kernel modes, streaming, the sharded wrappers) consumes it
+  with zero per-engine edits (`tests/test_engine_registry.py` pins
+  this contract with a test-only family).
+
+- **Policy modes** (:func:`register_mode`): a mode is a named bundle of
+  engine closures — the fused packed entry (``packed_summary``), the
+  carried-state streaming bundle (``block_summary``), the mesh variant
+  (``sharded_block_summary``) and the lax reference engine
+  (``lax_summary``). `sim/megakernel.py` registers the four kernel
+  modes {rule, carbon, neural, plan} and dispatches its own
+  ``packed_mode_summary_fn`` / ``packed_mode_block_summary_fn``
+  through here; `sim/rollout.py` provides the lax engines;
+  `parallel/sharded_kernel.py` provides the mesh engines;
+  `sim/streaming.py` consumes whichever the mesh argument selects. A
+  new policy mode is ONE registration, not five edits — the
+  quintuplication tax (kernel out rows grew 14→16→21 across rounds
+  10–11, each a five-site thread-through) ends here.
 
 Block sizes (all padded to the f32 sublane multiple of 8):
 
     exo_rows(Z)       3Z+3 signal rows (ARCHITECTURE §6)
     fault_rows(Z)     hazard[Z] + deny + delay + stale   (§12)
     workload_rows(Z)  3 family-arrival rows, sized fault_rows(Z)+8 so
-                      the four layouts below stay mutually
-                      distinguishable for ANY zone count (§13)
-
-Layout detection is purely row-count-based (`stream_layout`): a stream
-has exactly ``exo_rows(Z)`` rows (plain), ``+fault_rows`` (+faults),
-``+workload_rows`` (+workloads) or ``+both`` — anything else is
-rejected outright, because a half-widened stream would silently misread
-lanes as padding. ROADMAP item 5's unified rollout-engine refactor
-grows this module into the full packed-stream layout registry.
+                      the layouts stay mutually distinguishable for ANY
+                      zone count (§13)
 """
 
 from __future__ import annotations
 
+import importlib
+import itertools
 import math
 
 
@@ -52,32 +81,323 @@ def workload_rows(Z: int) -> int:
     return fault_rows(Z) + 8
 
 
-def stream_layout(rows: int, Z: int) -> tuple[bool, bool]:
-    """``(has_faults, has_workloads)`` of a packed stream, inferred from
-    its row count — the zero-API-churn detection every kernel entry
-    point uses. Rejects any other row count outright (a half-widened
-    stream would silently misread lanes as padding)."""
-    base, f, w = exo_rows(Z), fault_rows(Z), workload_rows(Z)
-    layouts = {base: (False, False),
-               base + f: (True, False),
-               base + w: (False, True),
-               base + f + w: (True, True)}
-    got = layouts.get(int(rows))
-    if got is None:
+# ---- lane-family registry -------------------------------------------------
+
+# Zone counts the ambiguity check sweeps at registration time: every
+# preset topology (default Z=3, multiregion Z=4) plus the plausible
+# spread a scenario suite could configure. A family whose rows collide
+# with another combination at ANY of these is rejected up front.
+_AMBIGUITY_ZS = (1, 2, 3, 4, 5, 6, 8)
+
+
+class LaneFamily:
+    """One registered packed-stream lane family (see module docstring).
+
+    ``generate(config, key, steps, t_pad, z, batch, *, ctx)`` is the
+    synthesis closure — registered separately by the family's
+    jax-importing provider module (:func:`provide_lane_generator`) so
+    this module stays import-light. ``ctx`` carries the generation
+    context the built-in families need (``price_dev`` AR(1) spot noise,
+    ``dt_s``, ``start_unix_s``, optional ``start_offset_s``); closures
+    take what they use. The closure receives the UNFOLDED stream key
+    and owns its tag fold — exactly how `faults.packed_fault_lanes` /
+    `workloads.packed_workload_lanes` always keyed, so registering them
+    here changed no bits.
+    """
+
+    __slots__ = ("name", "rows", "key_tag", "provider", "generate")
+
+    def __init__(self, name, rows, key_tag, provider=None):
+        self.name = name
+        self.rows = rows
+        self.key_tag = key_tag
+        self.provider = provider
+        self.generate = None
+
+
+LANE_FAMILIES: dict[str, LaneFamily] = {}
+
+
+def lane_families() -> tuple[LaneFamily, ...]:
+    """Registered families in registration order — the packed stream's
+    block order after the base exo block."""
+    return tuple(LANE_FAMILIES.values())
+
+
+def _subset_sums(families, Z: int) -> dict[int, tuple[str, ...]]:
+    """{total rows: family-name subset} over all present/absent
+    combinations of ``families`` at zone count ``Z``."""
+    base = exo_rows(Z)
+    sums: dict[int, tuple[str, ...]] = {}
+    for r in range(len(families) + 1):
+        for combo in itertools.combinations(families, r):
+            total = base + sum(f.rows(Z) for f in combo)
+            if total in sums:
+                raise ValueError(
+                    f"ambiguous lane layout at Z={Z}: families "
+                    f"{tuple(f.name for f in combo)} and "
+                    f"{sums[total]} both total {total} rows — a stream "
+                    "could not be resolved from its row count")
+            sums[total] = tuple(f.name for f in combo)
+    return sums
+
+
+def register_lane_family(name: str, *, rows, key_tag: int,
+                         provider: str | None = None) -> LaneFamily:
+    """Register a packed-stream lane family. ``rows`` is a
+    ``Z -> row count`` callable; ``key_tag`` the family's PRNG fold tag
+    (must be unique — two families folding the same tag would draw
+    correlated lanes); ``provider`` an optional dotted module path
+    imported lazily when the family's generator is first needed.
+    Rejects duplicates and any registration that would make row-count
+    layout resolution ambiguous (checked across ``_AMBIGUITY_ZS``)."""
+    if name in LANE_FAMILIES:
+        raise ValueError(f"lane family {name!r} already registered")
+    tags = {f.key_tag for f in LANE_FAMILIES.values()}
+    if key_tag in tags:
+        raise ValueError(f"lane family {name!r}: key tag {key_tag:#x} "
+                         "already registered to another family")
+    fam = LaneFamily(name, rows, key_tag, provider)
+    trial = list(LANE_FAMILIES.values()) + [fam]
+    for z in _AMBIGUITY_ZS:
+        _subset_sums(trial, z)   # raises on a collision
+    LANE_FAMILIES[name] = fam
+    return fam
+
+
+def unregister_lane_family(name: str) -> None:
+    """Remove a registered family — TEST plumbing only (the registry
+    contract test registers a throwaway family and must leave the
+    process-global registry exactly as it found it)."""
+    LANE_FAMILIES.pop(name, None)
+
+
+def provide_lane_generator(name: str, generate) -> None:
+    """Attach the synthesis closure to a registered family (called by
+    the family's jax-importing provider module at import time).
+    Re-providing a filled slot is rejected — two modules silently
+    fighting over one family's generator is a bug (the
+    `provide_mode_engine` rule); re-register the family to replace it."""
+    if name not in LANE_FAMILIES:
+        raise ValueError(f"unknown lane family {name!r}; registered: "
+                         f"{sorted(LANE_FAMILIES)}")
+    fam = LANE_FAMILIES[name]
+    if fam.generate is not None and fam.generate is not generate:
+        raise ValueError(f"lane family {name!r} already has a "
+                         "generator; unregister + re-register the "
+                         "family to replace it")
+    fam.generate = generate
+
+
+def lane_generator(name: str):
+    """The family's synthesis closure, importing its provider module on
+    first use (the registry itself never imports jax)."""
+    fam = LANE_FAMILIES.get(name)
+    if fam is None:
+        raise ValueError(f"unknown lane family {name!r}; registered: "
+                         f"{sorted(LANE_FAMILIES)}")
+    if fam.generate is None and fam.provider:
+        importlib.import_module(fam.provider)
+    if fam.generate is None:
+        raise ValueError(f"lane family {name!r} has no registered "
+                         "generator (provide_lane_generator)")
+    return fam.generate
+
+
+# The two built-in families. Their tags are canonical HERE; the process
+# modules re-export them (`faults.process.FAULT_KEY_TAG` /
+# `workloads.process.WORKLOAD_KEY_TAG`) and register the generators.
+register_lane_family("faults", rows=fault_rows, key_tag=0xFA117,
+                     provider="ccka_tpu.faults.process")
+register_lane_family("workloads", rows=workload_rows, key_tag=0x301AD,
+                     provider="ccka_tpu.workloads.process")
+
+
+class StreamLayout:
+    """The resolved lane layout of one packed stream: which registered
+    families are present and the row offsets of each block."""
+
+    __slots__ = ("Z", "rows", "families", "offsets")
+
+    def __init__(self, Z, rows, families, offsets):
+        self.Z = Z
+        self.rows = rows
+        self.families = families   # tuple of present family names
+        self.offsets = offsets     # name -> (lo, hi); "" = base exo
+
+    def has(self, name: str) -> bool:
+        return name in self.families
+
+    def block(self, name: str) -> tuple[int, int]:
+        if name not in self.offsets:
+            raise ValueError(f"stream carries no {name} lanes")
+        return self.offsets[name]
+
+
+def resolve_layout(rows: int, Z: int) -> StreamLayout:
+    """Resolve a packed stream's lane layout from its row count — the
+    zero-API-churn detection every engine uses, generalized over the
+    registered families. Rejects any other row count outright (a
+    half-widened stream would silently misread lanes as padding)."""
+    sums = _subset_sums(lane_families(), Z)
+    names = sums.get(int(rows))
+    if names is None:
+        valid = ", ".join(
+            f"{total} ({'+'.join(combo) or 'plain'})"
+            for total, combo in sorted(sums.items()))
         raise ValueError(
             f"packed stream has {rows} rows; this topology (Z={Z}) "
-            f"expects {base} (plain), {base + f} (+faults), {base + w} "
-            f"(+workloads) or {base + f + w} (+both)")
-    return got
+            f"expects one of: {valid}")
+    offsets = {}
+    off = exo_rows(Z)
+    for fam in lane_families():
+        if fam.name in names:
+            offsets[fam.name] = (off, off + fam.rows(Z))
+            off += fam.rows(Z)
+    return StreamLayout(Z, int(rows), names, offsets)
+
+
+def stream_layout(rows: int, Z: int) -> tuple[bool, bool]:
+    """``(has_faults, has_workloads)`` of a packed stream, inferred from
+    its row count via :func:`resolve_layout`. The long-standing
+    two-tuple form every kernel launcher consumes — lane families
+    beyond the built-in two resolve (and ride the stream) without
+    appearing here, because no engine consumes them in-kernel."""
+    lay = resolve_layout(rows, Z)
+    return lay.has("faults"), lay.has("workloads")
 
 
 def workload_base(rows: int, Z: int) -> int:
     """Row offset of the workload block inside a widened stream (after
     the fault block when one is present)."""
-    has_faults, has_wl = stream_layout(rows, Z)
-    if not has_wl:
+    lay = resolve_layout(rows, Z)
+    if not lay.has("workloads"):
         raise ValueError("stream carries no workload lanes")
-    return exo_rows(Z) + (fault_rows(Z) if has_faults else 0)
+    return lay.block("workloads")[0]
+
+
+# ---- policy-mode registry -------------------------------------------------
+
+# Engine slots and the provider module that registers each — imported
+# lazily at resolution time so this module's import graph stays empty.
+_ENGINE_PROVIDERS = {
+    "packed_summary": "ccka_tpu.sim.megakernel",
+    "block_summary": "ccka_tpu.sim.megakernel",
+    "sharded_block_summary": "ccka_tpu.parallel.sharded_kernel",
+    "lax_summary": "ccka_tpu.sim.rollout",
+}
+
+_MODE_REGISTRAR = "ccka_tpu.sim.megakernel"
+
+
+class EngineMode:
+    """One registered packed policy mode and its engine closures (see
+    module docstring). Slots default to None and are provided by their
+    engine modules (:func:`provide_mode_engine`); `mode_engine` imports
+    the declared provider on first use."""
+
+    __slots__ = ("name", "watch_name", "packed_summary", "block_summary",
+                 "sharded_block_summary", "lax_summary")
+
+    def __init__(self, name, watch_name):
+        self.name = name
+        self.watch_name = watch_name
+        for slot in _ENGINE_PROVIDERS:
+            setattr(self, slot, None)
+
+
+MODES: dict[str, EngineMode] = {}
+
+# Engines provided before their mode registers (engine modules and the
+# mode registrar import in either order — e.g. `sim/rollout.py` provides
+# the lax engines whether or not the kernel module has imported yet).
+# Drained by `register_mode`.
+_PENDING_ENGINES: list[tuple[str, str, object]] = []
+
+
+def _attach_engine(mode: EngineMode, slot: str, fn) -> None:
+    if getattr(mode, slot) is not None:
+        raise ValueError(f"mode {mode.name!r} already has a {slot} "
+                         "engine")
+    setattr(mode, slot, fn)
+
+
+def register_mode(name: str, *, watch_name: str, **engines) -> EngineMode:
+    """Register a packed policy mode (duplicates rejected). ``engines``
+    may provide any of the engine slots inline; the rest arrive via
+    :func:`provide_mode_engine` from their own modules (in either import
+    order — early provisions queue until the mode registers)."""
+    if name in MODES:
+        raise ValueError(f"packed mode {name!r} already registered")
+    mode = EngineMode(name, watch_name)
+    MODES[name] = mode
+    for slot, fn in engines.items():
+        provide_mode_engine(name, slot, fn)
+    for pending in [p for p in _PENDING_ENGINES if p[0] == name]:
+        _PENDING_ENGINES.remove(pending)
+        _attach_engine(mode, pending[1], pending[2])
+    return mode
+
+
+def unregister_mode(name: str) -> None:
+    """TEST plumbing only — see :func:`unregister_lane_family`."""
+    MODES.pop(name, None)
+    for pending in [p for p in _PENDING_ENGINES if p[0] == name]:
+        _PENDING_ENGINES.remove(pending)
+
+
+def provide_mode_engine(name: str, slot: str, fn) -> None:
+    """Attach one engine closure to a registered mode (called by the
+    engine's own module at import time; queued when the mode has not
+    registered yet). Re-providing a filled slot is rejected — two
+    modules silently fighting over one engine is a bug."""
+    if slot not in _ENGINE_PROVIDERS:
+        raise ValueError(f"unknown engine slot {slot!r}; have "
+                         f"{sorted(_ENGINE_PROVIDERS)}")
+    mode = MODES.get(name)
+    if mode is None:
+        _PENDING_ENGINES.append((name, slot, fn))
+        return
+    _attach_engine(mode, slot, fn)
+
+
+def mode_names() -> tuple[str, ...]:
+    """Registered mode names (importing the canonical registrar first so
+    an early caller sees the built-in four)."""
+    if not MODES:
+        importlib.import_module(_MODE_REGISTRAR)
+    return tuple(MODES)
+
+
+def resolve_mode(name: str) -> EngineMode:
+    if name not in MODES:
+        # The built-in modes register when the kernel module imports;
+        # resolve for an early caller rather than erroring on ordering.
+        importlib.import_module(_MODE_REGISTRAR)
+    if name not in MODES:
+        raise ValueError(f"unknown packed mode {name!r} — have "
+                         f"{tuple(MODES)}")
+    return MODES[name]
+
+
+def mode_engine(name: str, slot: str):
+    """The mode's engine closure for ``slot``, importing the slot's
+    provider module on first use. Raises (naming the mode and slot)
+    when the provider registers nothing — a mode genuinely missing an
+    engine must fail loudly, not fall back to a different engine."""
+    mode = resolve_mode(name)
+    fn = getattr(mode, slot, None)
+    if fn is None:
+        provider = _ENGINE_PROVIDERS.get(slot)
+        if provider is None:
+            raise ValueError(f"unknown engine slot {slot!r}; have "
+                             f"{sorted(_ENGINE_PROVIDERS)}")
+        importlib.import_module(provider)
+        fn = getattr(mode, slot, None)
+    if fn is None:
+        raise ValueError(f"packed mode {name!r} has no {slot} engine "
+                         "registered")
+    return fn
 
 
 # ---- time-axis block layout (ISSUE 13: the streaming pipeline) ------------
@@ -93,7 +413,7 @@ def workload_base(rows: int, Z: int) -> int:
 # — the folding itself lives with the jax-importing generators, but the
 # tag is declared here so every backend folds the SAME stream family.
 # Fault/workload lanes then key off the BLOCK key exactly as they key
-# off the whole-stream key today (fold_in(FAULT/WORKLOAD_KEY_TAG)), so
+# off the whole-stream key today (their registered family tags), so
 # widening a blocked stream with lanes changes neither the exo nor the
 # fault rows bitwise — per block, the same invariant the unblocked
 # layouts pin.

@@ -2045,9 +2045,12 @@ def packed_mode_block_summary_fn(params: SimParams, cluster, mode: str,
                                  ) -> BlockSummaryFns:
     """The per-mode ``*_block_summary`` closures of the streaming
     pipeline (ISSUE 13): a rollout resumable across time blocks, one
-    closure bundle per packed policy mode (`PACKED_MODE_WATCH_NAMES`
-    vocabulary — the same four modes `packed_mode_summary_fn` serves
-    synchronously).
+    closure bundle per REGISTERED packed policy mode (the `sim/lanes.py`
+    mode registry — the same modes `packed_mode_summary_fn` serves
+    synchronously). Since ISSUE 14 this is a registry dispatcher: each
+    mode's bundle builder is registered once (`lanes.register_mode`)
+    and every engine — this one, the mesh wrapper, the lax reference —
+    resolves it from the one vocabulary.
 
     - ``step(stream_block, state, j, seed) -> (out, state', stream')``
       runs block ``j`` ([block_T, rows, B] stream slice) from carried
@@ -2074,104 +2077,141 @@ def packed_mode_block_summary_fn(params: SimParams, cluster, mode: str,
     CarbonAwarePolicy's. ``net_params`` (mode "neural"): ActorCritic
     pytree, population axis supported ([NP, B] fields).
     """
-    from ccka_tpu.policy.rule import (neutral_action, offpeak_action,
-                                      peak_action)
+    builder = lanes.mode_engine(mode, "block_summary")
+    return builder(params, cluster, T=T, block_T=block_T,
+                   b_block=b_block, t_chunk=t_chunk, interpret=interpret,
+                   stochastic=stochastic, net_params=net_params,
+                   plan_packed=plan_packed, carbon=carbon)
 
-    n_blocks, T_pad = lanes.block_layout(T, block_T, t_chunk)
-    P, Z = cluster.n_pools, cluster.n_zones
-    K = int(params.provision_pipeline_k)
-    WD = int(params.wl_batch_deadline_ticks)
-    kw = dict(T=T, block_T=block_T, P=P, Z=Z, K=K, WD=WD,
-              stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
-              interpret=interpret)
 
+def _block_check(block_T: int):
     def check_block(stream_block):
         if stream_block.shape[0] != block_T:
             raise ValueError(
                 f"stream block covers {stream_block.shape[0]} ticks, "
                 f"the blocked layout needs exactly block_T={block_T} — "
                 "generate with packed_block_trace_device(block_T, ...)")
+    return check_block
 
-    if mode in ("rule", "carbon"):
-        off, peak = offpeak_action(cluster), peak_action(cluster)
-        if mode == "carbon" and carbon is None:
-            carbon = (10.0, 0.05, 1.0)   # CarbonAwarePolicy defaults
-        cstat = carbon if mode == "carbon" else None
 
-        def step(stream_block, state, j, seed):
-            check_block(stream_block)
-            return _fused_packed_block(
-                params, off, peak, stream_block, state, jnp.int32(seed),
-                jnp.int32(j), carbon=cstat, **kw)
+def _block_statics(params, cluster, *, T, block_T, t_chunk, b_block,
+                   stochastic, interpret):
+    n_blocks, T_pad = lanes.block_layout(T, block_T, t_chunk)
+    P, Z = cluster.n_pools, cluster.n_zones
+    kw = dict(T=T, block_T=block_T, P=P, Z=Z,
+              K=int(params.provision_pipeline_k),
+              WD=int(params.wl_batch_deadline_ticks),
+              stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+              interpret=interpret)
+    return n_blocks, T_pad, P, Z, kw
 
-        def init_state(stream_rows, batch):
-            return init_block_state(params, cluster, mode, stream_rows,
-                                    batch)
 
-        def finalize(out):
-            return _finalize(params, out, T)
+def _profile_block_fns(mode, params, cluster, *, T, block_T, b_block,
+                       t_chunk, interpret, stochastic, net_params=None,
+                       plan_packed=None, carbon=None) -> BlockSummaryFns:
+    """rule/carbon carried-state bundle (registered builder)."""
+    from ccka_tpu.policy.rule import offpeak_action, peak_action
 
-    elif mode == "neural":
-        if net_params is None:
-            raise ValueError("packed_mode_block_summary_fn: mode "
-                             "'neural' needs net_params")
-        from ccka_tpu.policy.constraints import slo_pool_mask
+    n_blocks, T_pad, _P, _Z, kw = _block_statics(
+        params, cluster, T=T, block_T=block_T, t_chunk=t_chunk,
+        b_block=b_block, stochastic=stochastic, interpret=interpret)
+    check_block = _block_check(block_T)
+    off, peak = offpeak_action(cluster), peak_action(cluster)
+    if mode == "carbon" and carbon is None:
+        carbon = (10.0, 0.05, 1.0)   # CarbonAwarePolicy defaults
+    cstat = carbon if mode == "carbon" else None
 
-        dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
-        if was_single:
-            net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
-                                      net_params)
-        slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
-        weights = _pack_mlp_tensors(net_params, dims, b_block)
-        n_pop = int(weights[0].shape[0])
-        nkw = dict(kw, slo_mask=slo, mlp_dims=dims)
+    def step(stream_block, state, j, seed):
+        check_block(stream_block)
+        return _fused_packed_block(
+            params, off, peak, stream_block, state, jnp.int32(seed),
+            jnp.int32(j), carbon=cstat, **kw)
 
-        def step(stream_block, state, j, seed):
-            check_block(stream_block)
-            return _fused_neural_block(
-                params, weights, stream_block, state, jnp.int32(seed),
-                jnp.int32(j), **nkw)
+    def init_state(stream_rows, batch):
+        return init_block_state(params, cluster, mode, stream_rows,
+                                batch)
 
-        def init_state(stream_rows, batch):
-            return init_block_state(params, cluster, mode, stream_rows,
-                                    batch, n_pop=n_pop)
+    def finalize(out):
+        return _finalize(params, out, T)
 
-        def finalize(out):
-            s = jax.vmap(lambda o: _finalize(params, o, T))(out)
-            return jax.tree.map(lambda x: x[0], s) if was_single else s
+    return BlockSummaryFns(step, init_state, finalize, n_blocks, T_pad)
 
-    elif mode == "plan":
-        if plan_packed is None:
-            base = neutral_action(cluster)
-            actions = jax.tree.map(
-                lambda x: jnp.broadcast_to(x, (T_pad,) + x.shape), base)
-            plan_packed = pack_plan(actions, T_pad)
-        pr = _plan_rows(P, Z)
-        if plan_packed.shape[0] != T_pad or plan_packed.shape[1] != pr:
-            raise ValueError(
-                f"plan stream shape {tuple(plan_packed.shape)} does not "
-                f"match T_pad={T_pad} / plan_rows={pr} — pack with "
-                "pack_plan(actions, T_pad)")
-        plan_batched = plan_packed.ndim == 3
-        pkw = dict(kw, plan_batched=plan_batched)
 
-        def step(stream_block, state, j, seed):
-            check_block(stream_block)
-            return _fused_plan_block(
-                params, plan_packed, stream_block, state,
-                jnp.int32(seed), jnp.int32(j), **pkw)
+def _neural_block_fns(params, cluster, *, T, block_T, b_block, t_chunk,
+                      interpret, stochastic, net_params=None,
+                      plan_packed=None, carbon=None) -> BlockSummaryFns:
+    """Population-MLP carried-state bundle (registered builder)."""
+    if net_params is None:
+        raise ValueError("packed_mode_block_summary_fn: mode "
+                         "'neural' needs net_params")
+    from ccka_tpu.policy.constraints import slo_pool_mask
 
-        def init_state(stream_rows, batch):
-            return init_block_state(params, cluster, mode, stream_rows,
-                                    batch)
+    n_blocks, T_pad, P, Z, kw = _block_statics(
+        params, cluster, T=T, block_T=block_T, t_chunk=t_chunk,
+        b_block=b_block, stochastic=stochastic, interpret=interpret)
+    check_block = _block_check(block_T)
+    dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
+    if was_single:
+        net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                  net_params)
+    slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
+    weights = _pack_mlp_tensors(net_params, dims, b_block)
+    n_pop = int(weights[0].shape[0])
+    nkw = dict(kw, slo_mask=slo, mlp_dims=dims)
 
-        def finalize(out):
-            return _finalize(params, out, T)
+    def step(stream_block, state, j, seed):
+        check_block(stream_block)
+        return _fused_neural_block(
+            params, weights, stream_block, state, jnp.int32(seed),
+            jnp.int32(j), **nkw)
 
-    else:
+    def init_state(stream_rows, batch):
+        return init_block_state(params, cluster, "neural", stream_rows,
+                                batch, n_pop=n_pop)
+
+    def finalize(out):
+        s = jax.vmap(lambda o: _finalize(params, o, T))(out)
+        return jax.tree.map(lambda x: x[0], s) if was_single else s
+
+    return BlockSummaryFns(step, init_state, finalize, n_blocks, T_pad)
+
+
+def _plan_block_fns(params, cluster, *, T, block_T, b_block, t_chunk,
+                    interpret, stochastic, net_params=None,
+                    plan_packed=None, carbon=None) -> BlockSummaryFns:
+    """Plan-playback carried-state bundle (registered builder)."""
+    from ccka_tpu.policy.rule import neutral_action
+
+    n_blocks, T_pad, P, Z, kw = _block_statics(
+        params, cluster, T=T, block_T=block_T, t_chunk=t_chunk,
+        b_block=b_block, stochastic=stochastic, interpret=interpret)
+    check_block = _block_check(block_T)
+    if plan_packed is None:
+        base = neutral_action(cluster)
+        actions = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (T_pad,) + x.shape), base)
+        plan_packed = pack_plan(actions, T_pad)
+    pr = _plan_rows(P, Z)
+    if plan_packed.shape[0] != T_pad or plan_packed.shape[1] != pr:
         raise ValueError(
-            f"unknown packed mode {mode!r} — have "
-            f"{tuple(PACKED_MODE_WATCH_NAMES)}")
+            f"plan stream shape {tuple(plan_packed.shape)} does not "
+            f"match T_pad={T_pad} / plan_rows={pr} — pack with "
+            "pack_plan(actions, T_pad)")
+    plan_batched = plan_packed.ndim == 3
+    pkw = dict(kw, plan_batched=plan_batched)
+
+    def step(stream_block, state, j, seed):
+        check_block(stream_block)
+        return _fused_plan_block(
+            params, plan_packed, stream_block, state,
+            jnp.int32(seed), jnp.int32(j), **pkw)
+
+    def init_state(stream_rows, batch):
+        return init_block_state(params, cluster, "plan", stream_rows,
+                                batch)
+
+    def finalize(out):
+        return _finalize(params, out, T)
 
     return BlockSummaryFns(step, init_state, finalize, n_blocks, T_pad)
 
@@ -2226,98 +2266,148 @@ _fused_plan_block = watch_jit(
     _fused_plan_block, "megakernel.plan_packed_block", hot=True,
     warmup_compiles=12)
 
-# The four packed policy modes the device-time observatory sweeps
-# (`bench.py --perf-only`, `ccka perf`, `obs/occupancy.py`): mode name →
-# the fused packed entry's compile-watch name, so attribution rows and
-# dispatch counters join on one vocabulary. "rule" and "carbon" share a
-# fused entry (the carbon statics re-key the same program family) —
-# the observatory's per-mode attribution names disambiguate them.
-PACKED_MODE_WATCH_NAMES = {
-    "rule": "megakernel.packed_summary",
-    "carbon": "megakernel.packed_summary",
-    "neural": "megakernel.neural_packed_summary",
-    "plan": "megakernel.plan_packed_summary",
-}
+def _profile_packed_fn(mode, params, cluster, *, T, b_block, t_chunk,
+                       interpret, stochastic, net_params=None,
+                       plan_packed=None):
+    """rule/carbon sync packed closure (registered builder)."""
+    from ccka_tpu.policy.rule import offpeak_action, peak_action
+
+    kw = dict(stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+              interpret=interpret)
+    off, peak = offpeak_action(cluster), peak_action(cluster)
+    entry = (carbon_megakernel_summary_from_packed if mode == "carbon"
+             else megakernel_summary_from_packed)
+
+    def fn(stream, seed):
+        return entry(params, off, peak, stream, T, seed, **kw)
+    return fn
+
+
+def _neural_packed_fn(params, cluster, *, T, b_block, t_chunk,
+                      interpret, stochastic, net_params=None,
+                      plan_packed=None):
+    """Population-MLP sync packed closure (registered builder) — hoists
+    the wrapper's host-side prep (slo mask via numpy, population
+    detection) OUT of the closure so the whole thing stays traceable
+    under an outer jit."""
+    if net_params is None:
+        raise ValueError("packed_mode_summary_fn: mode 'neural' "
+                         "needs net_params")
+    from ccka_tpu.policy.constraints import slo_pool_mask
+
+    P, Z = cluster.n_pools, cluster.n_zones
+    dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
+    if was_single:
+        net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                  net_params)
+    slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
+    nkw = dict(T=T, P=P, Z=Z, K=int(params.provision_pipeline_k),
+               WD=int(params.wl_batch_deadline_ticks),
+               stochastic=stochastic, b_block=b_block,
+               t_chunk=t_chunk, slo_mask=slo, mlp_dims=dims,
+               interpret=interpret)
+
+    def fn(stream, seed):
+        s = _fused_neural_packed_summary(params, net_params, stream,
+                                         jnp.int32(seed), **nkw)
+        return (jax.tree.map(lambda x: x[0], s) if was_single
+                else s)
+    return fn
+
+
+def _plan_packed_fn(params, cluster, *, T, b_block, t_chunk, interpret,
+                    stochastic, net_params=None, plan_packed=None):
+    """Plan-playback sync packed closure (registered builder).
+    ``plan_packed=None`` plays the broadcast neutral plan (bench's
+    content-independent throughput convention); the distillation
+    factory passes its per-cluster packed plans instead."""
+    from ccka_tpu.policy.rule import neutral_action
+
+    kw = dict(stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
+              interpret=interpret)
+    if plan_packed is None:
+        T_pad = math.ceil(T / t_chunk) * t_chunk
+        base = neutral_action(cluster)
+        actions = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (T_pad,) + x.shape), base)
+        plan_packed = pack_plan(actions, T_pad)
+
+    def fn(stream, seed):
+        return plan_megakernel_summary_from_packed(
+            params, cluster, plan_packed, stream, T, seed, **kw)
+    return fn
 
 
 def packed_mode_summary_fn(params: SimParams, cluster, mode: str, *,
                            T: int, b_block: int = 512,
                            t_chunk: int = 64, interpret: bool = False,
-                           stochastic: bool = True, net_params=None):
+                           stochastic: bool = True, net_params=None,
+                           plan_packed=None):
     """One JITTED ``(stream, seed) -> EpisodeSummary`` closure per packed
     policy mode — the device-time observatory's unit of timing and XLA
     attribution (`obs/costmodel.attribute` lowers exactly this callable,
     `bench.py --perf-only` and `ccka perf` both drive it, so the program
-    the table names is the program the pipeline dispatches). All four
-    modes consume the SAME packed stream layout, making their occupancy
+    the table names is the program the pipeline dispatches). All modes
+    consume the SAME packed stream layout, making their occupancy
     ledgers directly comparable.
 
-    "rule"/"carbon" close over the profile actions; "plan" plays a
-    broadcast neutral-action plan (playback throughput is
-    content-independent — the stream layout is what's measured);
-    "neural" requires ``net_params`` and hoists the wrapper's host-side
-    prep (slo mask via numpy, population detection) OUT of the closure
-    so the whole thing stays traceable under an outer jit."""
-    from ccka_tpu.policy.rule import (neutral_action, offpeak_action,
-                                      peak_action)
-
-    kw = dict(stochastic=stochastic, b_block=b_block, t_chunk=t_chunk,
-              interpret=interpret)
-    if mode == "rule":
-        off, peak = offpeak_action(cluster), peak_action(cluster)
-
-        def fn(stream, seed):
-            return megakernel_summary_from_packed(
-                params, off, peak, stream, T, seed, **kw)
-    elif mode == "carbon":
-        off, peak = offpeak_action(cluster), peak_action(cluster)
-
-        def fn(stream, seed):
-            return carbon_megakernel_summary_from_packed(
-                params, off, peak, stream, T, seed, **kw)
-    elif mode == "neural":
-        if net_params is None:
-            raise ValueError("packed_mode_summary_fn: mode 'neural' "
-                             "needs net_params")
-        from ccka_tpu.policy.constraints import slo_pool_mask
-
-        P, Z = cluster.n_pools, cluster.n_zones
-        dims, was_single = _mlp_dims(net_params, P=P, Z=Z)
-        if was_single:
-            net_params = jax.tree.map(lambda x: jnp.asarray(x)[None],
-                                      net_params)
-        slo = tuple(float(x) for x in np.asarray(slo_pool_mask(cluster)))
-        nkw = dict(T=T, P=P, Z=Z, K=int(params.provision_pipeline_k),
-                   WD=int(params.wl_batch_deadline_ticks),
-                   stochastic=stochastic, b_block=b_block,
-                   t_chunk=t_chunk, slo_mask=slo, mlp_dims=dims,
-                   interpret=interpret)
-
-        def fn(stream, seed):
-            s = _fused_neural_packed_summary(params, net_params, stream,
-                                             jnp.int32(seed), **nkw)
-            return (jax.tree.map(lambda x: x[0], s) if was_single
-                    else s)
-    elif mode == "plan":
-        T_pad = math.ceil(T / t_chunk) * t_chunk
-        base = neutral_action(cluster)
-        actions = jax.tree.map(
-            lambda x: jnp.broadcast_to(x, (T_pad,) + x.shape), base)
-        plan2d = pack_plan(actions, T_pad)
-
-        def fn(stream, seed):
-            return plan_megakernel_summary_from_packed(
-                params, cluster, plan2d, stream, T, seed, **kw)
-    else:
-        raise ValueError(
-            f"unknown packed mode {mode!r} — have "
-            f"{tuple(PACKED_MODE_WATCH_NAMES)}")
+    Since ISSUE 14 a registry dispatcher (`sim/lanes.py` mode registry;
+    unknown names rejected with the registered vocabulary): "rule"/
+    "carbon" close over the profile actions; "plan" plays ``plan_packed``
+    (or a broadcast neutral-action plan when None — playback throughput
+    is content-independent); "neural" requires ``net_params``."""
+    builder = lanes.mode_engine(mode, "packed_summary")
+    fn = builder(params, cluster, T=T, b_block=b_block, t_chunk=t_chunk,
+                 interpret=interpret, stochastic=stochastic,
+                 net_params=net_params, plan_packed=plan_packed)
     # Watched under the MODE's name (shared_stats: one closure per
     # geometry, one hot path to the reader) so `ccka perf`'s program
     # table joins dispatch counters and cost attribution on one row —
     # the inner fused entries inline under this jit and count nothing.
     return watch_jit(jax.jit(fn), f"megakernel.mode.{mode}", hot=True,
                      warmup_compiles=4, shared_stats=True)
+
+
+# ---- mode registration (the `sim/lanes.py` registry — ISSUE 14) ----------
+#
+# The four built-in packed policy modes register HERE, once: their fused
+# sync entries and carried-state streaming bundles. The lax reference
+# engines arrive from `sim/rollout.py` and the mesh engines from
+# `parallel/sharded_kernel.py` (each module provides its slot at import
+# — `lanes.provide_mode_engine`), so a NEW policy mode is one
+# `register_mode` call plus its engine closures, not a five-site edit.
+# "rule" and "carbon" share a fused entry (the carbon statics re-key
+# the same program family) — the observatory's per-mode attribution
+# names disambiguate them.
+
+lanes.register_mode(
+    "rule", watch_name="megakernel.packed_summary",
+    packed_summary=functools.partial(_profile_packed_fn, "rule"),
+    block_summary=functools.partial(_profile_block_fns, "rule"))
+lanes.register_mode(
+    "carbon", watch_name="megakernel.packed_summary",
+    packed_summary=functools.partial(_profile_packed_fn, "carbon"),
+    block_summary=functools.partial(_profile_block_fns, "carbon"))
+lanes.register_mode(
+    "neural", watch_name="megakernel.neural_packed_summary",
+    packed_summary=_neural_packed_fn,
+    block_summary=_neural_block_fns)
+lanes.register_mode(
+    "plan", watch_name="megakernel.plan_packed_summary",
+    packed_summary=_plan_packed_fn,
+    block_summary=_plan_block_fns)
+
+
+def packed_mode_watch_names() -> dict:
+    """mode → compile-watch name, derived LIVE from the mode registry so
+    the observatory's vocabulary (`bench.py --perf-only`, `ccka perf`,
+    `obs/occupancy.py`) can never drift from the registered modes."""
+    return {m: mode.watch_name for m, mode in lanes.MODES.items()}
+
+
+# Import-time snapshot kept for the existing surface; prefer the
+# function (a mode registered later — e.g. by a test — appears there).
+PACKED_MODE_WATCH_NAMES = packed_mode_watch_names()
 
 
 def unpack_exo(exo_packed: jnp.ndarray, T: int, Z: int) -> ExogenousTrace:

@@ -28,16 +28,15 @@ ActionFn = Callable[[ClusterState, ExoStep, jnp.ndarray], Action]
 _UNROLL = 8
 
 
-def initial_state(cfg: FrameworkConfig) -> ClusterState:
-    """Fresh cluster: only the managed base nodegroup, nothing pending."""
-    p, z = cfg.cluster.n_pools, cfg.cluster.n_zones
-    c = 2
-    k = cfg.sim.provision_delay_steps
+def _fresh_state(p: int, z: int, k: int) -> ClusterState:
+    """The one fresh-cluster constructor `initial_state` and
+    `zero_state` share — a change to the start-state invariant must not
+    be able to diverge the config and registry-engine paths."""
     zero = jnp.float32(0.0)
     return ClusterState(
         nodes=jnp.zeros((p, z, N_CT), jnp.float32),
         pipeline=jnp.zeros((k, p, z, N_CT), jnp.float32),
-        running=jnp.zeros((c,), jnp.float32),
+        running=jnp.zeros((2,), jnp.float32),
         consol_timer_s=jnp.zeros((p,), jnp.float32),
         time_s=zero,
         acc_cost_usd=zero,
@@ -46,6 +45,12 @@ def initial_state(cfg: FrameworkConfig) -> ClusterState:
         acc_slo_ok_s=zero,
         acc_evictions=zero,
     )
+
+
+def initial_state(cfg: FrameworkConfig) -> ClusterState:
+    """Fresh cluster: only the managed base nodegroup, nothing pending."""
+    return _fresh_state(cfg.cluster.n_pools, cfg.cluster.n_zones,
+                        cfg.sim.provision_delay_steps)
 
 
 def exo_steps(trace: ExogenousTrace) -> ExoStep:
@@ -355,3 +360,160 @@ def batched_rollout(params: SimParams,
                                  stochastic=stochastic),
         in_axes=(0, 0, 0))
     return fn(states0, traces, keys)
+
+
+# ---- the unified LAX reference engine (ISSUE 14: the mode registry) -------
+#
+# One lax-path engine per registered packed policy mode, consuming the
+# SAME ``[T_pad, rows, B]`` packed stream the kernels consume: the lane
+# layout resolves through the `sim/lanes.py` registry, fault/workload
+# lane blocks unpack into the pytrees `rollout_summary` already
+# threads, and any further registered (passive) lane families ride the
+# stream untouched — so a new lane family reaches this engine with zero
+# edits here (the registry contract test pins it). This is the
+# reference implementation the kernel parity suite pins the megakernel
+# against, now reachable through the one mode vocabulary
+# (`lax_mode_summary`), and the distillation factory's "naive lax"
+# baseline engine.
+
+
+def zero_state(params: SimParams, cluster) -> ClusterState:
+    """`initial_state` from (params, cluster) — the registry engines
+    carry SimParams + ClusterConfig, not a full FrameworkConfig."""
+    return _fresh_state(cluster.n_pools, cluster.n_zones,
+                        int(params.provision_pipeline_k))
+
+
+def lax_summary_from_packed(params: SimParams, cluster, stream, T: int,
+                            key, *, action_fn=None, plan_latents=None,
+                            stochastic: bool = False):
+    """EpisodeSummary batch for a packed stream on the LAX path — the
+    shared body of every registered mode's ``lax_summary`` engine.
+
+    Exactly one of ``action_fn`` (a shared jittable decide) or
+    ``plan_latents`` (``[B, T, A]`` per-cluster latent plans, decoded
+    and executed tick-for-tick — the playback kernel's contract: a plan
+    observes nothing) must be given. Pays the unpack transposes the
+    packed pipeline exists to skip — this is the reference/labeling
+    engine, never the hot path.
+    """
+    from ccka_tpu.models import latent_to_action
+    from ccka_tpu.sim.megakernel import unpack_exo
+
+    if (action_fn is None) == (plan_latents is None):
+        raise ValueError("lax_summary_from_packed: pass exactly one of "
+                         "action_fn or plan_latents")
+    Z = cluster.n_zones
+    lay = lanes.resolve_layout(int(stream.shape[1]), Z)
+    traces = unpack_exo(stream, T, Z)
+    faults = None
+    workloads = None
+    if lay.has("faults"):
+        from ccka_tpu.faults.process import unpack_fault_lanes
+
+        faults = unpack_fault_lanes(stream, T, Z)
+    if lay.has("workloads"):
+        from ccka_tpu.workloads.process import unpack_workload_lanes
+
+        workloads = unpack_workload_lanes(stream, T, Z)
+    B = int(traces.is_peak.shape[0])
+    states0 = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (B,) + x.shape),
+        zero_state(params, cluster))
+    keys = jax.random.split(key, B)
+    if action_fn is not None:
+        return batched_rollout_summary(
+            params, states0, action_fn, traces, keys,
+            stochastic=stochastic, faults=faults, workloads=workloads)[1]
+
+    def one(s, tr, k, pl, f, w):
+        def plan_action(_state, _exo, t):
+            # Tick t of THIS cluster's plan — content-identical to
+            # `rollout_actions` (a plan observes nothing, so the
+            # faulted observation path is a no-op through it).
+            return latent_to_action(jnp.take(pl, t, axis=0), cluster)
+
+        return rollout_summary(params, s, plan_action, tr, k,
+                               stochastic=stochastic, faults=f,
+                               workloads=w)[1]
+
+    hf, hw = faults is not None, workloads is not None
+    fn = jax.vmap(one, in_axes=(0, 0, 0, 0, 0 if hf else None,
+                                0 if hw else None))
+    return fn(states0, traces, keys, plan_latents, faults, workloads)
+
+
+def lax_mode_summary(params: SimParams, cluster, mode: str, stream,
+                     T: int, key, *, stochastic: bool = False,
+                     net_params=None, plan_latents=None):
+    """Registry dispatcher: the lax reference engine of a registered
+    packed policy mode (`sim/lanes.py`; unknown modes rejected with the
+    registered vocabulary). ``net_params`` (mode "neural"): a SINGLE
+    ActorCritic pytree (no population axis — the lax reference scores
+    one policy). ``plan_latents`` (mode "plan"): ``[B, T, A]``."""
+    engine = lanes.mode_engine(mode, "lax_summary")
+    return engine(params, cluster, stream, T, key, stochastic=stochastic,
+                  net_params=net_params, plan_latents=plan_latents)
+
+
+def _rule_lax_summary(params, cluster, stream, T, key, *,
+                      stochastic=False, net_params=None,
+                      plan_latents=None):
+    from ccka_tpu.policy.rule import RulePolicy
+
+    return lax_summary_from_packed(
+        params, cluster, stream, T, key, stochastic=stochastic,
+        action_fn=RulePolicy(cluster).action_fn())
+
+
+def _carbon_lax_summary(params, cluster, stream, T, key, *,
+                        stochastic=False, net_params=None,
+                        plan_latents=None):
+    from ccka_tpu.policy.carbon import CarbonAwarePolicy
+
+    return lax_summary_from_packed(
+        params, cluster, stream, T, key, stochastic=stochastic,
+        action_fn=CarbonAwarePolicy(cluster).action_fn())
+
+
+def _neural_lax_summary(params, cluster, stream, T, key, *,
+                        stochastic=False, net_params=None,
+                        plan_latents=None):
+    if net_params is None:
+        raise ValueError("lax_mode_summary: mode 'neural' needs "
+                         "net_params (a single ActorCritic pytree)")
+    from ccka_tpu.models import ActorCritic, latent_dim, latent_to_action
+    from ccka_tpu.policy.base import observe
+
+    net = ActorCritic(act_dim=latent_dim(cluster))
+
+    def action_fn(state, exo, t):
+        # PPOBackend.decide's deterministic forward (train/ppo.py).
+        obs = observe(params, state, exo).flatten()
+        mean, _, _ = net.apply(net_params, obs)
+        return latent_to_action(mean, cluster)
+
+    return lax_summary_from_packed(
+        params, cluster, stream, T, key, stochastic=stochastic,
+        action_fn=action_fn)
+
+
+def _plan_lax_summary(params, cluster, stream, T, key, *,
+                      stochastic=False, net_params=None,
+                      plan_latents=None):
+    if plan_latents is None:
+        raise ValueError("lax_mode_summary: mode 'plan' needs "
+                         "plan_latents [B, T, A]")
+    return lax_summary_from_packed(
+        params, cluster, stream, T, key, stochastic=stochastic,
+        plan_latents=plan_latents)
+
+
+from ccka_tpu.sim import lanes  # noqa: E402
+
+for _m, _fn in (("rule", _rule_lax_summary),
+                ("carbon", _carbon_lax_summary),
+                ("neural", _neural_lax_summary),
+                ("plan", _plan_lax_summary)):
+    lanes.provide_mode_engine(_m, "lax_summary", _fn)
+del _m, _fn

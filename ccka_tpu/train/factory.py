@@ -1,0 +1,478 @@
+"""MPC-distillation data factory (ISSUE 14, ROADMAP item 2's cash-in).
+
+The round-16 streaming pipeline runs plan playback at kernel speed but
+sat idle between benches; the unified rollout-engine registry makes a
+plan one mode among equals. This module turns both into a label
+factory: mass-produce ``(state, optimized-plan)`` pairs across the
+scenario library × fault intensities, label them by replaying the plans
+through the double-buffered streaming pipeline, and emit a distillation
+dataset (`train/imitate.ImitationBatch`) the flagship's
+``init_from="distill:mpc-factory"`` consumes — the KIS-S-style
+simulator-in-the-training-loop move.
+
+One factory CELL (scenario × intensity) runs four stages:
+
+1. **Worlds**: the scenario's widened packed stream, generated block-
+   wise with the STREAMING key family (`packed_block_trace_device` per
+   block, concatenated) so the labeling pipeline later regenerates
+   bitwise the same worlds; the lax planner sees the clean exo view
+   (`unpack_exo` — plans are blind to fault/workload lanes, the
+   established scoreboard convention).
+2. **Plan** (the teacher): ``optimize_plan_batch`` fans the whole
+   cell's windows across the mesh — ONE dispatch plans every pair's
+   full window (teacher "mpc"); teacher "mpc-rh" runs the
+   receding-horizon quick planner instead (slower, closed-loop-shaped
+   plans).
+3. **Label at kernel speed**: the packed per-cluster plans replay
+   through `sim/streaming.streaming_rollout_summary` (mode "plan",
+   double-buffered) on the same (key, seed) — EpisodeSummary labels per
+   pair — with the rule kernel scored on the SAME stream as the paired
+   baseline column.
+4. **Collect**: one jitted batched scan executes the plans on
+   expectation dynamics against the true traces, recording
+   ``(observation, plan latent, discounted return)`` per tick — the
+   ImitationBatch rows `train/imitate.imitate(dataset=...)` trains on.
+
+The throughput claim this module carries (BENCH_r17): factory pairs/sec
+is measured against :func:`naive_lax_pair_rate` — the status-quo way to
+produce one labeled pair, a per-pair `receding_horizon_rollout` loop at
+the repo's standing MPC protocol (``cfg.train.mpc_horizon/mpc_iters``)
+— paired in the same record, ≥5× on the CPU-interpret host.
+
+Name validation is UP FRONT (the round-10 convention): unknown
+scenario/intensity/teacher names raise before any device work.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import lru_cache, partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccka_tpu.config import FAULT_PRESETS, FrameworkConfig
+from ccka_tpu.models import action_to_latent, latent_to_action
+from ccka_tpu.policy.base import observe
+from ccka_tpu.policy.rule import neutral_action
+from ccka_tpu.sim.rollout import exo_steps, zero_state
+from ccka_tpu.sim.types import SimParams
+from ccka_tpu.train.imitate import _TARGET_CLIP, ImitationBatch
+from ccka_tpu.train.objective import step_reward
+from ccka_tpu.train.ppo import _REWARD_SCALE
+
+# Teacher protocols. "mpc": ONE full-window `optimize_plan_batch` per
+# cell (the factory's quick-distill protocol — `iters` gradient steps
+# over the whole horizon, batched across pairs). "mpc-rh": the
+# receding-horizon quick planner (`receding_horizon_plan_batch`),
+# closed-loop-shaped plans at several times the planning cost. The
+# registry exists so `ccka distill-factory` and `bench.py` reject
+# unknown names up front with one vocabulary.
+FACTORY_TEACHERS = ("mpc", "mpc-rh")
+
+# Factory planning protocol defaults (the quick-distill operating
+# point BENCH_r17 records): one-shot full-window plans at lr ×10 —
+# enough iterations to shape zone/capacity choices without paying the
+# closed-loop tax the factory exists to remove. Plan quality vs the
+# closed-loop teacher is exactly what the student-vs-teacher scoreboard
+# column measures; raise `iters` to trade throughput for labels.
+FACTORY_ITERS = 12
+
+
+def resolve_b_block(pairs: int, b_block: int | None) -> int:
+    """Kernel lane width for a cell: ``None`` picks the widest
+    power-of-two divisor of ``pairs`` up to 64 (interpret-mode cost
+    scales with grid cells, not lanes — wider is faster); an explicit
+    value must divide ``pairs`` exactly."""
+    if b_block is None:
+        b = 1
+        while b * 2 <= min(64, pairs) and pairs % (b * 2) == 0:
+            b *= 2
+        return b
+    if pairs % b_block:
+        raise ValueError(f"pairs={pairs} must divide into "
+                         f"b_block={b_block} kernel lanes")
+    return b_block
+
+
+def validate_factory_names(*, scenarios, intensities,
+                           teacher: str) -> dict:
+    """Resolve + validate every name UP FRONT; returns the resolved
+    scenario map. A typo must not run a long sweep and emit a record
+    missing that cell (the round-10 unknown-name convention)."""
+    from ccka_tpu.workloads.scenarios import resolve_scenarios
+
+    resolved = resolve_scenarios(scenarios)
+    bad = [i for i in intensities if i != "off" and i not in FAULT_PRESETS]
+    if bad:
+        raise ValueError(f"unknown intensities {bad}; presets: "
+                         f"['off'] + {sorted(FAULT_PRESETS)}")
+    if not intensities:
+        raise ValueError("no intensities named; presets: "
+                         f"['off'] + {sorted(FAULT_PRESETS)}")
+    if teacher not in FACTORY_TEACHERS:
+        raise ValueError(f"unknown teacher {teacher!r}; teachers: "
+                         f"{sorted(FACTORY_TEACHERS)}")
+    return resolved
+
+
+@lru_cache(maxsize=64)
+def _cell_source(cfg: FrameworkConfig, scenario, intensity: str):
+    """The cell's widened-stream source: the scenario's workload mix
+    composed with the intensity axis (the factory sweeps intensity as
+    its own axis, so the scenario's own fault preset is NOT applied —
+    `intensity="off"` is the genuinely calm column). MEMOIZED on the
+    (frozen) configs: the source object carries the compiled
+    generation programs (`_device_fns`), so a fresh source per cell
+    would recompile block synthesis for every cell and a warmup sweep
+    could never warm the timed one."""
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+    faults = FAULT_PRESETS[intensity] if intensity != "off" else None
+    return SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                 cfg.signals, faults=faults,
+                                 workloads=scenario.workloads)
+
+
+def _cell_stream(source, *, steps: int, block_T: int, t_chunk: int,
+                 pairs: int, key):
+    """The cell's full packed stream, generated BLOCK-wise with the
+    streaming key family and concatenated — bitwise the blocks the
+    labeling pipeline regenerates from the same key (the
+    `unblocked_reference_summary` construction)."""
+    from ccka_tpu.sim import lanes
+
+    n_blocks, _T_pad = lanes.block_layout(steps, block_T, t_chunk)
+    blocks = [source.packed_block_trace_device(
+        block_T, key, pairs, j, t_chunk=t_chunk)
+        for j in range(n_blocks)]
+    return jnp.concatenate(blocks, axis=0)
+
+
+class FactoryCell(NamedTuple):
+    scenario: str
+    intensity: str
+    dataset: ImitationBatch
+    plan_latents: jnp.ndarray      # [N, T, A]
+    teacher_summary: object        # EpisodeSummary fields [N]
+    rule_summary: object
+    report: dict
+
+
+@partial(jax.jit, static_argnames=("cluster", "tcfg"))
+def _collect_run(params, cluster, tcfg, states, xs, lat_t):
+    """The jitted collection scan — MODULE-level (static cluster/tcfg)
+    so every factory cell of one sweep shares a single compile."""
+    from ccka_tpu.sim.dynamics import step as sim_step
+
+    def body(st, inp):
+        exo_t, lat = inp
+        obs = jax.vmap(
+            lambda s, e: observe(params, s, e).flatten())(st, exo_t)
+        acts = jax.vmap(
+            lambda u: latent_to_action(u, cluster))(lat)
+        keys = jax.random.split(jax.random.key(0), obs.shape[0])
+        st, metrics = jax.vmap(
+            lambda s, a, e, k: sim_step(params, s, a, e, k,
+                                        stochastic=False)
+        )(st, acts, exo_t, keys)
+        r = step_reward(metrics, tcfg) * _REWARD_SCALE
+        return st, (obs, r)
+
+    _, (obs_t, rew_t) = jax.lax.scan(body, states, (xs, lat_t))
+
+    def disc(carry, r):
+        g = r + tcfg.gamma * carry
+        return g, g
+
+    _, ret_rev = jax.lax.scan(disc, jnp.zeros_like(rew_t[0]),
+                              rew_t[::-1])
+    return obs_t, ret_rev[::-1]
+
+
+def _collect_plan_pairs(params: SimParams, cluster, tcfg, states0,
+                        traces, plan_latents):
+    """Stage 4: one jitted batched scan executing the plans on
+    expectation dynamics, recording (obs, latent, discounted return)
+    per (pair, tick) — flattened ImitationBatch rows. Mirrors
+    `imitate.collect_dataset`'s record format so `imitate(dataset=...)`
+    consumes factory and teacher-rollout datasets interchangeably."""
+    xs = jax.tree.map(lambda x: jnp.moveaxis(x, 1, 0),
+                      exo_steps(traces))          # [T, B, ...]
+    lat_t = jnp.moveaxis(plan_latents, 1, 0)      # [T, B, A]
+    obs_t, returns = _collect_run(params, cluster, tcfg, states0, xs,
+                                  lat_t)
+    flat = lambda x: x.reshape((-1,) + x.shape[2:])  # noqa: E731
+    return ImitationBatch(
+        obs=flat(obs_t),
+        target=jnp.clip(flat(lat_t), -_TARGET_CLIP, _TARGET_CLIP),
+        returns=flat(returns))
+
+
+def produce_cell(cfg: FrameworkConfig, scenario, intensity: str, *,
+                 teacher: str = "mpc", pairs: int = 64, steps: int = 96,
+                 block_T: int = 48, t_chunk: int = 48,
+                 b_block: int | None = None,
+                 iters: int = FACTORY_ITERS, seed: int = 0, mesh=None,
+                 interpret: bool | None = None,
+                 with_ledger: bool = False) -> FactoryCell:
+    """One factory cell end to end (module docstring stages 1–4).
+    Returns the cell's dataset + paired summaries + throughput report.
+    ``interpret=None`` auto-selects interpret mode off-TPU (the CPU
+    lane); deterministic kernels there, stochastic Mosaic on TPU."""
+    from ccka_tpu.sim import streaming
+    from ccka_tpu.sim.megakernel import pack_plan, unpack_exo
+    from ccka_tpu.train.mpc import (optimize_plan_batch,
+                                    receding_horizon_plan_batch)
+
+    if teacher not in FACTORY_TEACHERS:
+        raise ValueError(f"unknown teacher {teacher!r}; teachers: "
+                         f"{sorted(FACTORY_TEACHERS)}")
+    b_block = resolve_b_block(pairs, b_block)
+    virtual = jax.devices()[0].platform != "tpu"
+    if interpret is None:
+        interpret = virtual
+    params = SimParams.from_config(cfg)
+    cluster = cfg.cluster
+    tcfg = cfg.train
+    Z = cluster.n_zones
+    src = _cell_source(cfg, scenario, intensity)
+    key = jax.random.key(seed)
+
+    # 1. Worlds (streaming key family) + the planner's clean exo view.
+    t0 = time.perf_counter()
+    full = _cell_stream(src, steps=steps, block_T=block_T,
+                        t_chunk=t_chunk, pairs=pairs, key=key)
+    traces = unpack_exo(full, steps, Z)
+    jax.block_until_ready(traces.is_peak)
+    gen_s = time.perf_counter() - t0
+
+    # 2. Plan: the whole cell in one dispatch (mesh-fanned when given).
+    base = jnp.zeros_like(action_to_latent(neutral_action(cluster),
+                                           cluster))
+    states0 = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (pairs,) + x.shape),
+        zero_state(params, cluster))
+    t0 = time.perf_counter()
+    if teacher == "mpc":
+        lat0 = jnp.broadcast_to(base, (pairs, steps) + base.shape)
+        plans = optimize_plan_batch(params, cluster, tcfg, states0,
+                                    traces, lat0, iters=iters,
+                                    mesh=mesh).plan_latent
+    else:                                     # "mpc-rh"
+        horizon = min(int(tcfg.mpc_horizon), steps)
+        lat0 = jnp.broadcast_to(base, (pairs, horizon) + base.shape)
+        plans = receding_horizon_plan_batch(
+            params, cluster, tcfg, states0, traces, lat0,
+            horizon=horizon, replan_every=8,
+            iters=max(2, iters // 4), mesh=mesh)
+    jax.block_until_ready(plans)
+    plan_s = time.perf_counter() - t0
+
+    # 3. Label at kernel speed: per-cluster plans through the
+    # double-buffered streaming pipeline; the rule kernel on the SAME
+    # (key, seed) stream is the paired baseline column.
+    plan_actions = jax.vmap(jax.vmap(
+        lambda u: latent_to_action(u, cluster)))(plans)
+    T_pad = full.shape[0]
+    plan_packed = pack_plan(plan_actions, T_pad)
+    skw = dict(key=key, batch=pairs, T=steps, block_T=block_T,
+               seed=seed, b_block=b_block, t_chunk=t_chunk,
+               interpret=interpret, stochastic=not interpret, mesh=mesh)
+    t0 = time.perf_counter()
+    teacher_summary, rep_play = streaming.streaming_rollout_summary(
+        src, params, cluster, "plan", plan_packed=plan_packed,
+        pipelined=True, label="factory.play", **skw)
+    label_s = time.perf_counter() - t0
+    rule_summary, _rep_rule = streaming.streaming_rollout_summary(
+        src, params, cluster, "rule", pipelined=True,
+        label="factory.rule", **skw)
+    ledger = None
+    if with_ledger:
+        _s, rep_sync = streaming.streaming_rollout_summary(
+            src, params, cluster, "plan", plan_packed=plan_packed,
+            pipelined=False, label="factory.play.sync", **skw)
+        ledger = rep_sync.get("occupancy")
+
+    # 4. Collect the distillation rows.
+    t0 = time.perf_counter()
+    dataset = _collect_plan_pairs(params, cluster, tcfg, states0,
+                                  traces, plans)
+    jax.block_until_ready(dataset.obs)
+    collect_s = time.perf_counter() - t0
+
+    days = steps * cfg.sim.dt_s / 86400.0
+    wall = gen_s + plan_s + label_s + collect_s
+    report = {
+        "scenario": scenario.name, "intensity": intensity,
+        "teacher": teacher, "seed": seed, "pairs": pairs, "steps": steps,
+        "block_T": block_T, "t_chunk": t_chunk, "b_block": b_block,
+        "iters": iters, "interpret": bool(interpret),
+        "gen_s": round(gen_s, 4), "plan_s": round(plan_s, 4),
+        "label_s": round(label_s, 4), "collect_s": round(collect_s, 4),
+        "wall_s": round(wall, 4),
+        "pairs_per_sec": round(pairs / wall, 4) if wall else None,
+        "plans_per_sec": round(pairs / plan_s, 4) if plan_s else None,
+        "playback_cluster_days_per_sec": (
+            round(pairs * days / rep_play["wall_s"], 2)
+            if rep_play.get("wall_s") else None),
+        "playback": {k: rep_play[k] for k in
+                     ("wall_s", "n_blocks", "pipeline")
+                     if k in rep_play},
+        "dataset_rows": int(dataset.obs.shape[0]),
+    }
+    if ledger is not None:
+        report["playback_occupancy"] = ledger
+    return FactoryCell(scenario.name, intensity, dataset, plans,
+                       teacher_summary, rule_summary, report)
+
+
+def _paired_usd_ratio(a_summary, b_summary) -> float:
+    """Mean paired $/SLO-hr ratio a/b over the cell's shared worlds."""
+    a = np.asarray(a_summary.usd_per_slo_hour, np.float64).ravel()
+    b = np.asarray(b_summary.usd_per_slo_hour, np.float64).ravel()
+    return float(a.mean() / max(b.mean(), 1e-9))
+
+
+def factory_run(cfg: FrameworkConfig, *, scenarios, intensities,
+                teacher: str = "mpc", pairs_per_cell: int = 64,
+                steps: int = 96, block_T: int = 48, t_chunk: int = 48,
+                b_block: int | None = None, iters: int = FACTORY_ITERS,
+                seed: int = 0, mesh=None,
+                with_ledger: bool = False,
+                return_cells: bool = False):
+    """The full factory sweep: every (scenario × intensity) cell through
+    :func:`produce_cell`, datasets concatenated, per-cell throughput +
+    paired teacher-vs-rule columns in the report. Name validation is
+    up front — nothing runs on a typo. Returns ``(dataset, report)``;
+    ``return_cells=True`` appends the raw :class:`FactoryCell` list
+    (bench's student-vs-teacher scoreboard re-scores the cells' shared
+    worlds)."""
+    resolved = validate_factory_names(scenarios=scenarios,
+                                      intensities=intensities,
+                                      teacher=teacher)
+    cells = []
+    raw_cells = []
+    datasets = []
+    for ci, (name, scenario) in enumerate(resolved.items()):
+        for ii, intensity in enumerate(intensities):
+            cell = produce_cell(
+                cfg, scenario, intensity, teacher=teacher,
+                pairs=pairs_per_cell, steps=steps, block_T=block_T,
+                t_chunk=t_chunk, b_block=b_block, iters=iters,
+                seed=cell_seed(seed, ci, ii), mesh=mesh,
+                with_ledger=with_ledger and not cells)
+            row = dict(cell.report)
+            row["teacher_vs_rule_usd_per_slo_hour"] = round(
+                _paired_usd_ratio(cell.teacher_summary,
+                                  cell.rule_summary), 4)
+            cells.append(row)
+            raw_cells.append(cell)
+            datasets.append(cell.dataset)
+    dataset = ImitationBatch(*(jnp.concatenate(parts, axis=0)
+                               for parts in zip(*datasets)))
+    total_pairs = pairs_per_cell * len(cells)
+    total_wall = sum(c["wall_s"] for c in cells)
+    report = {
+        "engine": "train/factory.py: batched full-window lax planning "
+                  "-> double-buffered streaming plan playback -> "
+                  "batched expectation-dynamics pair collection",
+        "teacher": teacher, "cells": cells,
+        "pairs_total": total_pairs,
+        "dataset_rows": int(dataset.obs.shape[0]),
+        "wall_s": round(total_wall, 4),
+        "pairs_per_sec": (round(total_pairs / total_wall, 4)
+                          if total_wall else None),
+        "plans_per_sec": (round(
+            total_pairs / max(sum(c["plan_s"] for c in cells), 1e-9), 4)),
+    }
+    if return_cells:
+        return dataset, report, raw_cells
+    return dataset, report
+
+
+def cell_seed(seed: int, scenario_index: int, intensity_index: int) -> int:
+    """The per-cell world seed `factory_run` uses — exported so a
+    caller re-scoring a cell's shared worlds (bench's student column)
+    regenerates exactly the streams the cell labeled."""
+    return seed + 1000 * scenario_index + 100 * intensity_index
+
+
+def naive_lax_pair_rate(cfg: FrameworkConfig, scenario, intensity: str,
+                        *, pairs: int = 3, steps: int = 96,
+                        block_T: int = 48, t_chunk: int = 48,
+                        seed: int = 0) -> dict:
+    """The PAIRED baseline the ≥5× factory claim is measured against:
+    the status-quo way to produce one labeled (state, plan) pair — a
+    per-pair ``receding_horizon_rollout`` loop (closed-loop MPC at the
+    repo's standing protocol, ``cfg.train.mpc_horizon``/``mpc_iters``,
+    expectation dynamics) over the SAME trace family the factory plans
+    on, one pair at a time, fenced per pair. The first pair's compile
+    is excluded (both sides are timed warm)."""
+    from ccka_tpu.sim.megakernel import unpack_exo
+    from ccka_tpu.train.mpc import receding_horizon_rollout
+
+    params = SimParams.from_config(cfg)
+    cluster = cfg.cluster
+    tcfg = cfg.train
+    src = _cell_source(cfg, scenario, intensity)
+    key = jax.random.key(seed)
+    full = _cell_stream(src, steps=steps, block_T=block_T,
+                        t_chunk=t_chunk, pairs=max(pairs, 1), key=key)
+    traces = unpack_exo(full, steps, cluster.n_zones)
+    horizon = min(int(tcfg.mpc_horizon), steps)
+    base = jnp.zeros_like(action_to_latent(neutral_action(cluster),
+                                           cluster))
+    lat0 = jnp.broadcast_to(base, (horizon,) + base.shape)
+    state0 = zero_state(params, cluster)
+
+    def one(i):
+        tr = jax.tree.map(lambda x: x[i], traces)
+        final, metrics = receding_horizon_rollout(
+            params, cluster, tcfg, state0, tr, lat0,
+            jax.random.key(seed + i), horizon=horizon, replan_every=8,
+            iters=int(tcfg.mpc_iters), stochastic=False)
+        jax.block_until_ready(metrics.cost_usd)
+
+    one(0)   # warm the compile — the loop is timed warm
+    t0 = time.perf_counter()
+    for i in range(pairs):
+        one(i)
+    wall = time.perf_counter() - t0
+    return {
+        "engine": "naive per-pair lax receding_horizon_rollout loop "
+                  "(closed-loop MPC at cfg.train.mpc_horizon/mpc_iters, "
+                  "one pair per dispatch, fenced per pair)",
+        "pairs": pairs, "steps": steps,
+        "mpc_horizon": horizon, "mpc_iters": int(tcfg.mpc_iters),
+        "wall_s": round(wall, 4),
+        "pairs_per_sec": round(pairs / wall, 4) if wall else None,
+    }
+
+
+def distill_from_factory(cfg: FrameworkConfig, *, scenarios=None,
+                         intensities=("off", "moderate"),
+                         teacher: str = "mpc",
+                         pairs_per_cell: int = 64, steps: int = 96,
+                         iterations: int = 1000, seed: int = 0,
+                         **factory_kw):
+    """Factory sweep → `imitate(dataset=...)` → (net_params, history,
+    report): the ``init_from="distill:mpc-factory"`` path
+    (`train/flagship.py`). Defaults sweep two calm-vs-faulted columns
+    of the two headline scenarios — DAgger-style coverage of the state
+    space the flagship will actually be asked to control."""
+    from ccka_tpu.train.imitate import imitate
+
+    if scenarios is None:
+        scenarios = ("diurnal-inference", "batch-backfill")
+    dataset, report = factory_run(
+        cfg, scenarios=scenarios, intensities=intensities,
+        teacher=teacher, pairs_per_cell=pairs_per_cell, steps=steps,
+        seed=seed, **factory_kw)
+    params, history = imitate(cfg, None, None, dataset=dataset,
+                              iterations=iterations, seed=seed)
+    report = dict(report, distill_iterations=iterations,
+                  final_actor_mse=history[-1]["actor_mse"])
+    return params, history, report

@@ -136,7 +136,34 @@ def train_flagship(cfg: FrameworkConfig | None = None, *,
             f"attain={rule_res['slo_attainment']:.4f}")
 
     teacher_res = None
-    if init_from.startswith("distill:"):
+    if init_from == "distill:mpc-factory":
+        # The MPC-distillation data factory (train/factory.py, ISSUE
+        # 14): (state, optimized-plan) pairs mass-produced across the
+        # scenario library x fault intensities and labeled through the
+        # streaming plan-playback kernel — DAgger-style coverage no
+        # single-teacher rollout gives. No PolicyBackend teacher exists
+        # to evaluate on the selection traces (the teacher IS the
+        # batch planner), so the teacher bar stays None and candidates
+        # compete on the rule bar alone.
+        from ccka_tpu.train.factory import distill_from_factory
+        rl.note("distilling the MPC factory dataset into the policy "
+                "net...")
+        params0, hist, fac_report = distill_from_factory(
+            cfg, seed=seed, iterations=distill_iterations)
+        rl.event("distill", _echo=(
+            f"factory-distilled: actor_mse {hist[-1]['actor_mse']:.4f} "
+            f"critic_mse {hist[-1]['critic_mse']:.4f} "
+            f"({fac_report['pairs_total']} pairs, "
+            f"{fac_report['dataset_rows']} rows)"),
+            teacher="mpc-factory", iterations=distill_iterations,
+            pairs=fac_report["pairs_total"],
+            actor_mse=float(hist[-1]["actor_mse"]),
+            critic_mse=float(hist[-1]["critic_mse"]))
+        if cfg.train.anchor_coef > 0:
+            trainer = PPOTrainer(cfg, anchor_params=params0)
+        ts = trainer.init_state(seed)._replace(
+            params=params0, opt_state=trainer.opt.init(params0))
+    elif init_from.startswith("distill:"):
         from ccka_tpu.train.imitate import build_teacher, distill_teacher
         teacher = init_from.split(":", 1)[1]
         # Resolve the teacher BEFORE the expensive distillation so an
@@ -425,7 +452,9 @@ def main(argv=None) -> int:
     ap.add_argument("--preset", default="default", choices=sorted(PRESETS))
     ap.add_argument("--init-from", default="scratch",
                     help='"scratch" or "distill:<teacher>" '
-                         '(carbon | rule)')
+                         '(carbon | rule | mpc-factory — the last runs '
+                         "the train/factory.py data factory and "
+                         "distills its (state, optimized-plan) pairs)")
     ap.add_argument("--refine", default="ppo", choices=("ppo", "cem"),
                     help="refinement loop: PPO surrogate or CEM episodic "
                          "direct search (train/cem.py; needs a distilled "

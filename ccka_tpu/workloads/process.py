@@ -50,8 +50,9 @@ _DAY_S = 86400.0
 # Key-domain tag separating the workload latents from the exo noise AND
 # the fault latents (FAULT_KEY_TAG = 0xFA117): folded into the same
 # generation key, so widening a stream with workload lanes changes
-# neither the exo rows nor the fault rows bitwise.
-WORKLOAD_KEY_TAG = 0x301AD
+# neither the exo rows nor the fault rows bitwise. Canonical value
+# lives in the lane-family registry (`sim/lanes.py` — ISSUE 14).
+WORKLOAD_KEY_TAG = lanes.LANE_FAMILIES["workloads"].key_tag
 
 
 # The layout arithmetic lives in the neutral `sim/lanes.py` (the one
@@ -169,3 +170,18 @@ def sample_workload_steps(wl: WorkloadsConfig, key, steps: int, Z: int,
         batch_arrivals=lanes[:steps, 1, 0],
         bg_arrivals=lanes[:steps, 2, 0],
     )
+
+
+def _registry_generate(cfg: WorkloadsConfig, key, steps: int, t_pad: int,
+                       z: int, batch: int, *, ctx: dict):
+    """Lane-family registry adapter (`sim/lanes.provide_lane_generator`)
+    — :func:`packed_workload_lanes` on the stream key with the clock
+    context the backends carry (bitwise the direct call)."""
+    return packed_workload_lanes(
+        cfg, key, steps, t_pad, z, batch, dt_s=ctx["dt_s"],
+        start_unix_s=ctx.get("start_unix_s", 0.0),
+        start_offset_s=ctx.get("start_offset_s"),
+        wrap_period_s=ctx.get("wrap_period_s"))
+
+
+lanes.provide_lane_generator("workloads", _registry_generate)

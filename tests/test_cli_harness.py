@@ -59,6 +59,10 @@ def test_cli_simulate_small(capsys):
     assert 0.0 <= doc["slo_attainment"] <= 1.0
 
 
+@pytest.mark.slow  # ISSUE 14 lane-time rule (~14s): a composition of
+# independently fast-pinned pieces — mesh fan-out in test_parallel,
+# device traces in test_signals, the simulate CLI by its non-mesh
+# siblings in this file.
 def test_cli_simulate_fleet_mesh_device_traces(capsys):
     """BASELINE config #5 path: batch sharded over the 8-device mesh with
     device-synthesized traces. 16 clusters / 8 devices = 2 per shard."""

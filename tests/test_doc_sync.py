@@ -704,3 +704,90 @@ class TestStreamingClaims:
                        "overlap_capable", "r15_replication",
                        "sharded_block_packed_trace"):
             assert phrase in arch, phrase
+
+
+class TestFactoryClaims:
+    """Round 17's distillation factory (ISSUE 14 docs satellite):
+    README's "Distillation factory" section is PARSED against the
+    BASELINE round17 record — the pairs/sec headline, the paired
+    naive-loop ratio, and the student-vs-teacher column are all
+    record-derived, never hand-synced."""
+
+    def test_round17_record_is_self_describing(self, baseline):
+        r17 = baseline["published"]["round17"]["factory_stage"]
+        assert r17["stage"] == "--factory-only"
+        # A CPU record must say so (interpret-mode, labeled).
+        assert r17["virtual"] is True and r17["platform"] == "cpu"
+        assert r17["interpret"] is True
+        # The paired-throughput acceptance gate (>= 5x) with the ratio
+        # recomputable from the record's own sides.
+        assert r17["throughput_ratio_vs_baseline"] \
+            >= r17["gate_min_ratio"] == 5.0
+        recomputed = (r17["pairs_per_sec"]
+                      / r17["baseline"]["pairs_per_sec"])
+        assert abs(recomputed - r17["throughput_ratio_vs_baseline"]) \
+            < 0.01
+        assert "receding_horizon_rollout" in r17["baseline"]["engine"]
+        # Every cell carries its throughput and its paired column; the
+        # first cell carries the occupancy ledger.
+        assert len(r17["cells"]) >= 4
+        for cell in r17["cells"]:
+            assert cell["pairs_per_sec"] > 0
+            assert cell["plans_per_sec"] > 0
+            assert cell["playback_cluster_days_per_sec"] > 0
+            assert cell["teacher_vs_rule_usd_per_slo_hour"] > 0
+        assert any("playback_occupancy" in c for c in r17["cells"])
+        assert r17["playback_roofline_floor_s"] > 0
+        # The student column: present, plausible, per-cell paired.
+        st = r17["student"]
+        assert 0 < st["student_vs_teacher_usd_per_slo_hour"] < 100
+        assert len(st["per_cell"]) == len(r17["cells"])
+        for row in st["per_cell"]:
+            assert row["student_vs_teacher_usd_per_slo_hour"] > 0
+            # The distilled student beats the rule baseline per cell
+            # (the claim README states as "in every cell").
+            assert row["student_vs_rule_usd_per_slo_hour"] < 1.0
+
+    def test_readme_factory_headline(self, readme, baseline):
+        r17 = baseline["published"]["round17"]["factory_stage"]
+        m = re.search(
+            r"\*\*([\d.,]+)\s*pairs/sec\*\*\s+\((\d+)\s+pairs\s+across"
+            r"\s+(\d+)\s+scenario×intensity\s+cells.*?\*\*([\d.]+)×\*\*"
+            r"\s+the\s+naive\s+per-pair\s+lax\s+receding-horizon\s+loop"
+            r"\s+\(([\d.]+)\s*pairs/sec", readme, re.S)
+        assert m, ("README's factory headline lost its pinned form "
+                   "(pairs/sec + paired naive ratio must stay "
+                   "together, labeled)")
+        pps, pairs, n_cells, ratio, naive = m.groups()
+        assert abs(float(pps.replace(",", ""))
+                   - r17["pairs_per_sec"]) < 0.05
+        assert int(pairs) == r17["pairs_total"]
+        assert int(n_cells) == len(r17["cells"])
+        assert abs(float(ratio)
+                   - r17["throughput_ratio_vs_baseline"]) < 5e-3
+        assert abs(float(naive)
+                   - r17["baseline"]["pairs_per_sec"]) < 0.05
+        m2 = re.search(r"([\d.]+)\s+plans/sec", readme)
+        assert m2, "README lost the plans/sec claim"
+        assert abs(float(m2.group(1)) - r17["plans_per_sec"]) < 0.05
+
+    def test_readme_student_claim(self, readme, baseline):
+        r17 = baseline["published"]["round17"]["factory_stage"]
+        st = r17["student"]
+        m = re.search(r"student\s+×([\d.]+)\s+\$/SLO-hr\s+vs\s+the\s+"
+                      r"teacher", readme)
+        assert m, "README's student-vs-teacher claim lost its form"
+        assert abs(float(m.group(1))
+                   - st["student_vs_teacher_usd_per_slo_hour"]) < 5e-3
+        m2 = re.search(r"×([\d.]+)\s+vs\s+the\s+rule\s+baseline\s+on\s+"
+                       r"average", readme)
+        assert m2, "README's student-vs-rule claim lost its form"
+        mean_rule = sum(r["student_vs_rule_usd_per_slo_hour"]
+                        for r in st["per_cell"]) / len(st["per_cell"])
+        assert abs(float(m2.group(1)) - mean_rule) < 5e-3
+
+    def test_readme_dataset_rows(self, readme, baseline):
+        r17 = baseline["published"]["round17"]["factory_stage"]
+        m = re.search(r"([\d,]+)-row\s+dataset", readme)
+        assert m, "README lost the dataset-size claim"
+        assert int(m.group(1).replace(",", "")) == r17["dataset_rows"]

@@ -1,0 +1,314 @@
+"""Rollout-engine registry contract (ISSUE 14 tentpole + satellite).
+
+The claim under test: adding a lane family THROUGH THE REGISTRY ALONE
+(`sim/lanes.register_lane_family` + `provide_lane_generator`) reaches
+every engine with ZERO per-engine edits — the synthetic source
+synthesizes the widened stream, layout resolution accepts it, and the
+lax reference engine, all four megakernel modes, the streaming drive
+and the 8-shard sharded wrapper all consume it BITWISE identically to
+the un-widened stream (a passive lane rides the stream; no engine
+consumes it in-kernel), while the lane block itself is bitwise the
+hand-threaded reference generation. Plus the mode registry's hygiene:
+unknown names rejected with the registered vocabulary, duplicate
+registrations rejected, ambiguous row arithmetic rejected, engines
+provided before their mode registers attach when it does.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import default_config
+from ccka_tpu.sim import SimParams, lanes
+from ccka_tpu.sim import streaming as streaming_mod
+from ccka_tpu.sim.megakernel import packed_mode_summary_fn
+from ccka_tpu.sim.rollout import lax_mode_summary
+from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+# One shared small geometry (interpret-mode kernels; one compile per
+# mode per stream layout).
+B, T, T_CHUNK, B_BLOCK = 32, 16, 8, 8
+
+_TEST_TAG = 0x7E571
+_TEST_NAME = "testlane"
+
+
+def _test_rows(Z: int) -> int:
+    # 2*fault_rows + 16 keeps every subset sum distinct for any Z the
+    # registration ambiguity check sweeps.
+    return 2 * lanes.fault_rows(Z) + 16
+
+
+def _test_generate(cfg, key, steps, t_pad, z, batch, *, ctx):
+    """Deterministic lane content keyed off the family tag — the
+    hand-threaded reference the registry-driven synthesis must match
+    bitwise. ``cfg`` is the family config (a float scale here)."""
+    k = jax.random.fold_in(key, _TEST_TAG)
+    block = cfg * jax.random.uniform(k, (steps, _test_rows(z), batch))
+    return jnp.pad(block, ((0, t_pad - steps), (0, 0), (0, 0)))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config()
+
+
+@pytest.fixture(scope="module")
+def testlane():
+    """Register the test-only family for the module; leave the
+    process-global registry exactly as found."""
+    fam = lanes.register_lane_family(_TEST_NAME, rows=_test_rows,
+                                     key_tag=_TEST_TAG)
+    lanes.provide_lane_generator(_TEST_NAME, _test_generate)
+    yield fam
+    lanes.unregister_lane_family(_TEST_NAME)
+
+
+@pytest.fixture(scope="module")
+def sources(cfg, testlane):
+    """(plain, widened) sources sharing every config except the extra
+    registered lane family."""
+    plain = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                  cfg.signals)
+    widened = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals,
+                                    extra_lanes={_TEST_NAME: 0.5})
+    return plain, widened
+
+
+@pytest.fixture(scope="module")
+def streams(sources):
+    key = jax.random.key(11)
+    plain, widened = sources
+    return (plain.packed_trace_device(T, key, B, t_chunk=T_CHUNK),
+            widened.packed_trace_device(T, key, B, t_chunk=T_CHUNK))
+
+
+@pytest.fixture(scope="module")
+def net_params(cfg):
+    from ccka_tpu.models import ActorCritic, latent_dim
+    from ccka_tpu.sim.megakernel import _obs_dim
+
+    net = ActorCritic(act_dim=latent_dim(cfg.cluster))
+    return net.init(jax.random.key(5), jnp.zeros(
+        (_obs_dim(cfg.cluster.n_pools, cfg.cluster.n_zones),)))
+
+
+def _fields_equal(a, b):
+    return {f for f in a._fields
+            if not np.array_equal(np.asarray(getattr(a, f)),
+                                  np.asarray(getattr(b, f)))}
+
+
+class TestLaneFamilyRegistry:
+    def test_builtin_families_registered_in_order(self):
+        names = [f.name for f in lanes.lane_families()]
+        assert names[:2] == ["faults", "workloads"]
+        from ccka_tpu.faults.process import FAULT_KEY_TAG
+        from ccka_tpu.workloads.process import WORKLOAD_KEY_TAG
+
+        assert lanes.LANE_FAMILIES["faults"].key_tag == FAULT_KEY_TAG
+        assert lanes.LANE_FAMILIES["workloads"].key_tag \
+            == WORKLOAD_KEY_TAG
+
+    def test_duplicate_name_and_tag_rejected(self):
+        with pytest.raises(ValueError, match="already registered"):
+            lanes.register_lane_family("faults", rows=lanes.fault_rows,
+                                       key_tag=0x123)
+        with pytest.raises(ValueError, match="key tag"):
+            lanes.register_lane_family("dup-tag", rows=lambda z: 64,
+                                       key_tag=0xFA117)
+        assert "dup-tag" not in lanes.LANE_FAMILIES
+
+    def test_ambiguous_rows_rejected_and_registry_unchanged(self):
+        before = tuple(lanes.LANE_FAMILIES)
+        # Same rows as the fault block: {new} and {faults} would both
+        # resolve the same widened count.
+        with pytest.raises(ValueError, match="ambiguous"):
+            lanes.register_lane_family("clash", rows=lanes.fault_rows,
+                                       key_tag=0x999)
+        assert tuple(lanes.LANE_FAMILIES) == before
+
+    def test_unknown_rows_rejected(self):
+        with pytest.raises(ValueError, match="rows"):
+            lanes.resolve_layout(lanes.exo_rows(3) + 1, 3)
+
+    def test_unknown_family_generator_rejected(self):
+        with pytest.raises(ValueError, match="unknown lane family"):
+            lanes.lane_generator("never-registered")
+
+    def test_refilling_a_generator_rejected(self, testlane):
+        """Two modules silently fighting over one family's generator is
+        a bug (the provide_mode_engine rule); re-providing the SAME
+        closure (an idempotent re-import) stays legal."""
+        lanes.provide_lane_generator(_TEST_NAME, _test_generate)
+        with pytest.raises(ValueError, match="already has a generator"):
+            lanes.provide_lane_generator(_TEST_NAME, lambda *a, **k: None)
+
+
+class TestModeRegistry:
+    def test_unknown_mode_lists_vocabulary(self, cfg):
+        params = SimParams.from_config(cfg)
+        with pytest.raises(ValueError, match="unknown packed mode"):
+            packed_mode_summary_fn(params, cfg.cluster, "nope", T=T)
+
+    def test_duplicate_mode_rejected(self):
+        with pytest.raises(ValueError, match="already registered"):
+            lanes.register_mode("rule", watch_name="x")
+
+    def test_missing_engine_slot_raises(self):
+        lanes.register_mode("half-mode", watch_name="half",
+                            packed_summary=lambda *a, **k: None)
+        try:
+            assert lanes.mode_engine("half-mode", "packed_summary")
+            with pytest.raises(ValueError, match="no block_summary"):
+                lanes.mode_engine("half-mode", "block_summary")
+        finally:
+            lanes.unregister_mode("half-mode")
+
+    def test_engine_provided_before_registration_attaches(self):
+        sentinel = object()
+        lanes.provide_mode_engine("late-mode", "lax_summary", sentinel)
+        try:
+            lanes.register_mode("late-mode", watch_name="late")
+            assert lanes.mode_engine("late-mode",
+                                     "lax_summary") is sentinel
+        finally:
+            lanes.unregister_mode("late-mode")
+
+    def test_unknown_slot_rejected(self):
+        with pytest.raises(ValueError, match="unknown engine slot"):
+            lanes.provide_mode_engine("rule", "teleport", lambda: None)
+
+
+class TestLaneReachesEveryEngine:
+    """The satellite's core contract: one registration, every engine."""
+
+    def test_widened_stream_resolves_and_lane_is_bitwise_reference(
+            self, cfg, sources, streams, testlane):
+        Z = cfg.cluster.n_zones
+        plain_s, wide_s = streams
+        assert wide_s.shape[1] == lanes.exo_rows(Z) + _test_rows(Z)
+        lay = lanes.resolve_layout(wide_s.shape[1], Z)
+        assert lay.families == (_TEST_NAME,)
+        assert lanes.stream_layout(wide_s.shape[1], Z) == (False, False)
+        lo, hi = lay.block(_TEST_NAME)
+        # Exo rows bitwise the plain source's (widening never disturbs
+        # the exo draws) and the lane block bitwise the hand-threaded
+        # reference generation.
+        assert np.array_equal(np.asarray(plain_s),
+                              np.asarray(wide_s[:, :lo]))
+        ref = _test_generate(0.5, jax.random.key(11), T,
+                             wide_s.shape[0], Z, B, ctx={})
+        assert np.array_equal(np.asarray(wide_s[:, lo:hi]),
+                              np.asarray(ref))
+        _plain, widened = sources
+        assert widened.packed_rows() == wide_s.shape[1]
+
+    @pytest.mark.parametrize("mode", ("rule", "carbon", "neural",
+                                      "plan"))
+    def test_all_four_kernel_modes_consume_it_bitwise(
+            self, cfg, streams, net_params, mode, testlane):
+        params = SimParams.from_config(cfg)
+        plain_s, wide_s = streams
+        kw = dict(T=T, b_block=B_BLOCK, t_chunk=T_CHUNK, interpret=True,
+                  stochastic=False,
+                  net_params=net_params if mode == "neural" else None)
+        kfn = packed_mode_summary_fn(params, cfg.cluster, mode, **kw)
+        a = kfn(plain_s, 3)
+        b = kfn(wide_s, 3)
+        assert not _fields_equal(a, b), mode
+
+    def test_lax_engine_consumes_it_bitwise(self, cfg, streams,
+                                            testlane):
+        params = SimParams.from_config(cfg)
+        plain_s, wide_s = streams
+        key = jax.random.key(7)
+        a = lax_mode_summary(params, cfg.cluster, "rule", plain_s, T,
+                             key)
+        b = lax_mode_summary(params, cfg.cluster, "rule", wide_s, T,
+                             key)
+        assert not _fields_equal(a, b)
+        from ccka_tpu.models import latent_dim
+
+        lat = jnp.zeros((B, T, latent_dim(cfg.cluster)), jnp.float32)
+        a = lax_mode_summary(params, cfg.cluster, "plan", plain_s, T,
+                             key, plan_latents=lat)
+        b = lax_mode_summary(params, cfg.cluster, "plan", wide_s, T,
+                             key, plan_latents=lat)
+        assert not _fields_equal(a, b)
+
+    def test_streaming_pipeline_consumes_it_bitwise(self, cfg, sources,
+                                                    testlane):
+        params = SimParams.from_config(cfg)
+        plain, widened = sources
+        key = jax.random.key(13)
+        kw = dict(key=key, batch=B, T=T, block_T=T_CHUNK,
+                  t_chunk=T_CHUNK, b_block=B_BLOCK, seed=5,
+                  interpret=True, stochastic=False, pipelined=True)
+        a, _ = streaming_mod.streaming_rollout_summary(
+            plain, params, cfg.cluster, "rule", **kw)
+        b, rep = streaming_mod.streaming_rollout_summary(
+            widened, params, cfg.cluster, "rule", **kw)
+        assert rep["n_blocks"] == T // T_CHUNK
+        assert not _fields_equal(a, b)
+
+    def test_8shard_wrapper_consumes_it_bitwise(self, cfg, sources,
+                                                testlane):
+        """Shard-local synthesis widens per shard and the sharded
+        kernel consumes the widened layout — bitwise the plain sharded
+        run (and the lane blocks bitwise the per-shard hand folds)."""
+        from ccka_tpu.parallel import (make_mesh, sharded_packed_trace)
+        from ccka_tpu.parallel.sharded_kernel import (
+            sharded_megakernel_summary_from_packed)
+        from ccka_tpu.policy.rule import offpeak_action, peak_action
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        params = SimParams.from_config(cfg)
+        mesh = make_mesh()
+        plain, widened = sources
+        key = jax.random.key(17)
+        Z = cfg.cluster.n_zones
+        sp = sharded_packed_trace(mesh, plain, T, key, B,
+                                  t_chunk=T_CHUNK)
+        sw = sharded_packed_trace(mesh, widened, T, key, B,
+                                  t_chunk=T_CHUNK)
+        lay = lanes.resolve_layout(sw.shape[1], Z)
+        lo, hi = lay.block(_TEST_NAME)
+        assert np.array_equal(np.asarray(sp), np.asarray(sw[:, :lo]))
+        # Shard i's lane block = the hand fold of (key, shard=i).
+        b_loc = B // 8
+        wide_np = np.asarray(sw)
+        for i in range(8):
+            ref = _test_generate(
+                0.5, jax.random.fold_in(key, i), T, sw.shape[0], Z,
+                b_loc, ctx={})
+            assert np.array_equal(
+                wide_np[:, lo:hi, i * b_loc:(i + 1) * b_loc],
+                np.asarray(ref)), f"shard {i}"
+        off, peak = offpeak_action(cfg.cluster), peak_action(cfg.cluster)
+        kw = dict(stochastic=False, b_block=b_loc, t_chunk=T_CHUNK,
+                  interpret=True)
+        a = sharded_megakernel_summary_from_packed(
+            mesh, params, off, peak, sp, T, 3, **kw)
+        b = sharded_megakernel_summary_from_packed(
+            mesh, params, off, peak, sw, T, 3, **kw)
+        assert not _fields_equal(a, b)
+
+
+class TestSourceValidation:
+    def test_unknown_extra_lane_rejected(self, cfg):
+        with pytest.raises(ValueError, match="unknown lane family"):
+            SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                  cfg.signals,
+                                  extra_lanes={"no-such": 1.0})
+
+    def test_builtin_via_extra_lanes_rejected(self, cfg):
+        with pytest.raises(ValueError, match="built-in"):
+            SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                  cfg.signals,
+                                  extra_lanes={"faults": 1.0})

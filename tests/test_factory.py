@@ -1,0 +1,303 @@
+"""MPC-distillation data factory (ISSUE 14): cell mechanics, label
+parity against the lax reference engine, dataset plumbing into
+`imitate(dataset=...)`, up-front name validation, and the bench-history
+sentinel's factory invariant gates (an injected bad record exits 1).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import default_config
+from ccka_tpu.models import latent_dim
+from ccka_tpu.sim import SimParams
+from ccka_tpu.sim.megakernel import mean_parity_violations
+from ccka_tpu.sim.rollout import lax_mode_summary
+from ccka_tpu.train import factory as factory_mod
+from ccka_tpu.workloads.scenarios import WORKLOAD_SCENARIOS
+
+# One tiny shared geometry (compiles cached across the module).
+FKW = dict(pairs=8, steps=32, block_T=16, t_chunk=16, b_block=8,
+           iters=2)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config()
+
+
+@pytest.fixture(scope="module")
+def cell(cfg):
+    """One produced cell with fault lanes ON (intensity "mild") — the
+    widened-stream path through planning, playback and collection."""
+    return factory_mod.produce_cell(
+        cfg, WORKLOAD_SCENARIOS["diurnal-inference"], "mild", seed=3,
+        with_ledger=True, **FKW)
+
+
+class TestValidation:
+    def test_unknown_names_rejected_up_front(self, cfg):
+        with pytest.raises(ValueError, match="unknown scenarios"):
+            factory_mod.validate_factory_names(
+                scenarios=("no-such",), intensities=("off",),
+                teacher="mpc")
+        with pytest.raises(ValueError, match="unknown intensities"):
+            factory_mod.validate_factory_names(
+                scenarios=("mixed",), intensities=("catastrophic",),
+                teacher="mpc")
+        with pytest.raises(ValueError, match="unknown teacher"):
+            factory_mod.validate_factory_names(
+                scenarios=("mixed",), intensities=("off",),
+                teacher="gpt")
+
+    def test_cli_rejects_unknown_names(self):
+        from ccka_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="unknown scenarios"):
+            main(["distill-factory", "--scenarios", "no-such"])
+        with pytest.raises(SystemExit, match="unknown intensities"):
+            main(["distill-factory", "--intensities", "huge"])
+        with pytest.raises(SystemExit, match="unknown teacher"):
+            main(["distill-factory", "--teacher", "oracle"])
+
+    def test_pairs_must_divide_b_block(self, cfg):
+        with pytest.raises(ValueError, match="b_block"):
+            factory_mod.produce_cell(
+                cfg, WORKLOAD_SCENARIOS["mixed"], "off",
+                **dict(FKW, pairs=12))
+
+
+class TestProduceCell:
+    def test_dataset_shapes_and_clip(self, cfg, cell):
+        n_rows = FKW["pairs"] * FKW["steps"]
+        A = latent_dim(cfg.cluster)
+        assert cell.dataset.obs.shape[0] == n_rows
+        assert cell.dataset.target.shape == (n_rows, A)
+        assert cell.dataset.returns.shape == (n_rows,)
+        t = np.asarray(cell.dataset.target)
+        assert np.all(np.abs(t) <= 3.0 + 1e-6)
+        assert cell.plan_latents.shape == (FKW["pairs"], FKW["steps"],
+                                           A)
+
+    def test_paired_summaries_and_report(self, cell):
+        for s in (cell.teacher_summary, cell.rule_summary):
+            assert np.asarray(s.usd_per_slo_hour).shape \
+                == (FKW["pairs"],)
+        rep = cell.report
+        for key in ("pairs_per_sec", "plans_per_sec",
+                    "playback_cluster_days_per_sec", "wall_s", "seed",
+                    "playback_occupancy"):
+            assert rep.get(key) is not None, key
+        assert rep["dataset_rows"] == FKW["pairs"] * FKW["steps"]
+        assert rep["playback"]["pipeline"] == "double-buffered"
+
+    def test_labels_match_the_lax_reference_engine(self, cfg, cell):
+        """The factory's kernel playback labels == the registry's lax
+        plan engine on the SAME stream and plans — the tentpole's
+        one-vocabulary claim, end to end (deterministic interpret, the
+        ONE shared tolerance table)."""
+        params = SimParams.from_config(cfg)
+        sc = WORKLOAD_SCENARIOS["diurnal-inference"]
+        stream = factory_mod._cell_stream(
+            factory_mod._cell_source(cfg, sc, "mild"),
+            steps=FKW["steps"], block_T=FKW["block_T"],
+            t_chunk=FKW["t_chunk"], pairs=FKW["pairs"],
+            key=jax.random.key(cell.report["seed"]))
+        lax = lax_mode_summary(params, cfg.cluster, "plan", stream,
+                               FKW["steps"], jax.random.key(0),
+                               plan_latents=cell.plan_latents)
+        bad = mean_parity_violations(cell.teacher_summary, lax)
+        assert not bad, bad
+
+    @pytest.mark.slow  # lane-time rule: the receding-horizon teacher
+    # compiles its own batch-planner program (~20s) and only re-proves
+    # the protocol switch; the "mpc" path carries the pinned contract.
+    def test_mpc_rh_teacher_runs(self, cfg):
+        cell = factory_mod.produce_cell(
+            cfg, WORKLOAD_SCENARIOS["diurnal-inference"], "off",
+            teacher="mpc-rh", seed=5, **dict(FKW, iters=4))
+        assert cell.report["teacher"] == "mpc-rh"
+        assert cell.plan_latents.shape[1] == FKW["steps"]
+
+
+class TestFactoryRunAndDistill:
+    def test_sweep_concats_cells_and_distills(self, cfg):
+        # Both cells keep the module cell fixture's stream LAYOUT
+        # (faults+workloads) so every kernel program is already warm —
+        # only the second scenario's generation program compiles.
+        run_kw = {k: v for k, v in FKW.items() if k != "pairs"}
+        dataset, report = factory_mod.factory_run(
+            cfg, scenarios=("diurnal-inference", "batch-backfill"),
+            intensities=("mild",), seed=3,
+            pairs_per_cell=FKW["pairs"], **run_kw)
+        assert len(report["cells"]) == 2
+        assert report["pairs_total"] == 2 * FKW["pairs"]
+        assert dataset.obs.shape[0] == 2 * FKW["pairs"] * FKW["steps"]
+        for row in report["cells"]:
+            assert row["teacher_vs_rule_usd_per_slo_hour"] is not None
+        from ccka_tpu.train.imitate import imitate
+
+        params, hist = imitate(cfg, None, None, dataset=dataset,
+                               iterations=5, minibatch=256, seed=0)
+        assert hist[-1]["actor_mse"] >= 0.0
+        mean, _, _ = __import__("ccka_tpu.models", fromlist=["x"]) \
+            .ActorCritic(act_dim=latent_dim(cfg.cluster)) \
+            .apply(params, np.asarray(dataset.obs[0]))
+        assert mean.shape == (latent_dim(cfg.cluster),)
+
+    def test_naive_baseline_reports_protocol(self, cfg):
+        nb = factory_mod.naive_lax_pair_rate(
+            cfg, WORKLOAD_SCENARIOS["diurnal-inference"], "off",
+            pairs=1, steps=32, block_T=16, t_chunk=16, seed=3)
+        assert nb["pairs_per_sec"] > 0
+        assert nb["mpc_iters"] == int(cfg.train.mpc_iters)
+        assert "receding_horizon_rollout" in nb["engine"] or \
+            "receding-horizon" in nb["engine"] or "lax" in nb["engine"]
+
+
+def _good_factory_record(**overrides) -> dict:
+    """A minimal well-formed --factory-only record for the gate tests
+    (mirrors `_good_stream_record`'s role for the round-16 gates)."""
+    def fcell(scenario, intensity):
+        return {
+            "scenario": scenario, "intensity": intensity, "pairs": 64,
+            "steps": 96, "seed": 41, "pairs_per_sec": 300.0,
+            "plans_per_sec": 380.0,
+            "playback_cluster_days_per_sec": 90.0,
+            "teacher_vs_rule_usd_per_slo_hour": 1.001,
+            "playback_occupancy": {"fractions": {"generation": 0.3,
+                                                 "kernel": 0.6,
+                                                 "host": 0.1}},
+        }
+
+    rec = {
+        "metric": "factory", "round": 93, "stage": "--factory-only",
+        "platform": "cpu", "virtual": True,
+        "engine": "train/factory.py",
+        "cells": [fcell("diurnal-inference", "off"),
+                  fcell("batch-backfill", "moderate")],
+        "pairs_total": 128, "pairs_per_sec": 295.0,
+        "plans_per_sec": 375.0, "wall_s": 0.43,
+        "baseline": {"pairs_per_sec": 12.0, "pairs": 4},
+        "throughput_ratio_vs_baseline": 24.6,
+        "playback_roofline_floor_s": 0.002,
+        "student": {
+            "iterations": 400, "final_actor_mse": 0.02,
+            "student_vs_teacher_usd_per_slo_hour": 1.006,
+            "per_cell": [
+                {"scenario": "diurnal-inference", "intensity": "off",
+                 "student_vs_teacher_usd_per_slo_hour": 1.004},
+                {"scenario": "batch-backfill", "intensity": "moderate",
+                 "student_vs_teacher_usd_per_slo_hour": 1.008}],
+        },
+        "provenance": {"platform": "cpu"},
+    }
+    rec.update(overrides)
+    return rec
+
+
+class TestBenchDiffFactoryGates:
+    """ISSUE 14 satellite: the sentinel's factory invariant gates — an
+    injected bad record drives exit 1, the real history stays clean."""
+
+    def _diff_of(self, tmp_path, rec):
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        (tmp_path / "BENCH_r93.json").write_text(json.dumps(rec))
+        return bench_diff(load_bench_history(str(tmp_path)))
+
+    def test_good_record_is_clean(self, tmp_path):
+        diff = self._diff_of(tmp_path, _good_factory_record())
+        assert diff["ok"], diff["regressions"]
+
+    def test_ratio_below_one_regresses_and_cli_exits_nonzero(
+            self, tmp_path, capsys):
+        rec = _good_factory_record(throughput_ratio_vs_baseline=0.8)
+        diff = self._diff_of(tmp_path, rec)
+        assert any(r["kind"] == "factory_invariant"
+                   for r in diff["regressions"])
+        from ccka_tpu.cli import main
+
+        assert main(["bench-diff", "--root", str(tmp_path)]) == 1
+        assert "REGRESSION" in capsys.readouterr().err
+
+    def test_missing_baseline_is_partial(self, tmp_path):
+        rec = _good_factory_record()
+        del rec["baseline"]
+        del rec["throughput_ratio_vs_baseline"]
+        diff = self._diff_of(tmp_path, rec)
+        assert any("baseline" in r["detail"]
+                   for r in diff["regressions"])
+
+    def test_student_ratio_missing_or_implausible(self, tmp_path):
+        rec = _good_factory_record()
+        rec["student"]["student_vs_teacher_usd_per_slo_hour"] = None
+        assert not self._diff_of(tmp_path, rec)["ok"]
+        rec = _good_factory_record()
+        rec["student"]["student_vs_teacher_usd_per_slo_hour"] = 500.0
+        diff = self._diff_of(tmp_path, rec)
+        assert any("plausible" in r["detail"]
+                   for r in diff["regressions"])
+
+    def test_missing_cells_entirely_is_a_regression(self, tmp_path):
+        """The most-degraded record — a factory stage with NO cells at
+        all — must not slip past the gates on its shape."""
+        rec = _good_factory_record()
+        del rec["cells"]
+        diff = self._diff_of(tmp_path, rec)
+        assert any("cells" in r["detail"]
+                   for r in diff["regressions"]), diff
+
+    def test_student_board_dropping_cells_is_a_regression(
+            self, tmp_path):
+        """The student column is per-CELL: a full-stage record whose
+        per_cell board covers fewer cells than it ran dropped rows."""
+        rec = _good_factory_record()
+        rec["student"]["per_cell"] = []
+        diff = self._diff_of(tmp_path, rec)
+        assert any("per_cell" in r["detail"]
+                   for r in diff["regressions"]), diff
+
+    def test_partial_cell_is_a_regression(self, tmp_path):
+        rec = _good_factory_record()
+        del rec["cells"][0]["pairs_per_sec"]
+        assert not self._diff_of(tmp_path, rec)["ok"]
+        rec = _good_factory_record()
+        del rec["cells"][1]["teacher_vs_rule_usd_per_slo_hour"]
+        assert not self._diff_of(tmp_path, rec)["ok"]
+        rec = _good_factory_record()
+        del rec["playback_roofline_floor_s"]
+        assert not self._diff_of(tmp_path, rec)["ok"]
+
+    def test_real_history_is_clean(self):
+        import os
+
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        diff = bench_diff(load_bench_history(root))
+        assert diff["ok"], diff["regressions"]
+
+    def test_scaling_curve_ingests_factory_rows(self, tmp_path):
+        from ccka_tpu.obs.bench_history import (scaling_curve,
+                                                write_scaling_csv)
+
+        (tmp_path / "BENCH_r93.json").write_text(
+            json.dumps(_good_factory_record()))
+        curve = scaling_curve(str(tmp_path))
+        rows = [p for p in curve["points"]
+                if p.get("source") == "factory_playback"]
+        assert len(rows) == 2
+        assert all(r["cluster_days_per_sec_aggregate"] == 90.0
+                   for r in rows)
+        assert "pairs/s" in rows[0]["note"]
+        path = write_scaling_csv(curve, str(tmp_path / "c.csv"))
+        assert "factory_playback" in open(path).read()

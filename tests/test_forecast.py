@@ -257,8 +257,14 @@ def test_planning_window_current_tick_plus_predictions(synth):
 # -- MPC + controller integration ---------------------------------------
 
 
-@pytest.mark.parametrize("fc_name", ["persistence", "seasonal-naive",
-                                     "ridge"])
+@pytest.mark.parametrize("fc_name", [
+    "persistence",
+    # ISSUE 14 lane-time rule (~21s for the pair): the three params run
+    # the SAME jitted MPC composition and differ only in the forecaster
+    # backend, whose math is pinned exactly by the exactness/AR-recovery
+    # tests above — persistence stays as the fast-lane representative.
+    pytest.param("seasonal-naive", marks=pytest.mark.slow),
+    pytest.param("ridge", marks=pytest.mark.slow)])
 def test_forecast_driven_mpc_jitted_end_to_end(cfg, synth, fc_name):
     """The tentpole contract: receding-horizon MPC planning against
     predicted windows runs fully jitted on CPU — no shape/tracer errors —

@@ -124,6 +124,10 @@ class TestInterpretExactParity:
         np.testing.assert_allclose(np.asarray(sk.hours),
                                    40 * cfg.sim.dt_s / 3600.0)
 
+    @pytest.mark.slow  # ISSUE 14 lane-time rule (~9s): batch-block
+    # independence is re-proven fast-lane by every multi-block parity
+    # run and by the streaming chunked==unblocked bitwise gates, whose
+    # cluster-chunk groups are exactly these blocks.
     def test_multiple_batch_blocks_are_independent(self, cfg, setup):
         """Scratch state must reset between batch blocks: running two
         blocks must equal each block run alone."""
@@ -305,6 +309,10 @@ class TestNeuralKernelParity:
         bad = {f: r for f, r in rel.items() if r > 1e-3}
         assert not bad, f"Z=4 neural parity broken: {bad}"
 
+    @pytest.mark.slow  # ISSUE 14 lane-time rule (~9s): the population
+    # fan-out is re-proven fast-lane by the sharded neural entry parity
+    # (test_sharded_kernel) and by every cem_refine-driven refinement
+    # test, whose ES generations run THIS population kernel.
     def test_population_axis(self, cfg, setup):
         """Stacked candidates: one launch, [NP, B] fields; member 0
         equals the single-pytree run (paired worlds) and a genuinely

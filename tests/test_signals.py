@@ -61,6 +61,11 @@ def test_batch_trace_bitwise_matches_stacked_traces(synth):
         assert np.array_equal(stacked, np.asarray(getattr(batch, name))), name
 
 
+@pytest.mark.slow  # ISSUE 14 lane-time rule (~11s): a 2880-tick
+# statistical composition — device-synthesized traces are consumed
+# bitwise by every packed parity test fast-lane, and the host path has
+# its own exactness pins; only the host-vs-device moment match rides
+# here.
 def test_device_trace_statistically_matches_host_path(synth):
     """batch_trace_device is the same signal family as batch_trace: same
     diurnal structure (exact, it's deterministic) and AR(1) noise moments."""

@@ -139,7 +139,9 @@ class TestCarryExactness:
         # The sync drive measured a real per-stage ledger.
         occ = rep["occupancy"]["fractions"]
         assert set(occ) == {"generation", "kernel", "host"}
-        assert abs(sum(occ.values()) - 1.0) < 1e-6
+        # The report's fractions are rounded to 6 dp (occupancy
+        # snapshot), so three roundings can land the sum at 1 ± 1.5e-6.
+        assert abs(sum(occ.values()) - 1.0) <= 2e-6
 
     def test_raw_rows_match_legacy_noncarry_program(self, cfg, setup):
         """The carry kernel family is tied to the PINNED pre-streaming
@@ -172,6 +174,11 @@ class TestCarryExactness:
             b_block=B_BLOCK, t_chunk=T_CHUNK, interpret=True)
         assert np.array_equal(np.asarray(out), np.asarray(legacy))
 
+    @pytest.mark.slow  # ISSUE 14 lane-time rule (~13s): the
+    # stream-level mechanism behind the stronger fast-lane composition
+    # — test_blocked_equals_unblocked_bitwise runs with fault+workload
+    # lanes ON, so a lane drifting under blocking would break its
+    # bitwise summary gate.
     def test_lanes_stay_bitwise_under_blocking(self, cfg, setup):
         """Widening a blocked stream with fault/workload lanes changes
         neither the exo rows nor the fault rows bitwise — per block,

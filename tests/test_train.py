@@ -311,6 +311,11 @@ class TestRefinementMechanics:
         assert float(np.asarray(p["critic"]["kernel"]).mean()) == 1.0
         assert float(np.asarray(p["Dense_0"]["kernel"]).mean()) == 1.0
 
+    @pytest.mark.slow  # ISSUE 14 lane-time rule: two 3-iter PPO runs
+    # (~25s) re-proving the dual-ascent direction per iteration — the
+    # multiplier's ascent and clamp stay fast-lane via
+    # test_lagrangian_respects_bounds (which drives the same update to
+    # its max) and test_fixed_weight_mode_unchanged (the off path).
     def test_lagrangian_multiplier_tracks_attainment_gap(self, cfg, source):
         """Dual ascent on the attainment constraint: the violation price
         rises while measured attainment is under target and decays above
@@ -381,6 +386,11 @@ class TestRefinementMechanics:
             np.asarray(perturbed["params"]["Dense_0"]["kernel"]),
             np.asarray(params["params"]["Dense_0"]["kernel"]))
 
+    @pytest.mark.slow  # ISSUE 14 lane-time rule (~24s): the plain
+    # "runs and reports" composition — the same cem_refine loop is
+    # driven fast-lane by the sibling refinement-mechanics tests
+    # (anchor drift, lagrangian bounds, warmup resume, fixed-weight),
+    # each asserting a sharper claim on the identical machinery.
     def test_cem_refine_runs_and_reports(self, cfg, source):
         from ccka_tpu.train.cem import CEMConfig, cem_refine
 
