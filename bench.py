@@ -1797,7 +1797,11 @@ def bench_obs(*, n_tenants: int = 16, ticks: int = 48, seed: int = 211,
     profiles = (["healthy"] * (n_tenants - 2 * n_stress)
                 + ["slow"] * n_stress + ["flaky"] * n_stress)
     dump_dir = tempfile.mkdtemp(prefix="ccka-obs-bench-")
-    obs_on = ObsConfig(enabled=True, dump_dir=dump_dir)
+    # decisions_enabled=False keeps this stage's number the RECORDER's
+    # cost, as recorded since r14 — the round-18 decision ledger is
+    # priced by its own paired stage (`bench_decisions`).
+    obs_on = ObsConfig(enabled=True, dump_dir=dump_dir,
+                       decisions_enabled=False)
 
     def det_clock():
         """Deterministic base: +0.1 virtual ms per read, fresh per
@@ -1923,6 +1927,230 @@ def bench_obs(*, n_tenants: int = 16, ticks: int = 48, seed: int = 211,
           f"{out['incidents_total']} incidents "
           f"({out['dumps_verified']}/{out['dumps_total']} dumps "
           "verified)", file=sys.stderr)
+    return out
+
+
+def bench_decisions(*, n_tenants: int = 16, ticks: int = 48,
+                    seed: int = 211, repeats: int = 3) -> dict | None:
+    """Decision-provenance ledger stage (round 18, `obs/decisions.py`):
+    paired ledger-ON / ledger-OFF FleetService runs over the SAME
+    seeded world — the LEARNED FLAGSHIP against its rule shadow (the
+    divergence the paper's pitch is actually about: the flagship moves
+    hpa_scale/ct_allow, so the one-step counterfactual's $/carbon
+    deltas are genuinely nonzero, where a zone-weight-only policy's
+    one-step deltas are ~0 behind the provisioning delay; carbon is
+    the fallback when no checkpoint is committed) with slow + flaky
+    tenants so the incident substrate fires too. Both arms run the
+    full round-14 obs layer; the ONLY
+    difference is `obs.decisions_enabled`, so the delta prices exactly
+    the ledger — and because the shadow lanes ride the compiled tick
+    unconditionally, the two arms share one XLA program by
+    construction. Gates on the record (the `ccka bench-diff` decision
+    invariants):
+
+    - ``bitwise_identical``: decisions (per-tenant $/SLO-hr + SLO tick
+      accumulators) AND patch streams byte-equal between the paired
+      det-clock runs — provenance must never steer;
+    - ``ledger_overhead_frac`` < 5% of the OFF run's p50 tick latency
+      (the PR 11/12 standard), measured on the real clock as the
+      median over ``repeats`` paired mean-latency deltas. This prices
+      the HOST-side recording only: the shadow lanes' device compute
+      is unconditional by design (program identity across obs
+      postures — ARCHITECTURE 20) and therefore part of both arms'
+      p50 denominator, not the delta;
+    - ``term_share_err_max``: |Σ shares − 1| over EVERY recorded row
+      (attribution must account for the whole objective);
+    - ≥1 ``policy_divergence`` incident, each attributable 1:1 to a
+      checksum-verified flight-recorder dump.
+
+    Host-side harness on the virtual clock — the INVARIANTS are the
+    result; no roofline floor applies."""
+    import tempfile
+
+    from ccka_tpu.config import ObsConfig, SERVICE_PRESETS, \
+        multi_region_config
+    from ccka_tpu.harness.service import (VirtualClock,
+                                          fleet_service_from_config)
+    from ccka_tpu.train.flagship import load_flagship_backend
+
+    cfg = multi_region_config().with_overrides(
+        **{"sim.horizon_steps": max(ticks + 4, 16)})
+    backend, _meta = load_flagship_backend(cfg)
+    backend_name = "flagship"
+    config_name = "multiregion(flagship checkpoint committed)"
+    if backend is None:
+        from ccka_tpu.policy import CarbonAwarePolicy
+        backend = CarbonAwarePolicy(cfg.cluster)
+        backend_name = "carbon (no flagship checkpoint committed)"
+        config_name = "multiregion (carbon fallback — no flagship " \
+                      "checkpoint)"
+    n_stress = max(2, n_tenants // 4)
+    profiles = (["healthy"] * (n_tenants - 2 * n_stress)
+                + ["slow"] * n_stress + ["flaky"] * n_stress)
+    scratch = tempfile.mkdtemp(prefix="ccka-decisions-bench-")
+    run_idx = [0]
+
+    def obs_cfg(decisions: bool) -> ObsConfig:
+        run_idx[0] += 1
+        return ObsConfig(
+            enabled=True,
+            dump_dir=os.path.join(scratch, f"dumps-{run_idx[0]}"),
+            decisions_enabled=decisions,
+            decision_log_path=(os.path.join(
+                scratch, f"decisions-{run_idx[0]}.jsonl")
+                if decisions else ""))
+
+    def det_clock():
+        state = {"s": 0.0}
+
+        def base():
+            state["s"] += 1e-4
+            return state["s"]
+        return VirtualClock(base=base)
+
+    def run(decisions: bool, clock=None):
+        svc = fleet_service_from_config(
+            cfg, backend, n_tenants, profiles=profiles,
+            service=SERVICE_PRESETS["default"], obs=obs_cfg(decisions),
+            horizon_ticks=max(ticks + 4, 8), seed=seed, clock=clock)
+        svc.warmup()
+        reports = svc.run(ticks)
+        lats = np.asarray(svc.latencies_ms)
+        led = svc.decisions
+        out = {
+            "p50_ms": float(np.percentile(lats, 50)),
+            "mean_ms": float(lats.mean()),
+            "usd": svc.tenant_usd_per_slo_hr().copy(),
+            "slo_ticks": svc.tenant_slo_ticks.copy(),
+            "commands": [[(c.name, c.patch_type, json.dumps(
+                c.patch, sort_keys=True))
+                for c in getattr(s, "inner", s).commands]
+                for s in svc.sinks],
+            "incidents": svc.incidents.counts(),
+            "incident_records": list(svc.incidents.incidents),
+            "rows_total": led.rows_total if led is not None else 0,
+            "diverged_total": (led.diverged_total
+                               if led is not None else 0),
+            "spikes_total": led.spikes_total if led is not None else 0,
+            "divergence_rate_last": reports[-1].policy_divergence_rate,
+            "term_shares_last": reports[-1].objective_term_shares,
+            "shadow_slo_delta_last": reports[-1].shadow_slo_delta,
+            "shadow_usd_delta_total": (led.shadow_usd_delta_total
+                                       if led is not None else 0.0),
+        }
+        led_path = led.path if led is not None else ""
+        svc.close()
+        # The every-row share gate must see EVERY row: the ledger's
+        # in-memory tail is retention-bounded (rows_retained), so a
+        # long run's oldest rows only survive on disk — read them back
+        # from the JSONL the run just flushed.
+        if led_path:
+            from ccka_tpu.obs.decisions import read_decisions
+            out["rows"] = read_decisions(led_path)
+            assert len(out["rows"]) == out["rows_total"]
+        else:
+            out["rows"] = []
+        return out
+
+    try:
+        # Bitwise non-interference on the deterministic clock: one
+        # pair suffices — no noise source left to average over.
+        det_off = run(False, clock=det_clock())
+        det_on = run(True, clock=det_clock())
+        bitwise = bool(
+            np.array_equal(det_off["usd"], det_on["usd"])
+            and np.array_equal(det_off["slo_ticks"],
+                               det_on["slo_ticks"])
+            and det_off["commands"] == det_on["commands"])
+
+        # Attribution invariant: shares sum to ~1 on EVERY row.
+        share_errs = [abs(sum(r["objective"]["shares"].values()) - 1.0)
+                      for r in det_on["rows"]]
+        shadow_share_errs = [
+            abs(sum(r["shadow"]["objective"]["shares"].values()) - 1.0)
+            for r in det_on["rows"]]
+        term_share_err_max = float(max(share_errs + shadow_share_errs,
+                                       default=1.0))
+
+        # Divergence incidents attributable 1:1 to verified dumps.
+        from ccka_tpu.obs.recorder import verify_dump
+        pd_records = [rec for rec in det_on["incident_records"]
+                      if rec.trigger == "policy_divergence"]
+        pd_dump_failures: list[str] = []
+        pd_dumps_verified = 0
+        for rec in pd_records:
+            if rec.dump_path is None:
+                pd_dump_failures.append(f"incident {rec.id} dump-less")
+                continue
+            try:
+                body = verify_dump(rec.dump_path)
+                assert body["t"] == rec.t
+                pd_dumps_verified += 1
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                pd_dump_failures.append(repr(e)[:120])
+
+        # Overhead on the REAL clock (the bench_obs estimator: median
+        # of paired mean-latency deltas over the OFF p50 denominator).
+        best_off = None
+        deltas = []
+        for _ in range(max(repeats, 1)):
+            off = run(False)
+            on = run(True)
+            deltas.append(on["mean_ms"] - off["mean_ms"])
+            best_off = (off["p50_ms"] if best_off is None
+                        else min(best_off, off["p50_ms"]))
+        overhead_ms = float(np.median(deltas))
+        overhead = overhead_ms / max(best_off, 1e-9)
+    finally:
+        import shutil
+
+        shutil.rmtree(scratch, ignore_errors=True)
+
+    out = {
+        "engine": "paired ledger-on/ledger-off fleet service (virtual "
+                  "clock, flagship backend vs rule shadow, seeded "
+                  "slow+flaky tenants)",
+        "n_tenants": n_tenants,
+        "ticks": ticks,
+        "seed": seed,
+        "repeats": repeats,
+        "backend": backend_name,
+        "config": config_name,
+        "shadow_policy": "rule",
+        "profiles": {"healthy": n_tenants - 2 * n_stress,
+                     "slow": n_stress, "flaky": n_stress},
+        "p50_tick_ms_off": round(best_off, 3),
+        "ledger_overhead_ms_per_tick": round(overhead_ms, 4),
+        "ledger_overhead_frac": round(max(overhead, 0.0), 4),
+        "ledger_overhead_raw_frac": round(overhead, 4),
+        "bitwise_identical": bool(bitwise),
+        "rows_total": det_on["rows_total"],
+        "rows_per_tick": n_tenants,
+        "term_share_err_max": term_share_err_max,
+        "diverged_total": det_on["diverged_total"],
+        "divergence_rate_last": det_on["divergence_rate_last"],
+        "term_shares_last": det_on["term_shares_last"],
+        "shadow_slo_delta_last": det_on["shadow_slo_delta_last"],
+        "shadow_usd_delta_total": round(
+            det_on["shadow_usd_delta_total"], 6),
+        "divergence_incidents": len(pd_records),
+        "divergence_spikes": det_on["spikes_total"],
+        "divergence_dumps_verified": pd_dumps_verified,
+        "divergence_dump_failures": pd_dump_failures,
+        "incidents": det_on["incidents"],
+        "overhead_gate_frac": 0.05,
+        "overhead_gate_ok": bool(max(overhead, 0.0) < 0.05),
+        "share_gate_err": 0.02,
+        "share_gate_ok": bool(term_share_err_max <= 0.02),
+    }
+    print(f"# decisions: p50 off {out['p50_tick_ms_off']:.3f}ms, ledger "
+          f"overhead {out['ledger_overhead_ms_per_tick']:.3f}ms/tick "
+          f"({out['ledger_overhead_frac'] * 100:.2f}% of p50), bitwise="
+          f"{out['bitwise_identical']}, {out['rows_total']} rows "
+          f"(share err {out['term_share_err_max']:.2e}), "
+          f"{out['divergence_incidents']} policy_divergence incident(s) "
+          f"({out['divergence_dumps_verified']} dumps verified)",
+          file=sys.stderr)
     return out
 
 
@@ -3127,6 +3355,15 @@ def main(argv=None) -> int:
                          "non-interference stage (bench_obs) and print "
                          "its JSON — the BENCH_r14 record path; "
                          "host-side virtual-clock harness")
+    ap.add_argument("--decisions-only", action="store_true",
+                    help="run ONLY the decision-provenance ledger "
+                         "stage (bench_decisions: paired ledger-on/off "
+                         "fleet service, flagship backend vs the rule "
+                         "shadow — bitwise gate, overhead budget, "
+                         "term-share invariant, policy_divergence "
+                         "attribution) and print its JSON — the "
+                         "BENCH_r18 record path; host-side "
+                         "virtual-clock harness")
     ap.add_argument("--perf-only", action="store_true",
                     help="run ONLY the device-time performance "
                          "observatory (bench_perf: occupancy ledger + "
@@ -3241,6 +3478,17 @@ def main(argv=None) -> int:
             ob["provenance"] = bench_provenance()
         print(json.dumps(ob))
         return 0 if ob is not None else 1
+
+    if args.decisions_only:
+        with _TRACER.span("bench.decisions_stage"):
+            dec = bench_decisions()
+        if dec is not None:
+            # Record-path stamp (see --perf-only): a raw redirect into
+            # BENCH_rNN.json arms the bench-diff decision gates.
+            dec["stage"] = "--decisions-only"
+            dec["provenance"] = bench_provenance()
+        print(json.dumps(dec))
+        return 0 if dec is not None else 1
 
     if args.perf_mesh_only:
         from ccka_tpu.config import default_config
@@ -3516,6 +3764,17 @@ def main(argv=None) -> int:
     except Exception as e:  # noqa: BLE001
         print(f"# obs stage failed (omitted): {e!r}", file=sys.stderr)
         obs_stage = None
+    # Decision-provenance ledger stage (round 18): paired ledger-on/off
+    # runs — same guard; host-side, so --quick only shrinks them.
+    try:
+        with _TRACER.span("bench.decisions_stage"):
+            decisions_stage = (
+                bench_decisions(n_tenants=8, ticks=12, repeats=2)
+                if args.quick else bench_decisions())
+    except Exception as e:  # noqa: BLE001
+        print(f"# decisions stage failed (omitted): {e!r}",
+              file=sys.stderr)
+        decisions_stage = None
     # Device-time observatory stage (round 15): occupancy ledger + XLA
     # attribution per kernel mode — same guard; --quick shrinks sizes
     # and drops the neural/carbon modes + the mesh section.
@@ -3596,6 +3855,8 @@ def main(argv=None) -> int:
         line["overload"] = overload
     if obs_stage is not None:
         line["obs"] = obs_stage
+    if decisions_stage is not None:
+        line["decisions"] = decisions_stage
     if perf_stage is not None:
         line["perf"] = perf_stage
     # Provenance + the session's span trace: a headline without device/

@@ -158,6 +158,10 @@ def _build_parser() -> argparse.ArgumentParser:
                          "incident records (JSONL) here and write "
                          "recorder dumps next to it — inspect with "
                          "`ccka incidents`")
+    sf.add_argument("--decisions-out", default="",
+                    help="with --service + obs: append the decision "
+                         "ledger's per-tenant provenance rows (JSONL) "
+                         "here — inspect with `ccka decisions`")
 
     swatch = sub.add_parser(
         "watch", help="the demo_40 observe session: port-forward Grafana/"
@@ -474,6 +478,32 @@ def _build_parser() -> argparse.ArgumentParser:
     sinc.add_argument("--window", type=int, default=8,
                       help="timeline --id: ticks of context around the "
                            "incident (default 8)")
+
+    sdec = sub.add_parser(
+        "decisions", help="inspect the decision-provenance ledger "
+                          "(obs/decisions JSONL from a service/"
+                          "controller run): list rows, show a tick's "
+                          "raw records, or explain a decision's 'why' "
+                          "— objective-term shares plus what the rule "
+                          "shadow would have done on the same inputs")
+    sdec.add_argument("action", choices=("list", "show", "explain"))
+    sdec.add_argument("path", help="decision JSONL (DecisionLedger "
+                                   "output). explain labels action "
+                                   "components from the CURRENT "
+                                   "--preset/--config cluster layout "
+                                   "— run it with the config the log "
+                                   "was recorded under (a length "
+                                   "mismatch falls back to bare "
+                                   "indices with a note)")
+    sdec.add_argument("--t", type=int, default=-1,
+                      help="show/explain: tick to render (show/explain "
+                           "require one; list ignores it)")
+    sdec.add_argument("--tenant", type=int, default=-1,
+                      help="show/explain: restrict to one tenant index "
+                           "(-1 = every tenant of the tick)")
+    sdec.add_argument("-n", "--lines", type=int, default=20,
+                      help="list: most recent rows to print "
+                           "(default 20)")
 
     sbd = sub.add_parser(
         "bench-diff", help="bench-history regression sentinel "
@@ -1146,6 +1176,55 @@ def _cmd_incidents(args) -> int:
     return 0
 
 
+def _cmd_decisions(args, cfg) -> int:
+    """`ccka decisions list|show|explain` — the decision-provenance
+    JSONL: compact recent rows, a tick's raw records, or the rendered
+    "why" (objective-term shares + the rule shadow's counterfactual)."""
+    from ccka_tpu.obs.decisions import (explain_row, flat_action_names,
+                                        read_decisions)
+
+    try:
+        rows = read_decisions(args.path)
+    except OSError as e:
+        raise SystemExit(f"ccka: cannot read decisions: {e}")
+    except json.JSONDecodeError as e:
+        raise SystemExit(f"ccka: corrupt decision log {args.path}: {e}")
+    if args.action == "list":
+        for rec in rows[-max(args.lines, 1):]:
+            sh = rec.get("shadow", {})
+            print(json.dumps({
+                "t": rec.get("t"), "tenant": rec.get("tenant"),
+                "lane": rec.get("lane"),
+                "objective_total": rec.get("objective", {}).get("total"),
+                "diverged": sh.get("diverged"),
+                "div_max_abs": sh.get("div_max_abs"),
+                "usd_delta": sh.get("usd_delta"),
+            }, sort_keys=True))
+        div = sum(1 for r in rows
+                  if r.get("shadow", {}).get("diverged"))
+        print(f"# {len(rows)} decision row(s), {div} diverged from "
+              "the rule shadow", file=sys.stderr)
+        return 0
+    if args.t < 0:
+        raise SystemExit(f"ccka: decisions {args.action} needs --t TICK "
+                         "(see `ccka decisions list`)")
+    sel = [r for r in rows if r.get("t") == args.t
+           and (args.tenant < 0 or r.get("tenant") == args.tenant)]
+    if not sel:
+        where = (f" tenant {args.tenant}" if args.tenant >= 0 else "")
+        raise SystemExit(f"ccka: no decision rows for tick "
+                         f"{args.t}{where} in {args.path}")
+    if args.action == "show":
+        for rec in sel:
+            print(json.dumps(rec, sort_keys=True))
+        return 0
+    names = flat_action_names(cfg.cluster)
+    for rec in sel:
+        print(explain_row(rec, action_names=names))
+        print()
+    return 0
+
+
 def _cmd_bench_diff(args) -> int:
     """`ccka bench-diff` — the regression sentinel: exit 0 on a clean
     history, 1 on any threshold regression (the CI contract)."""
@@ -1641,6 +1720,8 @@ def main(argv: list[str] | None = None) -> int:
             return 0
         if args.command == "incidents":
             return _cmd_incidents(args)
+        if args.command == "decisions":
+            return _cmd_decisions(args, cfg)
         if args.command == "bench-diff":
             return _cmd_bench_diff(args)
         if args.command == "perf":
@@ -1811,15 +1892,16 @@ def main(argv: list[str] | None = None) -> int:
             if args.clusters < 1 or args.ticks < 1:
                 raise SystemExit("ccka: fleet needs --clusters >= 1 and "
                                  "--ticks >= 1")
-            if (args.obs or args.incidents_out) and (
-                    not args.service or args.service == "off"):
+            if (args.obs or args.incidents_out or args.decisions_out) \
+                    and (not args.service or args.service == "off"):
                 # The obs layer rides the service loop; letting these
                 # flags silently no-op would leave the operator
                 # believing incidents were being recorded.
                 raise SystemExit(
-                    "ccka: --obs/--incidents-out need an ENABLED "
-                    "--service posture (the obs layer rides the "
-                    "service loop; 'off' delegates to the bare fleet)")
+                    "ccka: --obs/--incidents-out/--decisions-out need "
+                    "an ENABLED --service posture (the obs layer rides "
+                    "the service loop; 'off' delegates to the bare "
+                    "fleet)")
             backend = make_backend(cfg, args.backend, args.checkpoint)
             if args.service:
                 from ccka_tpu.config import SERVICE_PRESETS
@@ -1841,7 +1923,7 @@ def main(argv: list[str] | None = None) -> int:
                 profiles = [names[i % len(names)]
                             for i in range(args.clusters)]
                 obs = None
-                if args.obs or args.incidents_out:
+                if args.obs or args.incidents_out or args.decisions_out:
                     import dataclasses
                     import os
 
@@ -1852,14 +1934,16 @@ def main(argv: list[str] | None = None) -> int:
                             f"ccka: unknown obs preset {preset!r}; "
                             f"presets: {sorted(OBS_PRESETS)}")
                     obs = OBS_PRESETS[preset]
+                    if (args.incidents_out or args.decisions_out) \
+                            and args.obs and not obs.enabled:
+                        # An explicit off posture must not be
+                        # silently inverted by the output flags.
+                        raise SystemExit(
+                            f"ccka: --obs {args.obs} is the off "
+                            "posture but --incidents-out/"
+                            "--decisions-out need the obs layer "
+                            "running — drop one")
                     if args.incidents_out:
-                        if args.obs and not obs.enabled:
-                            # An explicit off posture must not be
-                            # silently inverted by the output flag.
-                            raise SystemExit(
-                                f"ccka: --obs {args.obs} is the off "
-                                "posture but --incidents-out needs "
-                                "the obs layer running — drop one")
                         out_dir = os.path.dirname(
                             os.path.abspath(args.incidents_out)) or "."
                         obs = dataclasses.replace(
@@ -1867,6 +1951,10 @@ def main(argv: list[str] | None = None) -> int:
                             incident_log_path=args.incidents_out,
                             dump_dir=os.path.join(out_dir,
                                                   "recorder-dumps"))
+                    if args.decisions_out:
+                        obs = dataclasses.replace(
+                            obs, enabled=True,
+                            decision_log_path=args.decisions_out)
                 try:
                     service = fleet_service_from_config(
                         cfg, backend, args.clusters, profiles=profiles,
@@ -1903,6 +1991,11 @@ def main(argv: list[str] | None = None) -> int:
                             service.recorder.dumps_total
                         summary["slo_burn_rate_last"] = \
                             sreports[-1].slo_burn_rate
+                    if service.decisions is not None:
+                        summary["decision_rows_total"] = \
+                            service.decisions.rows_total
+                        summary["policy_divergence_rate_last"] = \
+                            sreports[-1].policy_divergence_rate
                     service.close()
                     print(json.dumps(summary, indent=2))
                     return 0

@@ -771,13 +771,41 @@ class ObsConfig:
       (``burn_fast_window``/``burn_slow_window`` ticks) over the
       per-tenant SLO-violation/deadline/shed counters, exported as
       `ccka_slo_burn_rate`/`ccka_incident_active` gauges.
+    - **decision ledger** (`obs/decisions.py`, round 18): one
+      structured row per tick and tenant — the observed (possibly
+      stale) exo the policy saw, the state estimate, the chosen
+      action, the per-term decomposition of the step objective, and
+      the batched RULE SHADOW counterfactual (extra lanes inside the
+      same device dispatch — never a second dispatch or compile) with
+      its action-divergence and projected $/SLO deltas. Windowed
+      divergence over ``decision_window`` ticks (a decide disagrees
+      when its max-abs action delta vs the shadow exceeds
+      ``divergence_threshold``); the rate crossing
+      ``divergence_spike_rate`` from below stamps ONE
+      `policy_divergence` incident (edge-triggered, re-armed below
+      the bar). Rows append to ``decision_log_path`` ("" = in-memory
+      only; `ccka decisions` reads the file);
+      ``decisions_enabled=False`` skips the ledger while the rest of
+      the obs layer runs (the bench_decisions off-arm).
 
     ``enabled=False`` (the default, preset "off") is a hard gate in
-    the established idiom: no recorder, no triggers, no burn engine —
-    and the ENABLED path is proven bitwise non-interfering anyway
-    (paired recorder-on/recorder-off runs pin identical decisions and
-    patch streams, `tests/test_incidents.py`): all observation is
-    host-side, off the device hot path, after the tick's decisions.
+    the established idiom: no recorder, no triggers, no burn engine,
+    no decision ledger — and the ENABLED path is proven bitwise
+    non-interfering anyway (paired recorder-on/recorder-off runs pin
+    identical decisions and patch streams, `tests/test_incidents.py`):
+    all of THIS BLOCK's observation is host-side, after the tick's
+    decisions. The one deliberate exception to "off costs nothing":
+    the round-18 rule-shadow lanes are computed UNCONDITIONALLY by the
+    compiled batched ticks, in every posture including off — keying
+    them on any obs flag would make obs-on/obs-off runs compile
+    DIFFERENT XLA programs and put the round-14 recorder bitwise gate
+    at the compiler's mercy (the ~1-ulp separately-compiled-programs
+    hazard the streaming round measured). A few ms of elementwise
+    device work buys program identity across every posture;
+    ARCHITECTURE §20 carries the full cost accounting, and toggling
+    the ledger can therefore never select a different program —
+    non-interference by construction, re-proven bitwise per record
+    (`tests/test_decisions.py`).
     """
 
     enabled: bool = False
@@ -801,6 +829,23 @@ class ObsConfig:
     # Shed-rate spike trigger: a single tick shedding at least this
     # fraction of the fleet stamps a shed_spike incident.
     shed_spike_frac: float = 0.5
+    # Decision-provenance ledger (round 18, obs/decisions.py). The
+    # ledger is host-side recording ONLY — the shadow lanes ride the
+    # compiled tick whether or not it exists.
+    decisions_enabled: bool = True
+    # Per-tenant decision JSONL ("" = in-memory only; `ccka decisions
+    # list|show|explain` reads this file).
+    decision_log_path: str = ""
+    # Trailing ticks of the windowed shadow-disagreement rate behind
+    # ccka_policy_divergence_rate and the spike trigger.
+    decision_window: int = 16
+    # A decide "diverges" when max|chosen - rule_shadow| over the flat
+    # action exceeds this (action components are O(1): zone weights,
+    # ct allows, aggr in [0,1]; consolidate_after in tens of seconds).
+    divergence_threshold: float = 1e-6
+    # Windowed divergence rate crossing this from below stamps ONE
+    # policy_divergence incident (edge-triggered).
+    divergence_spike_rate: float = 0.5
 
     def validate(self) -> None:
         if self.ring_size < 1:
@@ -816,6 +861,12 @@ class ObsConfig:
             raise ConfigError("obs: burn_threshold out of (0, 1]")
         if not 0.0 < self.shed_spike_frac <= 1.0:
             raise ConfigError("obs: shed_spike_frac out of (0, 1]")
+        if self.decision_window < 1:
+            raise ConfigError("obs: decision_window must be >= 1 tick")
+        if self.divergence_threshold < 0.0:
+            raise ConfigError("obs: divergence_threshold must be >= 0")
+        if not 0.0 < self.divergence_spike_rate <= 1.0:
+            raise ConfigError("obs: divergence_spike_rate out of (0, 1]")
 
 
 # The flight-recorder postures (`bench.py bench_obs`, `ccka fleet

@@ -269,6 +269,7 @@ class Controller:
                  tracer=None,
                  interruption_feed=None,
                  incident_log=None,
+                 decision_ledger=None,
                  log_fn: Callable[[str], None] | None = None,
                  sleep_fn: Callable[[float], None] = time.sleep):
         self.cfg = cfg
@@ -339,6 +340,17 @@ class Controller:
         # reconciler's OWN hook (`actuation/reconcile.on_giveup`), at
         # the layer that defines "gave up".
         self.incident_log = incident_log
+        # Decision-provenance ledger (round 18, `obs/decisions.py`;
+        # None disables): one structured row per tick — the observed
+        # exo, the state estimate, the chosen action's objective terms
+        # and the RULE SHADOW stepped on the same inputs. Unlike the
+        # batched fleet/service ticks (where the shadow rides extra
+        # lanes of the one dispatch), the single-cluster loop pays two
+        # extra small dispatches per tick when a ledger is attached —
+        # noise against its 30s scrape cadence, and the REAL estimate
+        # path is untouched either way (same compiled step, same
+        # inputs), so attaching a ledger cannot steer a decision.
+        self.decision_ledger = decision_ledger
         self._obs_tick = 0
         # Regions may SHARE a reconciler (one per distinct sink), so
         # the give-up's region is stamped from the converge call site
@@ -768,6 +780,10 @@ class Controller:
         #    advances in the same fused step).
         with timer.stage("estimate") as sp_est:
             self.key, sub = jax.random.split(self.key)
+            state_pre = self.state
+            wl_state_pre = (self._wl_state if self._wl_steps is not None
+                            else None)
+            w = None
             if self._wl_steps is not None:
                 w = jax.tree.map(lambda x: x[t % self._wl_horizon],
                                  self._wl_steps)
@@ -785,6 +801,14 @@ class Controller:
                 metrics.inf_slo_violation)
             self.batch_deadline_misses_total += float(
                 metrics.batch_deadline_miss)
+
+        # 6a. decision provenance (round 18; no-op without a ledger):
+        #     the rule shadow stepped on the SAME pre-step state,
+        #     observed exo and key — strictly after this tick's real
+        #     decide/apply/estimate, so recording can never steer them.
+        if self.decision_ledger is not None:
+            self._observe_decision(t, action, exo, metrics, state_pre,
+                                   wl_state_pre, w, sub, stale)
 
         # 7. measured app-level SLO metrics, when the source scrapes them
         #    (live Prometheus p95/RPS/queue depth; {} for sources without
@@ -871,6 +895,65 @@ class Controller:
         if self.exporter is not None:
             self.exporter.update(report)
         return report
+
+    # -- decision provenance (round 18; obs/decisions.py) -------------------
+
+    def _observe_decision(self, t: int, action, exo, metrics, state_pre,
+                          wl_state_pre, w, sub, stale: bool) -> None:
+        """One ledger row: the chosen step's metrics vs the rule
+        shadow's on identical inputs (same pre-step state, same
+        observed exo, same key, same compiled step — no new compile).
+        The degraded machine maps onto the service's decision lanes:
+        ok→fresh, hold→hold, fallback→fallback (a fallback tick's
+        divergence is 0 by construction — the chosen action IS the
+        rule's)."""
+        lane = {"ok": "fresh", "hold": "hold",
+                "fallback": "fallback"}[self._degraded]
+        shadow_action = self._fallback_policy.decide(state_pre, exo,
+                                                     jnp.int32(t))
+        if self._wl_steps is not None:
+            _s, sh_metrics, _ws = self._step_wl(
+                state_pre, wl_state_pre, shadow_action, exo, w, sub)
+        else:
+            _s, sh_metrics = self._step(state_pre, shadow_action, exo,
+                                        sub)
+
+        def decomp(m) -> dict:
+            pend = np.maximum(np.asarray(m.demand_pods)
+                              - np.asarray(m.served_pods), 0.0)
+            return {"cost_usd": float(m.cost_usd),
+                    "carbon_g": float(m.carbon_g),
+                    "pend_c0": float(pend[0]),
+                    "pend_c1": float(pend[1]),
+                    "slo_ok": float(m.slo_ok)}
+
+        def flat(a) -> np.ndarray:
+            return np.concatenate(
+                [np.asarray(leaf, np.float64).reshape(-1) for leaf in a])
+
+        surfaces = self.decision_ledger.observe_single(
+            t, lane=lane, action=flat(action),
+            shadow_action=flat(shadow_action),
+            exo={
+                "spot_price_hr": float(
+                    np.asarray(exo.spot_price_hr).mean()),
+                "od_price_hr": float(np.asarray(exo.od_price_hr).mean()),
+                "carbon_g_kwh": float(
+                    np.asarray(exo.carbon_g_kwh).mean()),
+                "demand_pods": float(np.asarray(exo.demand_pods).sum()),
+                "is_peak": bool(float(exo.is_peak) > 0.5),
+                "stale": bool(stale),
+            },
+            state={"nodes_spot": float(metrics.nodes_by_ct[0]),
+                   "nodes_od": float(metrics.nodes_by_ct[1])},
+            chosen=decomp(metrics), shadow=decomp(sh_metrics))
+        # A windowed divergence spike is an incident here exactly as on
+        # the service path (the trigger vocabulary promises it without
+        # scoping to the fleet): one edge-triggered stamp, re-armed
+        # below the bar. No-op without an incident log.
+        spike = surfaces.get("spike")
+        if spike is not None and self.incident_log is not None:
+            self.incident_log.stamp("policy_divergence", t=t, **spike)
 
     # -- durable snapshot / resume (ARCHITECTURE §14) -----------------------
 

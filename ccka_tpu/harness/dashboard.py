@@ -96,6 +96,13 @@ _PANEL_DEFS = (
      "percentunit"),
     ("Kernel occupancy", "ccka_pipeline_occupancy", "percentunit"),
     ("Shard imbalance", "ccka_shard_imbalance", "short"),
+    # Decision-provenance panels (round 18; obs/decisions.py): how far
+    # the flagship departs from the rule shadow, which objective term
+    # is buying the decisions, and what the departure is projected to
+    # cost in SLO — the "why" next to the KPIs it explains.
+    ("Policy divergence", "ccka_policy_divergence_rate", "percentunit"),
+    ("Objective cost share", "ccka_objective_term_share", "percentunit"),
+    ("Shadow SLO delta", "ccka_shadow_slo_delta", "short"),
     # Workload-family panels (ccka_tpu/workloads): per-family queue
     # pressure and the session's SLO accounting, on the same board as
     # the fleet cost/SLO panels the families trade against.

@@ -77,7 +77,11 @@ class _Dispatched:
 
     t: int
     packed: jax.Array          # [N, A+1] actions ++ is_peak column
-    per_metrics: jax.Array     # [N, 4] slo_ok, cost, carbon, pending rows
+    # [N, W] per-cluster rows: slo_ok, cost, carbon, pending in the
+    # first four columns (the pre-round-18 block every consumer
+    # indexes), then the decision-provenance columns + rule-shadow
+    # action (`obs/decisions.decision_row_layout`).
+    per_metrics: jax.Array
     dispatch_ms: float
 
 
@@ -151,26 +155,54 @@ def _compiled_fleet_tick(cfg: FrameworkConfig, backend,
     the cache sound — `backend.action_fn()` mints a fresh closure per
     call and must therefore be resolved INSIDE the cached builder —
     while trace arrays move to arguments. Returns (packed [N, A+1],
-    new_states, per_metrics [N, 4]) — per-CLUSTER metric rows, so
-    callers that need per-tenant accounting (the service's bulkhead
-    isolation evidence) read them without a second transfer; fleet
-    aggregates are a host-side sum over the same rows."""
+    new_states, per_metrics [N, W]) — per-CLUSTER metric rows whose
+    FIRST FOUR columns are the pre-round-18 slo_ok/cost/carbon/pending
+    block (every existing consumer indexes those positions), followed
+    by the decision-provenance columns and the rule-shadow action
+    (`obs/decisions.decision_row_layout`): the rule profile evaluated
+    on the SAME states and observed exo inside the SAME dispatch, so
+    callers that need per-tenant accounting OR decision provenance
+    read both without a second transfer. The shadow lanes run
+    UNCONDITIONALLY — a ledger toggling on can never select a
+    different XLA program, which is what makes ledger-on/off bitwise
+    non-interference hold by construction. Fleet aggregates are a
+    host-side sum over the first four columns."""
     from ccka_tpu.obs.compile import watch_jit
+    from ccka_tpu.obs.decisions import shadow_decision_columns
+    from ccka_tpu.policy.rule import RulePolicy
 
     action_fn = backend.action_fn()
+    shadow_fn = RulePolicy(cfg.cluster).action_fn()
     params = SimParams.from_config(cfg)
 
     @jax.jit
     def fleet_tick(states, xs_all, t, key):
-        """One dispatch: slice exo, decide, estimate, pack per-cluster."""
+        """One dispatch: slice exo, decide (+ rule shadow), estimate
+        both, pack per-cluster."""
         exo_n = exo_at(xs_all, t, horizon_ticks)
         actions = jax.vmap(lambda s, e: action_fn(s, e, t))(states, exo_n)
+        shadow = jax.vmap(lambda s, e: shadow_fn(s, e, t))(states, exo_n)
         keys = jax.random.split(jax.random.fold_in(key, t), n)
         new_states, metrics = jax.vmap(
             partial(sim_step, params, stochastic=False)
         )(states, actions, exo_n, keys)
-        packed = pack_rows(flatten_actions(actions, n), exo_n)
-        return packed, new_states, per_cluster_metrics(metrics)
+        # Counterfactual one-step projection: same pre-step states,
+        # same exo, same keys — only the action differs. The shadow's
+        # next state is discarded (the real estimate chain must not
+        # fork); only its step metrics ride out.
+        _sh_states, sh_metrics = jax.vmap(
+            partial(sim_step, params, stochastic=False)
+        )(states, shadow, exo_n, keys)
+        flat = flatten_actions(actions, n)
+        flat_sh = flatten_actions(shadow, n)
+        packed = pack_rows(flat, exo_n)
+        per = jnp.concatenate([
+            per_cluster_metrics(metrics),
+            shadow_decision_columns(metrics, sh_metrics, exo_n,
+                                    flat, flat_sh),
+            flat_sh,
+        ], axis=-1)
+        return packed, new_states, per
 
     # Watched jit (obs/compile.py): the batched decide is THE fleet
     # hot path — one warmup compile is expected; any recompile after
@@ -197,7 +229,8 @@ class FleetController:
     def __init__(self, cfg: FrameworkConfig, backend: PolicyBackend,
                  source: SignalSource, sinks: Sequence[ActuationSink],
                  *, horizon_ticks: int = 2880, seed: int = 0,
-                 fanout_workers: int = 8, tracer=None,
+                 fanout_workers: int = 8, tracer=None, ledger=None,
+                 incident_log=None,
                  log_fn: Callable[[str], None] | None = None):
         from ccka_tpu.obs.trace import SpanTracer
         if not hasattr(source, "batch_trace_device"):
@@ -255,6 +288,18 @@ class FleetController:
         # (config, backend, N, horizon) shares ONE XLA program.
         self._tick_fn = _compiled_fleet_tick(cfg, backend, n,
                                              horizon_ticks)
+        # Decision-provenance ledger (obs/decisions.py; None disables
+        # recording — the shadow lanes ride the compiled tick either
+        # way, so attaching one later never triggers a recompile).
+        # Owned by the caller (the service closes its own). With an
+        # incident_log attached too, a windowed divergence spike
+        # stamps the same policy_divergence incident the service and
+        # single-cluster paths stamp — the spikes==incidents 1:1
+        # invariant must hold from every entry point.
+        self.ledger = ledger
+        self.incident_log = incident_log
+        from ccka_tpu.obs.decisions import decision_row_layout
+        self._dec_layout = decision_row_layout(cfg.cluster)
 
     def _fleet_tick(self, states, t, key):
         """The batched tick over this fleet's traces (kept as a bound
@@ -317,9 +362,22 @@ class FleetController:
         # on device work — near zero when pipelining hides the chain.
         with self.tracer.span("fleet.harvest", t=disp.t) as sp_h:
             packed = np.asarray(disp.packed)  # no-op if async copy landed
-            # Fleet aggregates are a host sum over the per-cluster rows
-            # (the rows themselves feed per-tenant accounting upstream).
-            agg = np.asarray(disp.per_metrics).sum(axis=0)
+            per_np = np.asarray(disp.per_metrics)
+            # Fleet aggregates are a host sum over the per-cluster
+            # KPI block (columns 0..3; the decision-provenance tail
+            # feeds the ledger, not the KPI line).
+            agg = per_np[:, :4].sum(axis=0)
+        # Decision provenance (round 18): host-side recording strictly
+        # AFTER the tick's decisions, before fan-out — the rows explain
+        # the patches about to go out. A bare fleet tick has no lane
+        # machinery, so every row records as the fresh lane.
+        if self.ledger is not None:
+            surfaces = self.ledger.observe_tick(disp.t, per_np, packed,
+                                                self._dec_layout)
+            spike = surfaces.get("spike")
+            if spike is not None and self.incident_log is not None:
+                self.incident_log.stamp("policy_divergence", t=disp.t,
+                                        **spike)
         with self.tracer.span("fleet.fanout", t=disp.t) as sp_f:
             applied = self._fanout(packed)
 

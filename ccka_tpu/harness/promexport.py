@@ -172,6 +172,26 @@ SERIES: dict[str, tuple[str, str]] = {
         "shard_imbalance",
         "Max/mean per-shard kernel time across the mesh "
         "(1.0 = perfectly balanced)"),
+    # Decision-provenance series (round 18; obs/decisions.py): the
+    # windowed shadow-disagreement rate, the cost-term share of the
+    # fleet's step-objective attribution (dotted term spec into the
+    # per-term share dict), and the tick's projected chosen-minus-
+    # rule-shadow SLO delta. Service-only, and skipped (never fake
+    # zeros) when the decision ledger is off.
+    "ccka_policy_divergence_rate": (
+        "policy_divergence_rate",
+        "Fraction of decides whose action departed from the rule "
+        "shadow beyond obs.divergence_threshold over the trailing "
+        "obs.decision_window ticks"),
+    "ccka_objective_term_share": (
+        "objective_term_shares.cost",
+        "Cost-term share of the fleet's per-tick objective "
+        "attribution (terms sum to 1; carbon/SLO shares ride the "
+        "same dict)"),
+    "ccka_shadow_slo_delta": (
+        "shadow_slo_delta",
+        "Chosen-minus-rule-shadow SLO-ok tenant count this tick "
+        "(projected on identical observed inputs)"),
     "ccka_applied": ("applied", "1 if every patch applied this tick"),
     "ccka_verified": ("verified", "1 if read-back matched intent"),
     "ccka_tick": ("t", "Controller tick counter"),
@@ -202,6 +222,8 @@ SERVICE_ONLY_SERIES = frozenset({
     "ccka_recorder_dumps_total",
     "ccka_program_dispatches_total", "ccka_achieved_roofline_fraction",
     "ccka_pipeline_occupancy", "ccka_shard_imbalance",
+    "ccka_policy_divergence_rate", "ccka_objective_term_share",
+    "ccka_shadow_slo_delta",
 })
 
 _LABEL = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")

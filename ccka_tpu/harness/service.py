@@ -245,8 +245,20 @@ def _compiled_service_tick(cfg: FrameworkConfig, backend,
     vector, with held actions supplied as an input buffer. One device
     round trip per tick regardless of how degraded the fleet is. Keyed
     on the backend INSTANCE (identity hash), so the overload board's
-    paired stressed/calm services share one XLA program."""
+    paired stressed/calm services share one XLA program.
+
+    Round 18: the per-cluster rows widen past the slo_ok/cost/carbon/
+    pending block with the decision-provenance columns and the rule
+    SHADOW action (`obs/decisions.decision_row_layout`): the fallback
+    lane's rule decide — already computed batched for the lane select
+    — doubles as the counterfactual, stepped through the same
+    expectation dynamics on the same pre-step states and observed exo.
+    Extra lanes on the existing dispatch, computed whether or not a
+    ledger reads them — toggling the ledger can never select a
+    different XLA program, which is the construction behind the
+    ledger-on/off bitwise gate."""
     from ccka_tpu.obs.compile import watch_jit
+    from ccka_tpu.obs.decisions import shadow_decision_columns
     from ccka_tpu.policy.rule import RulePolicy
 
     from ccka_tpu.harness.fleet import (exo_at, flatten_actions,
@@ -270,18 +282,30 @@ def _compiled_service_tick(cfg: FrameworkConfig, backend,
         exo_n = exo_at(xs_all, t, horizon_ticks)
         fresh = jax.vmap(lambda s, e: action_fn(s, e, t))(states, exo_n)
         fb = jax.vmap(lambda s, e: fallback_fn(s, e, t))(states, exo_n)
+        flat_fb = flatten_actions(fb, n)
         lane_col = lanes[:, None]
         flat_sel = jnp.where(
             lane_col == LANE_FRESH, flatten_actions(fresh, n),
-            jnp.where(lane_col == LANE_HOLD, held,
-                      flatten_actions(fb, n)))
+            jnp.where(lane_col == LANE_HOLD, held, flat_fb))
         actions = _unflatten(flat_sel)
         keys = jax.random.split(jax.random.fold_in(key, t), n)
         new_states, metrics = jax.vmap(
             functools.partial(sim_step, params, stochastic=False)
         )(states, actions, exo_n, keys)
+        # Rule-shadow counterfactual: same pre-step states, exo and
+        # keys; only the action differs. Shadow next-states are
+        # discarded — the real estimate chain must not fork.
+        _sh_states, sh_metrics = jax.vmap(
+            functools.partial(sim_step, params, stochastic=False)
+        )(states, _unflatten(flat_fb), exo_n, keys)
         packed = pack_rows(flat_sel, exo_n)
-        return packed, new_states, per_cluster_metrics(metrics)
+        per = jnp.concatenate([
+            per_cluster_metrics(metrics),
+            shadow_decision_columns(metrics, sh_metrics, exo_n,
+                                    flat_sel, flat_fb),
+            flat_fb,
+        ], axis=-1)
+        return packed, new_states, per
 
     return watch_jit(service_tick, "service.tick", hot=True,
                      shared_stats=True)
@@ -336,6 +360,16 @@ class ServiceTickReport:
     achieved_roofline_fraction: "float | None" = None
     pipeline_occupancy: dict = dataclasses.field(default_factory=dict)
     shard_imbalance: "float | None" = None
+    # Decision-provenance surfaces (round 18; obs/decisions.py): the
+    # windowed shadow-disagreement rate, the fleet's objective-term
+    # attribution shares (promexport reads the dotted "cost" share),
+    # and the tick's projected chosen-minus-rule-shadow SLO/$ deltas.
+    # None/{} when the ledger is off — the exporter SKIPS the series
+    # (never-fake-zeros), same as the perf surfaces above.
+    policy_divergence_rate: "float | None" = None
+    objective_term_shares: dict = dataclasses.field(default_factory=dict)
+    shadow_slo_delta: "float | None" = None
+    shadow_usd_delta: "float | None" = None
 
 
 class FleetService:
@@ -463,6 +497,7 @@ class FleetService:
         self.recorder = None
         self.incidents = None
         self.burn = None
+        self.decisions = None
         if ob.enabled:
             from ccka_tpu.obs.burnrate import BurnRateEngine
             from ccka_tpu.obs.incidents import IncidentLog
@@ -484,6 +519,19 @@ class FleetService:
             self._giveups_this_tick: list[int] = []
             for i, rec in enumerate(self._reconcilers):
                 rec.on_giveup = functools.partial(self._note_giveup, i)
+            # Decision-provenance ledger (round 18, obs/decisions.py):
+            # host-side recording of the shadow lanes the compiled
+            # tick already emits. Disabled-but-obs-on is the
+            # bench_decisions off-arm — the device program is the
+            # same either way.
+            if ob.decisions_enabled:
+                from ccka_tpu.obs.decisions import (DecisionLedger,
+                                                    decision_row_layout)
+                self.decisions = DecisionLedger(
+                    ob, cfg.train,
+                    policy=getattr(backend, "name",
+                                   type(backend).__name__))
+                self._dec_layout = decision_row_layout(cfg.cluster)
 
     def _note_giveup(self, tenant: int, _outcome) -> None:
         """Reconciler give-up hook (`actuation/reconcile.on_giveup`):
@@ -500,6 +548,8 @@ class FleetService:
     def close(self) -> None:
         if getattr(self, "incidents", None) is not None:
             self.incidents.close()
+        if getattr(self, "decisions", None) is not None:
+            self.decisions.close()
         self.ctrl.close()
 
     def warmup(self) -> None:
@@ -727,16 +777,20 @@ class FleetService:
             #     instead of hiding between ticks.
             slo_burn = slo_burn_slow = 0.0
             incident_active = 0
+            dec = None
             if self.burn is not None:
-                slo_burn, slo_burn_slow, incident_active = \
+                slo_burn, slo_burn_slow, incident_active, dec = \
                     self._observe_tick(t, t0, lanes, shed, scraped_ok,
-                                       per_np, applied,
+                                       per_np, packed_np, applied,
                                        deadline if has_deadline
                                        else None)
 
             latency_ms = (self.clock() - t0) * 1e3
         self.latencies_ms.append(latency_ms)
-        agg = per_np.sum(axis=0)
+        # KPI aggregates come from the base metric block only — the
+        # round-18 decision-provenance tail (shadow metrics + shadow
+        # actions) must never leak into fleet sums (fleet.py idiom).
+        agg = per_np[:, :4].sum(axis=0)
         dt_hr = float(self.ctrl.params.dt_s) / 3600.0
         report = ServiceTickReport(
             t=t,
@@ -772,6 +826,12 @@ class FleetService:
                              if self.incidents is not None else 0),
             recorder_dumps_total=(self.recorder.dumps_total
                                   if self.recorder is not None else 0),
+            policy_divergence_rate=(dec or {}).get(
+                "policy_divergence_rate"),
+            objective_term_shares=(dec or {}).get(
+                "objective_term_shares") or {},
+            shadow_slo_delta=(dec or {}).get("shadow_slo_delta"),
+            shadow_usd_delta=(dec or {}).get("shadow_usd_delta"),
             **self._perf_surfaces(),
         )
         self.log_fn(
@@ -799,15 +859,17 @@ class FleetService:
         }
 
     def _observe_tick(self, t: int, t0: float, lanes, shed: int,
-                      scraped_ok, per_np, applied: int,
+                      scraped_ok, per_np, packed_np, applied: int,
                       deadline: "float | None"):
         """The tick's obs pass: update burn windows, append ring rows,
-        stamp one incident per trigger occurrence (breaker open, lane
-        escalation, reconcile give-up, deadline overshoot, shed spike)
-        and return the (fast burn, slow burn, incident_active) report
-        surfaces. Every value recorded is a native host scalar — the
-        recorder must never force a device transfer, and the dump codec
-        (canonical JSON) would refuse numpy scalars anyway."""
+        record the decision ledger's rows, stamp one incident per
+        trigger occurrence (breaker open, lane escalation, reconcile
+        give-up, deadline overshoot, shed spike, divergence spike) and
+        return the (fast burn, slow burn, incident_active, decision
+        surfaces) report tuple. Every value recorded is a native host
+        scalar — the recorder must never force a device transfer, and
+        the dump codec (canonical JSON) would refuse numpy scalars
+        anyway."""
         ob = self.obs
         n = self.n
         lat_pre_ms = (self.clock() - t0) * 1e3
@@ -867,13 +929,27 @@ class FleetService:
             self.incidents.stamp("shed_spike", t=t, shed=int(shed),
                                  n_tenants=n)
 
+        # Decision provenance (round 18): record every tenant's row
+        # from the shadow lanes the dispatch already computed; an
+        # edge-triggered divergence spike stamps ONE policy_divergence
+        # incident carrying its flight-recorder dump like every other
+        # trigger. Host floats only — same budget discipline as the
+        # recorder rows above.
+        dec = None
+        if self.decisions is not None:
+            dec = self.decisions.observe_tick(
+                t, per_np, packed_np, self._dec_layout, lanes=lanes)
+            spike = dec.pop("spike", None)
+            if spike is not None:
+                self.incidents.stamp("policy_divergence", t=t, **spike)
+
         slo_burn = self.burn.rate("slo", "fast")
         slo_burn_slow = self.burn.rate("slo", "slow")
         last = self.incidents.last_tick()
         incident_active = int(
             self.burn.any_burning
             or (last is not None and t - last < ob.burn_fast_window))
-        return slo_burn, slo_burn_slow, incident_active
+        return slo_burn, slo_burn_slow, incident_active, dec
 
     def run(self, ticks: int, start_tick: int = 0) -> list:
         """Sequential bounded ticks (the deadline is a per-tick host

@@ -29,6 +29,11 @@ configured a metrics fabric for but never applied to itself):
 - `obs.occupancy` — the pipeline occupancy ledger: fenced per-stage
   (generation/kernel/host) and per-shard timings for the packed
   megakernel pipeline, with the max/mean shard-imbalance metric.
+- `obs.decisions` — decision provenance (round 18): per-tick
+  objective-term attribution, the batched rule-shadow counterfactual
+  riding extra lanes of the one compiled tick, windowed divergence
+  drift gauges, and the `policy_divergence` incident trigger behind
+  `ccka decisions list|show|explain`.
 """
 
 from ccka_tpu.obs.bench_history import (  # noqa: F401
@@ -63,6 +68,17 @@ from ccka_tpu.obs.occupancy import (  # noqa: F401
     measure_packed_pipeline,
     measure_shard_times,
     shard_imbalance,
+)
+from ccka_tpu.obs.decisions import (  # noqa: F401
+    DECISION_COLS,
+    TERM_NAMES,
+    DecisionLedger,
+    decision_row_layout,
+    explain_row,
+    objective_terms,
+    read_decisions,
+    shadow_decision_columns,
+    term_shares,
 )
 from ccka_tpu.obs.incidents import (  # noqa: F401
     TRIGGERS,

@@ -28,7 +28,12 @@ Two regression classes:
   shard imbalance >= 1, observatory-on/off bitwise, measurement
   overhead within ``max_perf_overhead`` — and a PARTIAL perf record,
   one missing a declared mode's occupancy or attribution, is itself a
-  regression), and the lane budget (the round's BEST complete run
+  regression), the round-18 decision-provenance invariants
+  (ledger-on/off bitwise, ledger overhead within the same 5%-of-p50
+  bound, objective-term shares summing to ~1 on every recorded row
+  within ``max_share_err``, policy_divergence incidents attributable
+  1:1 to verified dumps — partial decision records are regressions),
+  and the lane budget (the round's BEST complete run
   must be under `tests/conftest._LANE_BUDGET_S` — single noisy
   re-runs don't fail the gate, a round that cannot get under it
   does.)
@@ -201,6 +206,12 @@ def _extract_metrics(doc: dict) -> dict:
         out.update(_extract_factory(fac,
                                     full_stage=doc.get("stage")
                                     == "--factory-only"))
+    # Round-18 decision-provenance stage (stage record or nested
+    # "decisions").
+    dec = (doc if doc.get("stage") == "--decisions-only"
+           else doc.get("decisions"))
+    if isinstance(dec, dict):
+        out.update(_extract_decisions(dec))
     return out
 
 
@@ -423,6 +434,49 @@ def _extract_factory(fac: dict, *, full_stage: bool) -> dict:
     return out
 
 
+def _extract_decisions(dec: dict) -> dict:
+    """The round-18 decision-provenance invariants a record states
+    about itself (ISSUE 15 satellite): ledger-on/off runs bitwise in
+    decisions AND patch streams, the ledger priced within the 5%-of-
+    p50 budget, attribution shares summing to ~1 on every recorded
+    row, and every policy_divergence incident attributable 1:1 to a
+    checksum-verified dump. A PARTIAL record — a missing bitwise flag,
+    a missing share-error field, no recorded rows, an unverified
+    divergence dump — is itself a regression: the gate keys on what
+    the record STATES, so a record that silently dropped a claim must
+    read as degraded, not green (the factory/perf discipline)."""
+    out: dict = {"decisions_partial": []}
+    if dec.get("bitwise_identical") is None:
+        out["decisions_partial"].append(
+            "missing the ledger-on/off bitwise_identical flag")
+    else:
+        out["decisions_bitwise"] = bool(dec["bitwise_identical"])
+    if dec.get("ledger_overhead_frac") is None:
+        out["decisions_partial"].append(
+            "missing ledger_overhead_frac")
+    else:
+        out["decisions_overhead_frac"] = float(
+            dec["ledger_overhead_frac"])
+    if dec.get("term_share_err_max") is None:
+        out["decisions_partial"].append("missing term_share_err_max")
+    else:
+        out["decisions_share_err"] = float(dec["term_share_err_max"])
+    if not dec.get("rows_total"):
+        out["decisions_partial"].append(
+            "no decision rows recorded — the ledger measured nothing")
+    inc = dec.get("divergence_incidents")
+    verified = dec.get("divergence_dumps_verified")
+    if inc is None or verified is None:
+        out["decisions_partial"].append(
+            "missing the policy_divergence attribution section")
+    else:
+        out["decisions_divergence_incidents"] = int(inc)
+        out["decisions_divergence_dumps_ok"] = bool(
+            int(inc) >= 1 and int(verified) == int(inc)
+            and not dec.get("divergence_dump_failures"))
+    return out
+
+
 # A single-core virtual host cannot overlap generation with the kernel
 # (there is no second core to run it on): its pipelined drive is held
 # to this non-regression floor instead of the >= 1.0 overlap gate.
@@ -442,7 +496,8 @@ def bench_diff(history: dict, *,
                max_recorder_overhead: float = 0.05,
                max_achieved_fraction: float = 1.25,
                max_occupancy_sum_err: float = 0.02,
-               max_perf_overhead: float = 0.05) -> dict:
+               max_perf_overhead: float = 0.05,
+               max_share_err: float = 0.02) -> dict:
     """Diff the history; returns {"comparisons": [...], "regressions":
     [...], "ok": bool}. Empty regressions = exit 0 for the CLI.
 
@@ -675,6 +730,44 @@ def bench_diff(history: dict, *,
                 "detail": "student-vs-teacher $/SLO-hr ratio outside "
                           "the plausible band — broken pairing or a "
                           "corrupt record"})
+        # Round-18 decision-provenance invariants (ISSUE 15): the
+        # ledger must neither steer (bitwise) nor overspend (5% of
+        # p50), attribution must account for the whole objective on
+        # every row, and a divergence spike must be attributable to
+        # its checksummed dump. Partial records are regressions.
+        for what in rec.get("decisions_partial", []):
+            regressions.append({
+                "kind": "decisions_invariant", "round": rnd,
+                "detail": f"partial decision record: {what}"})
+        if rec.get("decisions_bitwise") is False:
+            regressions.append({
+                "kind": "decisions_invariant", "round": rnd,
+                "detail": "ledger-on/off decision+patch streams no "
+                          "longer bitwise identical"})
+        if rec.get("decisions_overhead_frac", 0.0) \
+                > max_recorder_overhead:
+            regressions.append({
+                "kind": "decisions_invariant", "round": rnd,
+                "value": rec["decisions_overhead_frac"],
+                "threshold": max_recorder_overhead,
+                "detail": "decision-ledger overhead exceeded the "
+                          "5%-of-p50 bound"})
+        if rec.get("decisions_share_err", 0.0) > max_share_err:
+            regressions.append({
+                "kind": "decisions_invariant", "round": rnd,
+                "value": rec["decisions_share_err"],
+                "threshold": max_share_err,
+                "detail": "objective-term shares no longer sum to ~1 "
+                          "on every recorded row — a term went "
+                          "unattributed or the record is corrupt"})
+        if rec.get("decisions_divergence_dumps_ok") is False:
+            regressions.append({
+                "kind": "decisions_invariant", "round": rnd,
+                "value": rec.get("decisions_divergence_incidents"),
+                "detail": "policy_divergence incidents no longer "
+                          "attributable 1:1 to verified recorder "
+                          "dumps (or none fired on the divergent "
+                          "backend)"})
     return {"comparisons": comparisons, "regressions": regressions,
             "ok": not regressions}
 

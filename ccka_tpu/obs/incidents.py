@@ -48,6 +48,13 @@ TRIGGERS: dict[str, str] = {
                           "tick_deadline_ms",
     "shed_spike": "one tick shed at least obs.shed_spike_frac of the "
                   "fleet's decides",
+    "policy_divergence": "the decision ledger's windowed shadow-"
+                         "disagreement rate (fraction of decides whose "
+                         "action departs from the rule shadow by more "
+                         "than obs.divergence_threshold over the "
+                         "trailing obs.decision_window ticks) crossed "
+                         "obs.divergence_spike_rate from below "
+                         "(edge-triggered, re-armed below the bar)",
 }
 
 

@@ -55,6 +55,11 @@ RUNLOG_EVENTS = frozenset({
     # the name cannot be claimed by an unrelated schema in the
     # meantime.
     "incident",
+    # RESERVED the same way for decision-provenance rows (round 18):
+    # `obs/decisions.py`'s DecisionLedger writes its own JSONL (with
+    # t/tenant/lane/objective/shadow keys) directly; the name is
+    # parked here so a future RunLog mirror cannot fork the schema.
+    "decision",
 })
 
 

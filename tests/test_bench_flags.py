@@ -183,6 +183,26 @@ def test_overload_only_flag_and_stage_wiring():
     assert "overload_scoreboard" in src
 
 
+def test_decisions_only_flag_and_stage_wiring():
+    """Round 18: the decision-provenance ledger has a record path
+    (`--decisions-only`) and the main sweep carries the stage —
+    argparse contract only (the ledger itself is exercised in
+    tests/test_decisions.py and the BENCH_r18 record)."""
+    parser_src = open(bench.__file__, encoding="utf-8").read()
+    assert "--decisions-only" in parser_src
+    assert "bench_decisions" in parser_src
+    import inspect
+
+    src = inspect.getsource(bench.bench_decisions)
+    # The stage drives the SAME service + ledger the tests pin (one
+    # implementation), pairs ledger-on/off via obs.decisions_enabled,
+    # and runs the flagship against the rule shadow.
+    assert "fleet_service_from_config" in src
+    assert "decisions_enabled" in src
+    assert "load_flagship_backend" in src
+    assert "verify_dump" in src
+
+
 def test_perf_only_flag_and_stage_wiring():
     """Round 15: the device-time observatory has a record path
     (`--perf-only`, with `--perf-mesh-only` as its virtual-mesh child)

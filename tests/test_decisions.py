@@ -1,0 +1,633 @@
+"""Decision provenance observatory (round 18, `obs/decisions.py`).
+
+The contracts pinned here:
+
+- **objective attribution**: per-term decomposition matches
+  `train/objective.step_cost` arithmetic, shares sum to 1 on every
+  recorded row, and the per-class split accounts for the pending term;
+- **shadow pairing** (ISSUE 15 satellite): the rule shadow row riding
+  the compiled tick is BITWISE a standalone rule evaluation on the
+  same pre-step states and observed exo, and a rule-backend service's
+  fresh decides are bitwise their own shadow (divergence exactly 0);
+- **ledger-on/off bitwise non-interference** under seeded ChaosSink +
+  slow-tenant chaos: decisions AND patch streams identical, while the
+  on-run genuinely records divergent rows;
+- **divergence-incident attribution**: the edge-triggered
+  `policy_divergence` trigger stamps exactly one incident per windowed
+  spike, each attributable 1:1 to a checksum-verified recorder dump;
+- **CLI + bench-diff gates**: `ccka decisions list|show|explain`, and
+  the decision invariant gates (injected bad record exits 1, real
+  history stays clean).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import (OBS_PRESETS, SERVICE_PRESETS, ConfigError,
+                             ObsConfig, default_config)
+from ccka_tpu.harness.service import (VirtualClock,
+                                      fleet_service_from_config)
+from ccka_tpu.obs.decisions import (DECISION_COLS, LANE_NAMES,
+                                    TERM_NAMES, DecisionLedger,
+                                    action_dim, decision_row_layout,
+                                    explain_row, flat_action_names,
+                                    objective_terms, read_decisions,
+                                    term_shares)
+from ccka_tpu.obs.recorder import verify_dump
+from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config().with_overrides(**{"sim.horizon_steps": 16})
+
+
+@pytest.fixture(scope="module")
+def rule(cfg):
+    # ONE backend instance module-wide: the service-tick compile cache
+    # keys on it (the test_service idiom).
+    return RulePolicy(cfg.cluster)
+
+
+@pytest.fixture(scope="module")
+def carbon(cfg):
+    return CarbonAwarePolicy(cfg.cluster)
+
+
+def det_clock() -> VirtualClock:
+    state = {"s": 0.0}
+
+    def base():
+        state["s"] += 1e-4
+        return state["s"]
+    return VirtualClock(base=base)
+
+
+def _obs(tmp_path=None, **kw) -> ObsConfig:
+    base = dict(enabled=True)
+    if tmp_path is not None:
+        base.update(dump_dir=str(tmp_path / "dumps"),
+                    incident_log_path=str(tmp_path / "incidents.jsonl"),
+                    decision_log_path=str(tmp_path / "decisions.jsonl"))
+    base.update(kw)
+    return ObsConfig(**base)
+
+
+class TestDecomposition:
+    def test_terms_match_step_cost_and_shares_sum_to_one(self, cfg):
+        """The decomposition IS step_cost: summed terms equal the
+        scalarization for the same inputs, shares sum to 1, and the
+        per-class split accounts for the whole pending term."""
+        from ccka_tpu.sim.types import StepMetrics
+
+        tcfg = cfg.train
+        terms, by_class = objective_terms(
+            tcfg, cost_usd=0.5, carbon_g=100.0, pend_c0=3.0,
+            pend_c1=1.0, slo_ok=0.0)
+        # Against the canonical scalarization on a minimal metrics row.
+        fields = {f: jnp.zeros(()) for f in StepMetrics._fields}
+        fields.update(cost_usd=jnp.float32(0.5),
+                      carbon_g=jnp.float32(100.0),
+                      demand_pods=jnp.asarray([4.0, 2.0], jnp.float32),
+                      served_pods=jnp.asarray([1.0, 1.0], jnp.float32),
+                      slo_ok=jnp.float32(0.0))
+        from ccka_tpu.train.objective import step_cost
+        j = float(step_cost(StepMetrics(**fields), tcfg))
+        assert sum(terms.values()) == pytest.approx(j, rel=1e-6)
+        shares = term_shares(terms)
+        assert set(shares) == set(TERM_NAMES)
+        assert sum(shares.values()) == pytest.approx(1.0, abs=1e-12)
+        assert sum(by_class.values()) == pytest.approx(
+            terms["slo_pending"], rel=1e-12)
+
+    def test_zero_objective_yields_no_fake_shares(self):
+        assert term_shares({k: 0.0 for k in TERM_NAMES}) == {}
+
+    def test_layout_and_action_names_consistent(self, cfg):
+        lay = decision_row_layout(cfg.cluster)
+        a = action_dim(cfg.cluster)
+        assert lay.a_dim == a == len(flat_action_names(cfg.cluster))
+        assert lay.cols == slice(4, 4 + len(DECISION_COLS))
+        assert lay.shadow_action.stop == lay.width \
+            == 4 + len(DECISION_COLS) + a
+        assert lay.col("div_max_abs") \
+            == 4 + DECISION_COLS.index("div_max_abs")
+
+    def test_obs_config_validation(self):
+        with pytest.raises(ConfigError, match="decision_window"):
+            ObsConfig(decision_window=0).validate()
+        with pytest.raises(ConfigError, match="divergence_spike_rate"):
+            ObsConfig(divergence_spike_rate=0.0).validate()
+        with pytest.raises(ConfigError, match="divergence_threshold"):
+            ObsConfig(divergence_threshold=-1.0).validate()
+        # The shipped default posture records decisions.
+        assert OBS_PRESETS["default"].decisions_enabled is True
+
+
+def _run_service(cfg, backend, n, obs, *, ticks=8, seed=11,
+                 profiles=None, capture_states=False):
+    svc = fleet_service_from_config(
+        cfg, backend, n,
+        profiles=profiles or ["healthy"] * n,
+        service=SERVICE_PRESETS["default"], obs=obs,
+        horizon_ticks=16, seed=seed, clock=det_clock())
+    svc.warmup()
+    states_pre = []
+    for t in range(ticks):
+        if capture_states:
+            states_pre.append(jax.tree.map(np.asarray, svc.ctrl.states))
+        svc.tick(t)
+    return svc, states_pre
+
+
+class TestShadowPairing:
+    """The counterfactual is real: the shadow rows ARE the rule on the
+    same observed inputs, bitwise."""
+
+    def test_shadow_rows_bitwise_equal_standalone_rule(self, cfg,
+                                                       carbon):
+        """For every recorded tick, the ledger's shadow action rows
+        must be BITWISE a standalone vmapped rule evaluation on the
+        same pre-step states and the same observed exo slice — the
+        in-dispatch lanes add provenance, never a different
+        counterfactual."""
+        from ccka_tpu.harness.fleet import exo_at, flatten_actions
+
+        svc, states_pre = _run_service(cfg, carbon, 3, _obs(),
+                                       ticks=4, capture_states=True)
+        rule_fn = RulePolicy(cfg.cluster).action_fn()
+        rows = list(svc.decisions.rows)
+        assert len(rows) == 4 * 3
+        for t in range(4):
+            exo_n = jax.tree.map(
+                np.asarray, exo_at(svc.ctrl._xs_all, jnp.int32(t), 16))
+            states_t = jax.tree.map(jnp.asarray, states_pre[t])
+            expect = np.asarray(flatten_actions(
+                jax.vmap(lambda s, e: rule_fn(s, e, jnp.int32(t)))(
+                    states_t, jax.tree.map(jnp.asarray, exo_n)), 3))
+            for i in range(3):
+                row = next(r for r in rows
+                           if r["t"] == t and r["tenant"] == i)
+                got = np.asarray(row["shadow"]["action"], np.float32)
+                np.testing.assert_array_equal(got, expect[i])
+                # And the observed exo on the row is the slice the
+                # policy saw (zone-mean/summed, exactly).
+                assert row["exo"]["is_peak"] == bool(
+                    float(exo_n.is_peak[i]) > 0.5)
+                assert row["exo"]["demand_pods"] == pytest.approx(
+                    float(np.asarray(exo_n.demand_pods[i]).sum()),
+                    rel=1e-6)
+        svc.close()
+
+    def test_rule_backend_fresh_rows_are_their_own_shadow(self, cfg,
+                                                          rule):
+        """Chosen == rule on every fresh lane: divergence exactly 0 and
+        the shadow step's metrics bitwise the chosen step's (same
+        program, same inputs — the pairing gate's other side)."""
+        svc, _ = _run_service(cfg, rule, 3, _obs(), ticks=6)
+        rows = list(svc.decisions.rows)
+        assert rows and all(r["lane"] == "fresh" for r in rows)
+        for r in rows:
+            assert r["shadow"]["div_max_abs"] == 0.0
+            assert r["shadow"]["div_l2"] == 0.0
+            assert r["shadow"]["diverged"] is False
+            assert r["shadow"]["action"] == r["action"]
+            assert r["shadow"]["objective"]["terms"] \
+                == r["objective"]["terms"]
+            assert r["shadow"]["usd_delta"] == 0.0
+            assert r["shadow"]["slo_delta"] == 0.0
+        assert svc.decisions.diverged_total == 0
+        assert svc.decisions.spikes_total == 0
+        assert svc.incidents.counts().get("policy_divergence", 0) == 0
+        svc.close()
+
+    def test_carbon_backend_genuinely_diverges(self, cfg, carbon):
+        svc, _ = _run_service(cfg, carbon, 3, _obs(), ticks=6)
+        rows = list(svc.decisions.rows)
+        assert any(r["shadow"]["diverged"] for r in rows)
+        assert svc.decisions.diverged_total > 0
+        svc.close()
+
+    def test_every_row_shares_sum_to_one(self, cfg, carbon):
+        svc, _ = _run_service(cfg, carbon, 3, _obs(), ticks=6)
+        for r in svc.decisions.rows:
+            for side in (r["objective"], r["shadow"]["objective"]):
+                assert sum(side["shares"].values()) \
+                    == pytest.approx(1.0, abs=1e-9)
+                assert set(side["shares"]) == set(TERM_NAMES)
+                assert side["total"] > 0.0
+        svc.close()
+
+
+class TestNonInterference:
+    """Ledger-on vs ledger-off over one seeded world (chaos + slow
+    tenants, deterministic clock): decisions and patch streams bitwise
+    identical — the shadow lanes ride the tick either way."""
+
+    def _run(self, cfg, backend, decisions_enabled, tmp_path=None):
+        obs = _obs(tmp_path, decisions_enabled=decisions_enabled) \
+            if tmp_path is not None \
+            else ObsConfig(enabled=True,
+                           decisions_enabled=decisions_enabled)
+        svc = fleet_service_from_config(
+            cfg, backend, 5,
+            profiles=["healthy"] * 3 + ["slow", "flaky"],
+            service=SERVICE_PRESETS["default"], obs=obs,
+            horizon_ticks=16, seed=11, clock=det_clock())
+        svc.warmup()
+        svc.run(10)
+        out = {
+            "usd": svc.tenant_usd_per_slo_hr().copy(),
+            "slo": svc.tenant_slo_ticks.copy(),
+            "fresh": svc.tenant_fresh_ticks.copy(),
+            "commands": [[(c.name, c.patch_type, json.dumps(
+                c.patch, sort_keys=True))
+                for c in getattr(s, "inner", s).commands]
+                for s in svc.sinks],
+            "rows": (svc.decisions.rows_total
+                     if svc.decisions is not None else 0),
+            "diverged": (svc.decisions.diverged_total
+                         if svc.decisions is not None else 0),
+        }
+        svc.close()
+        return out
+
+    def test_ledger_on_off_bitwise_identical(self, cfg, carbon,
+                                             tmp_path):
+        off = self._run(cfg, carbon, False)
+        on = self._run(cfg, carbon, True, tmp_path)
+        np.testing.assert_array_equal(off["usd"], on["usd"])
+        np.testing.assert_array_equal(off["slo"], on["slo"])
+        np.testing.assert_array_equal(off["fresh"], on["fresh"])
+        assert off["commands"] == on["commands"]
+        # Non-vacuous both ways: the off-arm built no ledger, the
+        # on-arm recorded genuinely divergent rows while changing
+        # nothing.
+        assert off["rows"] == 0
+        assert on["rows"] > 0 and on["diverged"] > 0
+
+    def test_decisions_off_builds_no_ledger(self, cfg, rule):
+        svc = fleet_service_from_config(
+            cfg, rule, 2, service=SERVICE_PRESETS["default"],
+            obs=ObsConfig(enabled=True, decisions_enabled=False),
+            horizon_ticks=16, seed=1)
+        assert svc.decisions is None
+        rep = svc.tick(0)
+        assert rep.policy_divergence_rate is None
+        assert rep.objective_term_shares == {}
+        assert rep.shadow_slo_delta is None
+        svc.close()
+
+
+class TestDivergenceIncident:
+    """ISSUE 15: the policy_divergence trigger is edge-triggered, 1:1
+    dump-attributable, and wired through the report gauges."""
+
+    @pytest.fixture(scope="class")
+    def div_run(self, cfg, carbon, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("divergence")
+        svc, _ = _run_service(cfg, carbon, 4, _obs(tmp), ticks=8,
+                              profiles=["healthy"] * 3 + ["slow"])
+        yield svc
+        svc.close()
+
+    def test_exactly_one_stamp_per_spike(self, div_run):
+        svc = div_run
+        counts = svc.incidents.counts()
+        assert svc.decisions.spikes_total >= 1
+        assert counts.get("policy_divergence", 0) \
+            == svc.decisions.spikes_total
+        # Carbon diverges every tick, so the windowed rate crosses the
+        # bar ONCE and stays above it — edge-triggering means exactly
+        # one stamp, not one per tick.
+        assert counts["policy_divergence"] == 1
+
+    def test_each_incident_attributable_to_verified_dump(self,
+                                                         div_run):
+        svc = div_run
+        pd = [i for i in svc.incidents.incidents
+              if i.trigger == "policy_divergence"]
+        assert pd
+        for inc in pd:
+            assert inc.dump_path is not None
+            body = verify_dump(inc.dump_path)
+            assert body["t"] == inc.t
+            assert inc.details["rate"] >= inc.details["threshold"]
+            assert inc.details["window_ticks"] >= 1
+
+    def test_report_surfaces_honest(self, div_run):
+        svc = div_run
+        rep = svc.tick(8)
+        assert 0.0 < rep.policy_divergence_rate <= 1.0
+        assert sum(rep.objective_term_shares.values()) \
+            == pytest.approx(1.0, abs=1e-5)
+        assert rep.shadow_slo_delta is not None
+        assert rep.shadow_usd_delta is not None
+
+    def test_ledger_jsonl_roundtrips(self, div_run):
+        svc = div_run
+        rows = read_decisions(svc.obs.decision_log_path)
+        assert len(rows) == svc.decisions.rows_total
+        assert rows[0]["t"] == 0 and "shadow" in rows[0]
+
+
+class TestControllerLedger:
+    def test_controller_records_rows_with_shadow(self, cfg):
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        led = DecisionLedger(ObsConfig(enabled=True), cfg.train,
+                             policy="carbon")
+        ctrl = Controller(cfg, CarbonAwarePolicy(cfg.cluster), src,
+                          DryRunSink(), interval_s=0.0,
+                          decision_ledger=led, log_fn=lambda _l: None)
+        ctrl.run(ticks=3)
+        ctrl.close()
+        assert led.rows_total == 3
+        rows = list(led.rows)
+        assert all(r["lane"] == "fresh" and r["tenant"] is None
+                   for r in rows)
+        assert all(r["shadow"]["diverged"] for r in rows)
+        for r in rows:
+            assert sum(r["objective"]["shares"].values()) \
+                == pytest.approx(1.0, abs=1e-9)
+            assert r["exo"]["stale"] is False
+
+    def test_fallback_lane_divergence_is_zero(self, cfg):
+        """A degraded-fallback tick's chosen action IS the rule — the
+        row must say lane=fallback, divergence 0."""
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        class StaleSource(SyntheticSignalSource):
+            last_scrape_stale = True
+
+        src = StaleSource(cfg.cluster, cfg.workload, cfg.sim,
+                          cfg.signals)
+        led = DecisionLedger(ObsConfig(enabled=True), cfg.train,
+                             policy="carbon")
+        ctrl = Controller(cfg, CarbonAwarePolicy(cfg.cluster), src,
+                          DryRunSink(), interval_s=0.0,
+                          degraded_fallback_after=1,
+                          decision_ledger=led, log_fn=lambda _l: None)
+        ctrl.run(ticks=2)
+        ctrl.close()
+        rows = list(led.rows)
+        assert all(r["lane"] == "fallback" for r in rows)
+        assert all(r["shadow"]["div_max_abs"] == 0.0 for r in rows)
+        assert all(r["exo"]["stale"] for r in rows)
+        assert led.diverged_total == 0
+
+    def test_controller_divergence_spike_stamps_incident(self, cfg):
+        """The declared trigger is not service-scoped: a single-cluster
+        controller with both an incident log and a ledger stamps ONE
+        edge-triggered policy_divergence incident when the windowed
+        rate crosses the bar (ledger.spikes_total == the log's
+        count)."""
+        from ccka_tpu.actuation.sink import DryRunSink
+        from ccka_tpu.harness.controller import Controller
+        from ccka_tpu.obs.incidents import IncidentLog
+        from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+        src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals)
+        log = IncidentLog()
+        led = DecisionLedger(ObsConfig(enabled=True), cfg.train,
+                             policy="carbon")
+        ctrl = Controller(cfg, CarbonAwarePolicy(cfg.cluster), src,
+                          DryRunSink(), interval_s=0.0,
+                          incident_log=log, decision_ledger=led,
+                          log_fn=lambda _l: None)
+        ctrl.run(ticks=4)
+        ctrl.close()
+        assert led.spikes_total == 1
+        assert log.counts().get("policy_divergence", 0) == 1
+        inc = log.incidents[0]
+        assert inc.details["rate"] >= inc.details["threshold"]
+
+    def test_fleet_controller_records_through_ledger(self, cfg):
+        from ccka_tpu.harness.fleet import fleet_controller_from_config
+        from ccka_tpu.obs.incidents import IncidentLog
+
+        led = DecisionLedger(ObsConfig(enabled=True), cfg.train,
+                             policy="carbon")
+        log = IncidentLog()
+        ctrl = fleet_controller_from_config(
+            cfg, CarbonAwarePolicy(cfg.cluster), 3, horizon_ticks=16,
+            seed=0, log_fn=lambda _l: None)
+        ctrl.ledger = led
+        ctrl.incident_log = log
+        ctrl.run(2)
+        ctrl.close()
+        assert led.rows_total == 6
+        assert led.diverged_total == 6
+        # The 1:1 spikes==incidents invariant holds from the bare
+        # fleet entry point too.
+        assert led.spikes_total == 1
+        assert log.counts().get("policy_divergence", 0) == 1
+
+    def test_lane_names_track_service_constants(self):
+        from ccka_tpu.harness import service as svc_mod
+
+        assert LANE_NAMES[svc_mod.LANE_FRESH] == "fresh"
+        assert LANE_NAMES[svc_mod.LANE_HOLD] == "hold"
+        assert LANE_NAMES[svc_mod.LANE_FALLBACK] == "fallback"
+
+
+class TestDecisionsCLI:
+    @pytest.fixture(scope="class")
+    def cli_log(self, cfg, carbon, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("cli-decisions")
+        svc, _ = _run_service(cfg, carbon, 3, _obs(tmp), ticks=4)
+        svc.close()
+        return svc.obs.decision_log_path
+
+    def test_list_show_explain(self, cli_log, capsys):
+        from ccka_tpu.cli import main
+
+        assert main(["decisions", "list", cli_log]) == 0
+        out = capsys.readouterr()
+        lines = out.out.strip().splitlines()
+        assert lines and all("diverged" in json.loads(l) for l in lines)
+        assert "decision row(s)" in out.err
+
+        assert main(["decisions", "show", cli_log, "--t", "2",
+                     "--tenant", "1"]) == 0
+        rows = [json.loads(l) for l in
+                capsys.readouterr().out.strip().splitlines()]
+        assert len(rows) == 1
+        assert rows[0]["t"] == 2 and rows[0]["tenant"] == 1
+
+        assert main(["decisions", "explain", cli_log, "--t", "2"]) == 0
+        text = capsys.readouterr().out
+        assert "objective $" in text
+        assert "rule shadow" in text
+        assert "tick 2" in text
+
+    def test_errors(self, cli_log, tmp_path):
+        from ccka_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="needs --t"):
+            main(["decisions", "show", cli_log])
+        with pytest.raises(SystemExit, match="no decision rows"):
+            main(["decisions", "show", cli_log, "--t", "999"])
+        bad = str(tmp_path / "bad.jsonl")
+        with open(bad, "w") as fh:
+            fh.write('{"t": 0}\nGARBAGE\n{"t": 1}\n')
+        with pytest.raises(SystemExit, match="corrupt decision log"):
+            main(["decisions", "list", bad])
+
+    def test_fleet_decisions_out_flag(self, tmp_path, capsys):
+        from ccka_tpu.cli import main
+
+        out = str(tmp_path / "dec.jsonl")
+        assert main(["fleet", "--clusters", "2", "--ticks", "2",
+                     "--service", "default", "--backend", "carbon",
+                     "--decisions-out", out]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["decision_rows_total"] == 4
+        assert os.path.exists(out)
+        assert main(["decisions", "list", out]) == 0
+        capsys.readouterr()
+        # The explicit off posture must not be silently inverted.
+        with pytest.raises(SystemExit, match="off posture"):
+            main(["fleet", "--clusters", "2", "--ticks", "1",
+                  "--service", "default", "--obs", "off",
+                  "--decisions-out", out])
+        # And without a service loop the flag must refuse, not no-op.
+        with pytest.raises(SystemExit, match="ENABLED --service"):
+            main(["fleet", "--clusters", "2", "--ticks", "1",
+                  "--decisions-out", out])
+
+    def test_explain_renderer_names_action_deltas(self, cfg):
+        row = {
+            "t": 3, "tenant": 0, "lane": "fresh", "policy": "carbon",
+            "exo": {"spot_price_hr": 0.03, "od_price_hr": 0.096,
+                    "carbon_g_kwh": 400.0, "demand_pods": 25.0,
+                    "is_peak": False},
+            "state": {"nodes_spot": 1.0, "nodes_od": 0.5},
+            "action": [0.25, 1.0],
+            "objective": {"total": 0.1,
+                          "terms": {k: 0.025 for k in TERM_NAMES},
+                          "shares": {k: 0.25 for k in TERM_NAMES},
+                          "by_class": {"class0": 0.02,
+                                       "class1": 0.005}},
+            "shadow": {"policy": "rule", "action": [1.0, 1.0],
+                       "objective": {"total": 0.1, "terms": {},
+                                     "shares": {}, "by_class": {}},
+                       "usd_delta": -0.01, "slo_delta": 1.0,
+                       "div_max_abs": 0.75, "div_l2": 0.75,
+                       "diverged": True},
+        }
+        text = explain_row(row, action_names=["zone_weight[0][0]",
+                                              "zone_weight[0][1]"])
+        assert "DIVERGED" in text
+        assert "zone_weight[0][0]: 0.250 vs rule 1.000" in text
+        assert "cost 25.0%" in text
+        assert "$-0.010000/tick" in text
+        # Label-length mismatch (a log recorded under another cluster
+        # topology): labels are OMITTED with a note, never mislabeled.
+        wrong = explain_row(row, action_names=["a", "b", "c"])
+        assert "action labels omitted" in wrong
+        assert "a[0]: 0.250 vs rule 1.000" in wrong
+
+
+class TestBenchDiffDecisionGates:
+    CLEAN = {
+        "bitwise_identical": True,
+        "ledger_overhead_frac": 0.02,
+        "term_share_err_max": 1e-12,
+        "rows_total": 768,
+        "divergence_incidents": 1,
+        "divergence_dumps_verified": 1,
+        "divergence_dump_failures": [],
+    }
+
+    def _diff(self, dec):
+        from ccka_tpu.obs import bench_history
+
+        return bench_history.bench_diff({
+            "records": [{"round": 18, "file": "BENCH_r18.json",
+                         "platform": "cpu",
+                         **bench_history._extract_decisions(dec)}],
+            "lane": []})
+
+    def test_clean_record_passes(self):
+        assert self._diff(dict(self.CLEAN))["ok"]
+
+    def test_each_gate_trips(self):
+        cases = [
+            (dict(self.CLEAN, bitwise_identical=False), "bitwise"),
+            (dict(self.CLEAN, ledger_overhead_frac=0.12), "overhead"),
+            (dict(self.CLEAN, term_share_err_max=0.1), "shares"),
+            (dict(self.CLEAN, rows_total=0), "no decision rows"),
+            (dict(self.CLEAN, divergence_dumps_verified=0),
+             "attributable"),
+            (dict(self.CLEAN, divergence_incidents=0), "attributable"),
+            (dict(self.CLEAN,
+                  divergence_dump_failures=["checksum"]),
+             "attributable"),
+        ]
+        for dec, needle in cases:
+            d = self._diff(dec)
+            assert not d["ok"], dec
+            assert any(needle in r["detail"] for r in d["regressions"])
+        # Missing claims are PARTIAL regressions, not silent passes.
+        for missing in ("bitwise_identical", "ledger_overhead_frac",
+                        "term_share_err_max", "divergence_incidents"):
+            dec = dict(self.CLEAN)
+            dec.pop(missing)
+            d = self._diff(dec)
+            assert not d["ok"], missing
+            assert any("partial decision record" in r["detail"]
+                       for r in d["regressions"])
+
+    def test_cli_bench_diff_doctored_root_exits_one(self, tmp_path,
+                                                    capsys):
+        from ccka_tpu.cli import main
+
+        os.makedirs(tmp_path / "data", exist_ok=True)
+        with open(tmp_path / "BENCH_r18.json", "w") as fh:
+            json.dump({"stage": "--decisions-only",
+                       "bitwise_identical": False,
+                       "ledger_overhead_frac": 0.01,
+                       "term_share_err_max": 1e-12,
+                       "rows_total": 10,
+                       "divergence_incidents": 1,
+                       "divergence_dumps_verified": 1,
+                       "divergence_dump_failures": [],
+                       "provenance": {"platform": "cpu"}}, fh)
+        with open(tmp_path / "data" / "lane_times.json", "w") as fh:
+            json.dump([], fh)
+        assert main(["bench-diff", "--root", str(tmp_path)]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["regressions"][0]["kind"] == "decisions_invariant"
+
+    def test_real_history_carries_round18_and_stays_clean(self):
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        history = load_bench_history(_ROOT)
+        r18 = [r for r in history["records"] if r["round"] == 18]
+        assert r18, "BENCH_r18.json missing from the repo root"
+        rec = r18[0]
+        assert rec["decisions_bitwise"] is True
+        assert rec["decisions_overhead_frac"] <= 0.05
+        assert rec["decisions_share_err"] <= 0.02
+        assert rec["decisions_divergence_dumps_ok"] is True
+        assert rec["decisions_partial"] == []
+        diff = bench_diff(history)
+        assert diff["ok"], diff["regressions"]

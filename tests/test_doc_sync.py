@@ -460,6 +460,92 @@ class TestIncidentClaims:
             assert phrase in arch, phrase
 
 
+class TestDecisionClaims:
+    """Round 18's decision provenance observatory (ISSUE 15 docs
+    satellite): README's "Decision provenance" claims are PARSED
+    against the BASELINE round18 record, not hand-synced."""
+
+    def test_round18_record_is_self_describing(self, baseline):
+        r18 = baseline["published"]["round18"]
+        dec = r18["decisions_stage"]
+        # The acceptance criteria hold on the record itself.
+        assert dec["bitwise_identical"] is True
+        assert dec["ledger_overhead_frac"] < 0.05
+        assert dec["overhead_gate_ok"] is True
+        assert dec["term_share_err_max"] <= 0.02
+        assert dec["share_gate_ok"] is True
+        assert dec["rows_total"] > 0
+        assert dec["divergence_incidents"] >= 1
+        assert dec["divergence_dumps_verified"] \
+            == dec["divergence_incidents"]
+        assert dec["divergence_dump_failures"] == []
+        assert dec["backend"] == "flagship"
+        assert dec["shadow_policy"] == "rule"
+        ev = r18["attribution_evidence"]
+        assert ev["rows_recorded"] == dec["rows_total"]
+        assert ev["shares_sum_to_one_on_every_row"] is True
+        assert ev["one_dump_per_divergence_incident"] is True
+        assert 0 < ev["diverged_decides"] <= ev["rows_recorded"]
+        assert "bitwise" in r18["non_interference_gate"]
+        assert "one XLA program" in r18["non_interference_gate"]
+
+    def test_readme_overhead_claim(self, readme, baseline):
+        dec = baseline["published"]["round18"]["decisions_stage"]
+        m = re.search(
+            r"([\d.]+)\s?ms/tick\s+of\s+ledger\s+overhead\s+—\s+"
+            r"([\d.]+)%\s+of\s+the\s+([\d.]+)\s?ms\s+p50\s+tick\s+"
+            r"latency", readme)
+        assert m, ("README's ledger-overhead claim no longer states "
+                   "the numbers in the pinned form — update the claim "
+                   "AND this regex together")
+        ms, pct, p50 = map(float, m.groups())
+        assert abs(ms - dec["ledger_overhead_ms_per_tick"]) < 5e-3
+        assert abs(pct / 100 - dec["ledger_overhead_frac"]) < 5e-3
+        assert abs(p50 - dec["p50_tick_ms_off"]) < 5e-3
+        assert pct / 100 < 0.05
+
+    def test_readme_attribution_claim(self, readme, baseline):
+        dec = baseline["published"]["round18"]["decisions_stage"]
+        m = re.search(
+            r"(\d+)\s+decision\s+rows\s+\(max\s+attribution-share\s+"
+            r"error\s+([\d.]+e-\d+)\),\s+of\s+which\s+(\d+)\s+diverged"
+            r"\s+from\s+the\s+rule\s+shadow", readme)
+        assert m, "README's attribution claim lost its pinned form"
+        rows, err, diverged = (int(m.group(1)), float(m.group(2)),
+                               int(m.group(3)))
+        assert rows == dec["rows_total"]
+        assert diverged == dec["diverged_total"]
+        assert err <= 0.02
+        assert err == pytest.approx(dec["term_share_err_max"],
+                                    rel=0.05)
+        m2 = re.search(
+            r"(\d+)\s+policy_divergence\s+incident\s+\((\d+)/(\d+)\s+"
+            r"dump\s+checksums\s+pass\)", readme)
+        assert m2, "README's divergence-incident claim lost its form"
+        inc, verified, of = map(int, m2.groups())
+        assert inc == dec["divergence_incidents"]
+        assert verified == of == dec["divergence_dumps_verified"]
+
+    def test_readme_names_the_gauges_and_trigger(self, readme):
+        flat = " ".join(readme.split())  # wrap-tolerant phrase match
+        for needle in ("ccka_policy_divergence_rate",
+                       "ccka_objective_term_share",
+                       "ccka_shadow_slo_delta",
+                       "policy_divergence",
+                       "no second dispatch, no second compile"):
+            assert needle in flat, needle
+
+    def test_architecture_has_section_20(self):
+        arch = _read("ARCHITECTURE.md")
+        assert "## 20. Decision provenance observatory" in arch
+        flat = " ".join(arch.split())
+        for phrase in ("decision_row_layout", "DECISION_COLS",
+                       "decisions_enabled", "policy_divergence",
+                       "edge-triggered", "objective_terms",
+                       "flat_action_names", "one XLA program"):
+            assert phrase in flat, phrase
+
+
 class TestWorkloadScenarioClaims:
     """Round 11's per-family scenario scoreboard (ISSUE 6 docs
     satellite): README's workload-scenario claims are PARSED against

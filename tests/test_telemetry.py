@@ -475,7 +475,13 @@ def _service_report():
         achieved_roofline_fraction=0.75,
         pipeline_occupancy={"generation": 0.3, "kernel": 0.6,
                             "host": 0.1},
-        shard_imbalance=1.25)
+        shard_imbalance=1.25,
+        policy_divergence_rate=0.375,
+        objective_term_shares={"cost": 0.7, "carbon": 0.2,
+                               "slo_pending": 0.06,
+                               "slo_violation": 0.04},
+        shadow_slo_delta=-1.0,
+        shadow_usd_delta=0.0125)
 
 
 class TestPromExport:
@@ -727,6 +733,68 @@ class TestPromExport:
                        "ccka_pipeline_occupancy",
                        "ccka_shard_imbalance",
                        "ccka_program_dispatches_total"):
+            assert series not in bare_text
+
+    def test_decision_gauges_cover_both_directions(self):
+        """Round-18 satellite: the decision-provenance series (windowed
+        divergence rate, the objective cost share via the dotted term
+        spec, the projected shadow SLO delta) must be exported,
+        panel-referenced, AND resolve from a real ServiceTickReport —
+        both directions of the parity contract — while a controller
+        TickReport (no decision fields) SKIPS them rather than
+        exporting fake zeros, and a service tick with the ledger OFF
+        (None/{} defaults) skips them too."""
+        import dataclasses
+
+        from ccka_tpu.harness.dashboard import _PANEL_DEFS
+        from ccka_tpu.harness.promexport import (SERIES,
+                                                 SERVICE_ONLY_SERIES,
+                                                 referenced_series,
+                                                 render_exposition,
+                                                 resolve_field)
+        from ccka_tpu.harness.service import ServiceTickReport
+
+        gauges = {"ccka_policy_divergence_rate",
+                  "ccka_objective_term_share", "ccka_shadow_slo_delta"}
+        assert gauges <= set(SERIES)
+        assert gauges <= set(SERVICE_ONLY_SERIES)
+        paneled = set()
+        for _t, expr, _u in _PANEL_DEFS:
+            paneled |= referenced_series(expr)
+        assert gauges <= paneled, ("decision gauges missing from the "
+                                   "dashboard")
+
+        rec = dataclasses.asdict(_service_report())
+        assert resolve_field(
+            rec, SERIES["ccka_policy_divergence_rate"][0]) == 0.375
+        # The dotted term spec reads the COST share out of the
+        # attribution dict (the other terms ride the same dict).
+        assert resolve_field(
+            rec, SERIES["ccka_objective_term_share"][0]) == 0.7
+        assert resolve_field(
+            rec, SERIES["ccka_shadow_slo_delta"][0]) == -1.0
+        text = render_exposition(rec)
+        assert "ccka_policy_divergence_rate 0.375" in text
+        assert "ccka_objective_term_share 0.7" in text
+        assert "ccka_shadow_slo_delta -1" in text
+        # Controller-skips contract: a TickReport has none of these.
+        for series in gauges:
+            assert resolve_field({"t": 1}, SERIES[series][0]) is None
+            assert series not in render_exposition({"t": 1})
+        # Ledger-off service tick: the defaulted report (None rate/
+        # delta, empty shares dict) skips all three instead of
+        # exporting zeros.
+        bare = dataclasses.asdict(ServiceTickReport(
+            t=1, n_tenants=2, admitted=2, deferred=0, shed=0,
+            cadence_skipped=0, bulkhead_skipped=0, scrape_failed=0,
+            probes=0, applied=2, fanout_deferred=0, slo_ok=2,
+            cost_usd_hr=1.0, carbon_g_hr=10.0, pending_pods=0.0,
+            tick_latency_ms=5.0, admission_queue_depth=2,
+            sheds_total=0, deferrals_total=0,
+            breaker_transitions_total=0, cadence_divisor=1,
+            decide_ms=1.0, fanout_ms=1.0))
+        bare_text = render_exposition(bare)
+        for series in gauges:
             assert series not in bare_text
 
     def test_live_scrape_serves_all_panel_series(self):

@@ -140,6 +140,10 @@ def test_guard_scans_a_nontrivial_tree():
     # device code would be MOST tempting and MOST wrong (it would time
     # dispatch of the whole loop, not its execution).
     assert any(os.path.join("sim", "streaming.py") in p for p in files)
+    # Round 18: the decision ledger sits directly beside the shadow
+    # lanes of the compiled tick — host recording next to device code
+    # is exactly where an un-fenced clock would sneak in.
+    assert any(os.path.join("obs", "decisions.py") in p for p in files)
 
 
 _HARNESS_DIR = os.path.join(ROOT, "ccka_tpu", "harness")
@@ -360,7 +364,11 @@ def test_observatory_modules_time_only_through_spans():
     for rel in (os.path.join("ccka_tpu", "obs", "occupancy.py"),
                 os.path.join("ccka_tpu", "sim", "streaming.py"),
                 os.path.join("ccka_tpu", "parallel",
-                             "sharded_kernel.py")):
+                             "sharded_kernel.py"),
+                # Round 18: the decision ledger records strictly after
+                # each tick's decisions and must never time anything
+                # itself — zero bare clocks, like the occupancy ledger.
+                os.path.join("ccka_tpu", "obs", "decisions.py")):
         path = os.path.join(ROOT, rel)
         with open(path, encoding="utf-8") as fh:
             tree = ast.parse(fh.read())
