@@ -2154,6 +2154,186 @@ def bench_decisions(*, n_tenants: int = 16, ticks: int = 48,
     return out
 
 
+def bench_geo(*, steps: int = 192, batch: int = 8, suite_seed: int = 0,
+              seed: int = 23) -> dict | None:
+    """Geo-arbitrage stage (ISSUE 16, `ccka_tpu/regions`): the
+    DCcluster-Opt-style scenario suite (regional spot storms, capacity
+    denials, migratable batch backfill) scored as per-workload-class
+    cost/carbon/SLO Pareto fronts, plus the zero-migration parity arm
+    the acceptance criterion pins. Gates on the record (the `ccka
+    bench-diff` geo invariants):
+
+    - ``zero_migration_parity``: (a) widening a stream with the
+      "regions" lane family leaves the pre-geo rows bitwise unchanged
+      and the lax + kernel engines consume the widened stream bitwise
+      (the round-17 registry contract — the round-18 multiregion
+      rollout is exactly this path with geo off, so zero-rate geo is
+      bitwise the round-18 record); (b) the lane block is bitwise the
+      hand-threaded generation; (c) the `none` policy's migration term
+      is EXACTLY 0 and its rollout is bitwise a zero-rate override
+      rollout;
+    - ``dominance_found``: >=1 scenario where a migration policy
+      STRICTLY dominates `none` on some class front;
+    - every per-class front row present and mutually non-dominated;
+    - ledger rows carry the migration term with |sum(shares) - 1|
+      <= 1e-12.
+
+    Host-side invariants stage — no roofline floor applies."""
+    import dataclasses
+
+    from ccka_tpu.config import ObsConfig, multi_region_config
+    from ccka_tpu.obs.decisions import DecisionLedger
+    from ccka_tpu.regions import geo as geo_dyn
+    from ccka_tpu.regions import pareto as geo_pareto
+    from ccka_tpu.regions.migrate import GEO_POLICIES
+    from ccka_tpu.regions.process import packed_region_lanes
+    from ccka_tpu.sim import SimParams, lanes
+    from ccka_tpu.sim.megakernel import packed_mode_summary_fn
+    from ccka_tpu.sim.rollout import lax_mode_summary
+    from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+    cfg = multi_region_config()
+    Z = cfg.cluster.n_zones
+    zri = cfg.cluster.zone_region_index
+    geo = dataclasses.replace(
+        geo_pareto.GEO_SCENARIOS["spot-storm"].geo, zone_region_index=zri)
+
+    # -- parity arm (bitwise; small geometry, interpret kernels) ------
+    P_B, P_T, P_TC = 32, 16, 8
+    plain_src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                      cfg.signals)
+    wide_src = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                     cfg.signals,
+                                     extra_lanes={"regions": geo})
+    key = jax.random.key(seed)
+    ps = plain_src.packed_trace_device(P_T, key, P_B, t_chunk=P_TC)
+    ws = wide_src.packed_trace_device(P_T, key, P_B, t_chunk=P_TC)
+    lay = lanes.resolve_layout(ws.shape[1], Z)
+    lo, hi = lay.block("regions")
+    parity = {}
+    parity["pre_geo_rows_bitwise"] = bool(
+        np.array_equal(np.asarray(ps), np.asarray(ws[:, :lo])))
+    # jit the reference: the widened stream is synthesized under jit,
+    # and XLA's fused float ops differ from eager at the ulp level.
+    ref = jax.jit(lambda k: packed_region_lanes(
+        geo, k, P_T, ws.shape[0], Z, P_B, dt_s=cfg.sim.dt_s))(key)
+    parity["lane_block_bitwise_reference"] = bool(
+        np.array_equal(np.asarray(ws[:, lo:hi]), np.asarray(ref)))
+    params = SimParams.from_config(cfg)
+    kkey = jax.random.key(7)
+    a = lax_mode_summary(params, cfg.cluster, "rule", ps, P_T, kkey)
+    b = lax_mode_summary(params, cfg.cluster, "rule", ws, P_T, kkey)
+    parity["lax_engine_bitwise"] = not {
+        f for f in a._fields
+        if not np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f)))}
+    kfn = packed_mode_summary_fn(params, cfg.cluster, "rule", T=P_T,
+                                 b_block=8, t_chunk=P_TC, interpret=True,
+                                 stochastic=False)
+    ka, kb = kfn(ps, 3), kfn(ws, 3)
+    parity["kernel_engine_bitwise"] = not {
+        f for f in ka._fields
+        if not np.array_equal(np.asarray(getattr(ka, f)),
+                              np.asarray(getattr(kb, f)))}
+    # Zero-rate overlay: `none` policy == zero override, bitwise, and
+    # its migration dollars are EXACTLY zero.
+    from ccka_tpu.regions.process import region_step_from_block
+    step = region_step_from_block(ws[:, lo:hi], P_T, Z, geo)
+    roll_none = geo_dyn.geo_rollout(geo, GEO_POLICIES["none"], step)
+    zeros = np.zeros((geo.n_regions, geo.n_regions, 3), np.float32)
+    roll_zero = geo_dyn.geo_rollout(geo, GEO_POLICIES["balanced"], step,
+                                    rates_override=zeros)
+    parity["zero_rate_migration_term_exact_zero"] = bool(
+        float(np.abs(np.asarray(roll_none.migration_cost_usd)).max())
+        == 0.0)
+    parity["zero_rate_rollout_bitwise_none"] = not {
+        f for f in roll_none._fields
+        if not np.array_equal(np.asarray(getattr(roll_none, f)),
+                              np.asarray(getattr(roll_zero, f)))}
+    zero_migration_parity = all(parity.values())
+
+    # -- the scenario suite -------------------------------------------
+    suite = geo_pareto.run_geo_suite(
+        scenarios=sorted(geo_pareto.GEO_SCENARIOS),
+        policies=sorted(GEO_POLICIES),
+        zone_region_index=zri, seed=suite_seed, steps=steps,
+        batch=batch, dt_s=cfg.sim.dt_s)
+
+    # -- ledger integration: geo ticks carry the migration term -------
+    ledger = DecisionLedger(
+        ObsConfig(enabled=True, decisions_enabled=True),
+        cfg.train, policy="geo-balanced")
+    roll = geo_dyn.geo_rollout(
+        geo, GEO_POLICIES["balanced"],
+        region_step_from_block(ws[:, lo:hi], P_T, Z, geo))
+    n_rows, mig_share_max, share_err_max = 8, 0.0, 0.0
+    act = np.zeros(4)
+    for t in range(n_rows):
+        mig_usd = float(np.asarray(roll.migration_cost_usd[t, 0]))
+        base = dict(cost_usd=float(np.asarray(roll.cost_usd[t, 0])),
+                    carbon_g=float(np.asarray(roll.carbon_g[t, 0])),
+                    pend_c0=float(np.asarray(
+                        roll.pending[t, 0, :, 0].sum())),
+                    pend_c1=float(np.asarray(
+                        roll.pending[t, 0, :, 1].sum())),
+                    slo_ok=1.0)
+        rec = ledger.observe_single(
+            t, lane="fresh", action=act, exo={}, state={},
+            chosen=dict(base, migration_cost_usd=mig_usd),
+            shadow=base, shadow_action=act,
+            migration_components={"total": mig_usd})
+        del rec
+    for row in ledger.rows:
+        shares = row["objective"]["shares"]
+        share_err_max = max(share_err_max,
+                            abs(sum(shares.values()) - 1.0))
+        mig_share_max = max(mig_share_max, shares.get("migration", 0.0))
+    ledger_out = {
+        "rows": len(ledger.rows),
+        "term_share_err_max": float(share_err_max),
+        "migration_share_max": float(mig_share_max),
+        "migration_term_present": all(
+            "migration" in r["objective"]["terms"] for r in ledger.rows),
+    }
+
+    out = {
+        "engine": "geo scenario suite (shared lanes per scenario, "
+                  "batched expectation dynamics) + bitwise parity arm "
+                  "(plain vs regions-widened stream, lax + interpret "
+                  "kernel)",
+        "steps": steps,
+        "batch": batch,
+        "suite_seed": suite_seed,
+        "zone_region_index": list(zri),
+        "parity": parity,
+        "zero_migration_parity": bool(zero_migration_parity),
+        "scenarios": suite["scenarios"],
+        "policies": suite["policies"],
+        "classes": suite["classes"],
+        "dominance_found": bool(suite["dominance_found"]),
+        "max_conservation_residual": suite["max_conservation_residual"],
+        "conservation_gate_pods": 0.01,
+        "conservation_gate_ok": bool(
+            suite["max_conservation_residual"] <= 0.01),
+        "ledger": ledger_out,
+        "share_gate_err": 1e-12,
+        "share_gate_ok": bool(share_err_max <= 1e-12),
+    }
+    dom_rows = [
+        f"{s['scenario']}/{k}:{'+'.join(f['dominates_none'])}"
+        for s in suite["scenarios"] for k, f in s["pareto"].items()
+        if f["dominates_none"]]
+    print(f"# geo: parity={zero_migration_parity} "
+          f"dominance={out['dominance_found']} "
+          f"({'; '.join(dom_rows) or 'none'}), residual "
+          f"{out['max_conservation_residual']:.2e} pods, ledger "
+          f"{ledger_out['rows']} rows (share err "
+          f"{ledger_out['term_share_err_max']:.2e}, migration share "
+          f"max {ledger_out['migration_share_max']:.3f})",
+          file=sys.stderr)
+    return out
+
+
 PERF_MODES = ("rule", "carbon", "neural", "plan")
 
 
@@ -3364,6 +3544,14 @@ def main(argv=None) -> int:
                          "attribution) and print its JSON — the "
                          "BENCH_r18 record path; host-side "
                          "virtual-clock harness")
+    ap.add_argument("--geo-only", action="store_true",
+                    help="run ONLY the geo-arbitrage stage (bench_geo: "
+                         "zero-migration bitwise parity arm + the "
+                         "DCcluster-Opt-style scenario suite scored as "
+                         "per-class cost/carbon/SLO Pareto fronts + the "
+                         "migration-term ledger invariant) and print "
+                         "its JSON — the BENCH_r19 record path; "
+                         "host-side deterministic off-TPU")
     ap.add_argument("--perf-only", action="store_true",
                     help="run ONLY the device-time performance "
                          "observatory (bench_perf: occupancy ledger + "
@@ -3489,6 +3677,17 @@ def main(argv=None) -> int:
             dec["provenance"] = bench_provenance()
         print(json.dumps(dec))
         return 0 if dec is not None else 1
+
+    if args.geo_only:
+        with _TRACER.span("bench.geo_stage"):
+            ge = bench_geo()
+        if ge is not None:
+            # Record-path stamp (see --perf-only): a raw redirect into
+            # BENCH_rNN.json arms the bench-diff geo gates.
+            ge["stage"] = "--geo-only"
+            ge["provenance"] = bench_provenance()
+        print(json.dumps(ge))
+        return 0 if ge is not None else 1
 
     if args.perf_mesh_only:
         from ccka_tpu.config import default_config

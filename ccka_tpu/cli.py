@@ -527,6 +527,30 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="print the loaded series without diffing "
                           "(always exits 0)")
 
+    sgeo = sub.add_parser(
+        "geo", help="geo-arbitrage scoreboard (regions/pareto): run "
+                    "the regional scenario suite (spot storms, "
+                    "capacity denials, carbon seesaws) under the "
+                    "migration-policy library and print the cost/"
+                    "carbon/SLO Pareto front per workload class")
+    sgeo.add_argument("--scenarios", default="",
+                      help="comma-separated scenario names (default: "
+                           "every library scenario); unknown names "
+                           "are rejected up front")
+    sgeo.add_argument("--policies", default="",
+                      help="comma-separated migration-policy names "
+                           "(default: every library policy); the "
+                           "'none' baseline is always included")
+    sgeo.add_argument("--steps", type=int, default=192,
+                      help="rollout horizon in ticks (default 192)")
+    sgeo.add_argument("--batch", type=int, default=8,
+                      help="batched rollouts per scenario (default 8)")
+    sgeo.add_argument("--seed", type=int, default=0,
+                      help="suite seed (default 0)")
+    sgeo.add_argument("--json", action="store_true",
+                      help="print the raw suite record instead of "
+                           "the rendered scoreboard")
+
     sperf = sub.add_parser(
         "perf", help="device-time performance observatory (obs/"
                      "costmodel + obs/occupancy): run a small packed "
@@ -1225,6 +1249,52 @@ def _cmd_decisions(args, cfg) -> int:
     return 0
 
 
+def _cmd_geo(cfg: "FrameworkConfig", args) -> int:
+    """`ccka geo` — the Pareto scoreboard: score the migration-policy
+    library on the regional scenario suite and render the cost/carbon/
+    SLO front per workload class (the multi-objective replacement for
+    the single $/SLO-hr scalar)."""
+    from ccka_tpu.regions.migrate import GEO_POLICIES
+    from ccka_tpu.regions.pareto import GEO_SCENARIOS, run_geo_suite
+
+    scenarios = ([s.strip() for s in args.scenarios.split(",")
+                  if s.strip()] or sorted(GEO_SCENARIOS))
+    policies = ([p.strip() for p in args.policies.split(",")
+                 if p.strip()] or sorted(GEO_POLICIES))
+    try:
+        suite = run_geo_suite(
+            scenarios=scenarios, policies=policies,
+            zone_region_index=cfg.cluster.zone_region_index,
+            seed=args.seed, steps=max(args.steps, 8),
+            batch=max(args.batch, 1), dt_s=cfg.sim.dt_s)
+    except ValueError as e:
+        raise SystemExit(f"ccka: {e}")
+    if args.json:
+        print(json.dumps(suite, indent=2, sort_keys=True))
+        return 0
+    for scn in suite["scenarios"]:
+        print(f"== {scn['scenario']}: {scn['description']}")
+        for klass in suite["classes"]:
+            fr = scn["pareto"][klass]
+            print(f"  {klass}: front = {', '.join(fr['front'])}"
+                  + (f"; dominates none: "
+                     f"{', '.join(fr['dominates_none'])}"
+                     if fr["dominates_none"] else ""))
+            for pname in suite["policies"]:
+                usd, kg, slo = fr["points"][pname]
+                tag = ("*" if pname in fr["front"] else " ")
+                print(f"   {tag} {pname:<12s} ${usd:9.4f}  "
+                      f"{kg:8.3f} kgCO2e  slo {slo:10.2f}")
+        res = max(scn["conservation_residual"].values())
+        print(f"  conservation residual: {res:.2e} pods")
+    print(f"# geo: {len(suite['scenarios'])} scenario(s), "
+          f"{len(suite['policies'])} policies, dominance_found="
+          f"{suite['dominance_found']}, max residual "
+          f"{suite['max_conservation_residual']:.2e} pods",
+          file=sys.stderr)
+    return 0
+
+
 def _cmd_bench_diff(args) -> int:
     """`ccka bench-diff` — the regression sentinel: exit 0 on a clean
     history, 1 on any threshold regression (the CI contract)."""
@@ -1724,6 +1794,8 @@ def main(argv: list[str] | None = None) -> int:
             return _cmd_decisions(args, cfg)
         if args.command == "bench-diff":
             return _cmd_bench_diff(args)
+        if args.command == "geo":
+            return _cmd_geo(cfg, args)
         if args.command == "perf":
             return _cmd_perf(cfg, args)
         if args.command == "scaling-curve":

@@ -503,6 +503,12 @@ class TrainConfig:
     gae_lambda: float = 0.95
     entropy_coef: float = 0.01
     value_coef: float = 0.5
+    # Price multiplier on the inter-region migration transfer cost
+    # (ccka_tpu/regions, ISSUE 16): the "migration" objective term is
+    # migration_weight x the tick's transfer-cost dollars. 1.0 prices
+    # transfers at face value; the term is exactly 0 whenever no
+    # migration runs, so the pre-geo objective is bitwise unchanged.
+    migration_weight: float = 1.0
     # MPC-specific.
     mpc_horizon: int = 32
     mpc_iters: int = 20
@@ -531,6 +537,8 @@ class TrainConfig:
         if self.attain_target > 0 and not (
                 0 < self.lagrange_min <= self.lagrange_max):
             raise ConfigError("train: lagrange bounds out of order")
+        if self.migration_weight < 0:
+            raise ConfigError("train: negative migration_weight")
 
 
 @dataclass(frozen=True)
@@ -698,6 +706,124 @@ class WorkloadsConfig:
         if self.inference_slo_ms <= 0:
             raise ConfigError("workloads: inference_slo_ms must be "
                               "positive")
+
+
+@dataclass(frozen=True)
+class GeoConfig:
+    """Geo-arbitrage subsystem (`ccka_tpu/regions`, ISSUE 16).
+
+    The multiregion topology (config #4) has carried diverging regional
+    carbon/price profiles since the early rounds, but regions stayed
+    passive: nothing ever *moved work between them*. This block
+    configures the three geo pieces:
+
+    - **per-region exo lanes** (`regions/process.py`): price-deviation,
+      carbon-deviation, migratable-capacity and migratable-family
+      arrival rows, registered once as the "regions" lane family
+      (`sim/lanes.register_lane_family`) so every engine derives them
+      with zero per-engine edits. Region values broadcast to each
+      region's zones via ``zone_region_index`` (bind it from
+      ``ClusterConfig.zone_region_index``; empty = single region).
+    - **migration action space** (`regions/migrate.py`): per-region-
+      pair, per-workload-family migration rates in [0, 1], sanitized so
+      per-source outflow never exceeds the queued mass (the work-
+      conservation invariant), priced at
+      ``transfer_cost_usd_per_pod`` and landing
+      ``transfer_latency_ticks`` later.
+    - **expectation dynamics overlay** (`regions/geo.py`): batched
+      per-region, per-family queues served from the capacity lanes,
+      with cost/carbon priced by the regional lanes and batch-deadline
+      misses as the SLO axis — the Pareto scoreboard's three
+      objectives.
+
+    ``enabled=False`` (the default) is a hard gate in the established
+    idiom: no lanes, no overlay, and the pre-geo stream/objective are
+    bitwise unchanged. The neutral contract mirrors `WorkloadsConfig`:
+    with every rate/sigma at 0 the emitted lanes are EXACTLY 0, and
+    with every migration rate at 0 the migration objective term is
+    EXACTLY 0 (the zero-migration parity gate `tests/test_regions.py`
+    pins).
+    """
+
+    enabled: bool = False
+    # -- per-region exo deviations (relative spot-price deviation; g/kWh
+    # carbon deviation), each an AR(1) latent per region.
+    price_dev_sigma: float = 0.0
+    carbon_dev_sigma_g_kwh: float = 0.0
+    # Regional spot-price storm windows: in-window the price deviation
+    # jumps by (mult - 1) of the regional mean (the DCcluster-Opt-style
+    # "spot storm" the geo suite composes).
+    price_storm_frac: float = 0.0
+    price_storm_mult: float = 3.0
+    price_storm_mean_ticks: int = 16
+    # Carbon added (g/kWh) inside the SAME storm windows — spot surges
+    # ride peaker-plant dispatch, so a storm region is dirty while it
+    # is expensive (what makes leaving it a cost AND carbon win).
+    price_storm_carbon_g_kwh: float = 0.0
+    # -- migratable capacity per region (pod-equivalents served per
+    # tick), with capacity-denial windows during which a region's
+    # migratable capacity collapses by deny_frac.
+    capacity_pods: float = 0.0
+    capacity_deny_frac: float = 1.0
+    capacity_deny_window_frac: float = 0.0
+    capacity_deny_mean_ticks: int = 12
+    # -- migratable workload-family arrivals (pod-equivalents per tick,
+    # per region; diurnal for inference, anti-diurnal for batch).
+    migratable_inference_pods: float = 0.0
+    migratable_batch_pods: float = 0.0
+    migratable_background_pods: float = 0.0
+    # Ticks a migratable batch unit has to complete; unfinished work
+    # past it counts as a deadline miss (the SLO axis of the front).
+    batch_deadline_ticks: int = 16
+    # -- migration pricing: $ per pod-equivalent moved between regions,
+    # and the in-transit latency before moved mass lands.
+    transfer_cost_usd_per_pod: float = 0.0
+    transfer_latency_ticks: int = 1
+    # Region index per zone (bind from ClusterConfig.zone_region_index;
+    # empty = every zone in region 0). Static so the lane generator
+    # stays a pure (config, key, dims) closure.
+    zone_region_index: Tuple[int, ...] = ()
+
+    @property
+    def n_regions(self) -> int:
+        return (max(self.zone_region_index) + 1
+                if self.zone_region_index else 1)
+
+    def validate(self) -> None:
+        for name in ("price_dev_sigma", "carbon_dev_sigma_g_kwh",
+                     "capacity_pods", "migratable_inference_pods",
+                     "migratable_batch_pods",
+                     "migratable_background_pods",
+                     "price_storm_carbon_g_kwh",
+                     "transfer_cost_usd_per_pod"):
+            if getattr(self, name) < 0:
+                raise ConfigError(f"geo: negative {name}")
+        for name in ("price_storm_frac", "capacity_deny_window_frac"):
+            if not 0.0 <= getattr(self, name) < 1.0:
+                raise ConfigError(f"geo: {name} out of [0, 1)")
+        if not 0.0 <= self.capacity_deny_frac <= 1.0:
+            raise ConfigError("geo: capacity_deny_frac out of [0, 1]")
+        if self.price_storm_mult < 1.0:
+            raise ConfigError("geo: price_storm_mult must be >= 1 "
+                              "(1 = no storm)")
+        for name in ("price_storm_mean_ticks", "capacity_deny_mean_ticks",
+                     "batch_deadline_ticks", "transfer_latency_ticks"):
+            if getattr(self, name) < 1:
+                raise ConfigError(f"geo: {name} must be >= 1")
+        if self.zone_region_index:
+            idx = self.zone_region_index
+            if any(i < 0 for i in idx):
+                raise ConfigError("geo: negative zone_region_index entry")
+            if set(idx) != set(range(max(idx) + 1)):
+                raise ConfigError("geo: zone_region_index must cover "
+                                  "0..R-1 with no gaps")
+
+    def bound_to(self, cluster: "ClusterConfig") -> "GeoConfig":
+        """This config with ``zone_region_index`` bound from the cluster
+        topology — the one hand-off between the cluster section and the
+        pure lane generator."""
+        return dataclasses.replace(
+            self, zone_region_index=cluster.zone_region_index)
 
 
 @dataclass(frozen=True)
@@ -1071,6 +1197,7 @@ class FrameworkConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
     workloads: WorkloadsConfig = field(default_factory=WorkloadsConfig)
+    geo: GeoConfig = field(default_factory=GeoConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     service: ServiceConfig = field(default_factory=ServiceConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
@@ -1084,6 +1211,12 @@ class FrameworkConfig:
         self.mesh.validate()
         self.faults.validate()
         self.workloads.validate()
+        self.geo.validate()
+        if self.geo.zone_region_index and len(
+                self.geo.zone_region_index) != self.cluster.n_zones:
+            raise ConfigError(
+                "geo: zone_region_index length does not match the "
+                "cluster's zone count — bind it with GeoConfig.bound_to")
         self.chaos.validate()
         self.service.validate()
         self.obs.validate()
@@ -1235,6 +1368,7 @@ _NESTED_TYPES = {
     "mesh": MeshConfig,
     "faults": FaultsConfig,
     "workloads": WorkloadsConfig,
+    "geo": GeoConfig,
     "chaos": ChaosConfig,
     "service": ServiceConfig,
     "obs": ObsConfig,

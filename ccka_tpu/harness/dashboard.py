@@ -111,6 +111,12 @@ _PANEL_DEFS = (
      "ccka_inference_slo_violations_total", "short"),
     ("Batch deadline misses (session)",
      "ccka_batch_deadline_misses_total", "short"),
+    # Geo-arbitrage panel (ISSUE 16; ccka_tpu/regions): how much work
+    # is moving between regions and how dirty the regional grids are —
+    # the migration rate next to the carbon intensity it arbitrages.
+    ("Geo migration vs grid carbon",
+     "ccka_region_migration_rate + ccka_region_carbon_intensity / 1000",
+     "short"),
 )
 
 

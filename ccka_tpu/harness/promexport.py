@@ -192,6 +192,19 @@ SERIES: dict[str, tuple[str, str]] = {
         "shadow_slo_delta",
         "Chosen-minus-rule-shadow SLO-ok tenant count this tick "
         "(projected on identical observed inputs)"),
+    # Geo-arbitrage series (ISSUE 16; regions/geo.py publish/read
+    # snapshot): the mean applied inter-region migration rate of the
+    # last geo rollout and the sum of the per-region carbon
+    # intensities its lanes saw. Service-only, skipped (never fake
+    # zeros) before any geo rollout has published.
+    "ccka_region_migration_rate": (
+        "region_migration_rate.mean",
+        "Mean applied off-diagonal inter-region migration rate of the "
+        "last published geo rollout (0 = no mass moving)"),
+    "ccka_region_carbon_intensity": (
+        "region_carbon_intensity.*",
+        "Sum of per-region grid carbon intensities (g/kWh) the last "
+        "published geo rollout's lanes saw"),
     "ccka_applied": ("applied", "1 if every patch applied this tick"),
     "ccka_verified": ("verified", "1 if read-back matched intent"),
     "ccka_tick": ("t", "Controller tick counter"),
@@ -224,6 +237,7 @@ SERVICE_ONLY_SERIES = frozenset({
     "ccka_pipeline_occupancy", "ccka_shard_imbalance",
     "ccka_policy_divergence_rate", "ccka_objective_term_share",
     "ccka_shadow_slo_delta",
+    "ccka_region_migration_rate", "ccka_region_carbon_intensity",
 })
 
 _LABEL = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")

@@ -370,6 +370,12 @@ class ServiceTickReport:
     objective_term_shares: dict = dataclasses.field(default_factory=dict)
     shadow_slo_delta: "float | None" = None
     shadow_usd_delta: "float | None" = None
+    # Geo-arbitrage surfaces (ISSUE 16; regions/geo.py): whatever the
+    # geo overlay last PUBLISHED (`publish_geo_snapshot` — the round-15
+    # costmodel publish/read idiom; the tick never threads geo state).
+    # {} when no geo rollout has run — the exporter SKIPS the series.
+    region_migration_rate: dict = dataclasses.field(default_factory=dict)
+    region_carbon_intensity: dict = dataclasses.field(default_factory=dict)
 
 
 class FleetService:
@@ -833,6 +839,7 @@ class FleetService:
             shadow_slo_delta=(dec or {}).get("shadow_slo_delta"),
             shadow_usd_delta=(dec or {}).get("shadow_usd_delta"),
             **self._perf_surfaces(),
+            **self._geo_surfaces(),
         )
         self.log_fn(
             f"service t={t}: {report.admitted}/{self.n} fresh, "
@@ -856,6 +863,22 @@ class FleetService:
             "achieved_roofline_fraction": snap.get("achieved_fraction"),
             "pipeline_occupancy": snap.get("occupancy") or {},
             "shard_imbalance": snap.get("shard_imbalance"),
+        }
+
+    def _geo_surfaces(self) -> dict:
+        """Geo-arbitrage gauges (ISSUE 16): read whatever rollout
+        snapshot `regions/geo.publish_geo_snapshot` last published —
+        dict lookups only, same budget rule and "off" gate as the perf
+        surfaces. No snapshot (geo never ran) → {} fields → the
+        exporter skips both series (never-fake-zeros)."""
+        if self.burn is None:  # the obs layer's hard "off" gate
+            return {}
+        from ccka_tpu.regions import geo as geo_dyn
+
+        snap = geo_dyn.geo_snapshot() or {}
+        return {
+            "region_migration_rate": snap.get("migration_rate") or {},
+            "region_carbon_intensity": snap.get("carbon_intensity") or {},
         }
 
     def _observe_tick(self, t: int, t0: float, lanes, shed: int,

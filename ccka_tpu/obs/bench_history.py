@@ -212,6 +212,106 @@ def _extract_metrics(doc: dict) -> dict:
            else doc.get("decisions"))
     if isinstance(dec, dict):
         out.update(_extract_decisions(dec))
+    # Round-19 geo-arbitrage stage (stage record or nested "geo").
+    geo = (doc if doc.get("stage") == "--geo-only" else doc.get("geo"))
+    if isinstance(geo, dict):
+        out.update(_extract_geo(geo))
+    return out
+
+
+def _pareto_dominates(a, b) -> bool:
+    """Strict Pareto dominance on minimized axes (stdlib mirror of
+    regions/pareto.dominates — this module must run jax-free)."""
+    return (all(x <= y for x, y in zip(a, b))
+            and any(x < y for x, y in zip(a, b)))
+
+
+def _extract_geo(geo: dict) -> dict:
+    """The round-19 geo-arbitrage invariants a record states about
+    itself (ISSUE 16 satellite): migration rates pinned to zero must
+    leave the multiregion rollout bitwise identical to the pre-geo
+    code path (the parity flag must be PRESENT and true — absent is
+    partial, not green), every workload class must carry its Pareto
+    rows, each recorded front must actually be mutually non-dominated
+    (a 'front' hiding a dominated member is a corrupt scoreboard),
+    migration mass must conserve within the record's own pinned gate,
+    and the decision-ledger section must state the migration term was
+    attributed with shares still summing to ~1. Partial records are
+    regressions — the factory/perf/decisions discipline."""
+    out: dict = {"geo_partial": [], "geo_front_violations": []}
+    zp = geo.get("zero_migration_parity")
+    if zp is None:
+        out["geo_partial"].append(
+            "missing the zero_migration_parity flag")
+    else:
+        out["geo_zero_migration_parity"] = bool(zp)
+    if geo.get("dominance_found") is None:
+        out["geo_partial"].append("missing the dominance_found flag")
+    else:
+        out["geo_dominance_found"] = bool(geo["dominance_found"])
+    residual = geo.get("max_conservation_residual")
+    gate = geo.get("conservation_gate_pods")
+    if residual is None or gate is None:
+        out["geo_partial"].append(
+            "missing the conservation residual or its pinned gate")
+    else:
+        out["geo_conservation_ok"] = bool(
+            float(residual) <= float(gate))
+        out["geo_conservation_residual"] = float(residual)
+    classes = geo.get("classes") or []
+    if not classes:
+        out["geo_partial"].append("no workload classes recorded")
+    scenarios = geo.get("scenarios") or []
+    if not scenarios:
+        out["geo_partial"].append("no geo scenarios recorded")
+    for scn in scenarios:
+        if not isinstance(scn, dict):
+            out["geo_partial"].append("scenario is not a record")
+            continue
+        sname = scn.get("scenario", "?")
+        fronts = scn.get("pareto")
+        if not isinstance(fronts, dict):
+            out["geo_partial"].append(
+                f"scenario {sname} missing its pareto section")
+            continue
+        for klass in classes:
+            fr = fronts.get(klass)
+            if not isinstance(fr, dict) \
+                    or not isinstance(fr.get("points"), dict) \
+                    or not isinstance(fr.get("front"), list):
+                out["geo_partial"].append(
+                    f"scenario {sname} class {klass} missing its "
+                    "Pareto rows")
+                continue
+            pts = fr["points"]
+            missing = [n for n in fr["front"] if n not in pts]
+            if missing:
+                out["geo_partial"].append(
+                    f"scenario {sname} class {klass} front names "
+                    f"{missing} with no recorded points")
+                continue
+            for i, a in enumerate(fr["front"]):
+                for b in fr["front"]:
+                    if a != b and _pareto_dominates(pts[b], pts[a]):
+                        out["geo_front_violations"].append(
+                            f"scenario {sname} class {klass}: "
+                            f"{a!r} on the front is dominated by "
+                            f"{b!r}")
+    led = geo.get("ledger")
+    if not isinstance(led, dict):
+        out["geo_partial"].append("missing the ledger section")
+    else:
+        if led.get("migration_term_present") is None:
+            out["geo_partial"].append(
+                "ledger section missing migration_term_present")
+        else:
+            out["geo_migration_term_present"] = bool(
+                led["migration_term_present"])
+        if led.get("term_share_err_max") is None:
+            out["geo_partial"].append(
+                "ledger section missing term_share_err_max")
+        else:
+            out["geo_share_err"] = float(led["term_share_err_max"])
     return out
 
 
@@ -768,6 +868,46 @@ def bench_diff(history: dict, *,
                           "attributable 1:1 to verified recorder "
                           "dumps (or none fired on the divergent "
                           "backend)"})
+        # Round-19 geo-arbitrage invariants (ISSUE 16): zero-rate
+        # migration must be a bitwise no-op, recorded fronts must be
+        # mutually non-dominated, migration mass must conserve within
+        # the record's own pinned gate, and the migration term must be
+        # attributed in the ledger with shares still ~1. Partial
+        # records are regressions.
+        for what in rec.get("geo_partial", []):
+            regressions.append({
+                "kind": "geo_invariant", "round": rnd,
+                "detail": f"partial geo record: {what}"})
+        if rec.get("geo_zero_migration_parity") is False:
+            regressions.append({
+                "kind": "geo_invariant", "round": rnd,
+                "detail": "zero-rate migration no longer bitwise "
+                          "identical to the pre-geo multiregion "
+                          "rollout"})
+        for what in rec.get("geo_front_violations", []):
+            regressions.append({
+                "kind": "geo_invariant", "round": rnd,
+                "detail": f"dominated Pareto front: {what}"})
+        if rec.get("geo_conservation_ok") is False:
+            regressions.append({
+                "kind": "geo_invariant", "round": rnd,
+                "value": rec.get("geo_conservation_residual"),
+                "detail": "migration mass no longer conserved within "
+                          "the record's pinned residual gate — pods "
+                          "created or destroyed in transit"})
+        if rec.get("geo_migration_term_present") is False:
+            regressions.append({
+                "kind": "geo_invariant", "round": rnd,
+                "detail": "migration term absent from the decision "
+                          "ledger's attribution rows"})
+        if rec.get("geo_share_err", 0.0) > max_share_err:
+            regressions.append({
+                "kind": "geo_invariant", "round": rnd,
+                "value": rec["geo_share_err"],
+                "threshold": max_share_err,
+                "detail": "objective-term shares (with the migration "
+                          "term) no longer sum to ~1 on the geo "
+                          "ledger rows"})
     return {"comparisons": comparisons, "regressions": regressions,
             "ok": not regressions}
 

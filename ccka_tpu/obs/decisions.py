@@ -53,8 +53,13 @@ from ccka_tpu.sim.types import CT_OD, CT_SPOT, Action
 
 # The objective terms of `train/objective.step_cost`, in J order:
 #   J = cost + carbon_weight*gCO2 + slo_weight*pending
-#       + slo_violation_weight*(1 - slo_ok).
-TERM_NAMES = ("cost", "carbon", "slo_pending", "slo_violation")
+#       + slo_violation_weight*(1 - slo_ok)
+#       [+ migration_weight*migration_cost_usd]   (geo overlay).
+# "migration" is always present in the decomposition (0.0 on every
+# non-geo tick — the zero-migration neutral contract), so `term_shares`
+# keys are stable across rounds and still sum to 1.
+TERM_NAMES = ("cost", "carbon", "slo_pending", "slo_violation",
+              "migration")
 
 # Leading per-cluster metric columns of the batched ticks
 # (`harness/fleet.per_cluster_metrics`): slo_ok, cost, carbon, pending.
@@ -156,11 +161,17 @@ def shadow_decision_columns(chosen_metrics, shadow_metrics, exo_n,
 
 def objective_terms(tcfg: TrainConfig, *, cost_usd: float,
                     carbon_g: float, pend_c0: float, pend_c1: float,
-                    slo_ok: float) -> tuple[dict, dict]:
+                    slo_ok: float,
+                    migration_cost_usd: float = 0.0) -> tuple[dict, dict]:
     """One tick's `step_cost` split into its priced terms (host
     floats), plus the per-workload-class split of the pending term —
     the family axis the aggregate number hides. Term sum equals
-    `step_cost` by construction (same weights, same clamps)."""
+    `step_cost` by construction (same weights, same clamps).
+
+    ``migration_cost_usd`` is the geo overlay's transfer-dollar tick
+    total (`regions/geo.py`); it defaults to 0.0 so every pre-geo row
+    decomposes identically while the "migration" key stays present
+    (TERM_NAMES is the stable share contract)."""
     terms = {
         "cost": float(cost_usd),
         "carbon": float(tcfg.carbon_weight) * float(carbon_g),
@@ -168,6 +179,8 @@ def objective_terms(tcfg: TrainConfig, *, cost_usd: float,
         * (float(pend_c0) + float(pend_c1)),
         "slo_violation": float(tcfg.slo_violation_weight)
         * (1.0 - float(slo_ok)),
+        "migration": float(tcfg.migration_weight)
+        * float(migration_cost_usd),
     }
     by_class = {
         "class0": float(tcfg.slo_weight) * float(pend_c0),
@@ -322,11 +335,14 @@ class DecisionLedger:
 
     def observe_single(self, t: int, *, lane: str, action, exo: dict,
                        state: dict, chosen: dict,
-                       shadow: dict, shadow_action) -> dict:
+                       shadow: dict, shadow_action,
+                       migration_components: dict | None = None) -> dict:
         """The single-cluster (Controller) variant: one row from host
         scalars already pulled by the tick report. ``chosen``/
         ``shadow`` each carry cost_usd/carbon_g/pend_c0/pend_c1/slo_ok
-        as floats."""
+        as floats (geo rows add migration_cost_usd, and may attach the
+        per-region-pair ``migration_components`` split that `ccka
+        decisions explain` renders component-by-component)."""
         terms, by_class = objective_terms(self.tcfg, **chosen)
         sh_terms, sh_by_class = objective_terms(self.tcfg, **shadow)
         flat_c = np.asarray(action, np.float64).reshape(-1)
@@ -343,7 +359,11 @@ class DecisionLedger:
             "objective": {"total": float(sum(terms.values())),
                           "terms": terms,
                           "shares": term_shares(terms),
-                          "by_class": by_class},
+                          "by_class": by_class,
+                          **({"migration_components": {
+                              k: float(v) for k, v in
+                              migration_components.items()}}
+                             if migration_components else {})},
             "shadow": {
                 "policy": "rule",
                 "action": [float(v) for v in flat_s],
@@ -459,6 +479,15 @@ def explain_row(row: Mapping, *, action_names: Sequence[str] = (),
                        for k, v in sorted(by_class.items())) + ")"
            if by_class else ""),
     ]
+    mig = obj.get("migration_components") or {}
+    if mig:
+        # Geo rows attach the migration term's per-component split
+        # (region-pair / family transfer dollars, `regions/geo.py`) —
+        # rendered one component per entry, largest first.
+        parts = sorted(mig.items(), key=lambda kv: -abs(float(kv[1])))
+        lines.append("migration components: "
+                     + "; ".join(f"{k} ${float(v):.6f}/tick"
+                                 for k, v in parts))
     if exo:
         lines.append(
             f"observed exo: spot ${exo.get('spot_price_hr', 0.0):.4f}/hr"

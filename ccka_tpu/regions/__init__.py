@@ -1,0 +1,24 @@
+"""Geo-arbitrage subsystem: per-region exo lane families, inter-region
+workload migration, and the cost/carbon/SLO Pareto scoreboard.
+
+Layering (ISSUE 16): `process` synthesizes the per-region price /
+carbon / capacity / migratable-arrival lanes through the round-17 lane
+registry (every engine derives them with zero per-engine edits);
+`migrate` defines the migration action space and its conservation
+sanitizer; `geo` runs the batched expectation dynamics that move
+pending mass between regions; `pareto` scores policies as cost/carbon/
+SLO fronts per workload class instead of one scalar.
+"""
+
+from ccka_tpu.regions.process import (  # noqa: F401
+    REGION_KEY_TAG,
+    REGION_LANE_FIELDS,
+    RegionStep,
+    has_region_lanes,
+    packed_region_lanes,
+    region_rows,
+    region_slots,
+    sample_region_steps,
+    region_step_from_block,
+    unpack_region_lanes,
+)

@@ -1,0 +1,239 @@
+"""Inter-region migration action space and its conservation sanitizer.
+
+The action is a per-tick rate tensor ``rates[R, R, F]`` — the fraction
+of region ``src``'s pending mass in migratable family ``f`` to move to
+region ``dst`` this tick. Three invariants make it safe to hand to the
+batched expectation dynamics (`regions/geo.py`):
+
+  * rates live in [0, 1] and the diagonal is zero (no self-migration);
+  * per-source outflow summed over destinations never exceeds 1, so a
+    tick can move AT MOST the mass that exists — work is conserved by
+    construction, not by clipping inside the dynamics;
+  * every policy's raw output passes through :func:`sanitize_rates`,
+    so a mis-tuned policy degrades to smaller moves, never to mass
+    creation.
+
+Moved mass pays ``transfer_cost_usd_per_pod`` dollars (the objective's
+"migration" term, `train/objective.step_cost`) and lands
+``transfer_latency_ticks`` later via the dynamics' in-transit buffer.
+
+The actuation half renders rates as the same `PatchCommand` stream the
+Karpenter sinks speak (:func:`render_migration_commands` /
+:func:`apply_migration_commands`), so a seeded `ChaosSink` can drop or
+rewrite individual migration commands and the conservation test can
+assert the invariant on the rates that actually survived the wire.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Callable, NamedTuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ccka_tpu.actuation.sink import PatchCommand
+
+# Migratable workload families, in lane order (`regions/process` rows
+# 3Z:6Z). These are the *mobile* counterparts of the per-class demand —
+# the per-class SLO axes of the Pareto scoreboard report over them.
+MIGRATABLE_FAMILIES = ("inference", "batch", "background")
+N_FAMILIES = len(MIGRATABLE_FAMILIES)
+
+# Per-family mobility multipliers for the built-in policies: inference
+# is latency-sensitive (migrates reluctantly), batch/background are the
+# arbitrage payload.
+_FAMILY_MOBILITY = jnp.asarray((0.25, 1.0, 0.75), jnp.float32)
+
+
+class RegionSignals(NamedTuple):
+    """Per-tick signals a migration policy reads — trailing axis is
+    the region axis ``R`` (leading batch axes broadcast)."""
+
+    price_dev: jnp.ndarray     # [..., R] relative spot-price deviation
+    carbon_dev: jnp.ndarray    # [..., R] carbon deviation, g/kWh
+    capacity: jnp.ndarray      # [..., R] serveable pods this tick
+    queues: jnp.ndarray        # [..., R, F] pending migratable mass
+
+
+def sanitize_rates(rates: jnp.ndarray) -> jnp.ndarray:
+    """Enforce the action-space invariants on a raw ``[..., R, R, F]``
+    rate tensor: clip to [0, 1], zero the diagonal, and rescale any
+    source whose outflow (summed over destinations) exceeds 1 so at
+    most the existing mass moves. Idempotent; pure jnp."""
+    r = jnp.clip(rates, 0.0, 1.0)
+    R = r.shape[-2]
+    eye = jnp.eye(R, dtype=bool)[:, :, None]
+    r = jnp.where(eye, 0.0, r)
+    # outflow per source: sum over dst (axis -2 of [..., src, dst, F])
+    out = r.sum(axis=-2, keepdims=True)
+    scale = jnp.where(out > 1.0, 1.0 / jnp.maximum(out, 1e-30), 1.0)
+    return r * scale
+
+
+def _pairwise_pref(x: jnp.ndarray, deadband: float = 0.0) -> jnp.ndarray:
+    """``[..., R] → [..., R, R]`` one-way preference: positive where the
+    source's signal exceeds the destination's by more than
+    ``deadband``. The deadband is the anti-ping-pong hysteresis: small
+    AR(1) wiggles must not shuttle mass back and forth paying transfer
+    cost on every hop — only material gradients (a storm, a seesaw
+    swing, a real backlog) open a migration lane."""
+    return jnp.maximum(x[..., :, None] - x[..., None, :] - deadband, 0.0)
+
+
+def _dest_gate(capacity: jnp.ndarray) -> jnp.ndarray:
+    """Soft destination-availability gate in [0, 1): a region with no
+    migratable capacity attracts nothing."""
+    cap = jnp.maximum(capacity, 0.0)
+    return (cap / (cap + 1.0))[..., None, :, None]   # [..., 1, R, 1]
+
+
+# Carbon deviations are g/kWh while price deviations are relative
+# multipliers; this brings a ~100 g/kWh inter-region gap onto the same
+# scale as a ~1x price gap for the blended policy.
+_CARBON_SCALE = 1.0 / 100.0
+_GAIN = 0.5
+# Gradient deadbands (see `_pairwise_pref`): a >20% price gap, a
+# >30 g/kWh carbon gap, or a >2-tick backlog-per-capacity gap.
+_PRICE_DEADBAND = 0.2
+_CARBON_DEADBAND = 0.3
+_CONG_DEADBAND = 2.0
+
+
+@dataclass(frozen=True)
+class GeoPolicy:
+    """A named migration policy: signals → raw ``[..., R, R, F]`` rates
+    (sanitized downstream by the dynamics)."""
+
+    name: str
+    description: str
+    rate_fn: Callable[[RegionSignals], jnp.ndarray]
+
+    def rates(self, sig: RegionSignals) -> jnp.ndarray:
+        return sanitize_rates(self.rate_fn(sig))
+
+
+def _rates_none(sig: RegionSignals) -> jnp.ndarray:
+    R = sig.price_dev.shape[-1]
+    shape = sig.price_dev.shape[:-1] + (R, R, N_FAMILIES)
+    return jnp.zeros(shape, jnp.float32)
+
+
+def _congestion(sig: RegionSignals) -> jnp.ndarray:
+    """Per-region backlog pressure: queued mass per unit of serve
+    capacity. Drives work OUT of capacity-denied regions (where the
+    ratio explodes) toward live ones."""
+    return sig.queues.sum(axis=-1) / (jnp.maximum(sig.capacity, 0.0) + 1.0)
+
+
+def _rates_cost_first(sig: RegionSignals) -> jnp.ndarray:
+    pref = (_pairwise_pref(sig.price_dev, _PRICE_DEADBAND)
+            + 0.2 * _pairwise_pref(_congestion(sig), _CONG_DEADBAND))
+    return (_GAIN * pref[..., None] * _dest_gate(sig.capacity)
+            * _FAMILY_MOBILITY)
+
+
+def _rates_carbon_first(sig: RegionSignals) -> jnp.ndarray:
+    pref = (_pairwise_pref(sig.carbon_dev * _CARBON_SCALE,
+                           _CARBON_DEADBAND)
+            + 0.2 * _pairwise_pref(_congestion(sig), _CONG_DEADBAND))
+    return (_GAIN * pref[..., None] * _dest_gate(sig.capacity)
+            * _FAMILY_MOBILITY)
+
+
+def _rates_balanced(sig: RegionSignals) -> jnp.ndarray:
+    pref = (0.5 * _pairwise_pref(sig.price_dev, _PRICE_DEADBAND)
+            + 0.5 * _pairwise_pref(sig.carbon_dev * _CARBON_SCALE,
+                                   _CARBON_DEADBAND)
+            + 0.5 * _pairwise_pref(_congestion(sig), _CONG_DEADBAND))
+    return (_GAIN * pref[..., None] * _dest_gate(sig.capacity)
+            * _FAMILY_MOBILITY)
+
+
+GEO_POLICIES: dict[str, GeoPolicy] = {
+    "none": GeoPolicy(
+        "none", "no migration — the round-18 status quo baseline",
+        _rates_none),
+    "cost-first": GeoPolicy(
+        "cost-first", "chase the cheapest region's spot price",
+        _rates_cost_first),
+    "carbon-first": GeoPolicy(
+        "carbon-first", "chase the cleanest region's grid",
+        _rates_carbon_first),
+    "balanced": GeoPolicy(
+        "balanced", "blend price and carbon gradients; inference "
+        "migrates reluctantly", _rates_balanced),
+}
+
+
+def resolve_geo_policies(names) -> dict[str, GeoPolicy]:
+    """Validated name→GeoPolicy map; rejects unknown names UP FRONT
+    (the round-10 unknown-name convention — a typo must not run a
+    long suite and emit a scoreboard missing that row)."""
+    names = [n for n in names if n]
+    if not names:
+        raise ValueError(f"no geo policies named; library: "
+                         f"{sorted(GEO_POLICIES)}")
+    bad = [n for n in names if n not in GEO_POLICIES]
+    if bad:
+        raise ValueError(f"unknown geo policies {bad}; library: "
+                         f"{sorted(GEO_POLICIES)}")
+    return {n: GEO_POLICIES[n] for n in names}
+
+
+# -- actuation rendering ----------------------------------------------------
+
+_MIG_RESOURCE = "configmap"
+_MIG_ANNOTATION = "ccka.io/migration-rate"
+
+
+def render_migration_commands(rates: np.ndarray,
+                              *, min_rate: float = 1e-6
+                              ) -> list[PatchCommand]:
+    """One merge `PatchCommand` per nonzero (src, dst, family) rate —
+    the audit/replay wire format the Karpenter sinks (and ChaosSink)
+    speak. Command order is deterministic (src, dst, family-major)."""
+    r = np.asarray(rates, np.float64)
+    if r.ndim != 3 or r.shape[0] != r.shape[1] or r.shape[2] != N_FAMILIES:
+        raise ValueError(f"migration rates must be [R, R, {N_FAMILIES}]; "
+                         f"got {r.shape}")
+    cmds: list[PatchCommand] = []
+    for src in range(r.shape[0]):
+        for dst in range(r.shape[1]):
+            for f, fam in enumerate(MIGRATABLE_FAMILIES):
+                rate = float(r[src, dst, f])
+                if src == dst or rate <= min_rate:
+                    continue
+                cmds.append(PatchCommand(
+                    _MIG_RESOURCE, f"geo-mig-{fam}-r{src}-r{dst}", "merge",
+                    {"metadata": {"annotations": {
+                        _MIG_ANNOTATION: f"{rate:.9f}"}}}))
+    return cmds
+
+
+def apply_migration_commands(commands, n_regions: int) -> np.ndarray:
+    """Parse a (possibly chaos-thinned) migration command stream back
+    into the effective ``[R, R, F]`` rate tensor — what the cluster
+    actually saw. Dropped commands simply leave their cell at 0, so
+    the conserved dynamics run on strictly-smaller moves; unrelated
+    commands are ignored. The parsed tensor is re-sanitized, so even a
+    chaos-rewritten stream cannot break conservation."""
+    rates = np.zeros((n_regions, n_regions, N_FAMILIES), np.float32)
+    fam_ix = {fam: f for f, fam in enumerate(MIGRATABLE_FAMILIES)}
+    for cmd in commands:
+        if (not isinstance(cmd, PatchCommand)
+                or cmd.resource != _MIG_RESOURCE
+                or not cmd.name.startswith("geo-mig-")):
+            continue
+        try:
+            fam, s_tok, d_tok = cmd.name[len("geo-mig-"):].rsplit("-", 2)
+            src, dst = int(s_tok[1:]), int(d_tok[1:])
+            rate = float(json.loads(json.dumps(cmd.patch))["metadata"]
+                         ["annotations"][_MIG_ANNOTATION])
+        except (ValueError, KeyError, TypeError):
+            continue
+        if fam in fam_ix and 0 <= src < n_regions and 0 <= dst < n_regions:
+            rates[src, dst, fam_ix[fam]] = rate
+    return np.asarray(sanitize_rates(jnp.asarray(rates)))

@@ -1,0 +1,197 @@
+"""Cost/carbon/SLO Pareto scoreboard for geo migration policies.
+
+Replaces the single $/SLO-hr scalar with per-workload-class Pareto
+fronts (BatchBench's convention: batch results reported per class, not
+averaged into one number). Each policy becomes one point per class —
+
+    (total $ incl. transfer cost,  kg CO2,  class SLO debt)
+
+— where the SLO axis is inference/background pending pod-ticks or
+batch deadline-miss pod-ticks, all lower-better. The front is the
+non-dominated subset; a migration policy "earns its keep" (ROADMAP
+open item 3) when it STRICTLY dominates the `none` baseline on some
+class in some scenario, which `bench.py --geo-only` records and
+`ccka bench-diff` gates.
+
+The scenario library composes the regional lane processes into
+DCcluster-Opt-style episodes: spot storms, capacity denials, and
+migratable batch backfill. Every policy in a suite is scored on the
+SAME sampled lanes (one storm, shared bitwise), so front positions are
+policy differences, not luck.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+
+from ccka_tpu.config import GeoConfig
+from ccka_tpu.regions import geo as geo_dyn
+from ccka_tpu.regions.migrate import (GEO_POLICIES, GeoPolicy,
+                                      MIGRATABLE_FAMILIES,
+                                      resolve_geo_policies)
+from ccka_tpu.regions.process import (packed_region_lanes,
+                                      region_step_from_block)
+
+# SLO axis per workload class (keys of `rollout_summary()["per_class"]`).
+_CLASS_SLO = {
+    "inference": "pending_pod_ticks",
+    "batch": "deadline_miss_pod_ticks",
+    "background": "pending_pod_ticks",
+}
+
+
+@dataclass(frozen=True)
+class GeoScenario:
+    """One named geo episode: a GeoConfig recipe (zone_region_index is
+    bound to the actual cluster at suite time, `GeoConfig.bound_to`)."""
+
+    name: str
+    description: str
+    geo: GeoConfig
+
+
+def _scn(name: str, description: str, **over) -> GeoScenario:
+    base = dict(
+        enabled=True, price_dev_sigma=0.05, carbon_dev_sigma_g_kwh=30.0,
+        capacity_pods=10.0, migratable_inference_pods=2.5,
+        migratable_batch_pods=4.0, migratable_background_pods=1.5,
+        batch_deadline_ticks=16, transfer_cost_usd_per_pod=0.005,
+        transfer_latency_ticks=2)
+    base.update(over)
+    return GeoScenario(name, description, GeoConfig(**base))
+
+
+GEO_SCENARIOS: dict[str, GeoScenario] = {s.name: s for s in (
+    _scn("calm",
+         "steady prices and grids — migration should roughly break even",
+         price_dev_sigma=0.02, carbon_dev_sigma_g_kwh=15.0),
+    _scn("spot-storm",
+         "regional spot-price storms (3-4x surges) hit one region while "
+         "the other stays cheap — the cost-arbitrage episode",
+         price_storm_frac=0.15, price_storm_mult=4.0,
+         price_storm_mean_ticks=24, price_storm_carbon_g_kwh=150.0,
+         price_dev_sigma=0.1),
+    _scn("capacity-denial",
+         "stockout windows zero one region's migratable capacity while "
+         "backlog builds — staying put means batch deadline misses",
+         capacity_pods=8.0, capacity_deny_frac=1.0,
+         capacity_deny_window_frac=0.3, capacity_deny_mean_ticks=20,
+         migratable_batch_pods=6.0),
+    _scn("carbon-seesaw",
+         "grid intensities swing +/-120 g/kWh out of phase across "
+         "regions — the carbon-arbitrage episode",
+         carbon_dev_sigma_g_kwh=120.0, price_dev_sigma=0.03),
+)}
+
+
+def resolve_geo_scenarios(names) -> dict[str, GeoScenario]:
+    """Validated name→GeoScenario map; rejects unknown names UP FRONT
+    (the round-10 unknown-name convention)."""
+    names = [n for n in names if n]
+    if not names:
+        raise ValueError(f"no geo scenarios named; library: "
+                         f"{sorted(GEO_SCENARIOS)}")
+    bad = [n for n in names if n not in GEO_SCENARIOS]
+    if bad:
+        raise ValueError(f"unknown geo scenarios {bad}; library: "
+                         f"{sorted(GEO_SCENARIOS)}")
+    return {n: GEO_SCENARIOS[n] for n in names}
+
+
+# -- dominance --------------------------------------------------------------
+
+def dominates(a, b, *, tol: float = 0.0) -> bool:
+    """True iff point ``a`` Pareto-dominates ``b`` (all axes lower-
+    better): a <= b everywhere and a < b somewhere, beyond ``tol``."""
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    return bool(np.all(a <= b + tol) and np.any(a < b - tol))
+
+
+def pareto_front(points: dict[str, tuple]) -> list[str]:
+    """Names of the non-dominated points, sorted. ``points`` maps a
+    name to its lower-better axis tuple."""
+    names = sorted(points)
+    return [n for n in names
+            if not any(dominates(points[m], points[n])
+                       for m in names if m != n)]
+
+
+def class_points(summaries: dict[str, dict], klass: str) -> dict[str, tuple]:
+    """Per-policy (total $, kg CO2, class-SLO) points for one class,
+    from `rollout_summary` dicts."""
+    axis = _CLASS_SLO[klass]
+    return {name: (s["total_cost_usd"], s["carbon_kg"],
+                   s["per_class"][klass][axis])
+            for name, s in summaries.items()}
+
+
+# -- the suite --------------------------------------------------------------
+
+def run_geo_suite(*, scenarios, policies, zone_region_index,
+                  seed: int = 0, steps: int = 192, batch: int = 8,
+                  dt_s: float = 30.0) -> dict:
+    """Score every policy on every scenario and build the per-class
+    Pareto fronts. Returns the BENCH-shaped record: per-scenario
+    summaries, per-class fronts, strict-dominance rows vs the `none`
+    baseline, and the conservation residuals the gates check."""
+    scn_map = resolve_geo_scenarios(scenarios)
+    pol_map = resolve_geo_policies(policies)
+    if "none" not in pol_map:          # the baseline anchors dominance
+        pol_map = {"none": GEO_POLICIES["none"], **pol_map}
+    zri = tuple(int(z) for z in zone_region_index)
+    Z = len(zri)
+    out_scenarios = []
+    dominance_found = False
+    max_residual = 0.0
+    for si, (sname, scn) in enumerate(sorted(scn_map.items())):
+        geo = dataclasses.replace(scn.geo, zone_region_index=zri)
+        geo.validate()
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), si)
+        block = packed_region_lanes(geo, key, steps, steps, Z, batch,
+                                    dt_s=dt_s)
+        step = region_step_from_block(block, steps, Z, geo)
+        summaries: dict[str, dict] = {}
+        residuals: dict[str, float] = {}
+        for pname, pol in sorted(pol_map.items()):
+            roll = geo_dyn.geo_rollout(geo, pol, step)
+            summaries[pname] = geo_dyn.rollout_summary(geo, roll)
+            residuals[pname] = geo_dyn.conservation_residual(step, roll)
+            max_residual = max(max_residual, residuals[pname])
+            if pname != "none":
+                geo_dyn.publish_geo_snapshot(geo, step, roll)
+        fronts = {}
+        for klass in _CLASS_SLO:
+            pts = class_points(summaries, klass)
+            fronts[klass] = {
+                "points": {n: [float(v) for v in p]
+                           for n, p in pts.items()},
+                "front": pareto_front(pts),
+                "dominates_none": sorted(
+                    n for n, p in pts.items()
+                    if n != "none" and dominates(p, pts["none"])),
+            }
+            if fronts[klass]["dominates_none"]:
+                dominance_found = True
+        out_scenarios.append({
+            "scenario": sname,
+            "description": scn.description,
+            "summaries": summaries,
+            "conservation_residual": residuals,
+            "pareto": fronts,
+        })
+    return {
+        "scenarios": out_scenarios,
+        "policies": sorted(pol_map),
+        "classes": sorted(_CLASS_SLO),
+        "families": list(MIGRATABLE_FAMILIES),
+        "steps": steps,
+        "batch": batch,
+        "dominance_found": dominance_found,
+        "max_conservation_residual": float(max_residual),
+    }

@@ -1,0 +1,257 @@
+"""Per-region geo exo processes, synthesized as packed-stream lanes.
+
+The geo-arbitrage subsystem's generation half, mirroring
+`faults/process.py` / `workloads/process.py`: pure-jnp processes
+emitting ``[T_pad, region_rows(Z), B]`` lane blocks that ride the SAME
+packed exo stream the megakernel reads. Because the lanes are part of
+stream synthesis they inherit every pairing property of the exo
+signals: shard-local on a mesh, and bitwise identical for every policy
+scored on the stream — the no-migration baseline and every migration
+policy see one regional spot storm.
+
+Lane layout, offsets relative to the region block base (which sits
+AFTER the fault and workload blocks when present — registration order,
+`sim/lanes.resolve_layout`). Region values broadcast to each of the
+region's zones (``GeoConfig.zone_region_index``); consumers read one
+representative zone per region (:func:`region_slots`):
+
+    rows 0..Z-1     price_dev[z]    relative spot-price deviation
+                                    (storm surge + AR(1); 0 = neutral)
+    rows Z..2Z-1    carbon_dev[z]   carbon-intensity deviation, g/kWh
+    rows 2Z..3Z-1   capacity[z]     migratable capacity, pods/tick
+                                    (collapses in denial windows)
+    rows 3Z..4Z-1   inf_arrivals[z]   migratable inference work
+    rows 4Z..5Z-1   batch_arrivals[z] migratable batch work
+    rows 5Z..6Z-1   bg_arrivals[z]    migratable background work
+    rows pad to ``region_rows(Z) = 4*fault_rows(Z) + 32`` (zeros)
+
+Storm/denial windows reuse the fault subsystem's thresholded
+stationary AR(1) family (`faults/process._window`); diurnal shape
+reuses the signal generator's `_bump`. The neutral contract: with
+every rate and sigma at 0 the emitted lanes are EXACTLY 0 — consuming
+them is a no-op, which is what lets the zero-geo gate
+(`tests/test_regions.py`) pin the widened pipeline against the
+pre-geo one.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ccka_tpu.config import GeoConfig
+from ccka_tpu.faults.process import _window
+from ccka_tpu.signals.synthetic import _ar1_device, _bump
+from ccka_tpu.sim import lanes
+
+_DAY_S = 86400.0
+
+# Key-domain tag separating the region latents from the exo noise AND
+# the fault/workload latents: folded into the same generation key, so
+# widening a stream with region lanes changes neither the exo nor the
+# other family rows bitwise. Canonical value lives in the lane-family
+# registry (`sim/lanes.py`).
+REGION_KEY_TAG = lanes.LANE_FAMILIES["regions"].key_tag
+
+# Layout arithmetic lives in the neutral `sim/lanes.py`; re-exported
+# for the `regions.*` surface like `workloads.workload_rows`.
+region_rows = lanes.region_rows
+
+# Sub-block order inside the region lane block (each Z rows wide).
+REGION_LANE_FIELDS = ("price_dev", "carbon_dev", "capacity",
+                      "inf_arrivals", "batch_arrivals", "bg_arrivals")
+
+
+class RegionStep(NamedTuple):
+    """Per-REGION geo lane values, time-major ``[T, R, B]`` leaves
+    (one representative zone per region — region values are broadcast
+    zone-wise in the packed block)."""
+
+    price_dev: jnp.ndarray
+    carbon_dev: jnp.ndarray
+    capacity: jnp.ndarray
+    inf_arrivals: jnp.ndarray
+    batch_arrivals: jnp.ndarray
+    bg_arrivals: jnp.ndarray
+
+
+def _zone_region_index(geo: GeoConfig, Z: int) -> tuple[int, ...]:
+    """The zone→region map actually used at zone count ``Z``: the bound
+    config's map when it matches, else the single-region fallback (a
+    widened source on a foreign topology reads as one region rather
+    than mis-indexing zones)."""
+    zri = geo.zone_region_index
+    if len(zri) == Z:
+        return zri
+    return (0,) * Z
+
+
+def region_slots(geo: GeoConfig, Z: int) -> tuple[int, ...]:
+    """First zone index of each region — the representative zone
+    consumers read each region's broadcast value from."""
+    zri = _zone_region_index(geo, Z)
+    slots: list[int] = []
+    for z, r in enumerate(zri):
+        if r == len(slots):
+            slots.append(z)
+    return tuple(slots)
+
+
+def packed_region_lanes(geo: GeoConfig, key, steps: int, t_pad: int,
+                        Z: int, batch: int, *,
+                        dt_s: float, start_unix_s: float = 0.0,
+                        start_offset_s=None,
+                        wrap_period_s: float | None = None) -> jnp.ndarray:
+    """``[T_pad, region_rows(Z), B]`` lane block for one stream.
+
+    Pure jnp — runs inside the (possibly shard_map'd) generation jit.
+    Clock arguments mirror `workloads.packed_workload_lanes` so the
+    diurnal/anti-diurnal shapes stay phase-aligned with the exo demand
+    under both the synthetic and blocked/replay clocks.
+    """
+    kp, ks, kc, kcap, kd, ki, kb, kg = jax.random.split(
+        jax.random.fold_in(key, REGION_KEY_TAG), 8)
+    f32 = jnp.float32
+    zri = _zone_region_index(geo, Z)
+    R = max(zri) + 1
+    zero = jnp.zeros((steps, R, batch), f32)
+
+    t = start_unix_s + np.arange(steps) * dt_s
+    if start_offset_s is None:
+        tod = jnp.asarray((t % _DAY_S) / _DAY_S, f32)[:, None, None]
+    else:
+        t_rel = (jnp.asarray(np.arange(steps) * dt_s, f32)[:, None]
+                 + jnp.asarray(start_offset_s, f32)[None, :])     # [T,B]
+        if wrap_period_s is not None:
+            t_rel = t_rel % f32(wrap_period_s)
+        tt = f32(start_unix_s % _DAY_S) + (t_rel % f32(_DAY_S))
+        tod = ((tt % _DAY_S) / _DAY_S)[:, None, :]                # [T,1,B]
+
+    # Per-region spot-price deviation: storm surge windows + AR(1)
+    # noise, each gated host-side so a zero config emits EXACT zeros.
+    # The SAME storm window optionally dirties the regional grid
+    # (peaker-plant dispatch, `price_storm_carbon_g_kwh`).
+    price = zero
+    storm = None
+    if geo.price_dev_sigma > 0.0:
+        price = price + _ar1_device(kp, (steps, R, batch), rho=0.97,
+                                    sigma=geo.price_dev_sigma, axis=0)
+    if geo.price_storm_frac > 0.0:
+        storm = _window(ks, (steps, R, batch),
+                        frac=geo.price_storm_frac,
+                        mean_ticks=geo.price_storm_mean_ticks)
+        price = price + (f32(geo.price_storm_mult) - 1.0) * storm
+
+    carbon = zero
+    if geo.carbon_dev_sigma_g_kwh > 0.0:
+        carbon = carbon + _ar1_device(
+            kc, (steps, R, batch), rho=0.95,
+            sigma=geo.carbon_dev_sigma_g_kwh, axis=0)
+    if storm is not None and geo.price_storm_carbon_g_kwh > 0.0:
+        carbon = carbon + f32(geo.price_storm_carbon_g_kwh) * storm
+
+    # Migratable capacity, collapsing by deny_frac in denial windows.
+    cap = zero
+    if geo.capacity_pods > 0.0:
+        cap = jnp.full((steps, R, batch), f32(geo.capacity_pods))
+        if geo.capacity_deny_window_frac > 0.0:
+            deny = _window(kd, (steps, R, batch),
+                           frac=geo.capacity_deny_window_frac,
+                           mean_ticks=geo.capacity_deny_mean_ticks)
+            cap = cap * (1.0 - f32(geo.capacity_deny_frac) * deny)
+        _ = kcap  # reserved: capacity AR(1) texture
+        cap = jnp.maximum(cap, 0.0)
+
+    # Migratable family arrivals — diurnal inference, anti-diurnal
+    # batch, flat background (the workload-family shapes).
+    diurnal = 0.4 + 0.6 * _bump(tod, center=14.0 / 24, width=5.0 / 24,
+                                xp=jnp)
+    anti = 1.5 - _bump(tod, center=14.0 / 24, width=5.0 / 24, xp=jnp)
+    inf = zero
+    if geo.migratable_inference_pods > 0.0:
+        noise_i = _ar1_device(ki, (steps, R, batch), rho=0.9,
+                              sigma=0.2, axis=0)
+        inf = jnp.maximum(f32(geo.migratable_inference_pods)
+                          * diurnal * (1.0 + noise_i), 0.0)
+    bat = zero
+    if geo.migratable_batch_pods > 0.0:
+        noise_b = _ar1_device(kb, (steps, R, batch), rho=0.85,
+                              sigma=0.3, axis=0)
+        bat = jnp.maximum(f32(geo.migratable_batch_pods)
+                          * anti * (1.0 + noise_b), 0.0)
+    bg = zero
+    if geo.migratable_background_pods > 0.0:
+        noise_g = _ar1_device(kg, (steps, R, batch), rho=0.9,
+                              sigma=0.2, axis=0)
+        bg = jnp.maximum(f32(geo.migratable_background_pods)
+                         * (1.0 + noise_g), 0.0)
+
+    # Region → zone broadcast, then the six Z-row sub-blocks in
+    # REGION_LANE_FIELDS order.
+    zri_ix = jnp.asarray(zri, jnp.int32)
+    per_zone = [x[:, zri_ix, :] for x in
+                (price, carbon, cap, inf, bat, bg)]     # each [T, Z, B]
+    block = jnp.concatenate(per_zone, axis=1).astype(f32)  # [T, 6Z, B]
+    return jnp.pad(block, ((0, t_pad - steps),
+                           (0, region_rows(Z) - block.shape[1]), (0, 0)))
+
+
+def has_region_lanes(exo_packed, Z: int) -> bool:
+    """Whether a packed stream carries the region lane block — row-
+    count detection via the registry resolver (raises on malformed
+    layouts)."""
+    return lanes.resolve_layout(int(exo_packed.shape[1]), Z).has("regions")
+
+
+def region_step_from_block(block, T: int, Z: int,
+                           geo: GeoConfig) -> RegionStep:
+    """A bare ``[T_pad, >=6Z, B]`` region lane block → time-major
+    :class:`RegionStep` (leaves ``[T, R, B]``), reading each region's
+    representative zone."""
+    slots = np.asarray(region_slots(geo, Z), np.int32)
+    fields = [block[:T, i * Z:(i + 1) * Z][:, slots]
+              for i in range(len(REGION_LANE_FIELDS))]
+    return RegionStep(*fields)
+
+
+def unpack_region_lanes(exo_packed, T: int, Z: int,
+                        geo: GeoConfig) -> RegionStep:
+    """Region lanes of a widened FULL stream (base exo + family
+    blocks) → :class:`RegionStep` — the geo overlay's and the parity
+    tests' consumption path."""
+    lay = lanes.resolve_layout(int(exo_packed.shape[1]), Z)
+    lo, _hi = lay.block("regions")
+    return region_step_from_block(exo_packed[:, lo:lo + 6 * Z], T, Z, geo)
+
+
+def sample_region_steps(geo: GeoConfig, key, steps: int, Z: int,
+                        *, dt_s: float = 30.0,
+                        start_unix_s: float = 0.0) -> RegionStep:
+    """Single-trace RegionStep (leaves ``[T, R]``) for standalone
+    rollouts — same processes, same key-tag scheme as the packed lanes
+    (a batch=1 synthesis, squeezed)."""
+    block = packed_region_lanes(geo, key, steps, steps, Z, 1,
+                                dt_s=dt_s, start_unix_s=start_unix_s)
+    slots = np.asarray(region_slots(geo, Z), np.int32)
+    fields = [block[:steps, i * Z:(i + 1) * Z][:, slots, 0]
+              for i in range(len(REGION_LANE_FIELDS))]
+    return RegionStep(*fields)
+
+
+def _registry_generate(cfg: GeoConfig, key, steps: int, t_pad: int,
+                       z: int, batch: int, *, ctx: dict):
+    """Lane-family registry adapter (`sim/lanes.provide_lane_generator`)
+    — :func:`packed_region_lanes` on the stream key with the clock
+    context the backends carry (bitwise the direct call)."""
+    return packed_region_lanes(
+        cfg, key, steps, t_pad, z, batch, dt_s=ctx["dt_s"],
+        start_unix_s=ctx.get("start_unix_s", 0.0),
+        start_offset_s=ctx.get("start_offset_s"),
+        wrap_period_s=ctx.get("wrap_period_s"))
+
+
+lanes.provide_lane_generator("regions", _registry_generate)

@@ -81,6 +81,19 @@ def workload_rows(Z: int) -> int:
     return fault_rows(Z) + 8
 
 
+def region_rows(Z: int) -> int:
+    """Rows of the per-region geo lane block (`ccka_tpu/regions`,
+    ISSUE 16): six Z-row sub-blocks (price deviation, carbon deviation,
+    migratable capacity, and the three migratable-family arrival rows,
+    each broadcast region→zone). Sized ``4*fault_rows(Z) + 32`` —
+    strictly greater than the SUM of every other registrable block
+    (faults + workloads + the test family total ``4*fault_rows(Z)+24``),
+    so any subset containing this family out-counts any subset without
+    it and row-count layout detection stays unambiguous at every zone
+    count, even while the registry test's throwaway family is live."""
+    return 4 * fault_rows(Z) + 32
+
+
 # ---- lane-family registry -------------------------------------------------
 
 # Zone counts the ambiguity check sweeps at registration time: every
@@ -204,13 +217,16 @@ def lane_generator(name: str):
     return fam.generate
 
 
-# The two built-in families. Their tags are canonical HERE; the process
+# The built-in families. Their tags are canonical HERE; the process
 # modules re-export them (`faults.process.FAULT_KEY_TAG` /
-# `workloads.process.WORKLOAD_KEY_TAG`) and register the generators.
+# `workloads.process.WORKLOAD_KEY_TAG` /
+# `regions.process.REGION_KEY_TAG`) and register the generators.
 register_lane_family("faults", rows=fault_rows, key_tag=0xFA117,
                      provider="ccka_tpu.faults.process")
 register_lane_family("workloads", rows=workload_rows, key_tag=0x301AD,
                      provider="ccka_tpu.workloads.process")
+register_lane_family("regions", rows=region_rows, key_tag=0x6E0,
+                     provider="ccka_tpu.regions.process")
 
 
 class StreamLayout:

@@ -9,8 +9,15 @@ three signal families in dollars:
       + carbon_weight · carbon_g           (default ≈ $50/tCO2e social cost)
       + slo_weight · pending_pod·ticks     (smooth SLO-burn proxy)
       + slo_violation_weight · (1−slo_ok)  (the tick failed the SLO gate)
+      [+ migration_weight · migration_cost_usd]   (geo overlay only)
 
 Lower is better. Rewards for PPO are the per-tick negative increments of J.
+
+The bracketed migration term (ISSUE 16) prices inter-region transfer
+dollars when the geo overlay runs (`ccka_tpu/regions`); it is an
+OPTIONAL kwarg defaulting to None so every pre-geo call site — and the
+kernel paths, whose StepMetrics carry no migration field — keeps the
+bitwise-identical four-term expression.
 
 Why two SLO terms: the scoreboard's headline denominators are *SLO-met
 hours* (usd_per_slo_hour) and attainment — a per-tick pass/fail — not
@@ -31,25 +38,32 @@ from ccka_tpu.sim.types import StepMetrics
 
 
 def step_cost(metrics: StepMetrics, tcfg: TrainConfig,
-              violation_weight=None) -> jnp.ndarray:
+              violation_weight=None, migration_cost=None) -> jnp.ndarray:
     """Per-tick scalar cost (leading axes preserved).
 
     ``violation_weight`` overrides the static config price — the
     Lagrangian-PPO path passes its adapted multiplier here (a traced
-    scalar carried in the train state, `TrainConfig.attain_target`)."""
+    scalar carried in the train state, `TrainConfig.attain_target`).
+
+    ``migration_cost`` — per-tick inter-region transfer dollars from
+    the geo overlay (`regions/geo.py`); None (every pre-geo caller)
+    leaves the four-term expression bitwise unchanged."""
     vw = (tcfg.slo_violation_weight if violation_weight is None
           else violation_weight)
     pending = jnp.maximum(
         metrics.demand_pods - metrics.served_pods, 0.0).sum(axis=-1)
-    return (metrics.cost_usd
+    cost = (metrics.cost_usd
             + tcfg.carbon_weight * metrics.carbon_g
             + tcfg.slo_weight * pending
             + vw * (1.0 - metrics.slo_ok))
+    if migration_cost is not None:
+        cost = cost + tcfg.migration_weight * migration_cost
+    return cost
 
 
 def step_reward(metrics: StepMetrics, tcfg: TrainConfig,
-                violation_weight=None) -> jnp.ndarray:
-    return -step_cost(metrics, tcfg, violation_weight)
+                violation_weight=None, migration_cost=None) -> jnp.ndarray:
+    return -step_cost(metrics, tcfg, violation_weight, migration_cost)
 
 
 def episode_objective(metrics: StepMetrics, tcfg: TrainConfig) -> jnp.ndarray:

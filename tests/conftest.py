@@ -93,6 +93,11 @@ def pytest_sessionfinish(session, exitstatus):
     # labeled row from a guessed one).
     last_round = max((r.get("round") or 0 for r in rows), default=0)
     wall = round(time.time() - _SESSION_T0["t"], 1)
+    # A run that executed zero tests (--collect-only, a bad -k filter)
+    # is not a lane measurement — recording its wall-clock would hand
+    # the budget gate a meaningless "best" row.
+    if not tr.stats.get("passed") and not tr.stats.get("failed"):
+        return
     round_inferred = not env_round.isdigit()
     row = {
         "round": int(env_round) if env_round.isdigit() else max(

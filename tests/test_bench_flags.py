@@ -224,3 +224,25 @@ def test_perf_only_flag_and_stage_wiring():
     assert "packed_mode_summary_fn" in src_k
     src_m = inspect.getsource(bench.bench_perf_mesh)
     assert "shard_lane_blocks" in src_m and "measure_shard_times" in src_m
+
+
+def test_geo_only_flag_and_stage_wiring():
+    """Round 19: the geo-arbitrage suite has a record path
+    (`--geo-only`) and the main sweep carries the stage — argparse
+    contract only (the subsystem itself is exercised in
+    tests/test_regions.py and the BENCH_r19 record)."""
+    parser_src = open(bench.__file__, encoding="utf-8").read()
+    assert "--geo-only" in parser_src
+    assert "bench_geo" in parser_src
+    import inspect
+
+    src = inspect.getsource(bench.bench_geo)
+    # The stage drives the SAME suite/rollout/ledger modules the tests
+    # pin (one implementation): the Pareto suite, the zero-rate parity
+    # arm against the registry-widened stream, and the migration-term
+    # ledger rows.
+    assert "run_geo_suite" in src
+    assert "packed_region_lanes" in src
+    assert "geo_rollout" in src
+    assert "DecisionLedger" in src
+    assert "zero_migration_parity" in src

@@ -219,6 +219,8 @@ def test_controller_from_config_builds_runners_from_kube_contexts():
     assert seen[0][:3] == ["kubectl", "--context", "ctx-a"]
 
 
+@pytest.mark.slow  # ISSUE 16 lane-time rule:
+# MPC replanning keeps its forecast-driven fast-lane representative.
 def test_controller_with_mpc_backend_replans(cfg_edge):
     """The receding-horizon path: controller triggers replan() on schedule
     and MPC decide() drives valid patches end to end."""

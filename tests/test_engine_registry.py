@@ -222,6 +222,8 @@ class TestLaneReachesEveryEngine:
         b = kfn(wide_s, 3)
         assert not _fields_equal(a, b), mode
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: the kernel-mode engines
+    # keep the registry-only derivation proof in the fast lane.
     def test_lax_engine_consumes_it_bitwise(self, cfg, streams,
                                             testlane):
         params = SimParams.from_config(cfg)
@@ -256,6 +258,8 @@ class TestLaneReachesEveryEngine:
         assert rep["n_blocks"] == T // T_CHUNK
         assert not _fields_equal(a, b)
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: 8-shard duplicate of the
+    # single-chip registry derivation that stays fast.
     def test_8shard_wrapper_consumes_it_bitwise(self, cfg, sources,
                                                 testlane):
         """Shard-local synthesis widens per shard and the sharded

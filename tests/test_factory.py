@@ -73,6 +73,9 @@ class TestValidation:
 
 
 class TestProduceCell:
+    @pytest.mark.slow  # ISSUE 16 lane-time rule:
+    # the cell fixture's full produce run; shapes are re-proven by the
+    # slow lane and the record's bench-diff factory gates.
     def test_dataset_shapes_and_clip(self, cfg, cell):
         n_rows = FKW["pairs"] * FKW["steps"]
         A = latent_dim(cfg.cluster)
@@ -84,6 +87,8 @@ class TestProduceCell:
         assert cell.plan_latents.shape == (FKW["pairs"], FKW["steps"],
                                            A)
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: rides the slow lane
+    # with the cell fixture (one produce run serves all three).
     def test_paired_summaries_and_report(self, cell):
         for s in (cell.teacher_summary, cell.rule_summary):
             assert np.asarray(s.usd_per_slo_hour).shape \
@@ -96,6 +101,8 @@ class TestProduceCell:
         assert rep["dataset_rows"] == FKW["pairs"] * FKW["steps"]
         assert rep["playback"]["pipeline"] == "double-buffered"
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule:
+    # label parity rides the slow lane with the cell fixture.
     def test_labels_match_the_lax_reference_engine(self, cfg, cell):
         """The factory's kernel playback labels == the registry's lax
         plan engine on the SAME stream and plans — the tentpole's
@@ -126,6 +133,8 @@ class TestProduceCell:
 
 
 class TestFactoryRunAndDistill:
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: e2e duplicate of the
+    # produce-cell + distill units; the ratio is pinned by BENCH_r17.
     def test_sweep_concats_cells_and_distills(self, cfg):
         # Both cells keep the module cell fixture's stream LAYOUT
         # (faults+workloads) so every kernel program is already warm —
@@ -150,6 +159,8 @@ class TestFactoryRunAndDistill:
             .apply(params, np.asarray(dataset.obs[0]))
         assert mean.shape == (latent_dim(cfg.cluster),)
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: the naive-vs-factory ratio
+    # is pinned per record by the bench-diff factory gates.
     def test_naive_baseline_reports_protocol(self, cfg):
         nb = factory_mod.naive_lax_pair_rate(
             cfg, WORKLOAD_SCENARIOS["diurnal-inference"], "off",

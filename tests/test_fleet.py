@@ -76,6 +76,8 @@ def test_fleet_state_advances_and_accumulates(cfg):
     assert np.all(np.asarray(ctrl.states.acc_cost_usd) > 0)
 
 
+@pytest.mark.slow  # ISSUE 16 lane-time rule: the pipelined-vs-sync
+# bitwise gate is pinned per record by the streaming bench stage.
 def test_pipelined_run_matches_sequential_ticks(cfg):
     """`run()` dispatches tick t+1 before fanning out tick t and pushes
     apply through the worker pool; neither may change WHAT is applied —
@@ -121,6 +123,8 @@ def test_cli_fleet_command(cfg, capsys):
     assert out["fleet_cost_usd_hr_last"] > 0
 
 
+@pytest.mark.slow  # ISSUE 16 lane-time rule: batched-plan parity is
+# exercised every record by the factory stage's one-dispatch planner.
 def test_optimize_plan_batch_matches_single(cfg):
     """vmap'd fleet planning is the same optimization per item."""
     from ccka_tpu.models import action_to_latent

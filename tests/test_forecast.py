@@ -285,6 +285,8 @@ def test_forecast_driven_mpc_jitted_end_to_end(cfg, synth, fc_name):
     assert np.all(np.isfinite(cost)) and cost.sum() > 0
 
 
+@pytest.mark.slow  # ISSUE 16 lane-time rule: the oracle default is
+# exercised by every non-forecast MPC test in the fast lane.
 def test_oracle_path_unchanged_by_forecaster_arg(cfg, synth):
     """forecaster=None must be bit-identical to the pre-subsystem
     behavior (it IS the pre-subsystem code path)."""
@@ -380,6 +382,8 @@ def test_cli_forecast_eval_unknown_forecaster():
               "--forecasters", "prophet"])
 
 
+@pytest.mark.slow  # ISSUE 16 lane-time rule: compile-cache hygiene,
+# not math; the e2e persistence representative stays fast.
 def test_forecaster_compile_cache_keys_on_config(cfg, synth):
     """ISSUE 4 satellite (ARCHITECTURE §8): forecasters hash by
     (type, config), so a FRESH same-config instance is a compile-cache

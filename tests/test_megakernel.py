@@ -108,6 +108,8 @@ class TestInterpretExactParity:
         bad = {f: r for f, r in rel.items() if r > 2e-3}
         assert not bad, f"interpret parity broken: {bad}"
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: padding masking rides the
+    # every-field interpret exactness proof that stays fast.
     def test_time_padding_masks_extra_ticks(self, cfg, setup):
         """T not divisible by t_chunk: padded ticks must contribute
         nothing (same result as the unpadded lax run)."""
@@ -223,6 +225,8 @@ class TestNeuralKernelParity:
     so exact parity holds only at short horizons; long horizons get the
     batch-mean gate (same structure as the on-chip contract)."""
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: neural parity keeps its
+    # sharded + streaming representatives in the slow lane too.
     def test_short_horizon_exact(self, cfg, setup):
         params, src, _, _ = setup
         net_params = _perturbed_net_params(cfg)
@@ -378,6 +382,8 @@ class TestPackedLayoutGeneration:
             jax.tree.map(jnp.asarray, trace), 96))
         np.testing.assert_allclose(packed, via_pack, rtol=1e-6, atol=1e-5)
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: duplicate of the
+    # every-field interpret exactness proof that stays fast.
     def test_packed_kernel_path_matches_unpacked(self, cfg, setup):
         """`megakernel_summary_from_packed` on a packed stream equals
         the standard wrapper on its unpacked traces (deterministic,

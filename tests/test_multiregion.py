@@ -356,6 +356,8 @@ class TestHysteresis:
 
 
 class TestMPCLearnsMigration:
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: the migration preference is
+    # pinned by the flagship BASELINE records; heavy MPC optimize run.
     def test_optimized_plan_prefers_clean_region_and_cuts_carbon(
             self, mcfg, msrc):
         """BASELINE config #4 with a *learned* backend: optimizing the plan

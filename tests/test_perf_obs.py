@@ -464,6 +464,8 @@ class TestScalingCurve:
 
 
 class TestPerfCLI:
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: full probe sweep; the
+    # render/unavailable CLI contract stays in the fast lane.
     def test_perf_probe_json(self, capsys):
         """`ccka perf` end to end on the CPU interpret path: the table
         carries a dispatch-joined, XLA-attributed row for the rule mode
@@ -486,6 +488,8 @@ class TestPerfCLI:
         assert rule["achieved_roofline_fraction"] is not None
         assert 0.0 < rule["achieved_roofline_fraction"] <= 1.25
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule:
+    # perf CLI contract rides the slow lane with probe-json.
     def test_perf_renders_unavailable_rows(self, capsys, monkeypatch):
         """Round-15 satellite: when the backend reports no cost
         analysis, `ccka perf` still prints attributed rows (flops '-')

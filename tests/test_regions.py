@@ -1,0 +1,604 @@
+"""Geo-arbitrage subsystem contract (ISSUE 16 tentpole + satellites).
+
+The claims under test:
+
+- **registry-only derivation**: the "regions" lane family reaches every
+  engine (lax, megakernel modes, streaming, sharded) THROUGH THE
+  REGISTRY ALONE — the widened stream's pre-geo rows and every engine
+  summary stay bitwise identical to the un-widened stream (region lanes
+  are passive; no engine consumes them in-kernel), while the lane block
+  itself is bitwise the hand-threaded `packed_region_lanes` reference
+  (the `test_engine_registry` discipline, now on a real family).
+- **work conservation**: the migration dynamics move pending mass,
+  never create or destroy it — including when the rendered migration
+  command stream is thinned/rewritten by a seeded ChaosSink and parsed
+  back (`apply_migration_commands` re-sanitizes).
+- **zero-migration parity**: all-zero rates are a bitwise no-op vs the
+  `none` policy, and the migration objective term is EXACTLY 0.0
+  (`step_cost(migration_cost=None)` is bitwise the pre-geo path).
+- **Pareto scoreboard**: `dominates`/`pareto_front` invariants, the
+  per-class suite record shape, and the `ccka bench-diff` geo gates
+  (zero-rate parity flag present AND true, fronts mutually
+  non-dominated, partial records are regressions, doctored root exits
+  1 through the CLI).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import ChaosConfig, ConfigError, multi_region_config
+from ccka_tpu.regions import (REGION_KEY_TAG, REGION_LANE_FIELDS,
+                              packed_region_lanes, region_rows,
+                              region_step_from_block, unpack_region_lanes)
+from ccka_tpu.regions import geo as geo_dyn
+from ccka_tpu.regions import migrate, pareto
+from ccka_tpu.sim import SimParams, lanes
+from ccka_tpu.signals.synthetic import SyntheticSignalSource
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The shared small geometry of test_engine_registry (interpret-mode
+# kernels; one compile per mode per stream layout).
+B, T, T_CHUNK, B_BLOCK = 32, 16, 8, 8
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return multi_region_config()
+
+
+@pytest.fixture(scope="module")
+def geo(cfg):
+    """The spot-storm scenario's geo config bound to the multiregion
+    cluster topology — active lanes on a 2-region, 4-zone layout."""
+    scn = pareto.GEO_SCENARIOS["spot-storm"]
+    g = dataclasses.replace(
+        scn.geo, zone_region_index=cfg.cluster.zone_region_index)
+    g.validate()
+    return g
+
+
+@pytest.fixture(scope="module")
+def sources(cfg, geo):
+    plain = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                  cfg.signals)
+    widened = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                    cfg.signals,
+                                    extra_lanes={"regions": geo})
+    return plain, widened
+
+
+@pytest.fixture(scope="module")
+def streams(sources):
+    key = jax.random.key(11)
+    plain, widened = sources
+    return (plain.packed_trace_device(T, key, B, t_chunk=T_CHUNK),
+            widened.packed_trace_device(T, key, B, t_chunk=T_CHUNK))
+
+
+@pytest.fixture(scope="module")
+def step(geo, cfg):
+    """One bare region-lane block as a RegionStep (packed [T, R, B]
+    layout squeezed through the block unpacker)."""
+    Z = cfg.cluster.n_zones
+    block = packed_region_lanes(geo, jax.random.key(3), 48, 48, Z, 4,
+                                dt_s=cfg.sim.dt_s)
+    return region_step_from_block(block, 48, Z, geo)
+
+
+def _fields_equal(a, b):
+    return {f for f in a._fields
+            if not np.array_equal(np.asarray(getattr(a, f)),
+                                  np.asarray(getattr(b, f)))}
+
+
+class TestRegionLaneFamily:
+    def test_registered_as_third_builtin(self):
+        names = [f.name for f in lanes.lane_families()]
+        assert "regions" in names
+        assert names.index("regions") > names.index("workloads")
+        assert lanes.LANE_FAMILIES["regions"].key_tag == REGION_KEY_TAG
+        assert lanes.LANE_FAMILIES["regions"].rows is lanes.region_rows
+        assert region_rows(4) == 64
+
+    def test_neutral_contract_default_config_is_exact_zero(self, cfg):
+        from ccka_tpu.config import GeoConfig
+
+        Z = cfg.cluster.n_zones
+        block = packed_region_lanes(GeoConfig(), jax.random.key(0),
+                                    8, 8, Z, 2, dt_s=30.0)
+        assert block.shape == (8, region_rows(Z), 2)
+        assert float(jnp.max(jnp.abs(block))) == 0.0
+
+    def test_builtin_via_extra_lanes_rejected(self, cfg):
+        with pytest.raises(ValueError, match="unknown lane family|built-in"):
+            SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                  cfg.signals,
+                                  extra_lanes={"no-such-family": 1.0})
+
+    def test_widened_stream_resolves_and_block_is_bitwise_reference(
+            self, cfg, geo, sources, streams):
+        Z = cfg.cluster.n_zones
+        plain_s, wide_s = streams
+        assert wide_s.shape[1] == plain_s.shape[1] + region_rows(Z)
+        lay = lanes.resolve_layout(wide_s.shape[1], Z)
+        assert lay.families == ("regions",)
+        assert lay.has("regions")
+        # Passive lanes: the two-tuple (faults?, workloads?) layout the
+        # engines branch on is unchanged — zero per-engine edits.
+        assert lanes.stream_layout(wide_s.shape[1], Z) \
+            == lanes.stream_layout(plain_s.shape[1], Z)
+        lo, hi = lay.block("regions")
+        assert np.array_equal(np.asarray(plain_s),
+                              np.asarray(wide_s[:, :lo]))
+        # The lane block is bitwise the hand-threaded reference. The
+        # reference must run under jit: the source synthesizes under
+        # jit and XLA's fused float ops differ from eager at ulp level.
+        ref = jax.jit(lambda k: packed_region_lanes(
+            geo, k, T, wide_s.shape[0], Z, B,
+            dt_s=cfg.sim.dt_s))(jax.random.key(11))
+        assert np.array_equal(np.asarray(wide_s[:, lo:hi]),
+                              np.asarray(ref))
+        _, widened = sources
+        assert widened.packed_rows() == wide_s.shape[1]
+
+    def test_unpack_roundtrips_the_widened_stream(self, cfg, geo,
+                                                  streams):
+        Z = cfg.cluster.n_zones
+        _, wide_s = streams
+        lay = lanes.resolve_layout(wide_s.shape[1], Z)
+        lo, hi = lay.block("regions")
+        a = unpack_region_lanes(wide_s, T, Z, geo)
+        b = region_step_from_block(wide_s[:, lo:hi], T, Z, geo)
+        assert not _fields_equal(a, b)
+        assert a._fields == REGION_LANE_FIELDS
+
+    @pytest.mark.slow  # lane-time rule: bench --geo-only pins lax
+    # parity per record; tier-1 keeps the rule-kernel representative.
+    def test_lax_engine_consumes_it_bitwise(self, cfg, streams):
+        from ccka_tpu.sim.rollout import lax_mode_summary
+
+        params = SimParams.from_config(cfg)
+        plain_s, wide_s = streams
+        key = jax.random.key(7)
+        a = lax_mode_summary(params, cfg.cluster, "rule", plain_s, T, key)
+        b = lax_mode_summary(params, cfg.cluster, "rule", wide_s, T, key)
+        assert not _fields_equal(a, b)
+
+    @pytest.mark.parametrize("mode", (
+        "rule",
+        pytest.param("carbon", marks=pytest.mark.slow),
+        pytest.param("neural", marks=pytest.mark.slow),
+        pytest.param("plan", marks=pytest.mark.slow),
+    ))
+    def test_kernel_modes_consume_it_bitwise(self, cfg, streams, mode):
+        # ISSUE 16 lane-time rule: one mode pins the fast-lane claim;
+        # the other three duplicate the same registry path and ride
+        # the slow lane.
+        from ccka_tpu.sim.megakernel import packed_mode_summary_fn
+
+        net_params = None
+        if mode == "neural":
+            from ccka_tpu.models import ActorCritic, latent_dim
+            from ccka_tpu.sim.megakernel import _obs_dim
+
+            net = ActorCritic(act_dim=latent_dim(cfg.cluster))
+            net_params = net.init(jax.random.key(5), jnp.zeros(
+                (_obs_dim(cfg.cluster.n_pools, cfg.cluster.n_zones),)))
+        params = SimParams.from_config(cfg)
+        plain_s, wide_s = streams
+        kfn = packed_mode_summary_fn(params, cfg.cluster, mode, T=T,
+                                     b_block=B_BLOCK, t_chunk=T_CHUNK,
+                                     interpret=True, stochastic=False,
+                                     net_params=net_params)
+        assert not _fields_equal(kfn(plain_s, 3), kfn(wide_s, 3)), mode
+
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: duplicates the
+    # registry path test_engine_registry already pins per-block.
+    def test_streaming_pipeline_consumes_it_bitwise(self, cfg, sources):
+        from ccka_tpu.sim import streaming as streaming_mod
+
+        params = SimParams.from_config(cfg)
+        plain, widened = sources
+        kw = dict(key=jax.random.key(13), batch=B, T=T, block_T=T_CHUNK,
+                  t_chunk=T_CHUNK, b_block=B_BLOCK, seed=5,
+                  interpret=True, stochastic=False, pipelined=True)
+        a, _ = streaming_mod.streaming_rollout_summary(
+            plain, params, cfg.cluster, "rule", **kw)
+        b, rep = streaming_mod.streaming_rollout_summary(
+            widened, params, cfg.cluster, "rule", **kw)
+        assert rep["n_blocks"] == T // T_CHUNK
+        # Decisions and dollar accounting are bitwise. The two carbon
+        # integrals may differ at the ulp level only: the block
+        # kernel's compiled program keys on the stream's row count, and
+        # XLA reassociates that one reduction differently at this
+        # width (~1e-9 absolute; the region lanes are still passive —
+        # a consumed lane would shift decisions macroscopically).
+        assert _fields_equal(a, b) <= {"carbon_kg", "g_co2_per_kreq"}
+        np.testing.assert_allclose(np.asarray(b.carbon_kg),
+                                   np.asarray(a.carbon_kg), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(b.g_co2_per_kreq),
+                                   np.asarray(a.g_co2_per_kreq),
+                                   rtol=1e-5)
+
+    @pytest.mark.slow  # 8-device mesh compile — slow-lane per the rule.
+    def test_8shard_wrapper_consumes_it_bitwise(self, cfg, geo, sources):
+        from ccka_tpu.parallel import make_mesh, sharded_packed_trace
+        from ccka_tpu.parallel.sharded_kernel import (
+            sharded_megakernel_summary_from_packed)
+        from ccka_tpu.policy.rule import offpeak_action, peak_action
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        params = SimParams.from_config(cfg)
+        mesh = make_mesh()
+        plain, widened = sources
+        key = jax.random.key(17)
+        Z = cfg.cluster.n_zones
+        sp = sharded_packed_trace(mesh, plain, T, key, B, t_chunk=T_CHUNK)
+        sw = sharded_packed_trace(mesh, widened, T, key, B,
+                                  t_chunk=T_CHUNK)
+        lay = lanes.resolve_layout(sw.shape[1], Z)
+        lo, _hi = lay.block("regions")
+        assert np.array_equal(np.asarray(sp), np.asarray(sw[:, :lo]))
+        off, peak = offpeak_action(cfg.cluster), peak_action(cfg.cluster)
+        kw = dict(stochastic=False, b_block=B // 8, t_chunk=T_CHUNK,
+                  interpret=True)
+        a = sharded_megakernel_summary_from_packed(
+            mesh, params, off, peak, sp, T, 3, **kw)
+        b = sharded_megakernel_summary_from_packed(
+            mesh, params, off, peak, sw, T, 3, **kw)
+        assert not _fields_equal(a, b)
+
+
+class TestMigrationActionSpace:
+    def test_sanitize_rates_invariants(self):
+        key = jax.random.key(0)
+        raw = jax.random.uniform(key, (3, 3, 3), minval=-0.5,
+                                 maxval=2.5)
+        r = np.asarray(migrate.sanitize_rates(raw))
+        assert r.min() >= 0.0 and r.max() <= 1.0
+        assert np.all(np.diagonal(r, axis1=0, axis2=1) == 0.0)
+        # Outflow per (source, family) never exceeds 1: at most the
+        # existing queued mass can move (conservation by construction).
+        assert np.all(r.sum(axis=1) <= 1.0 + 1e-6)
+        # Idempotent.
+        assert np.allclose(np.asarray(migrate.sanitize_rates(r)), r)
+
+    def test_policy_library_and_unknown_names_rejected(self):
+        assert set(migrate.GEO_POLICIES) \
+            == {"none", "cost-first", "carbon-first", "balanced"}
+        with pytest.raises(ValueError, match="unknown geo policies"):
+            migrate.resolve_geo_policies(["nope"])
+        with pytest.raises(ValueError, match="no geo policies"):
+            migrate.resolve_geo_policies([])
+        with pytest.raises(ValueError, match="unknown geo scenarios"):
+            pareto.resolve_geo_scenarios(["nope"])
+        with pytest.raises(ValueError, match="no geo scenarios"):
+            pareto.resolve_geo_scenarios([])
+
+    def test_zero_rate_rollout_bitwise_none_policy(self, geo, step):
+        R = geo.n_regions
+        zero = np.zeros((R, R, migrate.N_FAMILIES), np.float32)
+        a = geo_dyn.geo_rollout(geo, migrate.GEO_POLICIES["none"], step)
+        b = geo_dyn.geo_rollout(geo, None, step, rates_override=zero)
+        assert not _fields_equal(a, b)
+        assert float(jnp.max(a.migration_cost_usd)) == 0.0
+        assert float(jnp.max(a.moved_pods)) == 0.0
+
+    def test_step_cost_none_path_is_bitwise_pre_geo(self, cfg):
+        from ccka_tpu.sim.types import StepMetrics
+        from ccka_tpu.train.objective import step_cost
+
+        fields = {f: jnp.zeros(2) for f in StepMetrics._fields}
+        fields.update(
+            cost_usd=jnp.asarray([2.0, 3.0]),
+            carbon_g=jnp.asarray([100.0, 50.0]),
+            served_pods=jnp.asarray([[1.0], [0.0]]),
+            demand_pods=jnp.asarray([[2.0], [2.0]]),
+            slo_ok=jnp.asarray([1.0, 1.0]))
+        metrics = StepMetrics(**fields)
+        base = step_cost(metrics, cfg.train)
+        # None (the pre-geo call shape) and an explicit zero migration
+        # cost are both bitwise the original objective.
+        assert np.array_equal(
+            np.asarray(base),
+            np.asarray(step_cost(metrics, cfg.train, migration_cost=None)))
+        assert np.array_equal(
+            np.asarray(base),
+            np.asarray(step_cost(metrics, cfg.train,
+                                 migration_cost=jnp.zeros(2))))
+        with_mig = step_cost(metrics, cfg.train,
+                             migration_cost=jnp.asarray([0.5, 0.0]))
+        d = np.asarray(with_mig) - np.asarray(base)
+        assert d[0] == pytest.approx(cfg.train.migration_weight * 0.5)
+        assert d[1] == 0.0
+
+    def test_work_conservation_under_chaos(self, geo, step):
+        """The tentpole invariant end-to-end through the actuation
+        wire: policy rates -> rendered PatchCommands -> seeded
+        ChaosSink (drops + admission rewrites) -> parse-back of what
+        LANDED -> rollout. Pending mass is moved, never created or
+        destroyed, whatever subset of commands survives."""
+        from ccka_tpu.actuation.chaos import ChaosSink
+        from ccka_tpu.actuation.sink import DryRunSink
+
+        R = geo.n_regions
+        sig = migrate.RegionSignals(
+            price_dev=jnp.asarray([1.2, 0.0]),
+            carbon_dev=jnp.asarray([180.0, -40.0]),
+            capacity=jnp.asarray([8.0, 10.0]),
+            queues=jnp.full((R, migrate.N_FAMILIES), 5.0))
+        rates = np.asarray(
+            migrate.GEO_POLICIES["balanced"].rates(sig))
+        cmds = migrate.render_migration_commands(rates)
+        assert cmds, "balanced policy moved nothing on a hot gradient"
+        dry = DryRunSink()
+        chaos = ChaosSink(dry, ChaosConfig(enabled=True, drop_prob=0.4,
+                                           rewrite_prob=0.2), seed=7)
+        for cmd in cmds:
+            chaos._patch(cmd)
+        landed = [c for c in dry.commands
+                  if getattr(c, "name", "").startswith("geo-mig-")]
+        assert 0 < len(landed) < len(cmds), (
+            "seed 7 must realize a thinned-but-nonempty stream")
+        effective = migrate.apply_migration_commands(landed, R)
+        out = geo_dyn.geo_rollout(geo, None, step,
+                                  rates_override=effective)
+        residual = geo_dyn.conservation_residual(step, out)
+        assert residual < 1e-3, residual
+        # And the un-thinned wire round-trips the sanitized rates.
+        full = migrate.apply_migration_commands(cmds, R)
+        assert np.allclose(full, np.asarray(migrate.sanitize_rates(
+            jnp.asarray(rates))), atol=1e-8)
+
+    def test_conservation_across_policies(self, geo, step):
+        for name, pol in migrate.GEO_POLICIES.items():
+            out = geo_dyn.geo_rollout(geo, pol, step)
+            residual = geo_dyn.conservation_residual(step, out)
+            assert residual < 1e-3, (name, residual)
+
+
+class TestParetoScoreboard:
+    def test_dominates_and_front_properties(self):
+        pts = {"a": (1.0, 1.0, 0.0), "b": (2.0, 2.0, 0.0),
+               "c": (0.5, 3.0, 0.0), "d": (1.0, 1.0, 0.0)}
+        assert pareto.dominates(pts["a"], pts["b"])
+        assert not pareto.dominates(pts["b"], pts["a"])
+        # Equal points never strictly dominate.
+        assert not pareto.dominates(pts["a"], pts["d"])
+        front = pareto.pareto_front(pts)
+        assert "b" not in front
+        assert {"a", "c"} <= set(front)
+        # Front members are mutually non-dominated.
+        for x in front:
+            for y in front:
+                if x != y:
+                    assert not pareto.dominates(pts[x], pts[y])
+
+    @pytest.mark.slow  # lane-time rule: the bench-diff gate tests
+    # pin the record shape cheaply on a literal dict.
+    def test_small_suite_record_shape(self, cfg):
+        suite = pareto.run_geo_suite(
+            scenarios=["spot-storm"], policies=["none", "carbon-first"],
+            zone_region_index=cfg.cluster.zone_region_index,
+            seed=0, steps=24, batch=2, dt_s=cfg.sim.dt_s)
+        assert suite["policies"] == ["carbon-first", "none"]
+        assert suite["classes"] == sorted(pareto._CLASS_SLO)
+        (scn,) = suite["scenarios"]
+        for klass in suite["classes"]:
+            fr = scn["pareto"][klass]
+            assert set(fr["points"]) == {"none", "carbon-first"}
+            assert fr["front"], "empty Pareto front"
+            for n in fr["front"]:
+                assert n in fr["points"]
+        assert suite["max_conservation_residual"] < 1e-3
+
+
+class TestLedgerMigrationTerm:
+    def test_migration_term_always_present_and_shares_sum_to_one(
+            self, cfg):
+        from ccka_tpu.obs.decisions import (TERM_NAMES, objective_terms,
+                                            term_shares)
+
+        assert TERM_NAMES[-1] == "migration"
+        base = dict(cost_usd=2.0, carbon_g=120.0, pend_c0=1.0,
+                    pend_c1=0.5, slo_ok=1.0)
+        terms, _ = objective_terms(cfg.train, **base)
+        assert terms["migration"] == 0.0
+        assert set(terms) == set(TERM_NAMES)
+        terms, _ = objective_terms(cfg.train, **base,
+                                   migration_cost_usd=0.25)
+        assert terms["migration"] == pytest.approx(
+            cfg.train.migration_weight * 0.25)
+        shares = term_shares(terms)
+        assert set(shares) == set(TERM_NAMES)
+        assert abs(sum(shares.values()) - 1.0) < 1e-12
+
+    def test_observe_single_attaches_and_explain_renders_components(
+            self, cfg):
+        from ccka_tpu.config import ObsConfig
+        from ccka_tpu.obs.decisions import DecisionLedger, explain_row
+
+        led = DecisionLedger(
+            ObsConfig(enabled=True, decisions_enabled=True), cfg.train,
+            policy="geo-balanced")
+        chosen = dict(cost_usd=1.0, carbon_g=80.0, pend_c0=0.0,
+                      pend_c1=0.0, slo_ok=1.0, migration_cost_usd=0.04)
+        shadow = dict(cost_usd=1.1, carbon_g=90.0, pend_c0=0.0,
+                      pend_c1=0.0, slo_ok=1.0)
+        led.observe_single(
+            3, lane="peak", action=[1.0, 0.0], exo={}, state={},
+            chosen=chosen, shadow=shadow, shadow_action=[1.0, 0.0],
+            migration_components={"inference:r0->r1": 0.01,
+                                  "batch:r0->r1": 0.03})
+        (row,) = led.rows
+        shares = row["objective"]["shares"]
+        assert "migration" in shares
+        assert abs(sum(shares.values()) - 1.0) < 1e-12
+        text = explain_row(row)
+        assert "migration components" in text
+        # Largest component first.
+        assert text.index("batch:r0->r1") < text.index("inference:r0->r1")
+
+
+class TestGeoConfigValidation:
+    @pytest.mark.parametrize("field,value", (
+        ("price_dev_sigma", -1.0),
+        ("price_storm_frac", 1.5),
+        ("capacity_deny_frac", -0.1),
+        ("price_storm_mult", 0.5),
+        ("transfer_latency_ticks", 0),
+        ("transfer_cost_usd_per_pod", -0.01),
+        ("price_storm_carbon_g_kwh", -5.0),
+        ("zone_region_index", (0, 2)),
+    ))
+    def test_bad_values_rejected(self, geo, field, value):
+        with pytest.raises(ConfigError, match="geo"):
+            dataclasses.replace(geo, **{field: value}).validate()
+
+    def test_bound_to_binds_the_cluster_topology(self, cfg):
+        from ccka_tpu.config import GeoConfig
+
+        g = GeoConfig(enabled=True).bound_to(cfg.cluster)
+        assert g.zone_region_index == cfg.cluster.zone_region_index
+        assert g.n_regions == max(cfg.cluster.zone_region_index) + 1
+
+
+class TestBenchDiffGeoGates:
+    """The round-19 geo invariant gates (satellite 6)."""
+
+    CLEAN = {
+        "stage": "--geo-only",
+        "zero_migration_parity": True,
+        "dominance_found": True,
+        "max_conservation_residual": 9e-4,
+        "conservation_gate_pods": 0.01,
+        "classes": ["background", "batch", "inference"],
+        "scenarios": [{
+            "scenario": "spot-storm",
+            "pareto": {
+                k: {"points": {"none": [2.0, 2.0, 0.0],
+                               "carbon-first": [1.0, 1.0, 0.0]},
+                    "front": ["carbon-first"],
+                    "dominates_none": ["carbon-first"]}
+                for k in ("background", "batch", "inference")},
+        }],
+        "ledger": {"rows": 8, "term_share_err_max": 1e-15,
+                   "migration_share_max": 0.08,
+                   "migration_term_present": True},
+    }
+
+    def _diff(self, doc):
+        from ccka_tpu.obs import bench_history
+
+        return bench_history.bench_diff({
+            "records": [{"round": 19, "file": "BENCH_r19.json",
+                         "platform": "cpu",
+                         **bench_history._extract_geo(doc)}],
+            "lane": []})
+
+    def test_clean_record_passes(self):
+        assert self._diff(json.loads(json.dumps(self.CLEAN)))["ok"]
+
+    def test_each_gate_trips(self):
+        import copy
+
+        front_bad = copy.deepcopy(self.CLEAN)
+        # A 'front' that hides a dominated member is a corrupt board.
+        front_bad["scenarios"][0]["pareto"]["batch"]["front"] = [
+            "carbon-first", "none"]
+        residual_bad = dict(self.CLEAN, max_conservation_residual=0.5)
+        ledger_bad = copy.deepcopy(self.CLEAN)
+        ledger_bad["ledger"]["migration_term_present"] = False
+        shares_bad = copy.deepcopy(self.CLEAN)
+        shares_bad["ledger"]["term_share_err_max"] = 0.1
+        cases = [
+            (dict(self.CLEAN, zero_migration_parity=False), "bitwise"),
+            (front_bad, "dominated Pareto front"),
+            (residual_bad, "conserved"),
+            (ledger_bad, "migration term absent"),
+            (shares_bad, "sum to ~1"),
+        ]
+        for doc, needle in cases:
+            d = self._diff(doc)
+            assert not d["ok"], needle
+            assert any(needle in r["detail"] for r in d["regressions"]), \
+                (needle, d["regressions"])
+        # Missing claims are PARTIAL regressions, not silent passes.
+        for missing in ("zero_migration_parity", "dominance_found",
+                        "scenarios", "ledger", "classes",
+                        "max_conservation_residual"):
+            doc = {k: v for k, v in self.CLEAN.items() if k != missing}
+            d = self._diff(doc)
+            assert not d["ok"], missing
+            assert any("partial geo record" in r["detail"]
+                       for r in d["regressions"]), missing
+
+    def test_cli_bench_diff_doctored_root_exits_one(self, tmp_path,
+                                                    capsys):
+        from ccka_tpu.cli import main
+
+        os.makedirs(tmp_path / "data", exist_ok=True)
+        doc = json.loads(json.dumps(self.CLEAN))
+        doc["zero_migration_parity"] = False
+        doc["provenance"] = {"platform": "cpu"}
+        with open(tmp_path / "BENCH_r19.json", "w") as fh:
+            json.dump(doc, fh)
+        with open(tmp_path / "data" / "lane_times.json", "w") as fh:
+            json.dump([], fh)
+        assert main(["bench-diff", "--root", str(tmp_path)]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["regressions"][0]["kind"] == "geo_invariant"
+
+    def test_real_history_carries_round19_and_stays_clean(self):
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        history = load_bench_history(_ROOT)
+        r19 = [r for r in history["records"] if r["round"] == 19]
+        assert r19, "BENCH_r19.json missing from the repo root"
+        rec = r19[0]
+        assert rec["geo_zero_migration_parity"] is True
+        assert rec["geo_dominance_found"] is True
+        assert rec["geo_conservation_ok"] is True
+        assert rec["geo_migration_term_present"] is True
+        assert rec["geo_partial"] == []
+        assert rec["geo_front_violations"] == []
+        diff = bench_diff(history)
+        assert diff["ok"], diff["regressions"]
+
+
+class TestGeoCLI:
+    def test_unknown_names_rejected_up_front(self):
+        from ccka_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="unknown geo scenarios"):
+            main(["--preset", "multiregion", "geo",
+                  "--scenarios", "nope"])
+        with pytest.raises(SystemExit, match="unknown geo policies"):
+            main(["--preset", "multiregion", "geo",
+                  "--policies", "teleport"])
+
+    @pytest.mark.slow  # lane-time rule: the rejection test keeps
+    # the CLI entry in tier-1; rendering runs a real suite.
+    def test_renders_front_per_class(self, capsys):
+        from ccka_tpu.cli import main
+
+        assert main(["--preset", "multiregion", "geo",
+                     "--scenarios", "calm",
+                     "--policies", "none,balanced",
+                     "--steps", "16", "--batch", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "== calm" in out
+        for klass in ("inference", "batch", "background"):
+            assert f"{klass}: front = " in out
+        assert "conservation residual" in out

@@ -147,6 +147,8 @@ def _two_candidates(cfg):
     return jax.tree.map(lambda a, b: jnp.stack([a, b]), p0, p1)
 
 
+@pytest.mark.slow  # ISSUE 16 lane-time rule: profile/carbon sharded
+# parity stays fast; neural rides the slow lane with streaming's.
 def test_neural_entry_sharded_parity(mesh, cfg, setup, streams):
     """Sharded population-MLP entry: candidates replicated, batch split —
     [NP, B] fields match the single-device population launch."""
@@ -310,6 +312,8 @@ def test_cem_mega_engine_on_mesh(mesh, cfg):
                    engine="mega", mesh=mesh, mega_interpret=True)
 
 
+@pytest.mark.slow  # ISSUE 16 lane-time rule: plan playback parity keeps
+# its single-chip fast-lane proof; the mesh run is duplicative.
 def test_plan_playback_entry_sharded_parity(mesh, cfg, setup, streams):
     """Sharded plan-playback entry (ISSUE 4): per-cluster plans split on
     the exo stream's lane axis (and a broadcast plan replicated) must

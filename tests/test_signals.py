@@ -605,6 +605,8 @@ class TestReplayBatchWindows:
             16, jax.random.key(0), 4).carbon_g_kwh)
         assert not np.array_equal(b0, b1)
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: PPO-on-replay duplicates
+    # the fast-lane replay-window parity + PPO reward tests.
     def test_ppo_trains_on_replayed_traces(self):
         """Config #3 end to end: PPO over a replayed-trace batch runs and
         produces finite diagnostics (device_traces is ignored — replay

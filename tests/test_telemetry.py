@@ -481,7 +481,9 @@ def _service_report():
                                "slo_pending": 0.06,
                                "slo_violation": 0.04},
         shadow_slo_delta=-1.0,
-        shadow_usd_delta=0.0125)
+        shadow_usd_delta=0.0125,
+        region_migration_rate={"mean": 0.12},
+        region_carbon_intensity={"r0": 380.0, "r1": 420.0})
 
 
 class TestPromExport:
@@ -784,6 +786,63 @@ class TestPromExport:
         # Ledger-off service tick: the defaulted report (None rate/
         # delta, empty shares dict) skips all three instead of
         # exporting zeros.
+        bare = dataclasses.asdict(ServiceTickReport(
+            t=1, n_tenants=2, admitted=2, deferred=0, shed=0,
+            cadence_skipped=0, bulkhead_skipped=0, scrape_failed=0,
+            probes=0, applied=2, fanout_deferred=0, slo_ok=2,
+            cost_usd_hr=1.0, carbon_g_hr=10.0, pending_pods=0.0,
+            tick_latency_ms=5.0, admission_queue_depth=2,
+            sheds_total=0, deferrals_total=0,
+            breaker_transitions_total=0, cadence_divisor=1,
+            decide_ms=1.0, fanout_ms=1.0))
+        bare_text = render_exposition(bare)
+        for series in gauges:
+            assert series not in bare_text
+
+    def test_geo_gauges_cover_both_directions(self):
+        """Round-19 satellite: the geo-arbitrage series (the mean
+        applied migration rate via the dotted .mean spec, the summed
+        regional grid carbon intensity via the dict.* spec) must be
+        exported, panel-referenced, AND resolve from a real
+        ServiceTickReport — both directions of the parity contract —
+        while a controller TickReport (no geo fields) SKIPS them
+        rather than exporting fake zeros, and a service tick with no
+        published geo snapshot (empty default dicts) skips them too."""
+        import dataclasses
+
+        from ccka_tpu.harness.dashboard import _PANEL_DEFS
+        from ccka_tpu.harness.promexport import (SERIES,
+                                                 SERVICE_ONLY_SERIES,
+                                                 referenced_series,
+                                                 render_exposition,
+                                                 resolve_field)
+        from ccka_tpu.harness.service import ServiceTickReport
+
+        gauges = {"ccka_region_migration_rate",
+                  "ccka_region_carbon_intensity"}
+        assert gauges <= set(SERIES)
+        assert gauges <= set(SERVICE_ONLY_SERIES)
+        paneled = set()
+        for _t, expr, _u in _PANEL_DEFS:
+            paneled |= referenced_series(expr)
+        assert gauges <= paneled, "geo gauges missing from the dashboard"
+
+        rec = dataclasses.asdict(_service_report())
+        assert resolve_field(
+            rec, SERIES["ccka_region_migration_rate"][0]) == 0.12
+        # The .* spec sums the per-region intensity dict — the scrape
+        # sees total grid burden, the per-region split stays local.
+        assert resolve_field(
+            rec, SERIES["ccka_region_carbon_intensity"][0]) == 800.0
+        text = render_exposition(rec)
+        assert "ccka_region_migration_rate 0.12" in text
+        assert "ccka_region_carbon_intensity 800" in text
+        # Controller-skips contract: a TickReport has neither field.
+        for series in gauges:
+            assert resolve_field({"t": 1}, SERIES[series][0]) is None
+            assert series not in render_exposition({"t": 1})
+        # Geo-off service tick: the defaulted report (empty dicts for
+        # both surfaces) skips the series instead of exporting zeros.
         bare = dataclasses.asdict(ServiceTickReport(
             t=1, n_tenants=2, admitted=2, deferred=0, shed=0,
             cadence_skipped=0, bulkhead_skipped=0, scrape_failed=0,

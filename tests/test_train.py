@@ -256,6 +256,8 @@ class TestRefinementMechanics:
         assert not np.allclose(np.asarray(p0["critic"]["kernel"]),
                                np.asarray(p1["critic"]["kernel"]))
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: refinement mechanics ride
+    # the fast-lane PPO shape/reward tests; exactness unchanged.
     def test_warmup_then_actor_resumes(self, cfg, source):
         wcfg = cfg.with_overrides(**{"train.critic_warmup_iters": 1})
         trainer = PPOTrainer(wcfg)
@@ -266,6 +268,8 @@ class TestRefinementMechanics:
         assert not np.allclose(np.asarray(p0["actor_mean"]["kernel"]),
                                np.asarray(p1["actor_mean"]["kernel"]))
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: refinement mechanics ride
+    # the fast-lane PPO shape/reward tests; exactness unchanged.
     def test_anchor_bounds_policy_drift(self, cfg, source):
         # With a strong anchor, the refined policy's action means stay
         # near the anchor policy's; without, they drift further.
@@ -552,6 +556,8 @@ class TestMeshShardedPlanning:
         assert donated.is_deleted(), "warm-start buffer was not donated"
         assert r.plan_latent.shape == lat.shape
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: mesh duplicate of the
+    # single-chip plan-replay parity that stays in the fast lane.
     def test_receding_horizon_plan_replays_the_closed_loop(self, cfg,
                                                            source):
         from ccka_tpu.train.mpc import (receding_horizon_plan,

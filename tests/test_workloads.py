@@ -155,6 +155,8 @@ class TestLanes:
         assert plain.shape == disabled.shape
         assert np.array_equal(np.asarray(plain), np.asarray(disabled))
 
+    @pytest.mark.slow  # ISSUE 16 lane-time rule:
+    # widening bitwise keeps the faults-lane + regions-lane fast proofs.
     def test_widened_exo_and_fault_rows_bitwise(self, cfg, streams):
         Z = cfg.cluster.n_zones
         base = _exo_rows(Z)
