@@ -505,6 +505,27 @@ def _build_parser() -> argparse.ArgumentParser:
                       help="list: most recent rows to print "
                            "(default 20)")
 
+    stour = sub.add_parser(
+        "tournament", help="shadow-tournament observatory "
+                           "(obs/tournament JSONL from a service run): "
+                           "list the registered candidate builders, "
+                           "render the windowed per-class win board, "
+                           "or explain a signed promotion audit — who "
+                           "beat whom, on which windows and classes, "
+                           "with the signature verified")
+    stour.add_argument("action", choices=("list", "board", "explain"))
+    stour.add_argument("path", nargs="?", default="",
+                       help="tournament JSONL (TournamentLedger "
+                            "output; board/explain require it, list "
+                            "ignores it)")
+    stour.add_argument("--t", type=int, default=-1,
+                       help="board/explain: tick to render (default: "
+                            "the most recent board/audit row)")
+    stour.add_argument("--key", default="",
+                       help="explain: HMAC audit key (default: the "
+                            "--preset/--config obs.tournament_audit_"
+                            "key)")
+
     sbd = sub.add_parser(
         "bench-diff", help="bench-history regression sentinel "
                            "(obs/bench_history): load every "
@@ -1249,6 +1270,50 @@ def _cmd_decisions(args, cfg) -> int:
     return 0
 
 
+def _cmd_tournament(args, cfg) -> int:
+    """`ccka tournament list|board|explain` — the shadow-tournament
+    observatory: the registered candidate roster, the windowed
+    per-workload-class win board, or a signed promotion audit with its
+    signature verified against the config's audit key."""
+    from ccka_tpu.obs.tournament import (CANDIDATE_BUILDERS,
+                                         explain_audit, explain_board,
+                                         read_tournament)
+
+    if args.action == "list":
+        for name in sorted(CANDIDATE_BUILDERS):
+            _builder, desc = CANDIDATE_BUILDERS[name]
+            print(f"{name}: {desc}")
+        print(f"# {len(CANDIDATE_BUILDERS)} registered candidate "
+              "builder(s); compose a roster with "
+              "obs.tournament_roster", file=sys.stderr)
+        return 0
+    if not args.path:
+        raise SystemExit(f"ccka: tournament {args.action} needs the "
+                         "tournament JSONL path")
+    try:
+        rows = read_tournament(args.path)
+    except OSError as e:
+        raise SystemExit(f"ccka: cannot read tournament log: {e}")
+    except json.JSONDecodeError as e:
+        raise SystemExit(f"ccka: corrupt tournament log {args.path}: "
+                         f"{e}")
+    kind = "board" if args.action == "board" else "promotion_audit"
+    sel = [r for r in rows if r.get("kind") == kind
+           and (args.t < 0 or r.get("t") == args.t)]
+    if not sel:
+        where = f" at tick {args.t}" if args.t >= 0 else ""
+        raise SystemExit(f"ccka: no {kind} rows{where} in {args.path}"
+                         + ("" if kind == "board" else
+                            " — no challenger has sustained a win yet"))
+    if args.action == "board":
+        print(explain_board(sel[-1]))
+        return 0
+    key = args.key or cfg.obs.tournament_audit_key
+    for rec in sel if args.t >= 0 else sel[-1:]:
+        print(explain_audit(rec, key))
+    return 0
+
+
 def _cmd_geo(cfg: "FrameworkConfig", args) -> int:
     """`ccka geo` — the Pareto scoreboard: score the migration-policy
     library on the regional scenario suite and render the cost/carbon/
@@ -1792,6 +1857,8 @@ def main(argv: list[str] | None = None) -> int:
             return _cmd_incidents(args)
         if args.command == "decisions":
             return _cmd_decisions(args, cfg)
+        if args.command == "tournament":
+            return _cmd_tournament(args, cfg)
         if args.command == "bench-diff":
             return _cmd_bench_diff(args)
         if args.command == "geo":

@@ -913,6 +913,19 @@ class ObsConfig:
       only; `ccka decisions` reads the file);
       ``decisions_enabled=False`` skips the ledger while the rest of
       the obs layer runs (the bench_decisions off-arm).
+    - **shadow tournament** (`obs/tournament.py`, round 20): the rule
+      shadow generalized to a named K-candidate roster
+      (``tournament_roster``) ridden as unconditional lanes of the
+      same compiled ticks; a host-side win ledger scores candidates
+      per workload class and region over
+      ``tournament_window``-tick sliding windows, and a candidate
+      sustaining ``tournament_win_rate`` for
+      ``tournament_sustain_ticks`` ticks stamps ONE edge-triggered
+      `challenger_sustained_win` incident plus a SIGNED promotion
+      audit (``tournament_audit_key``) — never an automatic primary
+      switch. ``tournament_enabled=False`` skips the ledger only
+      (the bench_tournament off-arm); the roster names themselves
+      are program-shaping and therefore config, not toggle.
 
     ``enabled=False`` (the default, preset "off") is a hard gate in
     the established idiom: no recorder, no triggers, no burn engine,
@@ -972,6 +985,36 @@ class ObsConfig:
     # Windowed divergence rate crossing this from below stamps ONE
     # policy_divergence incident (edge-triggered).
     divergence_spike_rate: float = 0.5
+    # Shadow tournament (round 20, obs/tournament.py). The roster
+    # NAMES are PROGRAM-SHAPING: each one adds candidate lanes to the
+    # compiled batched ticks, so they must live on the config the
+    # compiled builders are keyed by (cfg.obs) — an obs override passed
+    # to FleetService may not disagree with it. Everything else below
+    # is host-side only: ``tournament_enabled`` toggles the ledger the
+    # way ``decisions_enabled`` toggles the decision ledger, and is
+    # never read by the traced function — toggling it cannot select a
+    # different XLA program (the round-18 construction, re-proven
+    # bitwise by `bench.py --tournament-only`).
+    tournament_roster: tuple = ()
+    tournament_enabled: bool = True
+    # Sliding win-ledger window (ticks) behind the per-class board and
+    # ccka_policy_candidate_win_rate.
+    tournament_window: int = 16
+    # Relative margin a candidate's projected objective must beat the
+    # chosen policy's by to count a win (0 = any strict improvement).
+    tournament_win_margin: float = 0.0
+    # Overall windowed win rate at/above this for
+    # tournament_sustain_ticks consecutive ticks stamps ONE
+    # edge-triggered challenger_sustained_win incident + a signed
+    # promotion audit (re-armed below the bar).
+    tournament_win_rate: float = 0.6
+    tournament_sustain_ticks: int = 8
+    # Board + promotion-audit JSONL ("" = in-memory only; `ccka
+    # tournament board|explain` reads this file).
+    tournament_log_path: str = ""
+    # HMAC key sealing promotion audit records (operator-configured in
+    # production; the default keeps dry runs verifiable).
+    tournament_audit_key: str = "ccka-tournament"
 
     def validate(self) -> None:
         if self.ring_size < 1:
@@ -993,6 +1036,23 @@ class ObsConfig:
             raise ConfigError("obs: divergence_threshold must be >= 0")
         if not 0.0 < self.divergence_spike_rate <= 1.0:
             raise ConfigError("obs: divergence_spike_rate out of (0, 1]")
+        if not isinstance(self.tournament_roster, tuple):
+            raise ConfigError("obs: tournament_roster must be a tuple "
+                              "of candidate names (it keys the "
+                              "compiled-tick cache)")
+        if len(set(self.tournament_roster)) != len(
+                self.tournament_roster):
+            raise ConfigError("obs: tournament_roster has duplicate "
+                              "candidate names — one lane per name")
+        if self.tournament_window < 1:
+            raise ConfigError("obs: tournament_window must be >= 1 tick")
+        if self.tournament_win_margin < 0.0:
+            raise ConfigError("obs: tournament_win_margin must be >= 0")
+        if not 0.0 < self.tournament_win_rate <= 1.0:
+            raise ConfigError("obs: tournament_win_rate out of (0, 1]")
+        if self.tournament_sustain_ticks < 1:
+            raise ConfigError("obs: tournament_sustain_ticks must be "
+                              ">= 1")
 
 
 # The flight-recorder postures (`bench.py bench_obs`, `ccka fleet

@@ -117,6 +117,13 @@ _PANEL_DEFS = (
     ("Geo migration vs grid carbon",
      "ccka_region_migration_rate + ccka_region_carbon_intensity / 1000",
      "short"),
+    # Shadow-tournament panels (round 20; obs/tournament.py): how hard
+    # the roster is pressing on the live primary (summed windowed win
+    # rate) and which candidate currently leads the board — the
+    # operator's cue to go read `ccka tournament explain`.
+    ("Tournament challenger pressure",
+     "ccka_policy_candidate_win_rate", "short"),
+    ("Tournament leader", "ccka_tournament_leader", "short"),
 )
 
 

@@ -169,39 +169,56 @@ def _compiled_fleet_tick(cfg: FrameworkConfig, backend,
     host-side sum over the first four columns."""
     from ccka_tpu.obs.compile import watch_jit
     from ccka_tpu.obs.decisions import shadow_decision_columns
+    from ccka_tpu.obs.tournament import (TournamentRoster,
+                                         add_candidate_lanes)
     from ccka_tpu.policy.rule import RulePolicy
 
     action_fn = backend.action_fn()
     shadow_fn = RulePolicy(cfg.cluster).action_fn()
     params = SimParams.from_config(cfg)
+    # Shadow-tournament lanes (round 20): the roster rides cfg.obs —
+    # program-shaping names resolved INSIDE the cached builder like
+    # the rule shadow, so the cache key stays (config, backend, n,
+    # horizon). An empty roster (the default) compiles EXACTLY the
+    # round-18 program.
+    cand_fns = TournamentRoster(
+        cfg, cfg.obs.tournament_roster).action_fns()
+    zone_region_index = cfg.cluster.zone_region_index
+    n_regions = cfg.cluster.n_regions
 
     @jax.jit
     def fleet_tick(states, xs_all, t, key):
-        """One dispatch: slice exo, decide (+ rule shadow), estimate
-        both, pack per-cluster."""
+        """One dispatch: slice exo, decide (+ rule shadow + tournament
+        candidates), estimate all, pack per-cluster."""
         exo_n = exo_at(xs_all, t, horizon_ticks)
         actions = jax.vmap(lambda s, e: action_fn(s, e, t))(states, exo_n)
         shadow = jax.vmap(lambda s, e: shadow_fn(s, e, t))(states, exo_n)
         keys = jax.random.split(jax.random.fold_in(key, t), n)
-        new_states, metrics = jax.vmap(
-            partial(sim_step, params, stochastic=False)
-        )(states, actions, exo_n, keys)
+        step_n = jax.vmap(partial(sim_step, params, stochastic=False))
+        new_states, metrics = step_n(states, actions, exo_n, keys)
         # Counterfactual one-step projection: same pre-step states,
         # same exo, same keys — only the action differs. The shadow's
         # next state is discarded (the real estimate chain must not
         # fork); only its step metrics ride out.
-        _sh_states, sh_metrics = jax.vmap(
-            partial(sim_step, params, stochastic=False)
-        )(states, shadow, exo_n, keys)
+        _sh_states, sh_metrics = step_n(states, shadow, exo_n, keys)
         flat = flatten_actions(actions, n)
         flat_sh = flatten_actions(shadow, n)
         packed = pack_rows(flat, exo_n)
-        per = jnp.concatenate([
+        blocks = [
             per_cluster_metrics(metrics),
             shadow_decision_columns(metrics, sh_metrics, exo_n,
                                     flat, flat_sh),
             flat_sh,
-        ], axis=-1)
+        ]
+        if cand_fns:
+            # K candidate lanes through the SAME expectation dynamics
+            # on the SAME inputs — computed unconditionally, so the
+            # host-side tournament ledger toggling can never select a
+            # different program (obs/tournament.py).
+            blocks.append(add_candidate_lanes(
+                states, exo_n, t, keys, flat, cand_fns, step_n, n,
+                zone_region_index, n_regions))
+        per = jnp.concatenate(blocks, axis=-1)
         return packed, new_states, per
 
     # Watched jit (obs/compile.py): the batched decide is THE fleet
@@ -299,7 +316,8 @@ class FleetController:
         self.ledger = ledger
         self.incident_log = incident_log
         from ccka_tpu.obs.decisions import decision_row_layout
-        self._dec_layout = decision_row_layout(cfg.cluster)
+        self._dec_layout = decision_row_layout(
+            cfg.cluster, candidates=cfg.obs.tournament_roster)
 
     def _fleet_tick(self, states, t, key):
         """The batched tick over this fleet's traces (kept as a bound

@@ -192,6 +192,21 @@ SERIES: dict[str, tuple[str, str]] = {
         "shadow_slo_delta",
         "Chosen-minus-rule-shadow SLO-ok tenant count this tick "
         "(projected on identical observed inputs)"),
+    # Shadow-tournament series (round 20; obs/tournament.py): the
+    # summed windowed win rate over every roster candidate (the
+    # challenger-pressure gauge — 0 means nothing on the roster is
+    # beating the primary anywhere) and the current board leader's
+    # roster index. Service-only, skipped (never fake zeros) when no
+    # tournament ledger runs.
+    "ccka_policy_candidate_win_rate": (
+        "candidate_win_rate.*",
+        "Summed windowed win rate over the tournament roster's "
+        "candidates vs the live primary (per-candidate and per-class "
+        "splits ride the board JSONL)"),
+    "ccka_tournament_leader": (
+        "tournament_leader",
+        "Roster index of the candidate currently leading the shadow "
+        "tournament's windowed board"),
     # Geo-arbitrage series (ISSUE 16; regions/geo.py publish/read
     # snapshot): the mean applied inter-region migration rate of the
     # last geo rollout and the sum of the per-region carbon
@@ -238,6 +253,7 @@ SERVICE_ONLY_SERIES = frozenset({
     "ccka_policy_divergence_rate", "ccka_objective_term_share",
     "ccka_shadow_slo_delta",
     "ccka_region_migration_rate", "ccka_region_carbon_intensity",
+    "ccka_policy_candidate_win_rate", "ccka_tournament_leader",
 })
 
 _LABEL = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")

@@ -259,6 +259,8 @@ def _compiled_service_tick(cfg: FrameworkConfig, backend,
     ledger-on/off bitwise gate."""
     from ccka_tpu.obs.compile import watch_jit
     from ccka_tpu.obs.decisions import shadow_decision_columns
+    from ccka_tpu.obs.tournament import (TournamentRoster,
+                                         add_candidate_lanes)
     from ccka_tpu.policy.rule import RulePolicy
 
     from ccka_tpu.harness.fleet import (exo_at, flatten_actions,
@@ -268,6 +270,14 @@ def _compiled_service_tick(cfg: FrameworkConfig, backend,
     params = SimParams.from_config(cfg)
     fallback_fn = RulePolicy(cfg.cluster).action_fn()
     shapes, sizes = action_layout(cfg.cluster)
+    # Shadow-tournament lanes (round 20): roster names come from
+    # cfg.obs (program-shaping, part of this builder's cache key);
+    # candidates are constructed INSIDE the builder like the rule
+    # fallback. Empty roster = exactly the round-18 program.
+    cand_fns = TournamentRoster(
+        cfg, cfg.obs.tournament_roster).action_fns()
+    zone_region_index = cfg.cluster.zone_region_index
+    n_regions = cfg.cluster.n_regions
 
     def _unflatten(flat: jnp.ndarray) -> Action:
         leaves, off = [], 0
@@ -289,22 +299,29 @@ def _compiled_service_tick(cfg: FrameworkConfig, backend,
             jnp.where(lane_col == LANE_HOLD, held, flat_fb))
         actions = _unflatten(flat_sel)
         keys = jax.random.split(jax.random.fold_in(key, t), n)
-        new_states, metrics = jax.vmap(
-            functools.partial(sim_step, params, stochastic=False)
-        )(states, actions, exo_n, keys)
+        step_n = jax.vmap(
+            functools.partial(sim_step, params, stochastic=False))
+        new_states, metrics = step_n(states, actions, exo_n, keys)
         # Rule-shadow counterfactual: same pre-step states, exo and
         # keys; only the action differs. Shadow next-states are
         # discarded — the real estimate chain must not fork.
-        _sh_states, sh_metrics = jax.vmap(
-            functools.partial(sim_step, params, stochastic=False)
-        )(states, _unflatten(flat_fb), exo_n, keys)
+        _sh_states, sh_metrics = step_n(states, _unflatten(flat_fb),
+                                        exo_n, keys)
         packed = pack_rows(flat_sel, exo_n)
-        per = jnp.concatenate([
+        blocks = [
             per_cluster_metrics(metrics),
             shadow_decision_columns(metrics, sh_metrics, exo_n,
                                     flat_sel, flat_fb),
             flat_fb,
-        ], axis=-1)
+        ]
+        if cand_fns:
+            # Unconditional K-candidate lanes (obs/tournament.py): the
+            # tournament ledger toggling on/off can never select a
+            # different XLA program.
+            blocks.append(add_candidate_lanes(
+                states, exo_n, t, keys, flat_sel, cand_fns, step_n, n,
+                zone_region_index, n_regions))
+        per = jnp.concatenate(blocks, axis=-1)
         return packed, new_states, per
 
     return watch_jit(service_tick, "service.tick", hot=True,
@@ -376,6 +393,13 @@ class ServiceTickReport:
     # {} when no geo rollout has run — the exporter SKIPS the series.
     region_migration_rate: dict = dataclasses.field(default_factory=dict)
     region_carbon_intensity: dict = dataclasses.field(default_factory=dict)
+    # Shadow-tournament surfaces (round 20; obs/tournament.py): the
+    # per-candidate windowed win rates (promexport sums the dict — the
+    # "challenger pressure" gauge) and the current board leader's
+    # roster index. {}/None when no tournament ledger runs — the
+    # exporter SKIPS both series (never-fake-zeros).
+    candidate_win_rate: dict = dataclasses.field(default_factory=dict)
+    tournament_leader: "int | None" = None
 
 
 class FleetService:
@@ -504,6 +528,7 @@ class FleetService:
         self.incidents = None
         self.burn = None
         self.decisions = None
+        self.tournament = None
         if ob.enabled:
             from ccka_tpu.obs.burnrate import BurnRateEngine
             from ccka_tpu.obs.incidents import IncidentLog
@@ -531,13 +556,39 @@ class FleetService:
             # bench_decisions off-arm — the device program is the
             # same either way.
             if ob.decisions_enabled:
-                from ccka_tpu.obs.decisions import (DecisionLedger,
-                                                    decision_row_layout)
+                from ccka_tpu.obs.decisions import DecisionLedger
                 self.decisions = DecisionLedger(
                     ob, cfg.train,
                     policy=getattr(backend, "name",
                                    type(backend).__name__))
-                self._dec_layout = decision_row_layout(cfg.cluster)
+            # Shadow tournament (round 20, obs/tournament.py): the
+            # host-side win ledger over the candidate lanes the
+            # compiled tick already emits. The roster is cfg.obs's
+            # (program truth); an obs override naming a DIFFERENT
+            # roster would score columns that don't exist — refuse.
+            roster = tuple(cfg.obs.tournament_roster)
+            if obs is not None and tuple(ob.tournament_roster) not in (
+                    (), roster):
+                raise ValueError(
+                    "obs override names tournament roster "
+                    f"{ob.tournament_roster} but the compiled tick "
+                    f"carries cfg.obs.tournament_roster={roster} — "
+                    "the roster is program-shaping and must be set on "
+                    "the FrameworkConfig, not the override")
+            if roster and ob.tournament_enabled:
+                from ccka_tpu.obs.tournament import (TournamentLedger,
+                                                     workload_class)
+                self.tournament = TournamentLedger(
+                    ob, cfg.train, roster,
+                    classes=[workload_class(p.name)
+                             for p in self.profiles],
+                    policy=getattr(backend, "name",
+                                   type(backend).__name__))
+        # ONE row layout for both host ledgers, widened by the
+        # program's roster (K=0 -> exactly the round-18 layout).
+        from ccka_tpu.obs.decisions import decision_row_layout
+        self._dec_layout = decision_row_layout(
+            cfg.cluster, candidates=cfg.obs.tournament_roster)
 
     def _note_giveup(self, tenant: int, _outcome) -> None:
         """Reconciler give-up hook (`actuation/reconcile.on_giveup`):
@@ -556,6 +607,8 @@ class FleetService:
             self.incidents.close()
         if getattr(self, "decisions", None) is not None:
             self.decisions.close()
+        if getattr(self, "tournament", None) is not None:
+            self.tournament.close()
         self.ctrl.close()
 
     def warmup(self) -> None:
@@ -783,9 +836,9 @@ class FleetService:
             #     instead of hiding between ticks.
             slo_burn = slo_burn_slow = 0.0
             incident_active = 0
-            dec = None
+            dec = tour = None
             if self.burn is not None:
-                slo_burn, slo_burn_slow, incident_active, dec = \
+                slo_burn, slo_burn_slow, incident_active, dec, tour = \
                     self._observe_tick(t, t0, lanes, shed, scraped_ok,
                                        per_np, packed_np, applied,
                                        deadline if has_deadline
@@ -838,6 +891,9 @@ class FleetService:
                 "objective_term_shares") or {},
             shadow_slo_delta=(dec or {}).get("shadow_slo_delta"),
             shadow_usd_delta=(dec or {}).get("shadow_usd_delta"),
+            candidate_win_rate=(tour or {}).get("candidate_win_rate")
+            or {},
+            tournament_leader=(tour or {}).get("tournament_leader"),
             **self._perf_surfaces(),
             **self._geo_surfaces(),
         )
@@ -966,13 +1022,25 @@ class FleetService:
             if spike is not None:
                 self.incidents.stamp("policy_divergence", t=t, **spike)
 
+        # Shadow tournament (round 20): score the candidate lanes the
+        # dispatch already computed; a sustained challenger stamps ONE
+        # edge-triggered challenger_sustained_win with its dump and
+        # the signed promotion audit's evidence. Host floats only.
+        tour = None
+        if self.tournament is not None:
+            tour = self.tournament.observe_tick(
+                t, per_np, self._dec_layout, lanes=lanes)
+            for ch in tour.get("challengers", ()):
+                self.incidents.stamp("challenger_sustained_win", t=t,
+                                     **ch)
+
         slo_burn = self.burn.rate("slo", "fast")
         slo_burn_slow = self.burn.rate("slo", "slow")
         last = self.incidents.last_tick()
         incident_active = int(
             self.burn.any_burning
             or (last is not None and t - last < ob.burn_fast_window))
-        return slo_burn, slo_burn_slow, incident_active, dec
+        return slo_burn, slo_burn_slow, incident_active, dec, tour
 
     def run(self, ticks: int, start_tick: int = 0) -> list:
         """Sequential bounded ticks (the deadline is a per-tick host

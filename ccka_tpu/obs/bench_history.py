@@ -216,6 +216,12 @@ def _extract_metrics(doc: dict) -> dict:
     geo = (doc if doc.get("stage") == "--geo-only" else doc.get("geo"))
     if isinstance(geo, dict):
         out.update(_extract_geo(geo))
+    # Round-20 shadow-tournament stage (stage record or nested
+    # "tournament").
+    tour = (doc if doc.get("stage") == "--tournament-only"
+            else doc.get("tournament"))
+    if isinstance(tour, dict):
+        out.update(_extract_tournament(tour))
     return out
 
 
@@ -577,6 +583,79 @@ def _extract_decisions(dec: dict) -> dict:
     return out
 
 
+def _extract_tournament(tour: dict) -> dict:
+    """The round-20 shadow-tournament invariants a record states about
+    itself (ISSUE 17 satellite): tournament-on/off runs bitwise in
+    decisions AND patch streams (the flag must be PRESENT and true —
+    absent is partial, not green), the host win-ledger priced within
+    the same 5%-of-p50 bound at the record's K=4 roster, every board
+    win rate (overall and per workload class) inside [0, 1], board
+    rows 1:1 with the roster the record names, and the seeded
+    challenger scenario holding its exactly-one-incident contract with
+    a verified dump and HMAC-valid promotion audits. Partial records
+    are regressions — the factory/perf/decisions/geo discipline."""
+    out: dict = {"tournament_partial": [],
+                 "tournament_rate_violations": []}
+    if tour.get("bitwise_identical") is None:
+        out["tournament_partial"].append(
+            "missing the tournament-on/off bitwise_identical flag")
+    else:
+        out["tournament_bitwise"] = bool(tour["bitwise_identical"])
+    if tour.get("ledger_overhead_frac") is None:
+        out["tournament_partial"].append(
+            "missing ledger_overhead_frac")
+    else:
+        out["tournament_overhead_frac"] = float(
+            tour["ledger_overhead_frac"])
+    roster = tour.get("roster")
+    board = tour.get("board")
+    if not isinstance(roster, list) or not roster:
+        out["tournament_partial"].append(
+            "missing the roster the record claims to have scored")
+    if not isinstance(board, dict) or not board:
+        out["tournament_partial"].append(
+            "no board recorded — the tournament scored nothing")
+    elif isinstance(roster, list) and roster:
+        out["tournament_board_matches_roster"] = bool(
+            list(board) == list(roster))
+        for name, entry in board.items():
+            if not isinstance(entry, dict):
+                out["tournament_partial"].append(
+                    f"board row {name!r} is not a record")
+                continue
+            rates = [("overall", entry.get("win_rate"))]
+            rates += [(f"class {c}", (ce or {}).get("win_rate"))
+                      for c, ce in (entry.get("classes") or {}).items()]
+            for where, rate in rates:
+                if rate is not None and not 0.0 <= float(rate) <= 1.0:
+                    out["tournament_rate_violations"].append(
+                        f"candidate {name!r} {where} win rate {rate} "
+                        "outside [0, 1]")
+            if not entry.get("classes"):
+                out["tournament_partial"].append(
+                    f"board row {name!r} missing its per-class split")
+    ch = tour.get("challenger")
+    if not isinstance(ch, dict):
+        out["tournament_partial"].append(
+            "missing the seeded challenger scenario section")
+    else:
+        inc = ch.get("incidents")
+        dumps = ch.get("dumps_verified")
+        audits = ch.get("audit_rows")
+        valid = ch.get("audits_verified")
+        if inc is None or dumps is None or audits is None \
+                or valid is None:
+            out["tournament_partial"].append(
+                "challenger section missing its incident/dump/audit "
+                "accounting")
+        else:
+            out["tournament_challenger_ok"] = bool(
+                int(inc) == 1 and int(dumps) == 1 and int(audits) >= 1
+                and int(valid) == int(audits)
+                and not ch.get("dump_failures"))
+    return out
+
+
 # A single-core virtual host cannot overlap generation with the kernel
 # (there is no second core to run it on): its pipelined drive is held
 # to this non-regression floor instead of the >= 1.0 overlap gate.
@@ -908,6 +987,47 @@ def bench_diff(history: dict, *,
                 "detail": "objective-term shares (with the migration "
                           "term) no longer sum to ~1 on the geo "
                           "ledger rows"})
+        # Round-20 shadow-tournament invariants (ISSUE 17): the
+        # tournament must neither steer (bitwise) nor overspend (the
+        # same 5%-of-p50 bound, at the record's K=4 roster), the board
+        # must cover the roster 1:1 with every win rate in [0,1], and
+        # the seeded challenger scenario must hold its exactly-one-
+        # incident contract with verified dump + signed audits.
+        # Partial records are regressions.
+        for what in rec.get("tournament_partial", []):
+            regressions.append({
+                "kind": "tournament_invariant", "round": rnd,
+                "detail": f"partial tournament record: {what}"})
+        if rec.get("tournament_bitwise") is False:
+            regressions.append({
+                "kind": "tournament_invariant", "round": rnd,
+                "detail": "tournament-on/off decision+patch streams "
+                          "no longer bitwise identical"})
+        if rec.get("tournament_overhead_frac", 0.0) \
+                > max_recorder_overhead:
+            regressions.append({
+                "kind": "tournament_invariant", "round": rnd,
+                "value": rec["tournament_overhead_frac"],
+                "threshold": max_recorder_overhead,
+                "detail": "tournament win-ledger overhead exceeded "
+                          "the 5%-of-p50 bound at the record's K"})
+        if rec.get("tournament_board_matches_roster") is False:
+            regressions.append({
+                "kind": "tournament_invariant", "round": rnd,
+                "detail": "board rows no longer 1:1 with the roster "
+                          "the record names — a candidate went "
+                          "unscored or a phantom row appeared"})
+        for what in rec.get("tournament_rate_violations", []):
+            regressions.append({
+                "kind": "tournament_invariant", "round": rnd,
+                "detail": f"implausible win rate: {what}"})
+        if rec.get("tournament_challenger_ok") is False:
+            regressions.append({
+                "kind": "tournament_invariant", "round": rnd,
+                "detail": "the seeded challenger scenario no longer "
+                          "yields exactly one challenger_sustained_win "
+                          "with a verified dump and HMAC-valid "
+                          "promotion audits"})
     return {"comparisons": comparisons, "regressions": regressions,
             "ok": not regressions}
 

@@ -84,6 +84,18 @@ DECISION_COLS = (
 # LANE_HOLD=1, LANE_FALLBACK=2); index-aligned by contract.
 LANE_NAMES = ("fresh", "hold", "fallback")
 
+# Per-candidate tournament columns (round 20, `obs/tournament.py`):
+# appended once per roster candidate after the shadow action, each
+# block followed by that candidate's per-region zone-weight lean
+# shares. Same contract style as DECISION_COLS — the order IS the
+# layout.
+CAND_COLS = (
+    "cand_cost_usd", "cand_carbon_g",      # candidate's projected step
+    "cand_pend_c0", "cand_pend_c1",        # per-class pending
+    "cand_slo_ok",                         # projected SLO gate
+    "cand_div_max",                        # max|cand - chosen| action delta
+)
+
 
 def action_dim(cluster) -> int:
     """Flat length A of one packed action row (is_peak excluded),
@@ -106,25 +118,56 @@ def flat_action_names(cluster) -> list[str]:
 
 class DecisionRowLayout:
     """Column offsets of one widened per-cluster metric row
-    ``[base metrics | decision cols | shadow flat action]`` — the
-    single definition both compiled-tick builders and the host ledger
-    slice by, so the two can never drift apart."""
+    ``[base metrics | decision cols | shadow flat action |
+    tournament tail]`` — the single definition both compiled-tick
+    builders and the host ledgers slice by, so they can never drift
+    apart.
 
-    def __init__(self, cluster):
+    ``candidates`` (round 20, `obs/tournament.py`) names the shadow-
+    tournament roster riding the tick: with K candidates the row grows
+    a per-region grid-carbon block (R = cluster.n_regions columns)
+    followed by one ``CAND_COLS`` block + R region lean-share columns
+    per candidate, in roster order. K=0 (the default everywhere the
+    tournament is not configured) is EXACTLY the round-18 layout — the
+    compiled programs of untouched configs cannot change."""
+
+    def __init__(self, cluster, candidates: Sequence[str] = ()):
         self.a_dim = action_dim(cluster)
         self.base = slice(0, N_BASE_METRIC_COLS)
         self.cols = slice(N_BASE_METRIC_COLS,
                           N_BASE_METRIC_COLS + len(DECISION_COLS))
         self.shadow_action = slice(
             self.cols.stop, self.cols.stop + self.a_dim)
-        self.width = self.shadow_action.stop
+        self.candidates = tuple(candidates)
+        self.n_regions = int(cluster.n_regions)
+        off = self.shadow_action.stop
+        self._cand_off: dict[str, int] = {}
+        self.region_carbon = slice(off, off)  # empty without a roster
+        if self.candidates:
+            self.region_carbon = slice(off, off + self.n_regions)
+            off = self.region_carbon.stop
+            for name in self.candidates:
+                self._cand_off[name] = off
+                off += len(CAND_COLS) + self.n_regions
+        self.width = off
 
     def col(self, name: str) -> int:
         return N_BASE_METRIC_COLS + DECISION_COLS.index(name)
 
+    def cand_col(self, cand: str, name: str) -> int:
+        """Column of one candidate's CAND_COLS entry."""
+        return self._cand_off[cand] + CAND_COLS.index(name)
 
-def decision_row_layout(cluster) -> DecisionRowLayout:
-    return DecisionRowLayout(cluster)
+    def cand_lean(self, cand: str) -> slice:
+        """One candidate's per-region zone-weight lean-share columns."""
+        lo = self._cand_off[cand] + len(CAND_COLS)
+        return slice(lo, lo + self.n_regions)
+
+
+def decision_row_layout(cluster,
+                        candidates: Sequence[str] = ()
+                        ) -> DecisionRowLayout:
+    return DecisionRowLayout(cluster, candidates)
 
 
 def shadow_decision_columns(chosen_metrics, shadow_metrics, exo_n,

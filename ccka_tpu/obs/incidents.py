@@ -55,6 +55,14 @@ TRIGGERS: dict[str, str] = {
                          "trailing obs.decision_window ticks) crossed "
                          "obs.divergence_spike_rate from below "
                          "(edge-triggered, re-armed below the bar)",
+    "challenger_sustained_win": "a tournament roster candidate held its "
+                                "windowed win rate at or above "
+                                "obs.tournament_win_rate for "
+                                "obs.tournament_sustain_ticks "
+                                "consecutive ticks against the live "
+                                "primary (edge-triggered, re-armed when "
+                                "the rate drops below the bar; carries "
+                                "the signed promotion audit's evidence)",
 }
 
 

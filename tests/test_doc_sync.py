@@ -980,3 +980,108 @@ class TestGeoClaims:
                        "packed_region_lanes", "zero per-engine edits",
                        "arrive → move → serve"):
             assert phrase in flat, phrase
+
+
+class TestTournamentClaims:
+    """Round 20's shadow tournament observatory (ISSUE 17 docs
+    satellite): README's "Shadow tournament" claims are PARSED against
+    the BASELINE round20 record, not hand-synced."""
+
+    def test_round20_record_is_self_describing(self, baseline):
+        r20 = baseline["published"]["round20"]
+        tour = r20["tournament_stage"]
+        # The acceptance criteria hold on the record itself.
+        assert tour["bitwise_identical"] is True
+        assert tour["ledger_overhead_frac"] <= 0.05
+        assert tour["overhead_gate_ok"] is True
+        assert tour["board_gate_ok"] is True
+        assert tour["challenger_gate_ok"] is True
+        assert tour["primary"] == "flagship"
+        assert len(tour["roster"]) == tour["k"] == 4
+        # Every K point of the lane-width curve is present.
+        assert set(tour["k_curve"]) == {"0", "1", "2", "4", "8"}
+        ch = r20["challenger_evidence"]
+        assert ch["incidents"] == 1
+        assert ch["dumps_verified"] == 1
+        assert ch["dump_failures"] == []
+        assert ch["promotion_audit_rows"] >= 1
+        assert (ch["promotion_audits_hmac_verified"]
+                == ch["promotion_audit_rows"])
+        assert ch["auto_switch"] is False
+        ev = r20["win_ledger_evidence"]
+        assert ev["roster"] == tour["roster"]
+        assert ev["board_matches_roster_1_to_1"] is True
+        assert set(ev["win_rate_last"]) == set(tour["roster"])
+        assert all(0.0 <= v <= 1.0
+                   for v in ev["win_rate_last"].values())
+        assert "bitwise" in r20["non_interference_gate"]
+        assert "one XLA program" in r20["non_interference_gate"]
+        assert "round-18 rule-shadow" in r20["k1_degeneracy_gate"]
+
+    def test_readme_overhead_claim(self, readme, baseline):
+        tour = baseline["published"]["round20"]["tournament_stage"]
+        m = re.search(
+            r"(-?[\d.]+)\s?ms/tick\s+median\s+paired\s+delta\s+—\s+"
+            r"([\d.]+)%\s+of\s+the\s+([\d.]+)\s?ms\s+p50\s+tick\s+"
+            r"latency,\s+under\s+the\s+5%\s+gate", readme)
+        assert m, ("README's tournament-overhead claim no longer "
+                   "states the numbers in the pinned form — update "
+                   "the claim AND this regex together")
+        ms, pct, p50 = map(float, m.groups())
+        assert abs(ms - tour["ledger_overhead_ms_per_tick"]) < 5e-3
+        assert abs(pct / 100 - tour["ledger_overhead_frac"]) < 5e-3
+        assert abs(p50 - tour["p50_tick_ms_off"]) < 5e-3
+        assert pct / 100 <= 0.05
+
+    def test_readme_k_curve_claim(self, readme, baseline):
+        tour = baseline["published"]["round20"]["tournament_stage"]
+        m = re.search(
+            r"\+([\d.]+)%\s+\(K=1\),\s+\+([\d.]+)%\s+\(K=2\),\s+"
+            r"\+([\d.]+)%\s+\(K=4\)\s+and\s+\+([\d.]+)%\s+\(K=8\)\s+"
+            r"over\s+the\s+([\d.]+)\s?ms\s+laneless\s+tick", readme)
+        assert m, "README's K-lane curve claim lost its pinned form"
+        for k, pct in zip(("1", "2", "4", "8"), m.groups()[:4]):
+            assert abs(float(pct) / 100
+                       - tour["k_curve"][k]["frac_vs_k0"]) < 5e-3, k
+        assert abs(float(m.group(5))
+                   - tour["k_curve"]["0"]["p50_ms"]) < 5e-3
+
+    def test_readme_challenger_claim(self, readme, baseline):
+        tour = baseline["published"]["round20"]["tournament_stage"]
+        m = re.search(
+            r"exactly\s+(\d+)\s+challenger_sustained_win\s+incident\s+"
+            r"\((\d+)/(\d+)\s+dump\s+checksums\s+pass,\s+(\d+)/(\d+)"
+            r"\s+promotion\s+audits\s+HMAC-verified\)", readme)
+        assert m, "README's challenger claim lost its pinned form"
+        inc, dv, dof, av, aof = map(int, m.groups())
+        ch = tour["challenger"]
+        assert inc == ch["incidents"] == 1
+        assert dv == dof == ch["dumps_verified"]
+        assert av == ch["audits_verified"]
+        assert aof == ch["audit_rows"]
+
+    def test_readme_names_the_surfaces(self, readme):
+        flat = " ".join(readme.split())  # wrap-tolerant phrase match
+        for needle in ("ccka_policy_candidate_win_rate",
+                       "ccka_tournament_leader",
+                       "challenger_sustained_win",
+                       "`ccka tournament list`", "--tournament-only",
+                       "PromotionGate", "never automatic"):
+            assert needle in flat, needle
+
+    def test_architecture_has_section_22(self):
+        arch = _read("ARCHITECTURE.md")
+        assert ("## 22. Online shadow tournament observatory"
+                in arch)
+        flat = " ".join(arch.split())
+        for phrase in ("tournament_roster", "CANDIDATE_BUILDERS",
+                       "register_candidate", "resolve_candidates",
+                       "TournamentRoster.register", "jax.eval_shape",
+                       "CAND_COLS", "workload_class",
+                       "tournament_win_margin",
+                       "tournament_sustain_ticks",
+                       "PromotionGate.review",
+                       "sign_audit", "verify_audit",
+                       "challenger_sustained_win",
+                       "program-shaping"):
+            assert phrase in flat, phrase

@@ -482,6 +482,8 @@ def _service_report():
                                "slo_violation": 0.04},
         shadow_slo_delta=-1.0,
         shadow_usd_delta=0.0125,
+        candidate_win_rate={"carbon": 0.7, "rule": 0.4},
+        tournament_leader=1,
         region_migration_rate={"mean": 0.12},
         region_carbon_intensity={"r0": 380.0, "r1": 420.0})
 
@@ -843,6 +845,68 @@ class TestPromExport:
             assert series not in render_exposition({"t": 1})
         # Geo-off service tick: the defaulted report (empty dicts for
         # both surfaces) skips the series instead of exporting zeros.
+        bare = dataclasses.asdict(ServiceTickReport(
+            t=1, n_tenants=2, admitted=2, deferred=0, shed=0,
+            cadence_skipped=0, bulkhead_skipped=0, scrape_failed=0,
+            probes=0, applied=2, fanout_deferred=0, slo_ok=2,
+            cost_usd_hr=1.0, carbon_g_hr=10.0, pending_pods=0.0,
+            tick_latency_ms=5.0, admission_queue_depth=2,
+            sheds_total=0, deferrals_total=0,
+            breaker_transitions_total=0, cadence_divisor=1,
+            decide_ms=1.0, fanout_ms=1.0))
+        bare_text = render_exposition(bare)
+        for series in gauges:
+            assert series not in bare_text
+
+    def test_tournament_gauges_cover_both_directions(self):
+        """Round-20 satellite: the shadow-tournament series (the summed
+        per-candidate win rate via the dict.* spec, the leader index)
+        must be exported, panel-referenced, AND resolve from a real
+        ServiceTickReport — both directions of the parity contract —
+        while a controller TickReport (no tournament fields) SKIPS
+        them rather than exporting fake zeros, and a service tick with
+        the tournament OFF (empty dict / None defaults) skips them
+        too."""
+        import dataclasses
+
+        from ccka_tpu.harness.dashboard import _PANEL_DEFS
+        from ccka_tpu.harness.promexport import (SERIES,
+                                                 SERVICE_ONLY_SERIES,
+                                                 referenced_series,
+                                                 render_exposition,
+                                                 resolve_field)
+        from ccka_tpu.harness.service import ServiceTickReport
+
+        gauges = {"ccka_policy_candidate_win_rate",
+                  "ccka_tournament_leader"}
+        assert gauges <= set(SERIES)
+        assert gauges <= set(SERVICE_ONLY_SERIES)
+        paneled = set()
+        for _t, expr, _u in _PANEL_DEFS:
+            paneled |= referenced_series(expr)
+        assert gauges <= paneled, ("tournament gauges missing from the "
+                                   "dashboard")
+
+        rec = dataclasses.asdict(_service_report())
+        # The .* spec sums the per-candidate dict — the scrape sees
+        # total challenger pressure; the per-name split stays on the
+        # board (`ccka tournament board`).
+        assert resolve_field(
+            rec, SERIES["ccka_policy_candidate_win_rate"][0]) \
+            == pytest.approx(1.1)
+        assert resolve_field(
+            rec, SERIES["ccka_tournament_leader"][0]) == 1
+        text = render_exposition(rec)
+        assert "ccka_policy_candidate_win_rate 1.1" in text
+        assert "ccka_tournament_leader 1" in text
+        # Controller-skips contract: a TickReport has neither field.
+        for series in gauges:
+            assert resolve_field({"t": 1}, SERIES[series][0]) is None
+            assert series not in render_exposition({"t": 1})
+        # Tournament-off service tick: the defaulted report (empty win
+        # dict, None leader) skips both instead of exporting zeros —
+        # a flat-zero win rate would read as "every candidate always
+        # loses", which is a claim, not an absence.
         bare = dataclasses.asdict(ServiceTickReport(
             t=1, n_tenants=2, admitted=2, deferred=0, shed=0,
             cadence_skipped=0, bulkhead_skipped=0, scrape_failed=0,

@@ -1,0 +1,722 @@
+"""Online shadow tournament observatory (round 20,
+`obs/tournament.py`).
+
+The contracts pinned here:
+
+- **roster discipline**: unknown candidate names are rejected up
+  front, duplicate registrations are refused (module registry AND
+  per-roster), and a candidate whose policy fails the registration
+  probe leaves the roster unchanged — a broken challenger can never
+  corrupt the lanes of the ones already registered;
+- **K=1 degeneracy**: a ``("rule",)`` roster's candidate columns are
+  BITWISE the round-18 rule-shadow columns riding the same tick — the
+  tournament generalizes the shadow, it does not fork it;
+- **tournament-on/off bitwise non-interference**: the host ledger
+  toggling changes NOTHING about decisions or patch streams (the
+  candidate lanes ride the compiled tick unconditionally), while the
+  on-run genuinely scores;
+- **ledger semantics**: windowed per-workload-class win accounting on
+  hand-crafted rows (who wins where is arithmetic, not vibes), window
+  retention, and the empty-class None (never a fake 0.0 win rate);
+- **the seeded challenger scenario**: an over-provisioned incumbent
+  loses to a one-candidate carbon roster — exactly ONE edge-triggered
+  ``challenger_sustained_win``, its dump checksum-verified, its
+  promotion audit HMAC-valid and never an auto-switch;
+- **CLI + bench-diff gates**: `ccka tournament list|board|explain`,
+  the tournament invariant gates (injected bad record exits 1, real
+  history stays clean), and bench_history staying stdlib-only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ccka_tpu.config import (SERVICE_PRESETS, ConfigError, ObsConfig,
+                             default_config)
+from ccka_tpu.harness.service import (VirtualClock,
+                                      fleet_service_from_config)
+from ccka_tpu.obs.decisions import CAND_COLS, decision_row_layout
+from ccka_tpu.obs.recorder import verify_dump
+from ccka_tpu.obs.tournament import (CANDIDATE_BUILDERS,
+                                     WORKLOAD_CLASSES,
+                                     OverProvisionPolicy,
+                                     PromotionGate, TournamentLedger,
+                                     TournamentRoster,
+                                     read_tournament,
+                                     register_candidate,
+                                     resolve_candidates, sign_audit,
+                                     verify_audit, workload_class)
+from ccka_tpu.policy import CarbonAwarePolicy, RulePolicy
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config().with_overrides(**{"sim.horizon_steps": 16})
+
+
+@pytest.fixture(scope="module")
+def cfg_k1(cfg):
+    """K=1 rule roster — the degeneracy program."""
+    return cfg.with_overrides(**{"obs.tournament_roster": ("rule",)})
+
+
+@pytest.fixture(scope="module")
+def cfg_k2(cfg):
+    return cfg.with_overrides(
+        **{"obs.tournament_roster": ("rule", "carbon")})
+
+
+def det_clock() -> VirtualClock:
+    state = {"s": 0.0}
+
+    def base():
+        state["s"] += 1e-4
+        return state["s"]
+    return VirtualClock(base=base)
+
+
+def _obs(tmp_path=None, **kw) -> ObsConfig:
+    base = dict(enabled=True)
+    if tmp_path is not None:
+        base.update(dump_dir=str(tmp_path / "dumps"),
+                    incident_log_path=str(tmp_path / "incidents.jsonl"),
+                    tournament_log_path=str(tmp_path
+                                            / "tournament.jsonl"))
+    base.update(kw)
+    return ObsConfig(**base)
+
+
+def _run_service(run_cfg, backend, n, obs, *, ticks=8, seed=11,
+                 profiles=None, capture_rows=False):
+    svc = fleet_service_from_config(
+        run_cfg, backend, n,
+        profiles=profiles or ["healthy"] * n,
+        service=SERVICE_PRESETS["default"], obs=obs,
+        horizon_ticks=16, seed=seed, clock=det_clock())
+    svc.warmup()
+    rows = []
+    if capture_rows and svc.tournament is not None:
+        orig = svc.tournament.observe_tick
+
+        def spy(t, per_np, layout, **kw):
+            rows.append(np.array(per_np))
+            return orig(t, per_np, layout, **kw)
+        svc.tournament.observe_tick = spy
+    reports = svc.run(ticks)
+    return svc, reports, rows
+
+
+class TestRoster:
+    def test_unknown_candidate_rejected_up_front(self, cfg):
+        with pytest.raises(ValueError,
+                           match="unknown tournament candidates"):
+            resolve_candidates(("rule", "no-such-policy"))
+        with pytest.raises(ValueError,
+                           match="unknown tournament candidates"):
+            TournamentRoster(cfg, ("no-such-policy",))
+
+    def test_registry_rejects_duplicate_names(self):
+        with pytest.raises(ValueError, match="already registered"):
+            register_candidate("rule", lambda cfg: None)
+        # The losing registration must not have clobbered the original.
+        assert "Peak/Off-Peak" in CANDIDATE_BUILDERS["rule"][1]
+
+    def test_roster_rejects_duplicate_lane(self, cfg):
+        roster = TournamentRoster(cfg, ("rule",))
+        with pytest.raises(ValueError,
+                           match="duplicate tournament candidate"):
+            roster.register("rule", RulePolicy(cfg.cluster))
+        assert roster.names == ("rule",)
+
+    def test_probe_failure_leaves_roster_unchanged(self, cfg):
+        """A candidate whose action_fn raises — or returns the wrong
+        shape — is refused by the registration probe, and the lanes
+        already registered survive untouched."""
+        roster = TournamentRoster(cfg, ("rule", "carbon"))
+
+        class Broken:
+            def action_fn(self):
+                def fn(state, exo, t):
+                    raise RuntimeError("no checkpoint for you")
+                return fn
+
+        with pytest.raises(ValueError,
+                           match="registration probe .roster "
+                                 "unchanged."):
+            roster.register("broken", Broken())
+
+        class WrongShape:
+            def action_fn(self):
+                import jax.numpy as jnp
+                return lambda state, exo, t: jnp.zeros((1,))
+
+        with pytest.raises(ValueError,
+                           match="registration probe .roster "
+                                 "unchanged."):
+            roster.register("wrong", WrongShape())
+        assert roster.names == ("rule", "carbon")
+        # And the survivors still resolve callable lanes.
+        assert [n for n, _fn in roster.action_fns()] \
+            == ["rule", "carbon"]
+
+    def test_config_rejects_duplicate_roster(self):
+        with pytest.raises(ConfigError, match="duplicate"):
+            ObsConfig(enabled=True,
+                      tournament_roster=("carbon", "carbon")).validate()
+        with pytest.raises(ConfigError, match="must be a tuple"):
+            ObsConfig(enabled=True,
+                      tournament_roster=["carbon"]).validate()
+
+    def test_workload_classes_cover_profiles(self):
+        assert set(WORKLOAD_CLASSES) == {"inference", "batch",
+                                         "background"}
+        assert workload_class("healthy") == "inference"
+        assert workload_class("batch") == "batch"
+        assert workload_class("slow") == "background"
+        assert workload_class("flaky") == "background"
+        assert workload_class("never-heard-of-it") == "inference"
+
+
+class TestK1Degeneracy:
+    """A ("rule",) roster IS the round-18 rule shadow, bitwise."""
+
+    def test_candidate_columns_bitwise_equal_shadow_columns(
+            self, cfg_k1, tmp_path):
+        svc, _reports, rows = _run_service(
+            cfg_k1, CarbonAwarePolicy(cfg_k1.cluster), 3,
+            _obs(tmp_path), ticks=4, capture_rows=True)
+        assert len(rows) == 4
+        lay = svc._dec_layout
+        pairs = [("cand_cost_usd", "shadow_cost_usd"),
+                 ("cand_carbon_g", "shadow_carbon_g"),
+                 ("cand_pend_c0", "shadow_pend_c0"),
+                 ("cand_pend_c1", "shadow_pend_c1"),
+                 ("cand_slo_ok", "shadow_slo_ok"),
+                 ("cand_div_max", "div_max_abs")]
+        for per in rows:
+            for cand_col, shadow_col in pairs:
+                np.testing.assert_array_equal(
+                    per[:, lay.cand_col("rule", cand_col)],
+                    per[:, lay.col(shadow_col)],
+                    err_msg=f"{cand_col} != {shadow_col}")
+        svc.close()
+
+    def test_k0_layout_is_exactly_round18(self, cfg):
+        lay0 = decision_row_layout(cfg.cluster)
+        lay1 = decision_row_layout(cfg.cluster, candidates=("rule",))
+        assert lay0.width < lay1.width
+        assert lay1.width == (lay0.width + cfg.cluster.n_regions
+                              + len(CAND_COLS) + cfg.cluster.n_regions)
+        # The widening is a pure tail: every round-18 column offset is
+        # unchanged.
+        assert lay0.cols == lay1.cols
+        assert lay0.shadow_action == lay1.shadow_action
+
+
+class TestNonInterference:
+    """Tournament-ledger-on vs -off over one seeded world: decisions
+    and patch streams bitwise identical — the candidate lanes ride the
+    compiled tick either way; only host scoring toggles."""
+
+    def _run(self, run_cfg, backend, tournament, tmp_path=None):
+        obs = (_obs(tmp_path, tournament_enabled=tournament)
+               if tmp_path is not None
+               else ObsConfig(enabled=True,
+                              tournament_enabled=tournament))
+        svc, _reports, _ = _run_service(run_cfg, backend, 5, obs,
+                                        ticks=10,
+                                        profiles=["healthy"] * 3
+                                        + ["slow", "flaky"])
+        out = {
+            "usd": svc.tenant_usd_per_slo_hr().copy(),
+            "slo": svc.tenant_slo_ticks.copy(),
+            "fresh": svc.tenant_fresh_ticks.copy(),
+            "commands": [[(c.name, c.patch_type, json.dumps(
+                c.patch, sort_keys=True))
+                for c in getattr(s, "inner", s).commands]
+                for s in svc.sinks],
+            "ticks": (svc.tournament.ticks_total
+                      if svc.tournament is not None else 0),
+            "ledger": svc.tournament,
+        }
+        svc.close()
+        return out
+
+    def test_on_off_bitwise_identical(self, cfg_k2, tmp_path):
+        backend = CarbonAwarePolicy(cfg_k2.cluster)
+        off = self._run(cfg_k2, backend, False)
+        on = self._run(cfg_k2, backend, True, tmp_path)
+        np.testing.assert_array_equal(off["usd"], on["usd"])
+        np.testing.assert_array_equal(off["slo"], on["slo"])
+        np.testing.assert_array_equal(off["fresh"], on["fresh"])
+        assert off["commands"] == on["commands"]
+        # Non-vacuous both ways.
+        assert off["ledger"] is None and off["ticks"] == 0
+        assert on["ticks"] == 10
+        assert on["ledger"].comparisons_total == 10 * 5 * 2
+
+    def test_empty_roster_builds_no_ledger(self, cfg):
+        svc, reports, _ = _run_service(
+            cfg, RulePolicy(cfg.cluster), 2,
+            ObsConfig(enabled=True), ticks=1)
+        assert svc.tournament is None
+        assert reports[-1].candidate_win_rate == {}
+        assert reports[-1].tournament_leader is None
+        svc.close()
+
+    def test_obs_override_roster_mismatch_refused(self, cfg_k2):
+        with pytest.raises(ValueError, match="program-shaping"):
+            fleet_service_from_config(
+                cfg_k2, RulePolicy(cfg_k2.cluster), 2,
+                service=SERVICE_PRESETS["default"],
+                obs=ObsConfig(enabled=True,
+                              tournament_roster=("carbon",)),
+                horizon_ticks=16, seed=1)
+
+
+class TestLedgerSemantics:
+    """Win accounting on hand-crafted rows: the board is arithmetic."""
+
+    def _ledger(self, cfg, tmp_path, names=("carbon",), classes=(),
+                **obs_kw):
+        obs = ObsConfig(enabled=True,
+                        tournament_log_path=str(
+                            tmp_path / "tournament.jsonl"),
+                        tournament_roster=tuple(names), **obs_kw)
+        lay = decision_row_layout(cfg.cluster, candidates=names)
+        led = TournamentLedger(obs, cfg.train, names,
+                               classes=list(classes), policy="chosen")
+        return led, lay
+
+    def _row(self, lay, n, *, chosen_cost, cand_cost, name="carbon"):
+        """Rows where ONLY the cost term differs: slo_ok=1 both sides,
+        pendings zero — win iff cand_cost < chosen_cost."""
+        per = np.zeros((n, lay.width), np.float32)
+        per[:, 0] = 1.0                       # chosen slo_ok
+        per[:, 1] = chosen_cost
+        per[:, lay.cand_col(name, "cand_slo_ok")] = 1.0
+        per[:, lay.cand_col(name, "cand_cost_usd")] = cand_cost
+        return per
+
+    def test_per_class_split_attributes_wins(self, cfg, tmp_path):
+        classes = ["inference", "inference", "batch", "background"]
+        led, lay = self._ledger(cfg, tmp_path, classes=classes)
+        per = self._row(lay, 4, chosen_cost=1.0,
+                        cand_cost=np.asarray([2.0, 2.0, 0.5, 0.25],
+                                             np.float32))
+        s = led.observe_tick(0, per, lay)
+        # Candidate wins on the batch and background rows only.
+        assert s["candidate_win_rate"] == {"carbon": 0.5}
+        board = led._board()
+        e = board["carbon"]
+        assert e["wins"] == 2 and e["comparisons"] == 4
+        assert e["classes"]["inference"]["win_rate"] == 0.0
+        assert e["classes"]["inference"]["comparisons"] == 2
+        assert e["classes"]["batch"]["win_rate"] == 1.0
+        assert e["classes"]["background"]["win_rate"] == 1.0
+        # The $ delta is chosen - candidate, summed per class.
+        assert e["classes"]["batch"]["usd_delta"] \
+            == pytest.approx(0.5, abs=1e-6)
+        assert e["classes"]["inference"]["usd_delta"] \
+            == pytest.approx(-2.0, abs=1e-6)
+        led.close()
+
+    def test_empty_class_is_none_not_fake_zero(self, cfg, tmp_path):
+        led, lay = self._ledger(cfg, tmp_path,
+                                classes=["inference", "inference"])
+        led.observe_tick(0, self._row(lay, 2, chosen_cost=1.0,
+                                      cand_cost=0.5), lay)
+        e = led._board()["carbon"]
+        assert e["classes"]["batch"]["win_rate"] is None
+        assert e["classes"]["batch"]["comparisons"] == 0
+        led.close()
+
+    def test_window_slides_and_running_sums_stay_exact(self, cfg,
+                                                       tmp_path):
+        led, lay = self._ledger(cfg, tmp_path,
+                                classes=["inference"] * 3,
+                                tournament_window=4)
+        rng = np.random.default_rng(7)
+        for t in range(11):
+            per = self._row(
+                lay, 3, chosen_cost=1.0,
+                cand_cost=rng.random(3).astype(np.float32) * 2.0)
+            led.observe_tick(t, per, lay)
+            exact = np.sum([w[0] for w in led._window], axis=0)
+            np.testing.assert_allclose(led._stat_sum, exact,
+                                       atol=1e-9)
+        e = led._board()["carbon"]
+        assert e["comparisons"] == 4 * 3      # window, not lifetime
+        assert led.ticks_total == 11
+        assert led.comparisons_total == 11 * 3
+        led.close()
+
+    def test_ties_do_not_win(self, cfg, tmp_path):
+        """Equal projected totals must not count as a win — the K=1
+        rule-vs-rule degenerate board stays all-zero."""
+        led, lay = self._ledger(cfg, tmp_path, classes=["inference"])
+        led.observe_tick(0, self._row(lay, 1, chosen_cost=1.0,
+                                      cand_cost=1.0), lay)
+        assert led._board()["carbon"]["win_rate"] == 0.0
+        led.close()
+
+
+class TestAuditSignature:
+    def test_sign_verify_roundtrip_and_tamper(self):
+        rec = {"kind": "promotion_audit", "t": 3, "challenger": "c",
+               "decision": "needs-bench-recheck"}
+        rec["signature"] = sign_audit(rec, "k1")
+        assert verify_audit(rec, "k1")
+        assert not verify_audit(rec, "k2")
+        assert not verify_audit({**rec, "t": 4}, "k1")
+        assert not verify_audit(
+            {k: v for k, v in rec.items() if k != "signature"}, "k1")
+
+    def test_gate_never_auto_switches(self):
+        obs = ObsConfig(enabled=True, tournament_audit_key="sekrit")
+        gate = PromotionGate(obs, "incumbent")
+        board = {"carbon": {"win_rate": 0.9, "classes": {}}}
+        plain = gate.review("carbon", board, sustained_ticks=8,
+                            window_ticks=16, t=5)
+        assert plain["decision"] == "needs-bench-recheck"
+        assert plain["auto_switch"] is False
+        assert verify_audit(plain, "sekrit")
+        good = gate.review("carbon", board, sustained_ticks=8,
+                           window_ticks=16, t=6,
+                           bench_record={"bitwise_identical": True,
+                                         "overhead_gate_ok": True,
+                                         "board_gate_ok": True})
+        assert good["decision"] == "eligible"
+        assert good["auto_switch"] is False
+        bad = gate.review("carbon", board, sustained_ticks=8,
+                          window_ticks=16, t=7,
+                          bench_record={"bitwise_identical": False,
+                                        "overhead_gate_ok": True})
+        assert bad["decision"] == "blocked"
+        assert bad["auto_switch"] is False
+        assert gate.audits_total == 3
+
+
+class TestChallengerIncident:
+    """The seeded scenario: an over-provisioned incumbent (HPA 1.5x,
+    consolidation off) grows slack the carbon candidate's projected
+    consolidation reclaims — exactly ONE edge-triggered
+    challenger_sustained_win, dump-attributable, audit-signed."""
+
+    @pytest.fixture(scope="class")
+    def ch_run(self, tmp_path_factory):
+        run_cfg = default_config().with_overrides(**{
+            "sim.horizon_steps": 16,
+            "obs.tournament_roster": ("carbon",),
+            "obs.tournament_window": 8,
+            "obs.tournament_sustain_ticks": 4,
+            "obs.tournament_win_rate": 0.6,
+        })
+        tmp = tmp_path_factory.mktemp("challenger")
+        svc, reports, _ = _run_service(
+            run_cfg, OverProvisionPolicy(run_cfg.cluster), 4,
+            _obs(tmp, tournament_roster=("carbon",),
+                 tournament_window=8, tournament_sustain_ticks=4,
+                 tournament_win_rate=0.6),
+            ticks=24,
+            profiles=["healthy", "healthy", "batch", "flaky"])
+        yield run_cfg, svc, reports
+        svc.close()
+
+    def test_exactly_one_edge_triggered_incident(self, ch_run):
+        _cfg, svc, reports = ch_run
+        counts = svc.incidents.counts()
+        assert counts.get("challenger_sustained_win", 0) == 1
+        assert svc.tournament.challengers_total == 1
+        # The win is sustained, not a blip: the final windowed rate is
+        # still at/above the bar and the leader gauge points at it.
+        assert reports[-1].candidate_win_rate["carbon"] >= 0.6
+        assert reports[-1].tournament_leader == 0
+
+    def test_incident_attributable_to_verified_dump(self, ch_run):
+        _cfg, svc, _reports = ch_run
+        incs = [i for i in svc.incidents.incidents
+                if i.trigger == "challenger_sustained_win"]
+        assert len(incs) == 1
+        inc = incs[0]
+        assert inc.dump_path is not None
+        body = verify_dump(inc.dump_path)
+        assert body["t"] == inc.t
+        assert inc.details["candidate"] == "carbon"
+        assert inc.details["incumbent"] == "overprovision"
+        assert inc.details["win_rate"] >= 0.6
+        assert inc.details["sustained_ticks"] >= 4
+
+    def test_audit_row_signed_and_never_auto_switch(self, ch_run):
+        run_cfg, svc, _reports = ch_run
+        rows = read_tournament(svc.obs.tournament_log_path)
+        audits = [r for r in rows
+                  if r.get("kind") == "promotion_audit"]
+        boards = [r for r in rows if r.get("kind") == "board"]
+        assert len(audits) == 1
+        assert boards, "no board rows logged"
+        audit = audits[0]
+        key = run_cfg.obs.tournament_audit_key
+        assert verify_audit(audit, key)
+        assert not verify_audit({**audit, "win_rate": 0.123}, key)
+        assert audit["challenger"] == "carbon"
+        assert audit["incumbent"] == "overprovision"
+        assert audit["decision"] == "needs-bench-recheck"
+        assert audit["auto_switch"] is False
+
+
+class TestTournamentCLI:
+    @pytest.fixture(scope="class")
+    def cli_log(self, tmp_path_factory):
+        run_cfg = default_config().with_overrides(**{
+            "sim.horizon_steps": 16,
+            "obs.tournament_roster": ("carbon",),
+            "obs.tournament_window": 8,
+            "obs.tournament_sustain_ticks": 4,
+            "obs.tournament_win_rate": 0.6,
+        })
+        tmp = tmp_path_factory.mktemp("cli-tournament")
+        svc, _reports, _ = _run_service(
+            run_cfg, OverProvisionPolicy(run_cfg.cluster), 4,
+            _obs(tmp, tournament_roster=("carbon",),
+                 tournament_window=8, tournament_sustain_ticks=4,
+                 tournament_win_rate=0.6),
+            ticks=16,
+            profiles=["healthy", "healthy", "batch", "flaky"])
+        svc.close()
+        return svc.obs.tournament_log_path
+
+    def test_list_names_every_registered_candidate(self, capsys):
+        from ccka_tpu.cli import main
+
+        assert main(["tournament", "list"]) == 0
+        out = capsys.readouterr()
+        lines = out.out.strip().splitlines()
+        names = {ln.split(":", 1)[0] for ln in lines}
+        assert names == set(CANDIDATE_BUILDERS)
+        assert "registered candidate builder(s)" in out.err
+
+    def test_board_and_explain(self, cli_log, capsys):
+        from ccka_tpu.cli import main
+
+        assert main(["tournament", "board", cli_log]) == 0
+        text = capsys.readouterr().out
+        assert "incumbent=overprovision" in text
+        assert "carbon: win" in text
+
+        assert main(["tournament", "explain", cli_log]) == 0
+        text = capsys.readouterr().out
+        assert "promotion audit @ tick" in text
+        assert "carbon vs incumbent overprovision" in text
+        assert "signature=valid" in text
+        assert "auto_switch=False" in text
+
+        # The wrong key must SAY the signature does not check out.
+        assert main(["tournament", "explain", cli_log,
+                     "--key", "not-the-key"]) == 0
+        assert "signature=INVALID" in capsys.readouterr().out
+
+    def test_errors(self, cli_log, tmp_path):
+        from ccka_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="needs the tournament"):
+            main(["tournament", "board"])
+        with pytest.raises(SystemExit,
+                           match="cannot read tournament log"):
+            main(["tournament", "board",
+                  str(tmp_path / "missing.jsonl")])
+        bad = str(tmp_path / "bad.jsonl")
+        with open(bad, "w") as fh:
+            fh.write('{"kind": "board", "t": 0}\nGARBAGE\n'
+                     '{"kind": "board", "t": 1}\n')
+        with pytest.raises(SystemExit,
+                           match="corrupt tournament log"):
+            main(["tournament", "board", bad])
+        empty = str(tmp_path / "empty.jsonl")
+        open(empty, "w").close()
+        with pytest.raises(SystemExit, match="no board rows"):
+            main(["tournament", "board", empty])
+        with pytest.raises(SystemExit,
+                           match="no challenger has sustained"):
+            main(["tournament", "explain", empty])
+        with pytest.raises(SystemExit, match="at tick 999"):
+            main(["tournament", "board", cli_log, "--t", "999"])
+
+
+class TestBenchDiffTournamentGates:
+    CLEAN = {
+        "bitwise_identical": True,
+        "ledger_overhead_frac": 0.02,
+        "roster": ["rule", "carbon"],
+        "board": {
+            name: {
+                "win_rate": 0.5,
+                "classes": {c: {"win_rate": 0.5}
+                            for c in WORKLOAD_CLASSES},
+            } for name in ("rule", "carbon")},
+        "challenger": {"incidents": 1, "dumps_verified": 1,
+                       "dump_failures": [], "audit_rows": 1,
+                       "audits_verified": 1},
+    }
+
+    def _diff(self, tour):
+        from ccka_tpu.obs import bench_history
+
+        return bench_history.bench_diff({
+            "records": [{"round": 20, "file": "BENCH_r20.json",
+                         "platform": "cpu",
+                         **bench_history._extract_tournament(tour)}],
+            "lane": []})
+
+    def _clean(self, **over):
+        tour = json.loads(json.dumps(self.CLEAN))
+        tour.update(over)
+        return tour
+
+    def test_clean_record_passes(self):
+        assert self._diff(self._clean())["ok"]
+
+    def test_each_gate_trips(self):
+        cases = [
+            (self._clean(bitwise_identical=False), "bitwise"),
+            (self._clean(ledger_overhead_frac=0.12), "overhead"),
+            (self._clean(roster=["rule"]), "1:1 with the roster"),
+            (self._clean(challenger={"incidents": 2,
+                                     "dumps_verified": 2,
+                                     "dump_failures": [],
+                                     "audit_rows": 2,
+                                     "audits_verified": 2}),
+             "exactly one"),
+            (self._clean(challenger={"incidents": 1,
+                                     "dumps_verified": 1,
+                                     "dump_failures": ["checksum"],
+                                     "audit_rows": 1,
+                                     "audits_verified": 1}),
+             "exactly one"),
+            (self._clean(challenger={"incidents": 1,
+                                     "dumps_verified": 1,
+                                     "dump_failures": [],
+                                     "audit_rows": 1,
+                                     "audits_verified": 0}),
+             "exactly one"),
+        ]
+        # A win rate outside [0, 1] — overall and per class.
+        bad_board = self._clean()
+        bad_board["board"]["carbon"]["win_rate"] = 1.5
+        cases.append((bad_board, "implausible win rate"))
+        bad_cls = self._clean()
+        bad_cls["board"]["rule"]["classes"]["batch"]["win_rate"] = -0.1
+        cases.append((bad_cls, "implausible win rate"))
+        for tour, needle in cases:
+            d = self._diff(tour)
+            assert not d["ok"], needle
+            assert any(needle in r["detail"]
+                       for r in d["regressions"]), needle
+            assert all(r["kind"] == "tournament_invariant"
+                       for r in d["regressions"]
+                       if needle in r["detail"])
+        # Missing claims are PARTIAL regressions, not silent passes.
+        for missing in ("bitwise_identical", "ledger_overhead_frac",
+                        "roster", "board", "challenger"):
+            tour = self._clean()
+            tour.pop(missing)
+            d = self._diff(tour)
+            assert not d["ok"], missing
+            assert any("partial tournament record" in r["detail"]
+                       for r in d["regressions"]), missing
+
+    def test_cli_bench_diff_doctored_root_exits_one(self, tmp_path,
+                                                    capsys):
+        from ccka_tpu.cli import main
+
+        os.makedirs(tmp_path / "data", exist_ok=True)
+        doctored = dict(self._clean(bitwise_identical=False),
+                        stage="--tournament-only",
+                        provenance={"platform": "cpu"})
+        with open(tmp_path / "BENCH_r20.json", "w") as fh:
+            json.dump(doctored, fh)
+        with open(tmp_path / "data" / "lane_times.json", "w") as fh:
+            json.dump([], fh)
+        assert main(["bench-diff", "--root", str(tmp_path)]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["regressions"][0]["kind"] == "tournament_invariant"
+
+    def test_real_history_carries_round20_and_stays_clean(self):
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        history = load_bench_history(_ROOT)
+        r20 = [r for r in history["records"] if r["round"] == 20]
+        assert r20, "BENCH_r20.json missing from the repo root"
+        rec = r20[0]
+        assert rec["tournament_bitwise"] is True
+        assert rec["tournament_overhead_frac"] <= 0.05
+        assert rec["tournament_board_matches_roster"] is True
+        assert rec["tournament_challenger_ok"] is True
+        assert rec["tournament_partial"] == []
+        assert rec["tournament_rate_violations"] == []
+        diff = bench_diff(history)
+        assert diff["ok"], diff["regressions"]
+
+
+class TestBenchHistoryStdlibOnly:
+    def test_bench_diff_runs_with_jax_and_numpy_blocked(self):
+        """`ccka bench-diff` is the CI tripwire — it must keep working
+        on a box with NO accelerator stack. Import bench_history in a
+        subprocess where jax/numpy/flax can never import, and run a
+        real diff through it."""
+        code = """
+import importlib.util, json, sys
+
+BLOCKED = ("jax", "jaxlib", "numpy", "flax", "optax", "orbax")
+
+class Blocker:
+    def find_spec(self, name, path=None, target=None):
+        if name.split(".")[0] in BLOCKED:
+            raise ImportError(name + " blocked: bench_history must "
+                              "stay stdlib-only")
+        return None
+
+sys.meta_path.insert(0, Blocker())
+for mod in list(sys.modules):
+    if mod.split(".")[0] in BLOCKED:
+        del sys.modules[mod]
+
+spec = importlib.util.spec_from_file_location(
+    "bench_history_stdlib", sys.argv[1])
+bh = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bh)
+
+tour = {
+    "bitwise_identical": True, "ledger_overhead_frac": 0.02,
+    "roster": ["rule"],
+    "board": {"rule": {"win_rate": 0.25,
+                       "classes": {"inference": {"win_rate": 0.25}}}},
+    "challenger": {"incidents": 1, "dumps_verified": 1,
+                   "dump_failures": [], "audit_rows": 1,
+                   "audits_verified": 1},
+}
+rec = {"round": 20, "file": "BENCH_r20.json", "platform": "cpu"}
+rec.update(bh._extract_tournament(tour))
+diff = bh.bench_diff({"records": [rec], "lane": []})
+assert diff["ok"], diff["regressions"]
+bad = dict(rec)
+bad["tournament_bitwise"] = False
+assert not bh.bench_diff({"records": [bad], "lane": []})["ok"]
+print("STDLIB_ONLY_OK")
+"""
+        path = os.path.join(_ROOT, "ccka_tpu", "obs",
+                            "bench_history.py")
+        proc = subprocess.run(
+            [sys.executable, "-c", code, path],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "STDLIB_ONLY_OK" in proc.stdout
