@@ -2642,6 +2642,37 @@ def bench_geo(*, steps: int = 192, batch: int = 8, suite_seed: int = 0,
     return out
 
 
+def bench_fleet_scale(*, tenants=(16, 256, 1024, 4096, 10240),
+                      ticks: int = 12, seed: int = 211,
+                      speedup_n: int = 4096) -> dict | None:
+    """Fleet-scale host-loop stage (round 21,
+    `harness/fleetscale.py`): the 10^4-tenant tail-latency record.
+    Sweeps N x {calm, 25% slow + moderate chaos} through the
+    vectorized host loop (chunked tenant-axis dispatch at N>=1024 via
+    `sim/lanes.chunk_layout`), and states the acceptance surface on
+    the record itself (the `ccka bench-diff` fleet-scale gates):
+
+    - ``parity.bitwise_identical``: vectorized-vs-object host loop,
+      same seeded world on the det clock — report counters, patch
+      streams, held rows, accumulators, breaker transitions;
+    - ``chunk_parity.bitwise_identical``: chunked vs unchunked
+      dispatch at N=1024;
+    - ``speedup.ratio``: object/vectorized host-loop µs per tenant at
+      N=4096 calm (gate >= 10x);
+    - ``invariants.healthy_ratio_exact_all``: paired healthy-tenant
+      $/SLO-hr ratio EXACTLY 1.0 in every stressed cell.
+
+    Host-side wall-clock harness (latencies are real time; the
+    host-loop gauge subtracts virtual-clock offsets) — no roofline
+    floor applies."""
+    from ccka_tpu.config import default_config
+    from ccka_tpu.harness.fleetscale import fleet_scale_record
+
+    return fleet_scale_record(default_config(), tenants=tenants,
+                              ticks=ticks, seed=seed,
+                              speedup_n=speedup_n)
+
+
 PERF_MODES = ("rule", "carbon", "neural", "plan")
 
 
@@ -3862,6 +3893,15 @@ def main(argv=None) -> int:
                          "dump + signed audits) and print its JSON — "
                          "the BENCH_r20 record path; host-side "
                          "virtual-clock harness")
+    ap.add_argument("--fleet-scale-only", action="store_true",
+                    help="run ONLY the fleet-scale host-loop stage "
+                         "(bench_fleet_scale: N ∈ {16…10240} × {calm, "
+                         "25% slow + moderate chaos} tail-latency "
+                         "sweep, vectorized-vs-object bitwise parity "
+                         "+ ≥10× speedup at N=4096, chunked-dispatch "
+                         "parity at N=1024, healthy-tenant isolation "
+                         "ratio) and print its JSON — the BENCH_r21 "
+                         "record path; host-side wall-clock harness")
     ap.add_argument("--geo-only", action="store_true",
                     help="run ONLY the geo-arbitrage stage (bench_geo: "
                          "zero-migration bitwise parity arm + the "
@@ -4006,6 +4046,20 @@ def main(argv=None) -> int:
             tr["provenance"] = bench_provenance()
         print(json.dumps(tr))
         return 0 if tr is not None else 1
+
+    if args.fleet_scale_only:
+        with _TRACER.span("bench.fleet_scale_stage"):
+            fs = bench_fleet_scale()
+        if fs is not None:
+            # Record-path stamp (see --perf-only): a raw redirect into
+            # BENCH_rNN.json arms the bench-diff fleet-scale gates.
+            fs["stage"] = "--fleet-scale-only"
+            fs["provenance"] = bench_provenance(
+                scenarios=list(fs["scenarios"]))
+            from ccka_tpu.obs.compile import compile_report
+            fs["compile_report"] = compile_report()
+        print(json.dumps(fs))
+        return 0 if fs is not None else 1
 
     if args.geo_only:
         with _TRACER.span("bench.geo_stage"):
@@ -4316,6 +4370,18 @@ def main(argv=None) -> int:
         print(f"# tournament stage failed (omitted): {e!r}",
               file=sys.stderr)
         tournament_stage = None
+    # Fleet-scale host-loop stage (round 21): the tenant-axis sweep —
+    # same guard; host-side, so --quick shrinks N and the tick count.
+    try:
+        with _TRACER.span("bench.fleet_scale_stage"):
+            fleet_scale_stage = (
+                bench_fleet_scale(tenants=(16, 256), ticks=8,
+                                  speedup_n=256)
+                if args.quick else bench_fleet_scale())
+    except Exception as e:  # noqa: BLE001
+        print(f"# fleet-scale stage failed (omitted): {e!r}",
+              file=sys.stderr)
+        fleet_scale_stage = None
     # Device-time observatory stage (round 15): occupancy ledger + XLA
     # attribution per kernel mode — same guard; --quick shrinks sizes
     # and drops the neural/carbon modes + the mesh section.
@@ -4400,6 +4466,8 @@ def main(argv=None) -> int:
         line["decisions"] = decisions_stage
     if tournament_stage is not None:
         line["tournament"] = tournament_stage
+    if fleet_scale_stage is not None:
+        line["fleet_scale"] = fleet_scale_stage
     if perf_stage is not None:
         line["perf"] = perf_stage
     # Provenance + the session's span trace: a headline without device/

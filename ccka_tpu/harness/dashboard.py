@@ -124,6 +124,13 @@ _PANEL_DEFS = (
     ("Tournament challenger pressure",
      "ccka_policy_candidate_win_rate", "short"),
     ("Tournament leader", "ccka_tournament_leader", "short"),
+    # Fleet-scale panels (round 21; harness/fleetscale.py): the host
+    # loop's real cost per tenant and the admitted-tenant count, on the
+    # same board as the shed/latency panels they explain — the operator
+    # sees "10k tenants, 0.1us each" next to the queue-depth spike.
+    ("Host loop cost per tenant", "ccka_host_loop_us_per_tenant",
+     "short"),
+    ("Active tenants", "ccka_active_tenants", "short"),
 )
 
 

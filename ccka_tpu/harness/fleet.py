@@ -166,7 +166,14 @@ def _compiled_fleet_tick(cfg: FrameworkConfig, backend,
     UNCONDITIONALLY — a ledger toggling on can never select a
     different XLA program, which is what makes ledger-on/off bitwise
     non-interference hold by construction. Fleet aggregates are a
-    host-side sum over the first four columns."""
+    host-side sum over the first four columns.
+
+    Round 21: the fleet-service tick (`service._build_service_tick`)
+    shares these helpers and this cache discipline, and its chunked
+    tenant-axis dispatch keys the cache on the CHUNK size — a 10^4
+    tenant sweep whose cells all dispatch 256-wide chunks under one
+    uniform horizon compiles exactly one program, which is why the
+    fleet-scale record's upper cells carry no per-N recompile cost."""
     from ccka_tpu.obs.compile import watch_jit
     from ccka_tpu.obs.decisions import shadow_decision_columns
     from ccka_tpu.obs.tournament import (TournamentRoster,

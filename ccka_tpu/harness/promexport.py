@@ -220,6 +220,22 @@ SERIES: dict[str, tuple[str, str]] = {
         "region_carbon_intensity.*",
         "Sum of per-region grid carbon intensities (g/kWh) the last "
         "published geo rollout's lanes saw"),
+    # Fleet-scale host-loop series (round 21; the vectorized admission
+    # machine): real host microseconds per tenant spent in the
+    # admission + accounting windows (virtual scrape delays excluded
+    # by the offset-subtracting gauge) and the tenants that entered
+    # the scrape/dispatch phase this tick. Service-only, and skipped
+    # (never fake zeros) on pre-round-21 reports that don't carry the
+    # fields.
+    "ccka_host_loop_us_per_tenant": (
+        "host_loop_us_per_tenant",
+        "Real host-loop microseconds per tenant this tick (admission "
+        "machine + masked accounting; scrape waits and device "
+        "dispatch excluded)"),
+    "ccka_active_tenants": (
+        "active_tenants",
+        "Tenants admitted into the scrape/dispatch phase this tick "
+        "(post cadence/bulkhead/cap)"),
     "ccka_applied": ("applied", "1 if every patch applied this tick"),
     "ccka_verified": ("verified", "1 if read-back matched intent"),
     "ccka_tick": ("t", "Controller tick counter"),
@@ -254,6 +270,7 @@ SERVICE_ONLY_SERIES = frozenset({
     "ccka_shadow_slo_delta",
     "ccka_region_migration_rate", "ccka_region_carbon_intensity",
     "ccka_policy_candidate_win_rate", "ccka_tournament_leader",
+    "ccka_host_loop_us_per_tenant", "ccka_active_tenants",
 })
 
 _LABEL = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")

@@ -55,7 +55,6 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
-import random
 import time
 from typing import Callable, Sequence
 
@@ -160,8 +159,49 @@ class VirtualClock:
     def advance(self, seconds: float) -> None:
         self._offset += float(seconds)
 
+    @property
+    def offset(self) -> float:
+        """Cumulative virtual seconds injected so far. The host-loop
+        µs/tenant gauge reads real host time as (clock delta) minus
+        (offset delta), so simulated scrape delays never inflate it."""
+        return self._offset
+
 
 _BREAKER_LEVEL = {"closed": 0, "half-open": 1, "open": 2}
+_BREAKER_STATE = ("closed", "half-open", "open")
+
+# ---- counter-based per-tenant RNG streams (round 21) ----------------------
+#
+# The fleet's draw machinery at 10^4 tenants cannot afford N
+# `random.Random` objects walked one tenant at a time: every draw is
+# instead ADDRESSED as (stream seed, draw index) through a stateless
+# splitmix64-style hash, so the object breaker, the vectorized breaker
+# bank and the vectorized scrape phase all read the SAME streams —
+# identical probe schedules and scrape-fail draws whichever host loop
+# runs (pinned by the paired parity test in tests/test_service.py).
+
+_U64 = np.uint64
+_GOLD = _U64(0x9E3779B97F4A7C15)
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer (vectorized, uint64 wraparound)."""
+    with np.errstate(over="ignore"):
+        x = (x ^ (x >> _U64(30))) * _U64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> _U64(27))) * _U64(0x94D049BB133111EB)
+        return x ^ (x >> _U64(31))
+
+
+def counter_u01(seed, counter) -> np.ndarray:
+    """Uniform draw(s) in [0, 1) addressed by (stream seed, draw
+    index) — one seeded generator for the whole fleet, no per-tenant
+    RNG objects. Accepts scalars or equal-length arrays; float64 out."""
+    s = np.asarray(seed, _U64)
+    c = np.asarray(counter, _U64)
+    with np.errstate(over="ignore"):
+        z = _mix64(_mix64(s + _GOLD)
+                   ^ _mix64(c * _GOLD + _U64(0xD1B54A32D192ED03)))
+    return (z >> _U64(11)).astype(np.float64) * (2.0 ** -53)
 
 
 class CircuitBreaker:
@@ -172,12 +212,16 @@ class CircuitBreaker:
     refuses work until the seeded-jittered probe tick arrives, at which
     point ONE half-open probe is allowed through — success re-closes,
     failure re-opens with the probe delay doubled (capped at
-    ``breaker_max_probe_ticks``). The jitter RNG is seeded so paired
-    runs see identical probe schedules (`RetryingFetch` idiom)."""
+    ``breaker_max_probe_ticks``). Jitter draws come from the
+    counter-based stream addressed by (seed, ``draws``) — no RNG
+    object, and the draw index is exposed so paired runs (and the
+    vectorized breaker bank) can prove they consumed the identical
+    schedule (`RetryingFetch` idiom, round-21 form)."""
 
     def __init__(self, svc: ServiceConfig, seed: int = 0):
         self._svc = svc
-        self._rng = random.Random(seed)
+        self._seed = _U64(seed & 0xFFFFFFFFFFFFFFFF)
+        self.draws = 0  # jitter draws consumed (one per open)
         self.state = "closed"
         self._fails = 0          # consecutive failures while closed
         self._opens = 0          # consecutive opens (probe backoff expo)
@@ -228,15 +272,241 @@ class CircuitBreaker:
         self._opens += 1
         self._fails = 0
         base = svc.breaker_probe_ticks * (2.0 ** min(self._opens - 1, 8))
-        jit = 1.0 + svc.breaker_probe_jitter * (
-            2.0 * self._rng.random() - 1.0)
+        u = float(counter_u01(self._seed, self.draws))
+        self.draws += 1
+        jit = 1.0 + svc.breaker_probe_jitter * (2.0 * u - 1.0)
         delay = int(round(base * jit))
         self._probe_at = t + max(1, min(delay, svc.breaker_max_probe_ticks))
 
 
-@functools.lru_cache(maxsize=32)
-def _compiled_service_tick(cfg: FrameworkConfig, backend,
-                           n: int, horizon_ticks: int):
+class _ObjectBreakerBank:
+    """The pre-round-21 per-tenant breaker OBJECTS, kept as the paired
+    baseline the fleet-scale bench measures the vectorized machine
+    against. Same stream seeds, same draw addressing — `host_loop=
+    "object"` must produce bitwise the vectorized path's schedules."""
+
+    kind = "object"
+
+    def __init__(self, svc: ServiceConfig, seed: int, n: int):
+        self.breakers = [CircuitBreaker(svc, seed=seed ^ (0xB4EA + i))
+                         for i in range(n)]
+
+    def views(self):
+        return self.breakers
+
+    def level_of(self, i: int) -> int:
+        return self.breakers[i].level
+
+    def is_open(self, i: int) -> bool:
+        return self.breakers[i].state == "open"
+
+    def open_ticks(self, i: int, t: int) -> int:
+        return self.breakers[i].open_ticks(t)
+
+    def record_success(self, i: int) -> None:
+        self.breakers[i].record_success()
+
+    def record_failure(self, i: int, t: int) -> None:
+        self.breakers[i].record_failure(t)
+
+    def levels(self) -> np.ndarray:
+        return np.asarray([b.level for b in self.breakers], np.int8)
+
+    def opened_counts(self) -> list:
+        return [b.transitions["opened"] for b in self.breakers]
+
+    def transitions_total(self) -> int:
+        return sum(sum(b.transitions.values()) for b in self.breakers)
+
+    def transition_counts(self) -> dict:
+        out = {"opened": 0, "half_open": 0, "closed": 0}
+        for b in self.breakers:
+            for k, v in b.transitions.items():
+                out[k] += v
+        return out
+
+    def states_dict(self) -> dict:
+        return {str(i): b.level for i, b in enumerate(self.breakers)}
+
+
+class _BreakerView:
+    """Read-only object facade over ONE tenant's row of the vectorized
+    breaker bank — the ``svc.breakers[i]`` surface the board accessors
+    and pinned tests read (state/level/transitions/open_ticks), without
+    resurrecting N stateful objects."""
+
+    __slots__ = ("_bank", "_i")
+
+    def __init__(self, bank: "_VectorBreakerBank", i: int):
+        self._bank = bank
+        self._i = i
+
+    @property
+    def state(self) -> str:
+        return _BREAKER_STATE[int(self._bank.level[self._i])]
+
+    @property
+    def level(self) -> int:
+        return int(self._bank.level[self._i])
+
+    @property
+    def draws(self) -> int:
+        return int(self._bank.draws[self._i])
+
+    @property
+    def transitions(self) -> dict:
+        b, i = self._bank, self._i
+        return {"opened": int(b.tr_opened[i]),
+                "half_open": int(b.tr_half[i]),
+                "closed": int(b.tr_closed[i])}
+
+    def open_ticks(self, t: int) -> int:
+        oa = int(self._bank.opened_at[self._i])
+        return 0 if oa < 0 else max(0, t - oa)
+
+
+class _VectorBreakerBank:
+    """All N breakers as flat arrays: level/probe-deadline vectors,
+    counter-based jitter streams, masked transitions. Scalar methods
+    mirror :class:`_ObjectBreakerBank` for the shared fan-out loop;
+    the float arithmetic per element is EXACTLY the object breaker's
+    (``np.rint`` is half-to-even like Python ``round`` — the parity
+    test pins the probe schedules bitwise)."""
+
+    kind = "vectorized"
+
+    def __init__(self, svc: ServiceConfig, seed: int, n: int):
+        self._svc = svc
+        self.n = n
+        self.level = np.zeros(n, np.int8)       # 0 closed/1 half/2 open
+        self.fails = np.zeros(n, np.int64)
+        self.opens = np.zeros(n, np.int64)
+        self.probe_at = np.zeros(n, np.int64)
+        self.opened_at = np.full(n, -1, np.int64)   # -1 = closed epoch
+        self.tr_opened = np.zeros(n, np.int64)
+        self.tr_half = np.zeros(n, np.int64)
+        self.tr_closed = np.zeros(n, np.int64)
+        # Identical per-tenant seed derivation to the object bank.
+        idx = np.arange(n, dtype=np.int64)
+        self.seeds = ((_U64(seed & 0xFFFFFFFFFFFFFFFF)
+                       ^ (idx + 0xB4EA).astype(_U64))
+                      if n else np.zeros(0, _U64))
+        self.draws = np.zeros(n, np.int64)
+        # O(1) count of not-closed breakers: the calm-fleet fast paths
+        # (no probe gate, no escalation scan) key off this instead of
+        # scanning N levels every tick.
+        self.n_tripped = 0
+        self._state_keys = [str(i) for i in range(n)]
+
+    @property
+    def all_closed(self) -> bool:
+        return self.n_tripped == 0
+
+    def views(self) -> list:
+        return [_BreakerView(self, i) for i in range(self.n)]
+
+    # -- vectorized admission interface ---------------------------------
+
+    def allow_due(self, due: np.ndarray, t: int):
+        """Vectorized :meth:`CircuitBreaker.allow` over the due set:
+        returns (allowed mask, probing mask) aligned with ``due``,
+        flipping open→half-open exactly where the probe is due."""
+        lv = self.level[due]
+        flip = (lv == 2) & (t >= self.probe_at[due])
+        idx = due[flip]
+        self.level[idx] = 1
+        self.tr_half[idx] += 1
+        allowed = (lv != 2) | flip
+        probing = (lv == 1) | flip
+        return allowed, probing
+
+    def record_success_idx(self, idx: np.ndarray) -> None:
+        if idx.size == 0:
+            return
+        was_tripped = self.level[idx] != 0
+        self.tr_closed[idx] += was_tripped
+        self.n_tripped -= int(was_tripped.sum())
+        self.level[idx] = 0
+        self.fails[idx] = 0
+        self.opens[idx] = 0
+        self.opened_at[idx] = -1
+
+    def record_failure_idx(self, idx: np.ndarray, t: int) -> None:
+        if idx.size == 0:
+            return
+        self.fails[idx] += 1
+        opening = (self.level[idx] == 1) | (
+            self.fails[idx] >= self._svc.breaker_failures)
+        self._open_idx(idx[opening], t)
+
+    def _open_idx(self, idx: np.ndarray, t: int) -> None:
+        if idx.size == 0:
+            return
+        svc = self._svc
+        self.tr_opened[idx] += (self.level[idx] != 2)
+        self.n_tripped += int((self.level[idx] == 0).sum())
+        fresh = self.opened_at[idx] < 0
+        self.opened_at[idx] = np.where(fresh, t, self.opened_at[idx])
+        self.level[idx] = 2
+        self.opens[idx] += 1
+        self.fails[idx] = 0
+        base = svc.breaker_probe_ticks * np.power(
+            2.0, np.minimum(self.opens[idx] - 1, 8).astype(np.float64))
+        u = counter_u01(self.seeds[idx], self.draws[idx])
+        self.draws[idx] += 1
+        jit = 1.0 + svc.breaker_probe_jitter * (2.0 * u - 1.0)
+        delay = np.clip(np.rint(base * jit), 1,
+                        svc.breaker_max_probe_ticks).astype(np.int64)
+        self.probe_at[idx] = t + delay
+
+    def open_ticks_vec(self, t: int) -> np.ndarray:
+        return np.where(self.opened_at >= 0,
+                        np.maximum(0, t - self.opened_at), 0)
+
+    # -- scalar interface (shared fan-out loop) -------------------------
+
+    def level_of(self, i: int) -> int:
+        return int(self.level[i])
+
+    def is_open(self, i: int) -> bool:
+        return self.level[i] == 2
+
+    def open_ticks(self, i: int, t: int) -> int:
+        oa = int(self.opened_at[i])
+        return 0 if oa < 0 else max(0, t - oa)
+
+    def record_success(self, i: int) -> None:
+        self.record_success_idx(np.asarray([i], np.int64))
+
+    def record_failure(self, i: int, t: int) -> None:
+        self.record_failure_idx(np.asarray([i], np.int64), t)
+
+    # -- reporting ------------------------------------------------------
+
+    def levels(self) -> np.ndarray:
+        return self.level.astype(np.int8, copy=True)
+
+    def opened_counts(self) -> list:
+        return self.tr_opened.tolist()
+
+    def transitions_total(self) -> int:
+        return int(self.tr_opened.sum() + self.tr_half.sum()
+                   + self.tr_closed.sum())
+
+    def transition_counts(self) -> dict:
+        return {"opened": int(self.tr_opened.sum()),
+                "half_open": int(self.tr_half.sum()),
+                "closed": int(self.tr_closed.sum())}
+
+    def states_dict(self) -> dict:
+        # tolist() yields python ints — same values, ~6x cheaper than
+        # per-element int() at fleet scale (this dict is per tick).
+        return dict(zip(self._state_keys, self.level.tolist()))
+
+
+def _build_service_tick(cfg: FrameworkConfig, backend,
+                        n: int, horizon_ticks: int,
+                        precomputed_keys: bool):
     """The lane-selecting batched tick, jitted once per (config,
     backend, fleet size, horizon) — `fleet._compiled_fleet_tick` with
     the service's three decision lanes folded into the SAME single
@@ -246,6 +516,15 @@ def _compiled_service_tick(cfg: FrameworkConfig, backend,
     round trip per tick regardless of how degraded the fleet is. Keyed
     on the backend INSTANCE (identity hash), so the overload board's
     paired stressed/calm services share one XLA program.
+
+    Round 21 (``precomputed_keys``): the chunked tenant-axis variant
+    takes the per-tenant PRNG keys as an INPUT instead of deriving
+    them from (key, t) inside the program — the caller derives keys
+    for the FULL fleet once (`_tick_keys`, bit-identical to the
+    in-program derivation) and feeds each k-tenant chunk its slice,
+    so chunking the tenant axis can never change any tenant's key
+    stream. One compiled program per chunk width, reused across every
+    chunk and every tick.
 
     Round 18: the per-cluster rows widen past the slo_ok/cost/carbon/
     pending block with the decision-provenance columns and the rule
@@ -287,8 +566,7 @@ def _compiled_service_tick(cfg: FrameworkConfig, backend,
             off += size
         return Action(*leaves)
 
-    @jax.jit
-    def service_tick(states, xs_all, t, key, lanes, held):
+    def _tick_core(states, xs_all, t, keys, lanes, held):
         exo_n = exo_at(xs_all, t, horizon_ticks)
         fresh = jax.vmap(lambda s, e: action_fn(s, e, t))(states, exo_n)
         fb = jax.vmap(lambda s, e: fallback_fn(s, e, t))(states, exo_n)
@@ -298,7 +576,6 @@ def _compiled_service_tick(cfg: FrameworkConfig, backend,
             lane_col == LANE_FRESH, flatten_actions(fresh, n),
             jnp.where(lane_col == LANE_HOLD, held, flat_fb))
         actions = _unflatten(flat_sel)
-        keys = jax.random.split(jax.random.fold_in(key, t), n)
         step_n = jax.vmap(
             functools.partial(sim_step, params, stochastic=False))
         new_states, metrics = step_n(states, actions, exo_n, keys)
@@ -324,8 +601,48 @@ def _compiled_service_tick(cfg: FrameworkConfig, backend,
         per = jnp.concatenate(blocks, axis=-1)
         return packed, new_states, per
 
+    if precomputed_keys:
+        @jax.jit
+        def service_tick(states, xs_all, t, keys, lanes, held):
+            return _tick_core(states, xs_all, t, keys, lanes, held)
+        return watch_jit(service_tick, "service.tick_chunk", hot=True,
+                         shared_stats=True)
+
+    @jax.jit
+    def service_tick(states, xs_all, t, key, lanes, held):
+        keys = jax.random.split(jax.random.fold_in(key, t), n)
+        return _tick_core(states, xs_all, t, keys, lanes, held)
+
     return watch_jit(service_tick, "service.tick", hot=True,
                      shared_stats=True)
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_service_tick(cfg: FrameworkConfig, backend,
+                           n: int, horizon_ticks: int):
+    return _build_service_tick(cfg, backend, n, horizon_ticks,
+                               precomputed_keys=False)
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_service_tick_chunk(cfg: FrameworkConfig, backend,
+                                 k: int, horizon_ticks: int):
+    """The k-tenant chunk program (precomputed keys). Cached separately
+    from the unchunked tick so a chunked N=10240 fleet compiles exactly
+    ONE chunk program, not one per chunk index."""
+    return _build_service_tick(cfg, backend, k, horizon_ticks,
+                               precomputed_keys=True)
+
+
+@functools.lru_cache(maxsize=32)
+def _tick_keys(n: int):
+    """Jitted full-fleet key derivation, bit-identical to the
+    in-program `split(fold_in(key, t), n)` of the unchunked tick."""
+    @jax.jit
+    def derive(key, t):
+        return jax.random.split(jax.random.fold_in(key, t), n)
+
+    return derive
 
 
 @dataclasses.dataclass
@@ -400,6 +717,15 @@ class ServiceTickReport:
     # exporter SKIPS both series (never-fake-zeros).
     candidate_win_rate: dict = dataclasses.field(default_factory=dict)
     tournament_leader: "int | None" = None
+    # Fleet-scale host-loop surfaces (round 21): real host microseconds
+    # the admission/accounting machine spent per tenant this tick
+    # (virtual scrape delay subtracted via the clock offset; dispatch
+    # and fan-out excluded — they are common to both host loops), and
+    # the tenant count that entered the scrape/dispatch phase. None on
+    # reports that never measured them — the exporter SKIPS the series
+    # (never-fake-zeros).
+    host_loop_us_per_tenant: "float | None" = None
+    active_tenants: "int | None" = None
 
 
 class FleetService:
@@ -425,9 +751,15 @@ class FleetService:
                  obs=None,
                  horizon_ticks: int = 2880, seed: int = 0,
                  clock: VirtualClock | None = None, tracer=None,
+                 host_loop: str = "vectorized",
+                 dispatch_chunk: "int | None" = None,
+                 transport=None,
                  log_fn: Callable[[str], None] | None = None):
         svc = cfg.service if service is None else service
         svc.validate()
+        if host_loop not in ("vectorized", "object"):
+            raise ValueError(f"host_loop={host_loop!r} — expected "
+                             "'vectorized' or 'object'")
         self.svc = svc
         self.cfg = cfg
         n = len(sinks)
@@ -460,8 +792,25 @@ class FleetService:
             return  # hard gate: tick()/run() delegate to the controller
 
         self.clock = clock if clock is not None else VirtualClock()
-        self._tick_fn = _compiled_service_tick(cfg, backend, n,
-                                               horizon_ticks)
+        self._host_loop = host_loop
+        self._transport = transport
+        # Chunked tenant-axis dispatch (round 21): N=10^3-10^4 fleets
+        # ride `sim/lanes.chunk_layout`-validated chunks through ONE
+        # compiled k-tenant program (keys precomputed for the full
+        # fleet, so chunking never changes a tenant's key stream).
+        if dispatch_chunk is not None and dispatch_chunk < n:
+            from ccka_tpu.sim.lanes import chunk_layout
+            self._n_chunks = chunk_layout(n, dispatch_chunk)
+            self._chunk = int(dispatch_chunk)
+            self._tick_fn = _compiled_service_tick_chunk(
+                cfg, backend, self._chunk, horizon_ticks)
+            self._keys_fn = _tick_keys(n)
+        else:
+            self._n_chunks = 1
+            self._chunk = n
+            self._tick_fn = _compiled_service_tick(cfg, backend, n,
+                                                   horizon_ticks)
+            self._keys_fn = None
         # Service-tuned reconcilers over the (chaos-wrapped) sinks: the
         # fleet controller's defaults carry a 2s internal deadline and
         # 10ms backoffs — one converge started just before the tick
@@ -481,10 +830,32 @@ class FleetService:
                        deadline_s=self._converge_budget_s,
                        seed=seed ^ (0x5EC0 + i))
             for i, snk in enumerate(self.ctrl.sinks)]
-        self.breakers = [CircuitBreaker(svc, seed=seed ^ (0xB4EA + i))
-                         for i in range(n)]
-        self._scrape_rngs = [random.Random((seed, i, "scrape").__repr__())
-                             for i in range(n)]
+        # Breaker machinery: flat arrays by default; the object bank is
+        # the paired baseline the fleet-scale bench measures against
+        # (same stream seeds → identical probe schedules either way).
+        self._brk = (_VectorBreakerBank(svc, seed, n)
+                     if host_loop == "vectorized"
+                     else _ObjectBreakerBank(svc, seed, n))
+        # Per-tenant scrape-fail streams, counter-addressed (replaces
+        # the N `random.Random` objects): draw order across tenants is
+        # irrelevant by construction, which is what lets the vectorized
+        # scrape phase batch the zero-delay tenants' draws.
+        idx_n = np.arange(n, dtype=np.int64)
+        self._scrape_seeds = (_U64(seed & 0xFFFFFFFFFFFFFFFF)
+                              ^ (idx_n + 0x5C12A9).astype(_U64))
+        self._scrape_draws = np.zeros(n, np.int64)
+        # Flat per-profile vectors for the vectorized admission machine.
+        self._stale_arr = np.asarray(
+            [p.stale_tolerant for p in self.profiles], bool)
+        self._delay_s_arr = np.asarray(
+            [p.scrape_delay_ms / 1e3 for p in self.profiles], np.float64)
+        self._failp_arr = np.asarray(
+            [p.scrape_fail_prob for p in self.profiles], np.float64)
+        # Static profile facts the admission fast path keys off: a
+        # fleet with no budget-consuming and no fallible scrapes skips
+        # the whole scrape walk (profiles are fixed per service).
+        self._any_delay = bool((self._delay_s_arr > 0.0).any())
+        self._any_failp = bool((self._failp_arr > 0.0).any())
         # Held action rows [N, A] (packed layout minus the is_peak
         # column); neutral until a tenant's first fresh decide lands.
         neutral = np.concatenate(
@@ -496,6 +867,12 @@ class FleetService:
         # tenants scrape (and actuate) inside the budget first.
         self._order = sorted(range(n),
                              key=lambda i: (self.profiles[i].priority, i))
+        # The argsort-once form of the same order (lexsort is stable on
+        # its last key, so ties break by index exactly like the tuple
+        # sort above) — computed once, reused by every vectorized tick.
+        self._order_arr = np.lexsort((
+            np.arange(n, dtype=np.int64),
+            np.asarray([p.priority for p in self.profiles], np.int64)))
         # Session counters + per-tenant accounting (the overload board's
         # isolation evidence reads these).
         self.sheds_total = 0
@@ -602,6 +979,18 @@ class FleetService:
     def states(self):
         return self.ctrl.states
 
+    @property
+    def breakers(self) -> list:
+        """Per-tenant breaker surface (objects in ``host_loop="object"``
+        mode, read-only row views over the vectorized bank otherwise).
+        Raises AttributeError when the service is disabled — the off
+        preset carries no breaker machinery (``hasattr`` gate pinned in
+        tests/test_service.py)."""
+        bank = self.__dict__.get("_brk")
+        if bank is None:
+            raise AttributeError("breakers (service disabled)")
+        return bank.views()
+
     def close(self) -> None:
         if getattr(self, "incidents", None) is not None:
             self.incidents.close()
@@ -619,10 +1008,21 @@ class FleetService:
         daemon may skip it and simply eat one deferred first tick."""
         if not self.svc.enabled:
             return
-        out = self._tick_fn(
-            self.ctrl.states, self.ctrl._xs_all, jnp.int32(0),
-            self.ctrl.key, jnp.zeros(self.n, jnp.int32),
-            jnp.asarray(self._held))
+        if self._n_chunks > 1:
+            k = self._chunk
+            keys = self._keys_fn(self.ctrl.key, jnp.int32(0))
+            st = jax.tree_util.tree_map(lambda x: x[:k],
+                                        self.ctrl.states)
+            xs = jax.tree_util.tree_map(lambda x: x[:k],
+                                        self.ctrl._xs_all)
+            out = self._tick_fn(st, xs, jnp.int32(0), keys[:k],
+                                jnp.zeros(k, jnp.int32),
+                                jnp.asarray(self._held[:k]))
+        else:
+            out = self._tick_fn(
+                self.ctrl.states, self.ctrl._xs_all, jnp.int32(0),
+                self.ctrl.key, jnp.zeros(self.n, jnp.int32),
+                jnp.asarray(self._held))
         jax.block_until_ready(out[0])
 
     # -- scrape simulation ---------------------------------------------------
@@ -632,7 +1032,12 @@ class FleetService:
         (ok, timed_out). A profile delay larger than the remaining
         budget consumes the WHOLE remaining budget and times out — the
         straggler is abandoned at the budget edge, exactly what a
-        scrape-with-timeout does to a hung endpoint."""
+        scrape-with-timeout does to a hung endpoint. With a concurrent
+        ``transport`` injected (signals/transport.py) the real fetch
+        replaces the VirtualClock profile simulation behind the same
+        contract."""
+        if self._transport is not None:
+            return self._transport.scrape(i, budget_s)
         prof = self.profiles[i]
         delay_s = prof.scrape_delay_ms / 1e3
         if delay_s > 0.0:
@@ -641,9 +1046,288 @@ class FleetService:
                 return False, True
             self.clock.advance(delay_s)
         if prof.scrape_fail_prob > 0.0 and \
-                self._scrape_rngs[i].random() < prof.scrape_fail_prob:
+                self._scrape_fail_draw(i) < prof.scrape_fail_prob:
             return False, False
         return True, False
+
+    def _scrape_fail_draw(self, i: int) -> float:
+        """One draw from tenant i's counter-addressed scrape stream."""
+        u = float(counter_u01(self._scrape_seeds[i],
+                              int(self._scrape_draws[i])))
+        self._scrape_draws[i] += 1
+        return u
+
+    # -- admission machine (steps 1-5 of the tick) ---------------------------
+
+    def _admit_object(self, t: int, scrape_end: float):
+        """The pre-round-21 per-tenant admission loop (cadence →
+        bulkheads → cap/shed → bounded scrape → lanes), kept verbatim
+        as the paired baseline the fleet-scale bench measures the
+        vectorized machine against. Returns the admission tuple shared
+        with :meth:`_admit_vectorized`."""
+        svc = self.svc
+        brs = self._brk.breakers
+
+        # 1. arrivals: every tenant is due unless cadence-degraded
+        #    (stale-tolerant tenants decide every `divisor` ticks
+        #    while the queue has been saturating). Tenants whose
+        #    breaker is not closed are NEVER cadence-skipped: the
+        #    seeded probe schedule must not silently depend on
+        #    admission outcomes.
+        due: list[int] = []
+        cadence_skipped = 0
+        div = self._cadence_divisor
+        for i in self._order:
+            if (div > 1 and self.profiles[i].stale_tolerant
+                    and brs[i].state == "closed"
+                    and (t + i) % div != 0):
+                cadence_skipped += 1
+                continue
+            due.append(i)
+
+        # 2. bulkheads BEFORE the cap: an open breaker must not
+        #    consume an admission slot (known-bad tenants filling
+        #    the queue would starve healthy ones into being shed —
+        #    the inverse of the isolation contract). allow() is the
+        #    probe gate: it flips open→half-open exactly when the
+        #    seeded schedule says so.
+        live: list[int] = []
+        probing: set[int] = set()
+        bulkhead_skipped = 0
+        for i in due:
+            br = brs[i]
+            if not br.allow(t):
+                # Bulkheaded for the WHOLE tick (scrape and fan-out
+                # both skipped); the fan-out loop must not count it
+                # again.
+                bulkhead_skipped += 1
+                continue
+            live.append(i)
+            if br.state == "half-open":
+                probing.add(i)
+        queue_depth = len(live)
+
+        # 3. admission cap: shed overflow from the BACK of the
+        #    priority order (stale-tolerant/low-priority first).
+        #    Due half-open probes are EXEMPT from the cap — the
+        #    seeded probe schedule must not be shed by backpressure
+        #    — but they keep their priority position in the scrape
+        #    order, so a probe never burns the budget ahead of a
+        #    healthier tenant.
+        cap = svc.admission_queue_cap or self.n
+        non_probing = [i for i in live if i not in probing]
+        shed = max(0, len(non_probing) - cap)
+        keep = set(non_probing[:cap]) | probing
+        ready = [i for i in live if i in keep]
+
+        # 4. bounded scrape loop: stragglers defer when the budget
+        #    runs out — abandoned at the budget edge, never awaited.
+        admitted: list[int] = []
+        scraped_ok = np.zeros(self.n, bool)
+        deferred = scrape_failed = probes = 0
+        for pos, i in enumerate(ready):
+            now = self.clock()
+            if now >= scrape_end:
+                deferred += len(ready) - pos
+                self.deferrals_total += len(ready) - pos
+                break
+            if brs[i].state == "half-open":
+                probes += 1
+            ok, timed_out = self._scrape(i, scrape_end - now)
+            if ok:
+                admitted.append(i)
+                scraped_ok[i] = True
+            else:
+                scrape_failed += 1
+                self.scrape_timeouts_total += int(timed_out)
+                self.scrape_failures_total += int(not timed_out)
+                brs[i].record_failure(t)
+
+        # 5. lanes: fresh for admitted; open breakers escalate
+        #    hold → rule-fallback after hold_fallback_after ticks.
+        lanes = np.full(self.n, LANE_HOLD, np.int32)
+        if admitted:
+            lanes[np.asarray(admitted, int)] = LANE_FRESH
+        for i in range(self.n):
+            if lanes[i] == LANE_HOLD and brs[i].open_ticks(
+                    t) >= svc.hold_fallback_after:
+                lanes[i] = LANE_FALLBACK
+        return (cadence_skipped, bulkhead_skipped, queue_depth, shed,
+                len(ready), np.asarray(admitted, np.int64), scraped_ok,
+                deferred, scrape_failed, probes, lanes)
+
+    def _admit_vectorized(self, t: int, scrape_end: float):
+        """Steps 1-5 as flat array ops: masked cadence/shed accounting
+        over the argsort-once admission order, the breaker bank's
+        vectorized probe gate, batched counter-stream fail draws for
+        zero-delay tenants, and a sequential walk over ONLY the tenants
+        whose scrapes consume budget (their VirtualClock advances are
+        order-dependent by design — the budget edge is a shared
+        resource). Decisions, patch streams and report counters are
+        bitwise `_admit_object`'s on the det clock."""
+        svc = self.svc
+        bank = self._brk
+        n = self.n
+        order = self._order_arr
+
+        # 1. cadence (closed breakers only — the probe schedule must
+        #    not depend on admission outcomes).
+        div = self._cadence_divisor
+        if div > 1:
+            skip = (self._stale_arr[order]
+                    & (bank.level[order] == 0)
+                    & ((t + order) % div != 0))
+            cadence_skipped = int(skip.sum())
+        else:
+            cadence_skipped = 0
+        due = order[~skip] if cadence_skipped else order
+
+        # 2. bulkheads BEFORE the cap (vectorized probe gate). With
+        #    every breaker closed (the calm-fleet common case, O(1) via
+        #    the bank's tripped count) the gate trivially allows all
+        #    and probes none — same outputs, no mask machinery.
+        if bank.all_closed:
+            bulkhead_skipped = 0
+            live = due
+            probing = None
+        else:
+            allowed, probing_all = bank.allow_due(due, t)
+            bulkhead_skipped = int(due.size) - int(allowed.sum())
+            live = due[allowed]
+            probing = probing_all[allowed]
+        queue_depth = int(live.size)
+
+        # 3. admission cap: probes exempt, overflow shed from the back
+        #    of the priority order (rank among non-probing rows; with
+        #    no probes the kept set is exactly the first `cap` rows).
+        cap = svc.admission_queue_cap or n
+        if probing is None:
+            shed = max(0, queue_depth - cap)
+            ready = live[:cap] if shed else live
+        else:
+            non_probing = ~probing
+            shed = max(0, int(non_probing.sum()) - cap)
+            rank = np.cumsum(non_probing) - 1
+            keep = probing | (non_probing & (rank < cap))
+            ready = live[keep]
+
+        # 4. bounded scrape phase. Zero-delay tenants never move the
+        #    clock, so their fail draws batch through the counter
+        #    streams; only budget-consuming tenants walk sequentially
+        #    (stragglers abandoned at the budget edge, never awaited).
+        nr = int(ready.size)
+        if self._transport is None and not self._any_delay \
+                and not self._any_failp:
+            # Every scrape is free and cannot fail: all ready rows
+            # admit, nothing defers, no draws are consumed — exactly
+            # what the general walk below computes, without building
+            # its masks.
+            probes = (0 if probing is None
+                      else int((bank.level[ready] == 1).sum()))
+            admitted = ready
+            scraped_ok = np.zeros(n, bool)
+            lanes = np.full(n, LANE_HOLD, np.int32)
+            if admitted.size:
+                a0 = int(admitted.min())
+                a1 = int(admitted.max())
+                if a1 - a0 + 1 == admitted.size:
+                    # Pigeonhole: distinct indices spanning their
+                    # range ARE the range — strided stores, no
+                    # scatter.
+                    scraped_ok[a0:a1 + 1] = True
+                    lanes[a0:a1 + 1] = LANE_FRESH
+                else:
+                    scraped_ok[admitted] = True
+                    lanes[admitted] = LANE_FRESH
+            if not bank.all_closed:
+                esc = (lanes == LANE_HOLD) & (
+                    bank.open_ticks_vec(t) >= svc.hold_fallback_after)
+                lanes[esc] = LANE_FALLBACK
+            return (cadence_skipped, bulkhead_skipped, queue_depth,
+                    shed, nr, admitted.astype(np.int64, copy=False),
+                    scraped_ok, 0, 0, probes, lanes)
+        half_open_before = bank.level[ready] == 1
+        ok_mask = np.zeros(nr, bool)
+        fail_mask = np.zeros(nr, bool)
+        timeout_mask = np.zeros(nr, bool)
+        cut = nr
+        if self._transport is not None:
+            # Concurrent fan-in: every ready tenant's fetch launches
+            # at once, each bounded by the remaining scrape budget.
+            budget = max(scrape_end - self.clock(), 0.0)
+            res = self._transport.fan_in(
+                [int(i) for i in ready], budget)
+            for q in range(nr):
+                ok, timed_out = res[int(ready[q])]
+                ok_mask[q] = ok
+                if not ok:
+                    fail_mask[q] = True
+                    timeout_mask[q] = timed_out
+        else:
+            delays = self._delay_s_arr[ready]
+            failp = self._failp_arr[ready]
+            clk = self.clock
+            for q in np.flatnonzero(delays > 0.0):
+                q = int(q)
+                rem = scrape_end - clk()
+                if rem <= 0.0:
+                    cut = q
+                    break
+                i = int(ready[q])
+                if delays[q] > rem:
+                    clk.advance(max(rem, 0.0))
+                    fail_mask[q] = True
+                    timeout_mask[q] = True
+                    cut = q + 1
+                    break
+                clk.advance(delays[q])
+                if failp[q] > 0.0 and \
+                        self._scrape_fail_draw(i) < failp[q]:
+                    fail_mask[q] = True
+                else:
+                    ok_mask[q] = True
+                if clk() >= scrape_end:
+                    cut = q + 1
+                    break
+            free = delays == 0.0
+            free[cut:] = False
+            free_pos = np.flatnonzero(free)
+            drawp = free_pos[failp[free_pos] > 0.0]
+            if drawp.size:
+                ids = ready[drawp]
+                u = counter_u01(self._scrape_seeds[ids],
+                                self._scrape_draws[ids])
+                self._scrape_draws[ids] += 1
+                f = u < failp[drawp]
+                fail_mask[drawp] = f
+                ok_mask[drawp] = ~f
+            ok_mask[free_pos[failp[free_pos] == 0.0]] = True
+        deferred = nr - cut
+        if deferred:
+            self.deferrals_total += deferred
+        probes = int(half_open_before[:cut].sum())
+        scrape_failed = int(fail_mask.sum())
+        self.scrape_timeouts_total += int(timeout_mask.sum())
+        self.scrape_failures_total += int(
+            (fail_mask & ~timeout_mask).sum())
+        bank.record_failure_idx(ready[fail_mask], t)
+        admitted = ready[ok_mask]
+        scraped_ok = np.zeros(n, bool)
+        scraped_ok[admitted] = True
+
+        # 5. lanes (masked hold→fallback escalation; with every
+        #    breaker closed — checked AFTER this tick's failures
+        #    recorded — no opened_at stamp exists and the scan is
+        #    vacuous).
+        lanes = np.full(n, LANE_HOLD, np.int32)
+        lanes[admitted] = LANE_FRESH
+        if not bank.all_closed:
+            esc = (lanes == LANE_HOLD) & (bank.open_ticks_vec(t)
+                                          >= svc.hold_fallback_after)
+            lanes[esc] = LANE_FALLBACK
+        return (cadence_skipped, bulkhead_skipped, queue_depth, shed,
+                nr, admitted.astype(np.int64), scraped_ok,
+                deferred, scrape_failed, probes, lanes)
 
     # -- one bounded tick ----------------------------------------------------
 
@@ -661,103 +1345,63 @@ class FleetService:
                           * svc.scrape_budget_frac / 1e3
                           if has_deadline else math.inf)
 
-            # 1. arrivals: every tenant is due unless cadence-degraded
-            #    (stale-tolerant tenants decide every `divisor` ticks
-            #    while the queue has been saturating). Tenants whose
-            #    breaker is not closed are NEVER cadence-skipped: the
-            #    seeded probe schedule must not silently depend on
-            #    admission outcomes.
-            due: list[int] = []
-            cadence_skipped = 0
-            div = self._cadence_divisor
-            for i in self._order:
-                if (div > 1 and self.profiles[i].stale_tolerant
-                        and self.breakers[i].state == "closed"
-                        and (t + i) % div != 0):
-                    cadence_skipped += 1
-                    continue
-                due.append(i)
-
-            # 2. bulkheads BEFORE the cap: an open breaker must not
-            #    consume an admission slot (known-bad tenants filling
-            #    the queue would starve healthy ones into being shed —
-            #    the inverse of the isolation contract). allow() is the
-            #    probe gate: it flips open→half-open exactly when the
-            #    seeded schedule says so.
-            live: list[int] = []
-            probing: set[int] = set()
-            bulkhead_skipped = 0
-            for i in due:
-                br = self.breakers[i]
-                if not br.allow(t):
-                    # Bulkheaded for the WHOLE tick (scrape and fan-out
-                    # both skipped); the fan-out loop must not count it
-                    # again.
-                    bulkhead_skipped += 1
-                    continue
-                live.append(i)
-                if br.state == "half-open":
-                    probing.add(i)
-            queue_depth = len(live)
-
-            # 3. admission cap: shed overflow from the BACK of the
-            #    priority order (stale-tolerant/low-priority first).
-            #    Due half-open probes are EXEMPT from the cap — the
-            #    seeded probe schedule must not be shed by backpressure
-            #    — but they keep their priority position in the scrape
-            #    order, so a probe never burns the budget ahead of a
-            #    healthier tenant.
-            cap = svc.admission_queue_cap or self.n
-            non_probing = [i for i in live if i not in probing]
-            shed = max(0, len(non_probing) - cap)
-            keep = set(non_probing[:cap]) | probing
-            ready = [i for i in live if i in keep]
-
-            # 4. bounded scrape loop: stragglers defer when the budget
-            #    runs out — abandoned at the budget edge, never awaited.
-            admitted: list[int] = []
-            scraped_ok = np.zeros(self.n, bool)
-            deferred = scrape_failed = probes = 0
-            for pos, i in enumerate(ready):
-                now = self.clock()
-                if now >= scrape_end:
-                    deferred += len(ready) - pos
-                    self.deferrals_total += len(ready) - pos
-                    break
-                if self.breakers[i].state == "half-open":
-                    probes += 1
-                ok, timed_out = self._scrape(i, scrape_end - now)
-                if ok:
-                    admitted.append(i)
-                    scraped_ok[i] = True
-                else:
-                    scrape_failed += 1
-                    self.scrape_timeouts_total += int(timed_out)
-                    self.scrape_failures_total += int(not timed_out)
-                    self.breakers[i].record_failure(t)
+            # 1-5. the admission machine (cadence → bulkheads →
+            #    cap/shed → bounded scrape → lanes): flat-array
+            #    vectorized by default, the pre-round-21 object loop
+            #    kept as the paired host_loop="object" baseline —
+            #    bitwise-identical decisions on the det clock (pinned
+            #    by tests/test_service.py).
+            off0 = self.clock.offset
+            admit = (self._admit_object if self._host_loop == "object"
+                     else self._admit_vectorized)
+            (cadence_skipped, bulkhead_skipped, queue_depth, shed,
+             n_ready, admitted, scraped_ok, deferred, scrape_failed,
+             probes, lanes) = admit(t, scrape_end)
             self.sheds_total += shed
-
-            # 5. lanes: fresh for admitted; open breakers escalate
-            #    hold → rule-fallback after hold_fallback_after ticks.
-            lanes = np.full(self.n, LANE_HOLD, np.int32)
-            if admitted:
-                lanes[np.asarray(admitted, int)] = LANE_FRESH
-            for i in range(self.n):
-                if lanes[i] == LANE_HOLD and self.breakers[i].open_ticks(
-                        t) >= svc.hold_fallback_after:
-                    lanes[i] = LANE_FALLBACK
             self.last_lanes = lanes.copy()
+            # Real host seconds the admission machine consumed: clock
+            # delta minus the virtual scrape delay injected into it.
+            host_adm_s = ((self.clock() - t0)
+                          - (self.clock.offset - off0))
 
-            # 6. ONE batched dispatch, lanes selected on device.
+            # 6. ONE batched dispatch, lanes selected on device — or,
+            #    chunked on the tenant axis (round 21), the SAME
+            #    program over k-tenant slices with full-fleet
+            #    precomputed keys, per-chunk rows gathered on host so
+            #    device output stays bounded by the chunk width.
             with self.tracer.span("service.dispatch", t=t) as sp_d:
-                packed, new_states, per = self._tick_fn(
-                    self.ctrl.states, self.ctrl._xs_all, jnp.int32(t),
-                    self.ctrl.key, jnp.asarray(lanes),
-                    jnp.asarray(self._held))
-                self.ctrl.states = new_states
-                for arr in (packed, per):
-                    if hasattr(arr, "copy_to_host_async"):
-                        arr.copy_to_host_async()
+                if self._n_chunks > 1:
+                    k = self._chunk
+                    keys = self._keys_fn(self.ctrl.key, jnp.int32(t))
+                    lanes_j = jnp.asarray(lanes)
+                    held_j = jnp.asarray(self._held)
+                    packed_parts, state_parts, per_parts = [], [], []
+                    for c in range(self._n_chunks):
+                        sl = slice(c * k, (c + 1) * k)
+                        st = jax.tree_util.tree_map(
+                            lambda x: x[sl], self.ctrl.states)
+                        xs = jax.tree_util.tree_map(
+                            lambda x: x[sl], self.ctrl._xs_all)
+                        p, s, m = self._tick_fn(
+                            st, xs, jnp.int32(t), keys[sl],
+                            lanes_j[sl], held_j[sl])
+                        packed_parts.append(np.asarray(p))
+                        per_parts.append(np.asarray(m))
+                        state_parts.append(s)
+                    self.ctrl.states = jax.tree_util.tree_map(
+                        lambda *leaves: jnp.concatenate(leaves, axis=0),
+                        *state_parts)
+                    packed = np.concatenate(packed_parts, axis=0)
+                    per = np.concatenate(per_parts, axis=0)
+                else:
+                    packed, new_states, per = self._tick_fn(
+                        self.ctrl.states, self.ctrl._xs_all,
+                        jnp.int32(t), self.ctrl.key,
+                        jnp.asarray(lanes), jnp.asarray(self._held))
+                    self.ctrl.states = new_states
+                    for arr in (packed, per):
+                        if hasattr(arr, "copy_to_host_async"):
+                            arr.copy_to_host_async()
 
             # 7. bounded fan-out through the per-tenant reconcilers
             #    (priority order; open breakers bulkheaded; stragglers
@@ -765,10 +1409,10 @@ class FleetService:
             with self.tracer.span("service.fanout", t=t) as sp_f:
                 packed_np = np.asarray(packed)
                 per_np = np.asarray(per)
+                bank = self._brk
                 applied = fanout_deferred = 0
                 for pos, i in enumerate(self._order):
-                    br = self.breakers[i]
-                    if br.state == "open":
+                    if bank.is_open(i):
                         # Not re-counted: either it was bulkheaded at
                         # scrape time (already in bulkhead_skipped) or
                         # it opened on THIS tick's scrape/probe failure
@@ -799,18 +1443,34 @@ class FleetService:
                         # A probe (or a plain tick) closes the breaker
                         # only when scrape AND actuation both held.
                         if scraped_ok[i]:
-                            br.record_success()
+                            bank.record_success(i)
                     else:
                         self.actuation_giveups_total += 1
-                        br.record_failure(t)
+                        bank.record_failure(i, t)
 
-            # 8. held rows advance for fresh lanes; accounting.
-            if admitted:
-                idx = np.asarray(admitted, int)
-                self._held[idx] = packed_np[idx, :-1]
-                self.tenant_fresh_ticks[idx] += 1
-            self.tenant_cost_usd += per_np[:, 1].astype(np.float64)
-            self.tenant_slo_ticks += per_np[:, 0].astype(np.float64)
+            # 8. held rows advance for fresh lanes; accounting (masked
+            #    — part of the host-loop window the µs/tenant gauge
+            #    measures, like the admission machine above).
+            acct0 = self.clock()
+            aoff0 = self.clock.offset
+            if admitted.size:
+                a0 = int(admitted.min())
+                a1 = int(admitted.max())
+                if a1 - a0 + 1 == admitted.size:
+                    # Distinct indices spanning exactly their range ARE
+                    # that range (pigeonhole) — a strided copy instead
+                    # of a gather/scatter pair. Uniform-priority fleets
+                    # admit a contiguous prefix every calm tick.
+                    sl = slice(a0, a1 + 1)
+                    self._held[sl] = packed_np[sl, :-1]
+                    self.tenant_fresh_ticks[sl] += 1
+                else:
+                    self._held[admitted] = packed_np[admitted, :-1]
+                    self.tenant_fresh_ticks[admitted] += 1
+            # In-place += casts f32 rows without materializing a f64
+            # temporary (bitwise the old astype-then-add).
+            self.tenant_cost_usd += per_np[:, 1]
+            self.tenant_slo_ticks += per_np[:, 0]
 
             # 9. cadence degradation: sustained shedding doubles the
             #    stale-tolerant divisor (bounded); relief halves it.
@@ -825,6 +1485,9 @@ class FleetService:
                     self._cadence_divisor //= 2
             self.cadence_skips_total += cadence_skipped
             self.bulkhead_skips_total += bulkhead_skipped
+            host_loop_s = host_adm_s + ((self.clock() - acct0)
+                                        - (self.clock.offset - aoff0))
+            host_loop_us = max(host_loop_s, 0.0) * 1e6 / max(self.n, 1)
 
             # 10. incident-grade observation (round 14, `ccka_tpu/obs`):
             #     burn windows, ring recording, trigger stamps and
@@ -854,7 +1517,7 @@ class FleetService:
         report = ServiceTickReport(
             t=t,
             n_tenants=self.n,
-            admitted=len(admitted),
+            admitted=int(admitted.size),
             deferred=deferred,
             shed=shed,
             cadence_skipped=cadence_skipped,
@@ -871,13 +1534,13 @@ class FleetService:
             admission_queue_depth=queue_depth,
             sheds_total=self.sheds_total,
             deferrals_total=self.deferrals_total,
-            breaker_transitions_total=sum(
-                sum(b.transitions.values()) for b in self.breakers),
+            breaker_transitions_total=self._brk.transitions_total(),
             cadence_divisor=self._cadence_divisor,
             decide_ms=round(sp_d.dur_ms, 3),
             fanout_ms=round(sp_f.dur_ms, 3),
-            breaker_states={str(i): b.level
-                            for i, b in enumerate(self.breakers)},
+            breaker_states=self._brk.states_dict(),
+            host_loop_us_per_tenant=round(host_loop_us, 4),
+            active_tenants=int(n_ready),
             slo_burn_rate=round(slo_burn, 6),
             slo_burn_rate_slow=round(slo_burn_slow, 6),
             incident_active=int(incident_active),
@@ -967,10 +1630,11 @@ class FleetService:
             "burn_slo_fast": round(self.burn.rate("slo", "fast"), 4),
             "burn_slo_slow": round(self.burn.rate("slo", "slow"), 4),
         })
+        lvls = self._brk.levels()
         for i in range(n):
             self.recorder.record(i, {
                 "t": int(t), "lane": int(lanes[i]),
-                "breaker": int(self.breakers[i].level),
+                "breaker": int(lvls[i]),
                 "scraped": bool(scraped_ok[i]),
             })
 
@@ -979,12 +1643,14 @@ class FleetService:
         # come off the breakers' own transition tallies; both the
         # scrape phase and the fan-out phase already happened, so the
         # tallies are final for this tick.
-        for i, br in enumerate(self.breakers):
-            while self._prev_opened[i] < br.transitions["opened"]:
+        opened = self._brk.opened_counts()
+        for i in range(n):
+            while self._prev_opened[i] < opened[i]:
                 self._prev_opened[i] += 1
                 self.incidents.stamp(
                     "breaker_open", t=t, tenant=i,
-                    open_number=self._prev_opened[i], state=br.state,
+                    open_number=self._prev_opened[i],
+                    state=_BREAKER_STATE[int(lvls[i])],
                     profile=self.profile_names[i])
         prev = self._prev_lanes
         for i in range(n):
@@ -992,7 +1658,7 @@ class FleetService:
                     prev is None or prev[i] != LANE_FALLBACK):
                 self.incidents.stamp(
                     "hold_fallback", t=t, tenant=i,
-                    open_ticks=int(self.breakers[i].open_ticks(t)),
+                    open_ticks=int(self._brk.open_ticks(i, t)),
                     profile=self.profile_names[i])
         self._prev_lanes = lanes.copy()
         for i in self._giveups_this_tick:
@@ -1053,11 +1719,7 @@ class FleetService:
     # -- board accessors -----------------------------------------------------
 
     def breaker_transition_counts(self) -> dict:
-        out = {"opened": 0, "half_open": 0, "closed": 0}
-        for b in self.breakers:
-            for k, v in b.transitions.items():
-                out[k] += v
-        return out
+        return self._brk.transition_counts()
 
     def chaos_injected(self) -> dict:
         """Summed injected-failure stats over chaos-wrapped tenant
@@ -1086,6 +1748,9 @@ def fleet_service_from_config(cfg: FrameworkConfig,
                               obs=None,
                               horizon_ticks: int = 2880, seed: int = 0,
                               clock: VirtualClock | None = None,
+                              host_loop: str = "vectorized",
+                              dispatch_chunk: "int | None" = None,
+                              transport=None,
                               log_fn=None) -> FleetService:
     """Dry-run service wiring: N in-memory sinks over the synthetic
     source (per-tenant chaos wraps ride the profiles)."""
@@ -1098,4 +1763,6 @@ def fleet_service_from_config(cfg: FrameworkConfig,
     return FleetService(cfg, backend, source, sinks, profiles=profiles,
                         service=service, obs=obs,
                         horizon_ticks=horizon_ticks,
-                        seed=seed, clock=clock, log_fn=log_fn)
+                        seed=seed, clock=clock, host_loop=host_loop,
+                        dispatch_chunk=dispatch_chunk,
+                        transport=transport, log_fn=log_fn)

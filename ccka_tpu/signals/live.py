@@ -40,6 +40,14 @@ def _default_fetch(timeout_s: float) -> Fetch:
     return fetch
 
 
+def default_fetch(timeout_s: float) -> Fetch:
+    """Public alias of the module's urllib transport — the fetch the
+    concurrent scrape fan-in (`signals/transport.py`) pools per tenant.
+    Kept as a separate name so the private one can keep evolving with
+    the retry stack without committing its signature."""
+    return _default_fetch(timeout_s)
+
+
 class RetryingFetch:
     """Jittered exponential-backoff retry around any ``fetch`` transport.
 

@@ -465,7 +465,14 @@ def chunk_layout(batch: int, chunk: int) -> int:
     """Number of cluster-axis chunks when a ``batch``-wide fleet streams
     through the mesh ``chunk`` clusters at a time (bench's 10^4–10^5
     rows). Rejects a chunk that does not tile the batch — a ragged tail
-    chunk would silently change the per-launch geometry mid-sweep."""
+    chunk would silently change the per-launch geometry mid-sweep.
+
+    Round 21 reuses this as the TENANT-axis layout for the fleet
+    service's chunked dispatch (`harness/service.py`): N=10^3–10^4
+    tenants ride ``N // chunk`` launches of ONE compiled chunk-sized
+    tick program in bounded memory, with the same equal-width
+    contract — the chunked run must be bitwise the unchunked one, and
+    a ragged tail would be a second program shape."""
     if chunk <= 0:
         raise ValueError(f"cluster chunk={chunk} must be positive")
     if batch % chunk:

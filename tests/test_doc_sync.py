@@ -1085,3 +1085,111 @@ class TestTournamentClaims:
                        "challenger_sustained_win",
                        "program-shaping"):
             assert phrase in flat, phrase
+
+
+class TestFleetScaleClaims:
+    """Round 21's fleet-scale host loop (ISSUE 18 docs satellite):
+    README's "Fleet scale" claims are PARSED against the BASELINE
+    round21 record, not hand-synced."""
+
+    def test_round21_record_is_self_describing(self, baseline):
+        r21 = baseline["published"]["round21"]
+        fs = r21["fleet_scale_stage"]
+        inv = fs["invariants"]
+        # The acceptance criteria hold on the record itself.
+        assert inv["parity_bitwise"] is True
+        assert inv["chunk_parity_bitwise"] is True
+        assert inv["speedup_ratio"] >= 10.0
+        assert inv["healthy_usd_ratio_max"] == 1.0
+        assert inv["healthy_ratio_exact_all"] is True
+        assert inv["max_tenants"] == 10240
+        assert fs["sweep_n"] == [16, 256, 1024, 4096, 10240]
+        assert len(fs["scenarios"]) == 2
+        # Every sweep cell the spec names is present.
+        for n in fs["sweep_n"]:
+            for scen in fs["scenarios"]:
+                assert f"n{n}/{scen}" in fs["cells"], (n, scen)
+        sp = r21["speedup_evidence"]
+        assert sp["ratio"] == inv["speedup_ratio"]
+        assert sp["ratio"] >= sp["floor"] == 10.0
+        assert sp["warmup_ticks_dropped"] >= 1
+        assert fs["parity"]["mismatches"] == []
+        assert fs["chunk_parity"]["mismatches"] == []
+        assert fs["parity"]["n_tenants"] <= 64
+        assert fs["chunk_parity"]["n_tenants"] == 1024
+        for gate, needle in (("parity_gate", "bitwise identical"),
+                             ("isolation_gate", "EXACTLY"),
+                             ("p99_curve_gate", "monotonically")):
+            assert needle in r21[gate], gate
+
+    def test_readme_speedup_claim(self, readme, baseline):
+        sp = baseline["published"]["round21"]["speedup_evidence"]
+        m = re.search(
+            r"N=4096\s+calm\s+fleet\s+at\s+([\d.]+)\s?µs/tenant\s+"
+            r"against\s+the\s+object\s+loop's\s+([\d.]+)\s?µs/tenant\s+"
+            r"—\s+a\s+([\d.]+)×\s+speedup\s+over\s+the\s+≥10×\s+gate",
+            readme)
+        assert m, ("README's fleet-scale speedup claim no longer "
+                   "states the numbers in the pinned form — update "
+                   "the claim AND this regex together")
+        vec, obj, ratio = map(float, m.groups())
+        assert abs(vec - sp["vectorized_us_per_tenant"]) < 5e-4
+        assert abs(obj - sp["object_us_per_tenant"]) < 5e-4
+        assert abs(ratio - sp["ratio"]) < 5e-3
+        assert ratio >= 10.0
+        assert sp["n_tenants"] == 4096 and sp["scenario"] == "calm"
+
+    def test_readme_tail_latency_claim(self, readme, baseline):
+        fs = baseline["published"]["round21"]["fleet_scale_stage"]
+        m = re.search(
+            r"N=10240\s+the\s+calm\s+fleet's\s+p99\s+tick\s+latency\s+"
+            r"is\s+([\d.]+)\s?ms\s+at\s+([\d.]+)\s?µs\s+of\s+host\s+"
+            r"loop\s+per\s+tenant", readme)
+        assert m, "README's 10^4-tenant tail claim lost its pinned form"
+        p99, us = map(float, m.groups())
+        cell = fs["cells"]["n10240/calm"]
+        assert abs(p99 - cell["latency_ms"]["p99"]) < 5e-3
+        assert abs(us - cell["host_loop_us_per_tenant"]) < 5e-4
+        # The per-tenant p99 curve the README calls monotone really
+        # falls over the record's upper sweep, both scenarios.
+        for scen in fs["scenarios"]:
+            series = [(n, fs["cells"][f"n{n}/{scen}"]["latency_ms"]
+                       ["p99"]) for n in fs["sweep_n"] if n >= 256]
+            per_tenant = [p / n for n, p in series]
+            assert per_tenant == sorted(per_tenant, reverse=True), scen
+
+    def test_readme_isolation_claim(self, readme, baseline):
+        fs = baseline["published"]["round21"]["fleet_scale_stage"]
+        flat = " ".join(readme.split())
+        assert ("paired $/SLO-hour ratio is exactly 1.0 in every "
+                "stressed cell, 16 through 10240 tenants") in flat
+        ratio_cells = [c for c in fs["cells"].values()
+                       if "healthy_usd_ratio_max" in c]
+        assert len(ratio_cells) == len(fs["sweep_n"])
+        assert all(c["healthy_usd_ratio_max"] == 1.0
+                   and c["healthy_usd_ratio_mean"] == 1.0
+                   for c in ratio_cells)
+
+    def test_readme_names_the_surfaces(self, readme):
+        flat = " ".join(readme.split())
+        for needle in ("ccka_host_loop_us_per_tenant",
+                       "ccka_active_tenants", "--fleet-scale-only",
+                       "`ccka scaling-curve`", "BENCH_r21.json",
+                       "ScrapeFanIn", "chunk_layout",
+                       "_VectorBreakerBank", "splitmix64"):
+            assert needle in flat, needle
+
+    def test_architecture_has_section_23(self):
+        arch = _read("ARCHITECTURE.md")
+        assert "## 23. Fleet-scale host loop" in arch
+        flat = " ".join(arch.split())
+        for phrase in ("counter_u01", "n_tripped",
+                       "_ObjectBreakerBank", "_run_paired",
+                       "bitwise_identical", "chunk_layout",
+                       "ScrapeFanIn", "FIRST_COMPLETED",
+                       "_FLEET_SPEEDUP_FLOOR", "_FLEET_MAX_N",
+                       "_FLEET_P99_MIN_N",
+                       "_FLEET_P99_PER_TENANT_SLACK",
+                       "skip-don't-fake-zeros",
+                       "warmup ticks dropped"):
+            assert phrase in flat, phrase

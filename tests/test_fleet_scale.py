@@ -1,0 +1,346 @@
+"""Fleet-scale host loop (round 21): the bench-diff invariant gates,
+the 10^3-tenant chunked-dispatch parity pin, and the async scrape
+fan-in's deadline-abandon contract.
+
+The contracts pinned here:
+
+- **bench-diff gates** (`obs/bench_history.py`): the round-21 record
+  must carry a >= 10x vectorized-vs-object speedup, true parity flags,
+  an exactly-1.0 healthy-tenant isolation ratio in every stressed
+  cell, and a monotone-sane per-tenant p99 curve; a doctored record
+  drives `ccka bench-diff` to exit 1, partial/unreadable records are
+  regressions, and small-N latency noise is NOT a false positive;
+- **chunked dispatch parity**: an N=1024 fleet ticked through
+  `sim/lanes.chunk_layout`-sized chunks is bitwise the unchunked run
+  on a deterministic clock — reports, patch streams, ledgers;
+- **deadline-abandon transport** (`signals/transport.ScrapeFanIn`):
+  a hung socket is abandoned at the budget edge (never awaited), a
+  re-scrape of the still-hung tenant fails fast, and the straggler
+  drains once its own socket timeout fires.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ccka_tpu.config import default_config
+
+
+def _good_fleet_record(**overrides) -> dict:
+    """A minimal healthy `--fleet-scale-only` stage record: every
+    surface `_extract_fleet_scale` gates on, with the real record's
+    shape (sweep x scenarios cells, parity flags, speedup pair)."""
+    sweep = [16, 256, 10240]
+    scen = ["calm", "slow0.25_moderate"]
+    # Per-tenant p99 falls with N in both scenarios; the n16 cell is
+    # deliberately noisy (one slow tick) — the gate must not care.
+    p99 = {16: {"calm": 101.0, "slow0.25_moderate": 106.0},
+           256: {"calm": 152.0, "slow0.25_moderate": 267.0},
+           10240: {"calm": 486.0, "slow0.25_moderate": 224.0}}
+    cells = {}
+    for n in sweep:
+        for s in scen:
+            cell = {
+                "n_tenants": n, "scenario": s,
+                "dispatch_chunk": 256 if n >= 1024 else None,
+                "latency_ms": {"p50": p99[n][s] * 0.5,
+                               "p99": p99[n][s],
+                               "max": p99[n][s] * 1.1},
+                "host_loop_us_per_tenant": 10.0 / n,
+                "sheds_total": n,
+            }
+            if s != "calm":
+                cell["healthy_usd_ratio_max"] = 1.0
+                cell["healthy_usd_ratio_mean"] = 1.0
+            cells[f"n{n}/{s}"] = cell
+    rec = {
+        "stage": "--fleet-scale-only",
+        "engine": "vectorized fleet-service host loop",
+        "ticks_per_run": 12,
+        "sweep_n": sweep,
+        "scenarios": scen,
+        "cells": cells,
+        "parity": {"bitwise_identical": True},
+        "chunk_parity": {"bitwise_identical": True},
+        "speedup": {"n_tenants": 10240, "scenario": "calm", "ticks": 24,
+                    "object_us_per_tenant": 1.5,
+                    "vectorized_us_per_tenant": 0.12, "ratio": 12.5},
+        "provenance": {"platform": "cpu"},
+    }
+    rec.update(overrides)
+    return rec
+
+
+class TestBenchDiffFleetScaleGates:
+    """ISSUE 18 satellite: the sentinel's fleet-scale invariant gates —
+    an injected doctored record drives exit 1, the real history stays
+    clean, small-N noise stays green."""
+
+    def _diff_of(self, tmp_path, rec):
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        (tmp_path / "BENCH_r94.json").write_text(json.dumps(rec))
+        return bench_diff(load_bench_history(str(tmp_path)))
+
+    def _fleet_regressions(self, diff):
+        return [r for r in diff["regressions"]
+                if r["kind"] == "fleet_scale_invariant"]
+
+    def test_good_record_is_clean(self, tmp_path):
+        diff = self._diff_of(tmp_path, _good_fleet_record())
+        assert diff["ok"], diff["regressions"]
+
+    def test_speedup_below_floor_regresses_and_cli_exits_one(
+            self, tmp_path, capsys):
+        rec = _good_fleet_record()
+        rec["speedup"]["ratio"] = 8.0
+        diff = self._diff_of(tmp_path, rec)
+        bad = self._fleet_regressions(diff)
+        assert any(r.get("threshold") == 10.0 and r.get("value") == 8.0
+                   for r in bad), diff["regressions"]
+        from ccka_tpu.cli import main
+
+        assert main(["bench-diff", "--root", str(tmp_path)]) == 1
+        assert "REGRESSION" in capsys.readouterr().err
+
+    def test_parity_flags_false_regress(self, tmp_path):
+        for key in ("parity", "chunk_parity"):
+            rec = _good_fleet_record()
+            rec[key] = {"bitwise_identical": False}
+            diff = self._diff_of(tmp_path, rec)
+            assert not diff["ok"], key
+            assert any("bitwise" in r["detail"]
+                       for r in self._fleet_regressions(diff)), key
+
+    def test_healthy_ratio_off_one_regresses_either_direction(
+            self, tmp_path):
+        # 0.97 AND 1.03 both regress: the gate is exact equality, not
+        # a floor — a "cheaper" healthy tenant under stress means the
+        # pairing broke, not that isolation improved.
+        for ratio in (0.97, 1.03):
+            rec = _good_fleet_record()
+            rec["cells"]["n256/slow0.25_moderate"][
+                "healthy_usd_ratio_mean"] = ratio
+            diff = self._diff_of(tmp_path, rec)
+            assert any("isolation" in r["detail"]
+                       for r in self._fleet_regressions(diff)), ratio
+
+    def test_rising_per_tenant_p99_regresses(self, tmp_path):
+        rec = _good_fleet_record()
+        # n10240 calm p99 jumps to 40x the n256 per-tenant level.
+        rec["cells"]["n10240/calm"]["latency_ms"] = {
+            "p50": 100.0, "p99": 25000.0, "max": 26000.0}
+        diff = self._diff_of(tmp_path, rec)
+        assert any("monotone" in r["detail"]
+                   for r in self._fleet_regressions(diff)), \
+            diff["regressions"]
+
+    def test_small_n_noise_is_not_a_false_positive(self, tmp_path):
+        # A 100x per-tenant p99 at N=16 (one slow tick swamps the
+        # quotient at small N) must NOT trip the monotone gate — the
+        # check starts at the _FLEET_P99_MIN_N floor.
+        rec = _good_fleet_record()
+        rec["cells"]["n16/calm"]["latency_ms"] = {
+            "p50": 1.0, "p99": 900.0, "max": 950.0}
+        diff = self._diff_of(tmp_path, rec)
+        assert diff["ok"], diff["regressions"]
+
+    def test_percentile_ordering_broken_regresses(self, tmp_path):
+        rec = _good_fleet_record()
+        rec["cells"]["n256/calm"]["latency_ms"] = {
+            "p50": 200.0, "p99": 150.0, "max": 160.0}
+        diff = self._diff_of(tmp_path, rec)
+        assert any("ordering" in r["detail"]
+                   for r in self._fleet_regressions(diff))
+
+    def test_partial_records_are_regressions(self, tmp_path):
+        # Absent is partial, not green — each degraded shape trips.
+        rec = _good_fleet_record()
+        del rec["speedup"]
+        assert not self._diff_of(tmp_path, rec)["ok"]
+        rec = _good_fleet_record()
+        del rec["parity"]
+        assert not self._diff_of(tmp_path, rec)["ok"]
+        rec = _good_fleet_record()
+        del rec["cells"]["n10240/calm"]
+        diff = self._diff_of(tmp_path, rec)
+        assert any("missing" in r["detail"]
+                   for r in self._fleet_regressions(diff))
+        rec = _good_fleet_record()
+        del rec["cells"]
+        assert not self._diff_of(tmp_path, rec)["ok"]
+        # A full stage record that never reached the 10^4 point.
+        rec = _good_fleet_record()
+        rec["sweep_n"] = [16, 256]
+        rec["cells"] = {k: v for k, v in rec["cells"].items()
+                        if "10240" not in k}
+        diff = self._diff_of(tmp_path, rec)
+        assert any("10^4" in r["detail"]
+                   for r in self._fleet_regressions(diff))
+
+    def test_real_history_is_clean_and_round21_extracted(self):
+        import os
+
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        history = load_bench_history(root)
+        diff = bench_diff(history)
+        assert diff["ok"], diff["regressions"]
+        rec = {r["round"]: r for r in history["records"]}[21]
+        # The committed record states the acceptance numbers.
+        assert rec["fleet_scale_speedup"] >= 10.0
+        assert rec["fleet_scale_parity"] is True
+        assert rec["fleet_scale_chunk_parity"] is True
+        assert rec["fleet_scale_healthy_exact"] is True
+        assert rec["fleet_scale_partial"] == []
+        assert rec["fleet_scale_p99_violations"] == []
+
+    def test_scaling_curve_ingests_tenant_axis_rows(self, tmp_path):
+        from ccka_tpu.obs.bench_history import scaling_curve
+
+        (tmp_path / "BENCH_r94.json").write_text(
+            json.dumps(_good_fleet_record()))
+        curve = scaling_curve(str(tmp_path))
+        rows = [p for p in curve["points"]
+                if p.get("source") == "fleet_scale"]
+        # 6 sweep cells + the speedup row, tenant count on the batch
+        # axis, the numbers in the note (the CLI's fallback column).
+        assert len(rows) == 7
+        assert {p["per_device_batch"] for p in rows} == {16, 256, 10240}
+        assert any("us/tenant" in p["note"] and "chunk 256" in p["note"]
+                   for p in rows)
+        assert any(p["note"].startswith("speedup:")
+                   and "12.5x" in p["note"] for p in rows)
+
+
+@pytest.mark.slow
+class TestChunkedDispatchParity:
+    """ISSUE 18 satellite: an N=1024 fleet ticked in 256-tenant chunks
+    (the `sim/lanes.chunk_layout` path) is bitwise the unchunked run
+    on a deterministic clock."""
+
+    def test_n1024_chunked_bitwise_unchunked(self):
+        from ccka_tpu.harness.fleetscale import _run_paired
+        from ccka_tpu.policy import RulePolicy
+
+        cfg = default_config().with_overrides(**{"sim.horizon_steps": 16})
+        n = 1024
+        profiles = ["healthy"] * n
+        from ccka_tpu.config import SERVICE_PRESETS
+        import dataclasses
+        svc = dataclasses.replace(SERVICE_PRESETS["default"],
+                                  admission_queue_cap=n - 64)
+        res = _run_paired(
+            cfg, RulePolicy(cfg.cluster), n, profiles, svc,
+            ticks=4, seed=211, horizon=8,
+            variants={"chunked": ("vectorized", 256),
+                      "unchunked": ("vectorized", None)})
+        assert res["bitwise_identical"], res["mismatches"]
+        assert res["variants"]["chunked"]["dispatch_chunk"] == 256
+
+
+class TestScrapeFanInDeadlines:
+    """The async transport's deadline-abandon contract against a real
+    hung socket (accepts, never responds)."""
+
+    @pytest.fixture()
+    def hung_server(self):
+        import socket
+        import threading
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(8)
+        port = srv.getsockname()[1]
+        conns = []
+        stop = threading.Event()
+
+        def accept_loop():
+            srv.settimeout(0.1)
+            while not stop.is_set():
+                try:
+                    c, _ = srv.accept()
+                    conns.append(c)     # hold open, never respond
+                except OSError:
+                    continue
+
+        th = threading.Thread(target=accept_loop, daemon=True)
+        th.start()
+        yield port
+        stop.set()
+        th.join(timeout=2)
+        for c in conns:
+            c.close()
+        srv.close()
+
+    def _hung_fetch(self, port, socket_timeout_s):
+        import socket
+
+        def fetch():
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=socket_timeout_s) as s:
+                s.settimeout(socket_timeout_s)
+                s.sendall(b"GET /metrics\r\n")
+                return s.recv(1024)     # never arrives
+        return fetch
+
+    def test_hung_socket_abandoned_at_budget_edge_never_awaited(
+            self, hung_server):
+        import time
+
+        from ccka_tpu.signals.transport import ScrapeFanIn
+
+        fan = ScrapeFanIn(
+            [self._hung_fetch(hung_server, socket_timeout_s=1.5),
+             lambda: b"ok"], workers=4)
+        try:
+            t0 = time.monotonic()
+            res = fan.fan_in([0, 1], budget_s=0.25)
+            took = time.monotonic() - t0
+            # The healthy tenant completed, the hung one was recorded
+            # as a timeout AT the budget edge — not after the socket's
+            # own 1.5s timeout.
+            assert res[1] == (True, False)
+            assert res[0] == (False, True)
+            assert took < 1.0
+            assert fan.abandoned_total == 1
+            assert fan.stragglers() == [0]
+            # Re-scraping the still-hung tenant fails FAST (no second
+            # request stacks behind the dead endpoint).
+            t0 = time.monotonic()
+            assert fan.scrape(0, budget_s=5.0) == (False, True)
+            assert time.monotonic() - t0 < 0.5
+            # The straggler drains by its OWN socket timeout, proving
+            # nothing awaited it: the worker unwinds on schedule.
+            deadline = time.monotonic() + 4.0
+            while fan.stragglers() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert fan.stragglers() == []
+        finally:
+            fan.close()
+
+    def test_http_fan_in_builds_per_url_fetchers(self):
+        from ccka_tpu.signals.transport import http_scrape_fan_in
+
+        calls = []
+
+        def fetch(url, headers):
+            calls.append(url)
+            return b"x"
+
+        fan = http_scrape_fan_in(
+            ["http://a/metrics", "http://b/metrics"], fetch=fetch)
+        try:
+            res = fan.fan_in([0, 1], budget_s=2.0)
+            assert res == {0: (True, False), 1: (True, False)}
+            assert sorted(calls) == ["http://a/metrics",
+                                     "http://b/metrics"]
+            assert fan.completed_total == 2
+        finally:
+            fan.close()

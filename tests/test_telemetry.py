@@ -485,7 +485,8 @@ def _service_report():
         candidate_win_rate={"carbon": 0.7, "rule": 0.4},
         tournament_leader=1,
         region_migration_rate={"mean": 0.12},
-        region_carbon_intensity={"r0": 380.0, "r1": 420.0})
+        region_carbon_intensity={"r0": 380.0, "r1": 420.0},
+        host_loop_us_per_tenant=0.875, active_tenants=4)
 
 
 class TestPromExport:
@@ -919,6 +920,59 @@ class TestPromExport:
         bare_text = render_exposition(bare)
         for series in gauges:
             assert series not in bare_text
+
+    def test_fleet_scale_gauges_cover_both_directions(self):
+        """Round-21 satellite: the fleet-scale host-loop series (real
+        microseconds of host admission+accounting per tenant, admitted
+        tenant count) must be exported, panel-referenced, AND resolve
+        from a real ServiceTickReport — both directions of the parity
+        contract — while a controller TickReport (no service fields)
+        SKIPS them rather than exporting fake zeros, and a service tick
+        predating the gauge (None defaults) skips them too: a fake
+        0us/tenant would read as an infinitely fast host loop."""
+        import dataclasses
+
+        from ccka_tpu.harness.dashboard import _PANEL_DEFS
+        from ccka_tpu.harness.promexport import (SERIES,
+                                                 SERVICE_ONLY_SERIES,
+                                                 referenced_series,
+                                                 render_exposition,
+                                                 resolve_field)
+        from ccka_tpu.harness.service import ServiceTickReport
+
+        gauges = {"ccka_host_loop_us_per_tenant", "ccka_active_tenants"}
+        assert gauges <= set(SERIES)
+        assert gauges <= set(SERVICE_ONLY_SERIES)
+        paneled = set()
+        for _t, expr, _u in _PANEL_DEFS:
+            paneled |= referenced_series(expr)
+        assert gauges <= paneled, ("fleet-scale gauges missing from the "
+                                   "dashboard")
+
+        rec = dataclasses.asdict(_service_report())
+        assert resolve_field(
+            rec, SERIES["ccka_host_loop_us_per_tenant"][0]) == 0.875
+        assert resolve_field(
+            rec, SERIES["ccka_active_tenants"][0]) == 4
+        text = render_exposition(rec)
+        assert "ccka_host_loop_us_per_tenant 0.875" in text
+        assert "ccka_active_tenants 4" in text
+        # Controller-skips contract: a TickReport has neither field.
+        for series in gauges:
+            assert resolve_field({"t": 1}, SERIES[series][0]) is None
+            assert series not in render_exposition({"t": 1})
+        # A defaulted service report (None gauge) skips, not zeros.
+        bare = dataclasses.asdict(ServiceTickReport(
+            t=1, n_tenants=2, admitted=2, deferred=0, shed=0,
+            cadence_skipped=0, bulkhead_skipped=0, scrape_failed=0,
+            probes=0, applied=2, fanout_deferred=0, slo_ok=2,
+            cost_usd_hr=1.0, carbon_g_hr=10.0, pending_pods=0.0,
+            tick_latency_ms=5.0, admission_queue_depth=2,
+            sheds_total=0, deferrals_total=0,
+            breaker_transitions_total=0, cadence_divisor=1,
+            decide_ms=1.0, fanout_ms=1.0))
+        assert "ccka_host_loop_us_per_tenant" not in render_exposition(
+            bare)
 
     def test_live_scrape_serves_all_panel_series(self):
         """Drive two controller ticks with an exporter on a real socket

@@ -144,6 +144,33 @@ def test_guard_scans_a_nontrivial_tree():
     # lanes of the compiled tick — host recording next to device code
     # is exactly where an un-fenced clock would sneak in.
     assert any(os.path.join("obs", "decisions.py") in p for p in files)
+    # Round 21: the async scrape transport deals in REAL deadlines
+    # (pool waits on the monotonic clock) — it must stay inside the
+    # scanned tree so any future jax import there turns its bare
+    # clocks into violations.
+    assert any(os.path.join("signals", "transport.py") in p
+               for p in files)
+
+
+def test_scrape_transport_is_device_free():
+    """Round-21 satellite: `signals/transport.py` reads the monotonic
+    clock for a living (budget-edge arithmetic around socket waits) and
+    passes the device-timing guard ONLY because it holds no device
+    code. Pin that condition directly: the module must keep its bare
+    timing calls (they are the contract) and must reference no jax —
+    the day someone dispatches device work from the fan-in pool, the
+    scoped guard above starts failing and this test says why."""
+    path = os.path.join(ROOT, "ccka_tpu", "signals", "transport.py")
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    tree = ast.parse(src)
+    assert _timing_calls(tree) or "time.monotonic" in src, (
+        "the transport lost its deadline clock — the budget-edge "
+        "contract needs one")
+    assert not any(m in src for m in _DEVICE_MARKERS), (
+        "signals/transport.py references device code — its bare "
+        "deadline clocks are only legal while it stays host-only")
+    assert "import jax" not in src
 
 
 _HARNESS_DIR = os.path.join(ROOT, "ccka_tpu", "harness")
