@@ -365,12 +365,60 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="service ticks per run")
     sov.add_argument("--seed", type=int, default=211)
 
-    sub.add_parser(
+    slsc = sub.add_parser(
         "scenarios", help="list the named workload scenario library "
                           "(ccka_tpu/workloads): family mix, fault "
                           "preset and arrival shapes per scenario — "
                           "the vocabulary scenario-eval/bench_workloads "
-                          "sweep")
+                          "sweep. --minted-dir folds in search-minted "
+                          "scenarios with their provenance column")
+    slsc.add_argument("--minted-dir", default="",
+                      help="a --mint-out JSON file or a directory of "
+                           "them; entries are digest-validated on load "
+                           "and listed with a 'minted' provenance "
+                           "column (search/adversarial.py)")
+
+    ssrch = sub.add_parser(
+        "scenario-search",
+        help="adversarial scenario search (ccka_tpu/search): CEM over "
+             "the traced ScenarioParams axis — every iteration scores "
+             "its whole population in ONE compiled S×B dispatch — and "
+             "mints the converged worst case as a named reproducible "
+             "scenario (params + digest + eval geometry)")
+    ssrch.add_argument("--policy", default="rule",
+                       help="packed policy mode to attack: rule|carbon "
+                            "(artifact-free modes only)")
+    ssrch.add_argument("--objective", default="usd_per_slo_hour",
+                       help="scoreboard row field the search degrades "
+                            "(e.g. usd_per_slo_hour, slo_attainment, "
+                            "inf_slo_violations, batch_deadline_misses)")
+    ssrch.add_argument("--iters", type=int, default=5,
+                       help="CEM iterations (default 5)")
+    ssrch.add_argument("--pop", type=int, default=12,
+                       help="candidates per iteration = the traced "
+                            "scenario axis S (default 12)")
+    ssrch.add_argument("--elite-frac", type=float, default=0.25)
+    ssrch.add_argument("--intensity", default="",
+                       help="scale the whole search box: mild|moderate|"
+                            "severe ('' = the full validated box)")
+    ssrch.add_argument("--bound", action="append", default=[],
+                       metavar="NAME=LO:HI",
+                       help="override one knob's box, e.g. "
+                            "--bound storm_hazard=0:2 (repeatable; "
+                            "unknown names rejected up front)")
+    ssrch.add_argument("--mint-out", default="",
+                       help="write the minted scenario document "
+                            "(scenario + objective + eval geometry) to "
+                            "this JSON path — `ccka scenarios "
+                            "--minted-dir` lists it, replay_minted "
+                            "reproduces it")
+    ssrch.add_argument("--name", default="",
+                       help="minted scenario name (default: "
+                            "minted-<policy>-<digest8>)")
+    ssrch.add_argument("--runlog", default="",
+                       help="append search_iter/search_mint events to "
+                            "this RunLog JSONL path")
+    ssrch.add_argument("--seed", type=int, default=0)
 
     ssc = sub.add_parser(
         "scenario-eval", help="per-family workload scoreboard "
@@ -1385,6 +1433,75 @@ def _cmd_bench_diff(args) -> int:
     return 0
 
 
+def _parse_bounds(specs: list) -> dict:
+    """``--bound NAME=LO:HI`` overrides → {name: (lo, hi)}. Shape errors
+    here; unknown names / out-of-box ranges are validate_bounds' job."""
+    out = {}
+    for spec in specs:
+        name, eq, rng = spec.partition("=")
+        lo, colon, hi = rng.partition(":")
+        if not eq or not colon or not name:
+            raise ValueError(f"malformed --bound {spec!r} "
+                             "(want NAME=LO:HI)")
+        try:
+            out[name] = (float(lo), float(hi))
+        except ValueError:
+            raise ValueError(f"non-numeric --bound {spec!r}")
+    return out
+
+
+def _cmd_scenario_search(cfg: FrameworkConfig, args) -> int:
+    """`ccka scenario-search` — run the CEM adversarial search and print
+    (and optionally mint to disk) the worst-case scenario document.
+    Unknown policy/objective/intensity/knob names are rejected BEFORE
+    any compilation (the round-10 up-front-guard discipline)."""
+    from ccka_tpu.obs.runlog import RunLog
+    from ccka_tpu.search.adversarial import (SEARCH_POLICIES,
+                                             intensity_bounds,
+                                             resolve_objective,
+                                             search_scenarios)
+    from ccka_tpu.search.params import validate_bounds
+
+    try:
+        if args.policy not in SEARCH_POLICIES:
+            raise ValueError(f"unknown search policy {args.policy!r}; "
+                             f"artifact-free policies: "
+                             f"{list(SEARCH_POLICIES)}")
+        resolve_objective(args.objective)
+        intensity_bounds(args.intensity or None)
+        bounds = _parse_bounds(args.bound)
+        validate_bounds(bounds)
+    except ValueError as e:
+        raise SystemExit(f"ccka: {e}")
+    runlog = RunLog(args.runlog or None, kind="scenario-search",
+                    echo=False,
+                    meta={"policy": args.policy,
+                          "objective": args.objective})
+    try:
+        result = search_scenarios(
+            cfg, policy=args.policy, objective=args.objective,
+            iters=args.iters, pop=args.pop, elite_frac=args.elite_frac,
+            seed=args.seed, bounds=bounds or None,
+            intensity=args.intensity or None,
+            mint_name=args.name or None, runlog=runlog)
+    except ValueError as e:
+        runlog.close(status="error")
+        raise SystemExit(f"ccka: {e}")
+    runlog.close()
+    doc = result.to_doc()
+    if args.mint_out:
+        with open(args.mint_out, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+        print(f"# minted {result.scenario.name!r} -> {args.mint_out}",
+              file=sys.stderr)
+    print(json.dumps(doc, indent=2))
+    print(f"# worst case: {result.objective}="
+          f"{result.best_value:.6g} ({'DOMINATES' if result.dominates else 'does not dominate'} "
+          f"the hand-named library) after {result.evals} cells",
+          file=sys.stderr)
+    return 0
+
+
 def _cmd_perf(cfg: FrameworkConfig, args) -> int:
     """`ccka perf` — the device-time observatory's interactive probe:
     a small packed generate→rollout→summary pipeline per requested
@@ -1944,15 +2061,27 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(board, indent=2))
             return 0
         if args.command == "scenarios":
-            from ccka_tpu.workloads.scenarios import WORKLOAD_SCENARIOS
+            from ccka_tpu.workloads.scenarios import (WORKLOAD_SCENARIOS,
+                                                      load_minted_scenarios)
+            library = dict(WORKLOAD_SCENARIOS)
+            if args.minted_dir:
+                try:
+                    library.update(load_minted_scenarios(args.minted_dir))
+                except (ValueError, OSError, KeyError) as e:
+                    raise SystemExit(f"ccka: {e}")
             listing = []
-            for name, sc in WORKLOAD_SCENARIOS.items():
+            for name, sc in library.items():
                 wl = sc.workloads
                 listing.append({
                     "name": name,
                     "description": sc.description,
                     "family_mix": sc.family_mix(),
                     "fault_preset": sc.fault_preset or None,
+                    # Search-mint provenance: null for hand-named rows,
+                    # else who minted it + the tamper-checked digest.
+                    "minted": ({"by": sc.minted_by,
+                                "params_digest": sc.params_digest}
+                               if sc.minted else None),
                     "inference": {
                         "flash_frac": wl.inference_flash_frac,
                         "flash_mult": wl.inference_flash_mult,
@@ -1967,6 +2096,8 @@ def main(argv: list[str] | None = None) -> int:
                 })
             print(json.dumps({"scenarios": listing}, indent=2))
             return 0
+        if args.command == "scenario-search":
+            return _cmd_scenario_search(cfg, args)
         if args.command == "scenario-eval":
             from ccka_tpu.workloads.scoreboard import workload_scoreboard
             try:

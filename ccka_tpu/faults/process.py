@@ -78,6 +78,41 @@ def _window(key, shape, *, frac: float, mean_ticks: int) -> jnp.ndarray:
     return (latent > _threshold(frac)).astype(jnp.float32)
 
 
+def _ar1_unit_p(key, shape, *, rho, scale, axis: int = 0):
+    """`signals.synthetic._ar1_device` with sigma=1 and TRACED AR(1)
+    coefficients — the window latent under the scenario-parameter axis
+    (ISSUE 19). ``rho``/``scale`` arrive as f32 scalars precomputed by
+    `search/params.ScenarioParams.derived` with exactly the baked path's
+    host arithmetic (scale = f32(sqrt(1 - rho64^2)) — NOT re-derived
+    in-trace from the f32 rho, which would differ by an ulp), so at any
+    concrete parameter value this is bitwise `_ar1_device(key, shape,
+    rho=rho, sigma=1.0, axis=axis)`: x0 = 1.0*normal is the identity,
+    eps = (scale*1.0)*normal is one f32 multiply by the same value, and
+    the scan/cumprod see identical element sequences."""
+    k0, k1 = jax.random.split(key)
+    x0_shape = shape[:axis] + (1,) + shape[axis + 1:]
+    x0 = jax.random.normal(k0, x0_shape, jnp.float32)
+    eps = scale * jax.random.normal(k1, shape, jnp.float32)
+    a = jnp.full(shape, jnp.float32(rho))
+
+    def combine(l, r):
+        return (l[0] * r[0], l[1] * r[0] + r[1])
+
+    _, b = jax.lax.associative_scan(combine, (a, eps), axis=axis)
+    apow = jnp.cumprod(a, axis=axis)
+    return apow * x0 + b
+
+
+def _window_p(key, shape, *, thresh, rho, scale) -> jnp.ndarray:
+    """:func:`_window` with TRACED derived coefficients (threshold /
+    rho / noise scale from `ScenarioParams.derived`): one compiled
+    program serves every window intensity, and a +inf threshold (frac
+    0) yields exact zeros — the traced form of the baked path's
+    "never"."""
+    latent = _ar1_unit_p(key, shape, rho=rho, scale=scale, axis=0)
+    return (latent > thresh).astype(jnp.float32)
+
+
 # The generator's spot-price AR(1) sigma — the price-coupling unit
 # ("+coupling x hazard per +1 sigma price anomaly"). Shared constant so
 # the docstring in `config.FaultsConfig` can never drift from the math.
@@ -119,6 +154,66 @@ def packed_fault_lanes(faults: FaultsConfig, key, steps: int, t_pad: int,
 
     stale = _window(ko, (steps, batch), frac=faults.outage_frac,
                     mean_ticks=faults.outage_mean_ticks)
+
+    block = jnp.concatenate(
+        [hazard, deny[:, None, :], delay[:, None, :], stale[:, None, :]],
+        axis=1).astype(f32)                          # [T, Z+3, B]
+    return jnp.pad(block, ((0, t_pad - steps),
+                           (0, fault_rows(Z) - block.shape[1]), (0, 0)))
+
+
+def packed_fault_lanes_p(faults: FaultsConfig, derived: dict, key,
+                         steps: int, t_pad: int, Z: int, batch: int, *,
+                         price_dev=None) -> jnp.ndarray:
+    """:func:`packed_fault_lanes` with the searchable intensities TRACED
+    (ISSUE 19): ``derived`` is `ScenarioParams.derived()["faults"]` — f32
+    scalars (window threshold/rho/scale triples, hazard, coupling, deny,
+    delay fractions) — so one compiled program serves every fault
+    parameterization, and `search/axis.ScenarioAxisSource` vmaps this
+    over the ``[S]`` axis with the key CLOSED OVER (common random
+    numbers: every candidate sees the same storm realization, the paired
+    property CEM needs).
+
+    Bitwise contract vs the baked path at any concrete value (pinned by
+    `tests/test_search.py`): the host value-gates become unconditional
+    arithmetic that is an exact f32 no-op at the neutral value —
+    coupling 0 multiplies hazard by exactly 1.0, and the delay lane's
+    ``jnp.abs`` collapses the one -0.0 edge (frac 0 times a negative
+    burst) to the baked branch's +0.0 while being the identity on the
+    active branch's non-negative clip output. Key consumption is
+    identical (the baked path splits all four subkeys regardless of
+    gating). ``faults`` itself is unused — every continuous field is
+    searchable — but kept for the registry's uniform
+    ``generate_p(config, derived, ...)`` signature."""
+    del faults  # all continuous fields arrive via `derived`
+    ks, ki, kd, ko = jax.random.split(jax.random.fold_in(key, FAULT_KEY_TAG),
+                                      4)
+    f32 = jnp.float32
+    d = derived
+
+    storm = _window_p(ks, (steps, batch), thresh=d["storm_thresh"],
+                      rho=d["storm_rho"], scale=d["storm_scale"])
+    hazard = 1.0 + d["storm_hazard"] * storm                     # [T, B]
+    hazard = jnp.broadcast_to(hazard[:, None, :], (steps, Z, batch))
+    if price_dev is not None:
+        # Pre-divide the coupling by sigma: XLA constant-folds the baked
+        # path's `c * max(dev,0) / SIGMA` into `(c/SIGMA) * max(dev,0)`
+        # (c is a compile-time constant there); with a TRACED coupling
+        # that reassociation can't happen, so do it by hand — the S=1
+        # bitwise-parity pin holds with coupling > 0 on both layouts.
+        hazard = hazard * (1.0 + (d["price_coupling"] / PRICE_DEV_SIGMA)
+                           * jnp.maximum(price_dev, 0.0))
+
+    ice = _window_p(ki, (steps, batch), thresh=d["ice_thresh"],
+                    rho=d["ice_rho"], scale=d["ice_scale"])
+    deny = d["ice_deny"] * ice                                   # [T, B]
+
+    burst = _ar1_device(kd, (steps, batch), rho=0.8, sigma=1.0, axis=0)
+    delay = jnp.abs(jnp.clip(d["delay_frac"] * (1.0 + 0.5 * burst),
+                             0.0, 0.9))
+
+    stale = _window_p(ko, (steps, batch), thresh=d["outage_thresh"],
+                      rho=d["outage_rho"], scale=d["outage_scale"])
 
     block = jnp.concatenate(
         [hazard, deny[:, None, :], delay[:, None, :], stale[:, None, :]],
@@ -180,4 +275,16 @@ def _registry_generate(cfg: FaultsConfig, key, steps: int, t_pad: int,
                               price_dev=ctx.get("price_dev"))
 
 
+def _registry_generate_p(cfg: FaultsConfig, derived: dict, key, steps: int,
+                         t_pad: int, z: int, batch: int, *, ctx: dict):
+    """Traced-parameter registry adapter
+    (`sim/lanes.provide_lane_param_generator`): exactly
+    :func:`packed_fault_lanes_p` on the stream key — the scenario-axis
+    source drives this generically, so every engine gains the traced
+    parameter axis with zero per-engine edits."""
+    return packed_fault_lanes_p(cfg, derived, key, steps, t_pad, z, batch,
+                                price_dev=ctx.get("price_dev"))
+
+
 lanes.provide_lane_generator("faults", _registry_generate)
+lanes.provide_lane_param_generator("faults", _registry_generate_p)

@@ -230,6 +230,12 @@ def _extract_metrics(doc: dict) -> dict:
         out.update(_extract_fleet_scale(fs,
                                         full_stage=doc.get("stage")
                                         == "--fleet-scale-only"))
+    # Round-22 traced scenario-axis stage (stage record or nested
+    # "scenario_search").
+    se = (doc if doc.get("stage") == "--search-only"
+          else doc.get("scenario_search"))
+    if isinstance(se, dict):
+        out.update(_extract_search(se))
     return out
 
 
@@ -758,6 +764,71 @@ def _extract_fleet_scale(fs: dict, *, full_stage: bool) -> dict:
     return out
 
 
+def _extract_search(se: dict) -> dict:
+    """The round-22 traced scenario-axis invariants a record states
+    about itself (ISSUE 19 satellite): the traced-vs-recompile-loop
+    speedup recorded and at its >=10x floor, ZERO recompiles across the
+    timed ``set_params`` swap window (the compiled-once claim, counted
+    by watch_jit + the axis trace cache), the S=1 bitwise-parity flags
+    PRESENT and true (absent is partial, not green — the stream AND the
+    summary), the N-cell traced-vs-loop allclose cross-check, and the
+    minted worst case STRICTLY exceeding the policy's worst hand-named
+    cell. Partial or unreadable search records are regressions — the
+    factory/perf/fleet-scale discipline."""
+    out: dict = {"search_partial": []}
+    sp = se.get("speedup")
+    if not isinstance(sp, dict) or sp.get("ratio") is None:
+        out["search_partial"].append(
+            "missing the traced-vs-recompile-loop speedup pair")
+    else:
+        out["search_speedup"] = float(sp["ratio"])
+    tr = se.get("traced")
+    if not isinstance(tr, dict) \
+            or tr.get("recompiles_during_swaps") is None:
+        out["search_partial"].append(
+            "missing the swap-window recompile count")
+    else:
+        out["search_recompiles"] = int(tr["recompiles_during_swaps"])
+    par = se.get("parity")
+    if not isinstance(par, dict):
+        out["search_partial"].append("no parity section recorded")
+    else:
+        for key, outk in (("s1_stream_bitwise", "search_s1_stream"),
+                          ("s1_summary_bitwise", "search_s1_summary"),
+                          ("ncell_allclose", "search_ncell_allclose")):
+            if par.get(key) is None:
+                out["search_partial"].append(
+                    f"missing the parity {key} flag")
+            else:
+                out[outk] = bool(par[key])
+    srch = se.get("search")
+    minted = (srch or {}).get("minted") if isinstance(srch, dict) else None
+    if not isinstance(srch, dict) or not isinstance(minted, dict) \
+            or minted.get("value") is None \
+            or srch.get("hand_worst") is None \
+            or srch.get("dominates") is None:
+        out["search_partial"].append(
+            "missing the minted-vs-hand-named dominance evidence")
+    else:
+        out["search_dominates"] = bool(srch["dominates"])
+        # Numeric cross-check where the sign is unambiguous (every
+        # objective but slo_attainment degrades UPWARD): a record whose
+        # flag says "dominates" while its own numbers say otherwise is
+        # doctored or corrupt.
+        if srch.get("objective") != "slo_attainment" \
+                and out["search_dominates"] \
+                and not minted["value"] > srch["hand_worst"]:
+            out["search_dominates"] = False
+            out["search_partial"].append(
+                "dominance flag contradicts the record's own minted/"
+                "hand_worst numbers")
+    return out
+
+
+# Round-22 traced scenario-axis gate: the ISSUE 19 acceptance floor on
+# traced-axis scenario-cells/sec over the per-config recompile loop.
+_SEARCH_SPEEDUP_FLOOR = 10.0
+
 # A single-core virtual host cannot overlap generation with the kernel
 # (there is no second core to run it on): its pipelined drive is held
 # to this non-regression floor instead of the >= 1.0 overlap gate.
@@ -1179,6 +1250,53 @@ def bench_diff(history: dict, *,
             regressions.append({
                 "kind": "fleet_scale_invariant", "round": rnd,
                 "detail": what})
+
+        # Round-22 traced scenario-axis invariants (ISSUE 19): the
+        # >=10x traced-vs-recompile-loop speedup, zero recompiles
+        # across set_params swaps, S=1 bitwise parity flags true, the
+        # N-cell allclose cross-check, and the minted worst case
+        # strictly beating the hand-named library. Partial records are
+        # regressions.
+        for what in rec.get("search_partial", []):
+            regressions.append({
+                "kind": "search_invariant", "round": rnd,
+                "detail": f"partial scenario-search record: {what}"})
+        if rec.get("search_speedup", _SEARCH_SPEEDUP_FLOOR) \
+                < _SEARCH_SPEEDUP_FLOOR:
+            regressions.append({
+                "kind": "search_invariant", "round": rnd,
+                "value": rec["search_speedup"],
+                "threshold": _SEARCH_SPEEDUP_FLOOR,
+                "detail": "traced-axis scenario-cells/sec fell below "
+                          "10x the per-config recompile loop"})
+        if rec.get("search_recompiles", 0) != 0:
+            regressions.append({
+                "kind": "search_invariant", "round": rnd,
+                "value": rec["search_recompiles"],
+                "detail": "the timed set_params swap window recompiled "
+                          "— scenario params leaked back into "
+                          "compile-time config"})
+        if rec.get("search_s1_stream") is False:
+            regressions.append({
+                "kind": "search_invariant", "round": rnd,
+                "detail": "S=1 traced stream no longer bitwise the "
+                          "config-baked generation path"})
+        if rec.get("search_s1_summary") is False:
+            regressions.append({
+                "kind": "search_invariant", "round": rnd,
+                "detail": "S=1 traced kernel summary no longer bitwise "
+                          "the config-baked path's"})
+        if rec.get("search_ncell_allclose") is False:
+            regressions.append({
+                "kind": "search_invariant", "round": rnd,
+                "detail": "N-cell traced batch diverged from the "
+                          "per-config loop beyond ulp tolerance"})
+        if rec.get("search_dominates") is False:
+            regressions.append({
+                "kind": "search_invariant", "round": rnd,
+                "detail": "minted worst case no longer strictly "
+                          "exceeds the policy's worst hand-named "
+                          "scenario cell"})
     return {"comparisons": comparisons, "regressions": regressions,
             "ok": not regressions}
 

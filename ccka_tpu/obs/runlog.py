@@ -60,6 +60,11 @@ RUNLOG_EVENTS = frozenset({
     # t/tenant/lane/objective/shadow keys) directly; the name is
     # parked here so a future RunLog mirror cannot fork the schema.
     "decision",
+    # Adversarial scenario search (`search/adversarial.py`, ISSUE 19):
+    # one record per CEM iteration (population best/mean objective,
+    # elite stats) and one per minted worst-case scenario (name,
+    # params digest, objective value).
+    "search_iter", "search_mint",
 })
 
 

@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from ccka_tpu.config import GeoConfig
-from ccka_tpu.faults.process import _window
+from ccka_tpu.faults.process import _window, _window_p
 from ccka_tpu.signals.synthetic import _ar1_device, _bump
 from ccka_tpu.sim import lanes
 
@@ -200,6 +200,99 @@ def packed_region_lanes(geo: GeoConfig, key, steps: int, t_pad: int,
                            (0, region_rows(Z) - block.shape[1]), (0, 0)))
 
 
+def packed_region_lanes_p(geo: GeoConfig, derived: dict, key, steps: int,
+                          t_pad: int, Z: int, batch: int, *,
+                          dt_s: float, start_unix_s: float = 0.0,
+                          start_offset_s=None,
+                          wrap_period_s: float | None = None
+                          ) -> jnp.ndarray:
+    """:func:`packed_region_lanes` with the SPOT-STORM block traced
+    (ISSUE 19): ``derived`` is `ScenarioParams.derived()["regions"]` —
+    the storm window triple plus surge mult / carbon coefficients as f32
+    scalars. Only the storm block becomes unconditional traced
+    arithmetic (``price += (mult-1)*storm``; ``carbon +=
+    carbon_g*storm`` — exact no-ops when the window never opens, since
+    the +inf threshold makes ``storm`` exact zeros); the sigma / capacity
+    / migration blocks are NOT searchable and keep their host config
+    gates verbatim, so a search never perturbs them and the compiled
+    program stays specialized to the non-searched topology. Key
+    consumption is identical to the baked path (all eight subkeys split
+    regardless of gating)."""
+    kp, ks, kc, kcap, kd, ki, kb, kg = jax.random.split(
+        jax.random.fold_in(key, REGION_KEY_TAG), 8)
+    f32 = jnp.float32
+    zri = _zone_region_index(geo, Z)
+    R = max(zri) + 1
+    zero = jnp.zeros((steps, R, batch), f32)
+
+    t = start_unix_s + np.arange(steps) * dt_s
+    if start_offset_s is None:
+        tod = jnp.asarray((t % _DAY_S) / _DAY_S, f32)[:, None, None]
+    else:
+        t_rel = (jnp.asarray(np.arange(steps) * dt_s, f32)[:, None]
+                 + jnp.asarray(start_offset_s, f32)[None, :])     # [T,B]
+        if wrap_period_s is not None:
+            t_rel = t_rel % f32(wrap_period_s)
+        tt = f32(start_unix_s % _DAY_S) + (t_rel % f32(_DAY_S))
+        tod = ((tt % _DAY_S) / _DAY_S)[:, None, :]                # [T,1,B]
+
+    price = zero
+    if geo.price_dev_sigma > 0.0:
+        price = price + _ar1_device(kp, (steps, R, batch), rho=0.97,
+                                    sigma=geo.price_dev_sigma, axis=0)
+    storm = _window_p(ks, (steps, R, batch), thresh=derived["storm_thresh"],
+                      rho=derived["storm_rho"],
+                      scale=derived["storm_scale"])
+    price = price + (derived["storm_mult"] - 1.0) * storm
+
+    carbon = zero
+    if geo.carbon_dev_sigma_g_kwh > 0.0:
+        carbon = carbon + _ar1_device(
+            kc, (steps, R, batch), rho=0.95,
+            sigma=geo.carbon_dev_sigma_g_kwh, axis=0)
+    carbon = carbon + derived["storm_carbon"] * storm
+
+    cap = zero
+    if geo.capacity_pods > 0.0:
+        cap = jnp.full((steps, R, batch), f32(geo.capacity_pods))
+        if geo.capacity_deny_window_frac > 0.0:
+            deny = _window(kd, (steps, R, batch),
+                           frac=geo.capacity_deny_window_frac,
+                           mean_ticks=geo.capacity_deny_mean_ticks)
+            cap = cap * (1.0 - f32(geo.capacity_deny_frac) * deny)
+        _ = kcap  # reserved: capacity AR(1) texture
+        cap = jnp.maximum(cap, 0.0)
+
+    diurnal = 0.4 + 0.6 * _bump(tod, center=14.0 / 24, width=5.0 / 24,
+                                xp=jnp)
+    anti = 1.5 - _bump(tod, center=14.0 / 24, width=5.0 / 24, xp=jnp)
+    inf = zero
+    if geo.migratable_inference_pods > 0.0:
+        noise_i = _ar1_device(ki, (steps, R, batch), rho=0.9,
+                              sigma=0.2, axis=0)
+        inf = jnp.maximum(f32(geo.migratable_inference_pods)
+                          * diurnal * (1.0 + noise_i), 0.0)
+    bat = zero
+    if geo.migratable_batch_pods > 0.0:
+        noise_b = _ar1_device(kb, (steps, R, batch), rho=0.85,
+                              sigma=0.3, axis=0)
+        bat = jnp.maximum(f32(geo.migratable_batch_pods)
+                          * anti * (1.0 + noise_b), 0.0)
+    bg = zero
+    if geo.migratable_background_pods > 0.0:
+        noise_g = _ar1_device(kg, (steps, R, batch), rho=0.9,
+                              sigma=0.2, axis=0)
+        bg = jnp.maximum(f32(geo.migratable_background_pods)
+                         * (1.0 + noise_g), 0.0)
+
+    zri_ix = jnp.asarray(zri, jnp.int32)
+    per_zone = [x[:, zri_ix, :] for x in
+                (price, carbon, cap, inf, bat, bg)]     # each [T, Z, B]
+    block = jnp.concatenate(per_zone, axis=1).astype(f32)  # [T, 6Z, B]
+    return jnp.pad(block, ((0, t_pad - steps),
+                           (0, region_rows(Z) - block.shape[1]), (0, 0)))
+
+
 def has_region_lanes(exo_packed, Z: int) -> bool:
     """Whether a packed stream carries the region lane block — row-
     count detection via the registry resolver (raises on malformed
@@ -254,4 +347,18 @@ def _registry_generate(cfg: GeoConfig, key, steps: int, t_pad: int,
         wrap_period_s=ctx.get("wrap_period_s"))
 
 
+def _registry_generate_p(cfg: GeoConfig, derived: dict, key, steps: int,
+                         t_pad: int, z: int, batch: int, *, ctx: dict):
+    """Traced-parameter registry adapter
+    (`sim/lanes.provide_lane_param_generator`) —
+    :func:`packed_region_lanes_p` on the stream key with the clock
+    context the backends carry."""
+    return packed_region_lanes_p(
+        cfg, derived, key, steps, t_pad, z, batch, dt_s=ctx["dt_s"],
+        start_unix_s=ctx.get("start_unix_s", 0.0),
+        start_offset_s=ctx.get("start_offset_s"),
+        wrap_period_s=ctx.get("wrap_period_s"))
+
+
 lanes.provide_lane_generator("regions", _registry_generate)
+lanes.provide_lane_param_generator("regions", _registry_generate_p)

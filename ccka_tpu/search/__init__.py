@@ -1,0 +1,26 @@
+"""Adversarial scenario search over a traced ``[S]`` parameter axis
+(ISSUE 19).
+
+- `params`: :class:`~ccka_tpu.search.params.ScenarioParams` — the
+  batched natural-unit knob pytree, its validated search box, and the
+  host bridge (`derived()`) to the f32 scalars the traced lane cores
+  consume.
+- `axis`: :class:`~ccka_tpu.search.axis.ScenarioAxisSource` — the
+  signal source that folds S parameterizations into the batch axis so
+  one compiled program evaluates S×B cells per dispatch.
+- `adversarial`: the CEM worst-case search + scenario minting on top.
+
+Import-light on purpose (same discipline as `sim/lanes.py`): importing
+`ccka_tpu.search.params` pulls no jax, so the CLI and the stdlib-only
+bench-history gates can reason about params/digests without a device
+runtime.
+"""
+
+from ccka_tpu.search.params import (  # noqa: F401
+    PARAM_NAMES,
+    SEARCH_BOUNDS,
+    SEARCH_SPEC,
+    ScenarioParams,
+    params_digest,
+    validate_bounds,
+)

@@ -1,0 +1,339 @@
+"""Adversarial scenario search: CEM over the traced parameter axis
+(ISSUE 19).
+
+ROADMAP item 4's loop, made affordable by `search/axis.
+ScenarioAxisSource`: every CEM iteration evaluates its whole population
+in ONE dispatch of one compiled program (S candidates × B paired
+clusters, derived parameters as traced arguments — zero recompiles
+across iterations, `watch_jit` pins it in the bench record), where the
+config-baked path would pay a full XLA retrace per candidate. The
+search maximizes a per-policy degradation objective read off the kernel
+summaries (the scoreboard's own row fields, so searched cells and
+hand-named cells speak one vocabulary), and each converged worst case
+is MINTED as a named, reproducible `workloads/scenarios.Scenario`:
+explicit config sections + the canonical params JSON + its sha256
+digest (`Scenario.validate` refuses a tampered record) + the evaluation
+geometry needed to replay the recorded objective exactly.
+
+Pairing discipline: one generation key drives every candidate (common
+random numbers — the axis source closes the key over the vmapped
+family cores), so CEM compares candidates on the SAME storm/flash
+realization, and the paired per-policy objectives are differences in
+parameters, not in luck. The authoritative minted objective is an S=1
+re-score (S-width recompiles differ at ulp — see `search/axis.py`),
+which :func:`replay_minted` reproduces bit-for-bit on the same backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ccka_tpu.config import (FAULT_PRESETS, FaultsConfig, GeoConfig,
+                             WorkloadsConfig)
+from ccka_tpu.search.axis import ScenarioAxisSource, summary_cells
+from ccka_tpu.search.params import (PARAM_NAMES, SEARCH_BOUNDS,
+                                    ScenarioParams, params_digest,
+                                    validate_bounds)
+
+# Artifact-free packed policy modes the search can score out of the box
+# (flagship/MPC need checkpoints or planning artifacts; they plug in by
+# passing a prebuilt `ScenarioScorer`-compatible scorer).
+SEARCH_POLICIES = ("rule", "carbon")
+
+# Degradation objectives = the scoreboard's row vocabulary. Sign: the
+# search MAXIMIZES sign*value ("worse for the policy" is up).
+_OBJECTIVE_SIGN = {"usd_per_slo_hour": 1.0, "slo_attainment": -1.0,
+                   "inf_slo_violations": 1.0, "inf_queue_mean": 1.0,
+                   "inf_dropped": 1.0, "batch_deadline_misses": 1.0,
+                   "batch_backlog_mean": 1.0}
+
+# Intensity presets: fraction of the full validated box the search may
+# explore (upper bounds scaled toward the lower; "severe" is the full
+# box). The same vocabulary as the fault-preset ladder.
+_INTENSITY_FRACTION = {"mild": 0.25, "moderate": 0.5, "severe": 1.0}
+
+
+def intensity_bounds(level: str | None) -> dict:
+    """Bounds dict scaling every knob's upper bound to the intensity
+    preset's fraction of the full box (None/"severe" = full box).
+    Unknown levels rejected up front."""
+    if level is None:
+        return {}
+    if level not in _INTENSITY_FRACTION:
+        raise ValueError(f"unknown intensity {level!r}; levels: "
+                         f"{sorted(_INTENSITY_FRACTION)}")
+    f = _INTENSITY_FRACTION[level]
+    return {n: (lo, lo + f * (hi - lo))
+            for n, (lo, hi) in SEARCH_BOUNDS.items()}
+
+
+def resolve_objective(name: str) -> float:
+    """The objective's maximization sign; unknown names rejected up
+    front with the full vocabulary."""
+    if name not in _OBJECTIVE_SIGN:
+        raise ValueError(f"unknown objective {name!r}; objectives: "
+                         f"{sorted(_OBJECTIVE_SIGN)}")
+    return _OBJECTIVE_SIGN[name]
+
+
+class ScenarioScorer:
+    """One policy's evaluation harness over the scenario-parameter axis:
+    a `ScenarioAxisSource` (all three searchable families present) + one
+    compiled packed-mode program. ``score`` evaluates any S-batch of
+    params in one dispatch; hand-named scenarios go through the SAME
+    harness (via `ScenarioParams.from_config`) so minted-vs-hand-named
+    comparisons are an apples-to-apples single vocabulary.
+
+    Kernel-side workload knobs (queue depth, SLO, deadlines) pin to
+    ``base_workloads`` for every cell — the search perturbs the WORLD
+    (generation side), never the meter."""
+
+    def __init__(self, cfg, *, policy: str = "rule",
+                 steps: int | None = None, inner_batch: int | None = None,
+                 t_chunk: int | None = None, b_block: int | None = None,
+                 seed: int = 0,
+                 base_faults: FaultsConfig | None = None,
+                 base_workloads: WorkloadsConfig | None = None,
+                 base_geo: GeoConfig | None = None):
+        import jax
+
+        from ccka_tpu.sim.megakernel import packed_mode_summary_fn
+        from ccka_tpu.sim.types import SimParams
+
+        if policy not in SEARCH_POLICIES:
+            raise ValueError(
+                f"unknown search policy {policy!r}; artifact-free "
+                f"policies: {list(SEARCH_POLICIES)}")
+        on_tpu = jax.default_backend() == "tpu"
+        self.policy = policy
+        self.steps = int(steps if steps is not None
+                         else (2880 if on_tpu else 96))
+        self.inner = int(inner_batch if inner_batch is not None
+                         else (64 if on_tpu else 4))
+        self.t_chunk = int(t_chunk if t_chunk is not None
+                           else (64 if on_tpu else 32))
+        # Divides both the population dispatch (S*inner) and the S=1
+        # re-score (inner) — one b_block for every stream width.
+        self.b_block = int(b_block if b_block is not None else self.inner)
+        self.seed = int(seed)
+        self.on_tpu = on_tpu
+        self.cfg = cfg
+        self.base_faults = base_faults or FaultsConfig(enabled=True)
+        self.base_workloads = base_workloads or WorkloadsConfig(
+            enabled=True)
+        self.base_geo = base_geo or GeoConfig(enabled=True)
+        sim_cfg = dataclasses.replace(
+            cfg, faults=self.base_faults, workloads=self.base_workloads,
+            geo=self.base_geo)
+        self.sim_params = SimParams.from_config(sim_cfg)
+        self.source = ScenarioAxisSource(
+            cfg.cluster, cfg.workload, cfg.sim, cfg.signals,
+            ScenarioParams.from_config(
+                faults=self.base_faults, workloads=self.base_workloads,
+                geo=self.base_geo),
+            faults=self.base_faults, workloads=self.base_workloads,
+            geo=self.base_geo)
+        self.mode_fn = packed_mode_summary_fn(
+            self.sim_params, cfg.cluster, policy, T=self.steps,
+            b_block=self.b_block, t_chunk=self.t_chunk,
+            interpret=not on_tpu, stochastic=on_tpu)
+        self.key = jax.random.key(self.seed)
+        self.evals = 0
+
+    def score(self, params: ScenarioParams) -> dict:
+        """{field: float64 [S]} per-cell objectives for one params batch
+        — one generation dispatch + one kernel dispatch."""
+        self.source.set_params(params)
+        stream = self.source.packed_trace_device(
+            self.steps, self.key, params.S * self.inner,
+            t_chunk=self.t_chunk)
+        summary = self.mode_fn(stream, self.seed)
+        self.evals += params.S
+        return summary_cells(summary, params.S)
+
+    def score_scenario(self, scenario) -> dict:
+        """A hand-named (or minted) `Scenario` through the same harness:
+        its config sections → S=1 params → one cell. {field: float}."""
+        faults = scenario.faults
+        if faults is None and scenario.fault_preset:
+            faults = FAULT_PRESETS[scenario.fault_preset]
+        p = ScenarioParams.from_config(faults=faults,
+                                       workloads=scenario.workloads,
+                                       geo=scenario.geo)
+        return {k: float(v[0]) for k, v in self.score(p).items()}
+
+
+@dataclasses.dataclass
+class SearchResult:
+    """A finished adversarial search: the minted worst case + the
+    evidence (per-iteration history, the same-harness hand-named cells
+    it is measured against, and the evaluation geometry for replay)."""
+
+    policy: str
+    objective: str
+    best_value: float          # raw objective field value, S=1 re-score
+    best_cells: dict           # every row field at the worst cell (S=1)
+    best_params: ScenarioParams
+    scenario: object           # minted workloads/scenarios.Scenario
+    hand_named: dict           # scenario name -> objective field value
+    dominates: bool            # strictly worse than every hand-named cell
+    history: list
+    evals: int
+    settings: dict
+
+    def to_doc(self) -> dict:
+        """The ``--mint-out`` document (`replay_minted` consumes it)."""
+        return {
+            "scenario": self.scenario.to_doc(),
+            "objective": {"field": self.objective,
+                          "value": self.best_value,
+                          "cells": self.best_cells,
+                          "hand_named": self.hand_named,
+                          "dominates": self.dominates},
+            "eval": dict(self.settings),
+            "history": self.history,
+            "evals": self.evals,
+        }
+
+
+def search_scenarios(cfg, *, policy: str = "rule",
+                     objective: str = "usd_per_slo_hour",
+                     iters: int = 5, pop: int = 12,
+                     elite_frac: float = 0.25, seed: int = 0,
+                     bounds: dict | None = None,
+                     intensity: str | None = None,
+                     scorer: ScenarioScorer | None = None,
+                     mint_name: str | None = None,
+                     runlog=None) -> SearchResult:
+    """CEM worst-case search over `ScenarioParams` within the validated
+    box (the `cem_refine` fan-out idiom, turned against the simulator's
+    own policies): S=pop candidates per iteration in one dispatch,
+    elites refit a diagonal Gaussian in normalized box coordinates, and
+    the converged worst cell is minted as a named reproducible
+    `Scenario`. Deterministic under a fixed ``seed`` (host
+    `numpy.random.default_rng` proposals + a fixed generation key).
+
+    ``bounds`` ({name: (lo, hi)}) overrides the box per knob;
+    ``intensity`` scales the whole box ("mild"/"moderate"/"severe");
+    both validated up front. ``runlog`` (an `obs.runlog.RunLog`) records
+    one ``search_iter`` event per iteration and a final ``search_mint``.
+    """
+    sign = resolve_objective(objective)
+    box = dict(SEARCH_BOUNDS)
+    box.update(intensity_bounds(intensity))
+    if bounds:
+        validate_bounds(bounds)
+        box.update(bounds)
+    validate_bounds(box)
+    if iters < 1 or pop < 2:
+        raise ValueError(f"need iters >= 1 and pop >= 2; got "
+                         f"iters={iters}, pop={pop}")
+    scorer = scorer or ScenarioScorer(cfg, policy=policy, seed=seed)
+
+    rng = np.random.default_rng(seed)
+    lo = np.asarray([box[n][0] for n in PARAM_NAMES], np.float64)
+    hi = np.asarray([box[n][1] for n in PARAM_NAMES], np.float64)
+    span = hi - lo
+    span_safe = np.where(span > 0, span, 1.0)
+    k_elite = max(1, int(round(pop * elite_frac)))
+    mu = np.full(len(PARAM_NAMES), 0.5)
+    sd = np.full(len(PARAM_NAMES), 0.25)
+    best_signed, best_params, history = -np.inf, None, []
+
+    for it in range(iters):
+        xn = np.clip(mu + sd * rng.standard_normal((pop, len(PARAM_NAMES))),
+                     0.0, 1.0)
+        cand = ScenarioParams.from_array(lo + xn * span).clip_to_bounds(box)
+        vals = sign * scorer.score(cand)[objective]        # [pop]
+        order = np.argsort(-vals)
+        elite_nat = cand.to_array()[order[:k_elite]]
+        elite_n = (elite_nat - lo) / span_safe
+        mu = elite_n.mean(axis=0)
+        sd = np.maximum(elite_n.std(axis=0), 0.05)
+        if float(vals[order[0]]) > best_signed:
+            best_signed = float(vals[order[0]])
+            best_params = cand.row(int(order[0]))
+        row = {"iter": it, "pop": pop,
+               "best": round(float(vals[order[0]]) * sign, 6),
+               "mean": round(float(vals.mean()) * sign, 6),
+               "elite_mean": round(float(vals[order[:k_elite]].mean())
+                                   * sign, 6)}
+        history.append(row)
+        if runlog is not None:
+            runlog.event("search_iter", policy=policy,
+                         objective=objective, **row)
+
+    # Authoritative S=1 re-score (S-width programs differ at ulp; the
+    # minted record must be what a replay of the minted cell computes).
+    cells1 = {k: float(v[0]) for k, v in scorer.score(best_params).items()}
+    best_value = cells1[objective]
+
+    # The hand-named library through the SAME harness — the dominance
+    # claim is same-vocabulary, same-realization, same-geometry.
+    from ccka_tpu.workloads.scenarios import WORKLOAD_SCENARIOS, Scenario
+
+    hand = {name: scorer.score_scenario(sc)[objective]
+            for name, sc in WORKLOAD_SCENARIOS.items()}
+    hand_worst_signed = max(sign * v for v in hand.values())
+    dominates = sign * best_value > hand_worst_signed
+
+    pj = best_params.to_json()
+    dig = params_digest(pj)
+    fa, wl, geo = best_params.to_config(
+        0, base_faults=scorer.base_faults,
+        base_workloads=scorer.base_workloads, base_geo=scorer.base_geo)
+    name = mint_name or f"minted-{policy}-{dig[:8]}"
+    scenario = Scenario(
+        name=name,
+        description=(f"adversarial worst case for policy {policy!r} on "
+                     f"{objective} (CEM, seed {seed}, "
+                     f"{scorer.evals} cells evaluated)"),
+        workloads=wl, faults=fa, geo=geo, params_json=pj,
+        params_digest=dig,
+        minted_by=(f"search/adversarial.search_scenarios iters={iters} "
+                   f"pop={pop} elite_frac={elite_frac} seed={seed}"
+                   + (f" intensity={intensity}" if intensity else "")))
+    scenario.validate()
+
+    settings = {"policy": policy, "objective": objective,
+                "steps": scorer.steps, "inner_batch": scorer.inner,
+                "t_chunk": scorer.t_chunk, "b_block": scorer.b_block,
+                "seed": scorer.seed,
+                "backend": "tpu" if scorer.on_tpu else "cpu",
+                "iters": iters, "pop": pop, "elite_frac": elite_frac,
+                "bounds": {n: list(box[n]) for n in PARAM_NAMES}}
+    result = SearchResult(
+        policy=policy, objective=objective, best_value=best_value,
+        best_cells=cells1, best_params=best_params, scenario=scenario,
+        hand_named=hand, dominates=dominates, history=history,
+        evals=scorer.evals, settings=settings)
+    if runlog is not None:
+        runlog.event("search_mint", name=name, digest=dig,
+                     policy=policy, objective=objective,
+                     value=round(best_value, 6),
+                     dominates=bool(dominates))
+    return result
+
+
+def replay_minted(cfg, doc: dict) -> dict:
+    """Re-evaluate a minted scenario document in its recorded geometry:
+    digest-validates the scenario, rebuilds the S=1 params and the
+    scorer from ``doc["eval"]``, and returns {field: value}. On the
+    recorded backend this reproduces ``doc["objective"]["value"]``
+    EXACTLY (same program, same key, same geometry) — the
+    reproducibility contract `tests/test_search.py` pins."""
+    from ccka_tpu.workloads.scenarios import scenario_from_doc
+
+    sc = scenario_from_doc(doc["scenario"])
+    if not sc.minted:
+        raise ValueError(f"scenario {sc.name!r} carries no mint "
+                         "provenance — nothing to replay")
+    params = ScenarioParams.from_json(sc.params_json)
+    ev = doc["eval"]
+    scorer = ScenarioScorer(
+        cfg, policy=ev["policy"], steps=ev["steps"],
+        inner_batch=ev["inner_batch"], t_chunk=ev["t_chunk"],
+        b_block=ev.get("b_block"), seed=ev["seed"])
+    return {k: float(v[0]) for k, v in scorer.score(params).items()}

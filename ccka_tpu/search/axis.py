@@ -1,0 +1,310 @@
+"""Scenario-axis signal source: S parameterizations × B clusters in ONE
+compiled program (ISSUE 19 tentpole).
+
+:class:`ScenarioAxisSource` subclasses the synthetic backend and folds a
+traced ``[S]`` scenario-parameter axis (`search/params.ScenarioParams`)
+into the packed stream's BATCH axis: the parameter-independent base exo
+block and family noise latents are synthesized once at the inner batch
+width and broadcast, the per-family traced cores
+(`sim/lanes.LaneFamily.generate_p` — faults, workloads, regions) are
+``jax.vmap``-ed over the derived f32 scalars with the generation key
+CLOSED OVER (common random numbers: every candidate scenario sees the
+same storm realization — the paired property the CEM search and the
+paired scoreboards rely on), and the result is laid out cell-major as
+``[T_pad, rows, S*B]``. Because the S axis is batch-folded rather than a
+``vmap`` over the kernel, every existing engine — the four packed kernel
+modes, the streaming pipeline, the sharded wrapper — consumes the axis
+with ZERO per-engine edits: they just see a wider batch. Summaries
+reshape per-field to ``[S, B]`` (cell ``s`` owns columns
+``s*B..(s+1)*B``).
+
+Batch contract: the ``batch`` argument of every generation entry point
+is the TOTAL column count and must be divisible by ``S`` — this is what
+makes the source a drop-in for `sim/streaming.py` and
+`parallel/sharded_kernel.py`, which size plans and shards off the batch
+they were given.
+
+Two compilation disciplines, deliberately split:
+
+- :meth:`packed_trace_device` / :meth:`packed_block_trace_device` use
+  this class's OWN jit caches with the derived scalars passed as traced
+  pytree arguments — :meth:`set_params` swaps the parameter batch with
+  NO recompile (the CEM loop's per-iteration path; `watch_jit` counts
+  pin exactly one compile across a whole search).
+- :meth:`packed_generate_fn` / :meth:`packed_block_generate_fn` return
+  closures with the derived values CLOSED OVER, because their callers
+  (`sharded_kernel._packed_trace_call`'s ``shard_map`` body) invoke
+  ``generate(key)`` with the base signature. Those embedded paths
+  recompile after :meth:`set_params` (the caches are cleared here) —
+  the documented tradeoff for keeping the sharded wrapper untouched.
+
+``S=1`` is pinned BITWISE against the config-baked
+`SyntheticSignalSource` for every engine (`tests/test_search.py`), so
+adopting the axis cannot move the existing record. Streams of DIFFERENT
+S widths are separate XLA programs and may differ at the 1–2 ulp level
+for identical cells (fusion/FMA ordering — the same eager-vs-jit caveat
+the round-16 record documents), which is why the bitwise claim lives at
+S=1 and the N-cell traced-vs-loop cross-check in `bench.py` is a strict
+allclose, not bitwise.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ccka_tpu.config import (ClusterConfig, FaultsConfig, GeoConfig,
+                             SignalsConfig, SimConfig, WorkloadConfig,
+                             WorkloadsConfig)
+from ccka_tpu.search.params import ScenarioParams
+from ccka_tpu.signals.synthetic import SyntheticSignalSource, _ar1_device
+
+
+class ScenarioAxisSource(SyntheticSignalSource):
+    """Synthetic packed-stream source with a traced ``[S]`` scenario-
+    parameter axis folded into the batch axis (module docstring)."""
+
+    def __init__(self, cluster: ClusterConfig, workload: WorkloadConfig,
+                 sim: SimConfig, signals: SignalsConfig,
+                 params: ScenarioParams, *,
+                 faults: FaultsConfig | None = None,
+                 workloads: WorkloadsConfig | None = None,
+                 geo: GeoConfig | None = None,
+                 start_unix_s: float = 0.0):
+        extra = ({"regions": geo}
+                 if geo is not None and geo.enabled else None)
+        super().__init__(cluster, workload, sim, signals,
+                         start_unix_s=start_unix_s, faults=faults,
+                         workloads=workloads, extra_lanes=extra)
+        # Traced-derived jit cache — SURVIVES set_params (derived values
+        # are runtime arguments there, not baked constants).
+        self._axis_fns: dict = {}
+        self.set_params(params)
+
+    @property
+    def params(self) -> ScenarioParams:
+        return self._params
+
+    def set_params(self, params: ScenarioParams) -> None:
+        """Swap the scenario-parameter batch. The traced-arg programs
+        (:meth:`packed_trace_device` et al.) keep their compiles as long
+        as ``S`` is unchanged; the closure-baked caches (base-signature
+        ``*_generate_fn`` products, the sharded wrapper's shard_map
+        programs) are cleared — they embedded the old values."""
+        import jax.numpy as jnp
+
+        if not isinstance(params, ScenarioParams):
+            raise TypeError("ScenarioAxisSource needs a ScenarioParams "
+                            f"batch; got {type(params).__name__}")
+        self._params = params
+        self._derived = {fam: {k: jnp.asarray(v) for k, v in d.items()}
+                         for fam, d in params.derived().items()}
+        self._device_fns.clear()
+        if hasattr(self, "_sharded_packed_fns"):
+            self._sharded_packed_fns.clear()
+
+    # -- the S×B synthesis core ---------------------------------------
+
+    def _axis_plan(self) -> list:
+        """``(name, config, generate, generate_p)`` per present family
+        — the baked closure stays the fallback for families that
+        register no traced core (their block is synthesized once and
+        broadcast constant across S)."""
+        from ccka_tpu.sim import lanes as _lanes
+
+        return [(name, cfg_f, gen_f, _lanes.lane_param_generator(name))
+                for name, cfg_f, gen_f in self._lane_generators()]
+
+    def _axis_core(self, steps: int, batch: int, *, t_chunk: int,
+                   blocked: bool = False):
+        """Un-jitted ``(key, derived[, t0_ticks]) -> [T_pad, rows, S*B]``
+        synthesis — the shared core both jit disciplines wrap."""
+        import jax
+        import jax.numpy as jnp
+
+        S = self._params.S
+        if batch % S:
+            raise ValueError(
+                f"batch is TOTAL columns and must be divisible by the "
+                f"scenario count: batch={batch}, S={S}")
+        inner = batch // S
+        z = self.cluster.n_zones
+        if blocked:
+            from ccka_tpu.sim import lanes as _lanes
+
+            _lanes.block_layout(steps, steps, t_chunk)  # divisibility
+            t_pad = steps
+        else:
+            t_pad = math.ceil(steps / t_chunk) * t_chunk
+        plan = self._axis_plan()
+        rows = self.packed_rows()
+        dt_s, start_s = self.sim.dt_s, self.start_unix_s
+
+        def core(k, derived, t0_ticks=None):
+            ks, kc, kd = jax.random.split(k, 3)
+            # Parameter-independent base exo noise at the INNER batch
+            # width — same key splits, shapes and draw order as the
+            # baked source, so the exo rows of every cell are bitwise
+            # the un-searched stream.
+            noise = (
+                _ar1_device(ks, (steps, z, inner), rho=0.97,
+                            sigma=0.04, axis=0),
+                _ar1_device(kc, (steps, z, inner), rho=0.95,
+                            sigma=0.03, axis=0),
+                _ar1_device(kd, (steps, inner), rho=0.9, sigma=0.5,
+                            axis=0),
+            )
+            packed = self._assemble_packed(steps, t_pad, noise,
+                                           t0_ticks=t0_ticks)
+            ctx = dict(price_dev=noise[0], dt_s=dt_s,
+                       start_unix_s=start_s)
+            if blocked:
+                ctx["start_offset_s"] = jnp.full(
+                    (inner,),
+                    jnp.asarray(t0_ticks, jnp.float32) * dt_s)
+            parts = [jnp.broadcast_to(packed[None], (S,) + packed.shape)]
+            for name, cfg_f, gen_f, gen_p in plan:
+                dv = derived.get(name) if gen_p is not None else None
+                if dv is None:
+                    block = gen_f(cfg_f, k, steps, t_pad, z, inner,
+                                  ctx=ctx)
+                    parts.append(jnp.broadcast_to(block[None],
+                                                  (S,) + block.shape))
+                else:
+                    # Key and ctx are CLOSED OVER — unmapped under vmap,
+                    # so the family's latent draws are computed once and
+                    # shared by all S cells (common random numbers), and
+                    # only the parameter-dependent arithmetic carries
+                    # the S axis.
+                    parts.append(jax.vmap(
+                        lambda dvi, g=gen_p, c=cfg_f: g(
+                            c, dvi, k, steps, t_pad, z, inner,
+                            ctx=ctx))(dv))
+            full = jnp.concatenate(parts, axis=2)  # [S, T_pad, rows, B]
+            # Cell-major layout: column s*inner + b is (scenario s,
+            # cluster b) — summaries reshape per-field to [S, inner].
+            return jnp.transpose(full, (1, 2, 0, 3)).reshape(
+                t_pad, rows, S * inner)
+
+        return core
+
+    # -- base-signature closures (sharded / embedded callers) ---------
+
+    def packed_generate_fn(self, steps: int, batch: int,
+                           *, t_chunk: int = 64):
+        """Base-signature ``key -> [T_pad, rows, S*B]`` closure with the
+        CURRENT derived values closed over — the form
+        `parallel.sharded_kernel` jits inside its shard_map body (each
+        shard's ``batch`` is the per-shard total and must still divide
+        by S). Recompiles after :meth:`set_params` by design (see module
+        docstring)."""
+        core = self._axis_core(steps, batch, t_chunk=t_chunk)
+        derived = self._derived
+
+        def generate(k):
+            return core(k, derived)
+
+        return generate
+
+    def packed_block_generate_fn(self, block_T: int, batch: int,
+                                 *, t_chunk: int = 64):
+        """Base-signature ``(key, t0_ticks) -> [block_T, rows, S*B]``
+        blocked closure with derived closed over — signature-compatible
+        with the streaming pipeline's generation unit."""
+        core = self._axis_core(block_T, batch, t_chunk=t_chunk,
+                               blocked=True)
+        derived = self._derived
+
+        def generate(k, t0_ticks):
+            return core(k, derived, t0_ticks)
+
+        return generate
+
+    # -- traced-derived jit caches (the search's hot path) ------------
+
+    def packed_trace_device(self, steps: int, key, batch: int,
+                            *, t_chunk: int = 64, recycle=None):
+        """``[T_pad, rows, S*B]`` stream on device, derived values as
+        TRACED arguments: one compile serves every parameter batch of
+        the same S (the CEM loop swaps params per iteration with zero
+        recompiles — `watch_jit` pins it in the bench record)."""
+        import jax
+
+        recycled = recycle is not None
+        cache_key = ("axis_packed", steps, batch, t_chunk, recycled,
+                     self._params.S)
+        fn = self._axis_fns.get(cache_key)
+        if fn is None:
+            core = self._axis_core(steps, batch, t_chunk=t_chunk)
+            if recycled:
+                fn = jax.jit(lambda k, d, buf: core(k, d),
+                             donate_argnums=(2,), keep_unused=True)
+            else:
+                fn = jax.jit(core)
+            self._axis_fns[cache_key] = fn
+        return (fn(key, self._derived, recycle) if recycled
+                else fn(key, self._derived))
+
+    def packed_block_trace_device(self, block_T: int, key, batch: int,
+                                  block_index, *, t_chunk: int = 64,
+                                  recycle=None, shard=None,
+                                  total_steps: int | None = None):
+        """One stream block with the S axis — same key-fold discipline
+        as the base class (`lanes.BLOCK_KEY_TAG` + block index + optional
+        shard/chunk index), derived values traced."""
+        import jax
+        import jax.numpy as jnp
+
+        from ccka_tpu.sim import lanes as _lanes
+
+        del total_steps  # uniform signature; unused by synthesis
+        recycled = recycle is not None
+        sharded = shard is not None
+        cache_key = ("axis_block", block_T, batch, t_chunk, recycled,
+                     sharded, self._params.S)
+        fn = self._axis_fns.get(cache_key)
+        if fn is None:
+            core = self._axis_core(block_T, batch, t_chunk=t_chunk,
+                                   blocked=True)
+
+            def block(k, j, d, *shard_arg):
+                kj = jax.random.fold_in(
+                    jax.random.fold_in(k, _lanes.BLOCK_KEY_TAG), j)
+                if shard_arg:
+                    kj = jax.random.fold_in(kj, shard_arg[0])
+                return core(kj, d, j * jnp.int32(block_T))
+
+            if recycled:
+                fn = jax.jit(
+                    lambda k, j, d, *rest: block(k, j, d, *rest[:-1]),
+                    donate_argnums=(3 + sharded,), keep_unused=True)
+            else:
+                fn = jax.jit(block)
+            self._axis_fns[cache_key] = fn
+        j = jnp.int32(block_index)
+        args = ((key, j, self._derived)
+                + ((jnp.int32(shard),) if sharded else ()))
+        return fn(*args, recycle) if recycled else fn(*args)
+
+
+def summary_cells(summary, S: int, fields=None) -> dict:
+    """Per-cell objectives off a kernel summary scored on an S-folded
+    stream: each per-batch-element field reshaped ``[S, B]`` and meaned
+    over the inner cluster axis → {field: float64 [S]}. ``fields``
+    defaults to the scoreboard's row fields
+    (`workloads/scoreboard._ROW_FIELDS`) — the same columns the paired
+    scoreboards report, so searched worst-cases and hand-named cells are
+    directly comparable."""
+    if fields is None:
+        from ccka_tpu.workloads.scoreboard import _ROW_FIELDS
+
+        fields = _ROW_FIELDS
+    out = {}
+    for f in fields:
+        x = np.asarray(getattr(summary, f), np.float64)
+        if x.size % S:
+            raise ValueError(f"summary field {f!r} has {x.size} elements"
+                             f" — not divisible by S={S}")
+        out[f] = x.reshape(S, x.size // S).mean(axis=1)
+    return out

@@ -116,9 +116,21 @@ class LaneFamily:
     and owns its tag fold — exactly how `faults.packed_fault_lanes` /
     `workloads.packed_workload_lanes` always keyed, so registering them
     here changed no bits.
+
+    ``generate_p(config, derived, key, steps, t_pad, z, batch, *, ctx)``
+    is the optional TRACED-PARAMETER synthesis closure (ISSUE 19): the
+    same lane block, but with the family's searchable knobs arriving as
+    ``derived`` — a dict of (possibly traced, possibly vmapped) f32
+    scalars precomputed host-side by `search/params.ScenarioParams.
+    derived()` — instead of baked Python constants. A family that
+    registers one rides the batched scenario-parameter axis
+    (`search/axis.ScenarioAxisSource`) with zero per-engine edits; a
+    family without one is synthesized by its plain closure, constant
+    across the S axis.
     """
 
-    __slots__ = ("name", "rows", "key_tag", "provider", "generate")
+    __slots__ = ("name", "rows", "key_tag", "provider", "generate",
+                 "generate_p")
 
     def __init__(self, name, rows, key_tag, provider=None):
         self.name = name
@@ -126,6 +138,7 @@ class LaneFamily:
         self.key_tag = key_tag
         self.provider = provider
         self.generate = None
+        self.generate_p = None
 
 
 LANE_FAMILIES: dict[str, LaneFamily] = {}
@@ -215,6 +228,40 @@ def lane_generator(name: str):
         raise ValueError(f"lane family {name!r} has no registered "
                          "generator (provide_lane_generator)")
     return fam.generate
+
+
+def provide_lane_param_generator(name: str, generate_p) -> None:
+    """Attach the TRACED-PARAMETER synthesis closure to a registered
+    family (see :class:`LaneFamily`). Same discipline as
+    :func:`provide_lane_generator`: called by the family's jax-importing
+    provider module at import time, and re-providing a filled slot is
+    rejected — two modules silently fighting over one family's traced
+    core is a bug."""
+    if name not in LANE_FAMILIES:
+        raise ValueError(f"unknown lane family {name!r}; registered: "
+                         f"{sorted(LANE_FAMILIES)}")
+    fam = LANE_FAMILIES[name]
+    if fam.generate_p is not None and fam.generate_p is not generate_p:
+        raise ValueError(f"lane family {name!r} already has a "
+                         "param generator; unregister + re-register "
+                         "the family to replace it")
+    fam.generate_p = generate_p
+
+
+def lane_param_generator(name: str):
+    """The family's traced-parameter synthesis closure, importing its
+    provider module on first use. Returns ``None`` (rather than
+    raising) when the family registers no param generator — the
+    scenario-axis source falls back to the plain closure, synthesizing
+    that family constant across the S axis. Unknown family names are
+    still rejected up front."""
+    fam = LANE_FAMILIES.get(name)
+    if fam is None:
+        raise ValueError(f"unknown lane family {name!r}; registered: "
+                         f"{sorted(LANE_FAMILIES)}")
+    if fam.generate_p is None and fam.provider:
+        importlib.import_module(fam.provider)
+    return fam.generate_p
 
 
 # The built-in families. Their tags are canonical HERE; the process

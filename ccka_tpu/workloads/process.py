@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from ccka_tpu.config import WorkloadsConfig
-from ccka_tpu.faults.process import _window
+from ccka_tpu.faults.process import _window, _window_p
 from ccka_tpu.signals.synthetic import _ar1_device, _bump
 from ccka_tpu.sim import lanes
 from ccka_tpu.workloads.types import WorkloadStep
@@ -134,6 +134,65 @@ def packed_workload_lanes(wl: WorkloadsConfig, key, steps: int, t_pad: int,
                            (0, workload_rows(Z) - block.shape[1]), (0, 0)))
 
 
+def packed_workload_lanes_p(wl: WorkloadsConfig, derived: dict, key,
+                            steps: int, t_pad: int, Z: int, batch: int, *,
+                            dt_s: float, start_unix_s: float = 0.0,
+                            start_offset_s=None,
+                            wrap_period_s: float | None = None
+                            ) -> jnp.ndarray:
+    """:func:`packed_workload_lanes` with the searchable rates and spike
+    amplitudes TRACED (ISSUE 19): ``derived`` is
+    `ScenarioParams.derived()["workloads"]` — f32 scalars (per-family
+    rates, flash/burst window triples + mults) — vmapped over ``[S]`` by
+    `search/axis.ScenarioAxisSource` with the key closed over (common
+    random numbers across candidates). The diurnal/anti-diurnal clock
+    shapes and the family noise AR(1)s are parameter-INDEPENDENT, so
+    under vmap they are computed once and broadcast — the S axis pays
+    only for what actually varies. Bitwise the baked path at any
+    concrete value (the rate/mult multiplies are the same f32 ops on
+    the same derived values; kernel-side knobs like queue_max stay in
+    ``wl``/SimParams and are untouched here)."""
+    del wl  # generation-side knobs all arrive via `derived`
+    ki, kif, kb, kbf, kg = jax.random.split(
+        jax.random.fold_in(key, WORKLOAD_KEY_TAG), 5)
+    f32 = jnp.float32
+    d = derived
+    t = start_unix_s + np.arange(steps) * dt_s
+    if start_offset_s is None:
+        tod = jnp.asarray((t % _DAY_S) / _DAY_S, f32)[:, None]      # [T,1]
+    else:
+        t_rel = (jnp.asarray(np.arange(steps) * dt_s, f32)[:, None]
+                 + jnp.asarray(start_offset_s, f32)[None, :])       # [T,B]
+        if wrap_period_s is not None:
+            t_rel = t_rel % f32(wrap_period_s)
+        tt = f32(start_unix_s % _DAY_S) + (t_rel % f32(_DAY_S))
+        tod = (tt % _DAY_S) / _DAY_S
+
+    diurnal = 0.4 + 0.6 * _bump(tod, center=14.0 / 24, width=5.0 / 24,
+                                xp=jnp)                          # [T,1]
+    noise_i = _ar1_device(ki, (steps, batch), rho=0.9, sigma=0.2, axis=0)
+    flash = _window_p(kif, (steps, batch), thresh=d["flash_thresh"],
+                      rho=d["flash_rho"], scale=d["flash_scale"])
+    inf = (d["inf_rate"] * diurnal * (1.0 + noise_i)
+           * (1.0 + (d["flash_mult"] - 1.0) * flash))
+    inf = jnp.maximum(inf, 0.0)
+
+    anti = 1.5 - _bump(tod, center=14.0 / 24, width=5.0 / 24, xp=jnp)
+    noise_b = _ar1_device(kb, (steps, batch), rho=0.85, sigma=0.3, axis=0)
+    burst = _window_p(kbf, (steps, batch), thresh=d["burst_thresh"],
+                      rho=d["burst_rho"], scale=d["burst_scale"])
+    bat = (d["batch_rate"] * anti * (1.0 + noise_b)
+           * (1.0 + (d["burst_mult"] - 1.0) * burst))
+    bat = jnp.maximum(bat, 0.0)
+
+    noise_g = _ar1_device(kg, (steps, batch), rho=0.9, sigma=0.2, axis=0)
+    bg = jnp.maximum(d["bg_rate"] * (1.0 + noise_g), 0.0)
+
+    block = jnp.stack([inf, bat, bg], axis=1).astype(f32)  # [T, 3, B]
+    return jnp.pad(block, ((0, t_pad - steps),
+                           (0, workload_rows(Z) - block.shape[1]), (0, 0)))
+
+
 def has_workload_lanes(exo_packed, Z: int) -> bool:
     """Whether a packed stream carries the workload lane block — row-
     count detection like `faults.has_fault_lanes` (raises on malformed
@@ -184,4 +243,19 @@ def _registry_generate(cfg: WorkloadsConfig, key, steps: int, t_pad: int,
         wrap_period_s=ctx.get("wrap_period_s"))
 
 
+def _registry_generate_p(cfg: WorkloadsConfig, derived: dict, key,
+                         steps: int, t_pad: int, z: int, batch: int, *,
+                         ctx: dict):
+    """Traced-parameter registry adapter
+    (`sim/lanes.provide_lane_param_generator`) —
+    :func:`packed_workload_lanes_p` on the stream key with the clock
+    context the backends carry."""
+    return packed_workload_lanes_p(
+        cfg, derived, key, steps, t_pad, z, batch, dt_s=ctx["dt_s"],
+        start_unix_s=ctx.get("start_unix_s", 0.0),
+        start_offset_s=ctx.get("start_offset_s"),
+        wrap_period_s=ctx.get("wrap_period_s"))
+
+
 lanes.provide_lane_generator("workloads", _registry_generate)
+lanes.provide_lane_param_generator("workloads", _registry_generate_p)

@@ -15,13 +15,25 @@ fleet's typical headroom so queues genuinely build under tight fleets,
 and the batch family needs sustained slack to meet deadlines — which is
 exactly what makes per-family columns separate policies that look
 identical on the aggregate $/SLO-hr headline.
+
+Since ISSUE 19 a scenario can also be MINTED by the adversarial search
+(`search/adversarial.py`): explicit ``faults``/``geo`` sections instead
+of a preset name, plus the provenance pair (``params_json``, the
+canonical `search/params.ScenarioParams` JSON the cell was found at,
+and ``params_digest``, its sha256). :meth:`Scenario.validate` REFUSES a
+minted scenario whose digest does not match its stored params — the
+snapshot-codec tamper discipline: a worst-case cell that cannot prove
+it is the cell the search recorded is not reproducible evidence.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import json
+import os
+from dataclasses import dataclass, field
 
-from ccka_tpu.config import FAULT_PRESETS, WorkloadsConfig
+from ccka_tpu.config import (FAULT_PRESETS, FaultsConfig, GeoConfig,
+                             WorkloadsConfig, _asdict, _from_dict)
 
 
 @dataclass(frozen=True)
@@ -33,12 +45,23 @@ class Scenario:
     fault intensities are orthogonal axes sharing one generation key,
     so a faulted scenario's exo AND workload rows stay bitwise identical
     to its calm twin's.
+
+    Minted scenarios (adversarial search, ISSUE 19) carry EXPLICIT
+    ``faults``/``geo`` sections (a searched cell is a point in the
+    continuous box, not a preset) plus the ``params_json``/
+    ``params_digest`` provenance pair; ``faults`` takes precedence over
+    ``fault_preset`` in :func:`scenario_source`.
     """
 
     name: str
     description: str
     workloads: WorkloadsConfig
     fault_preset: str = ""
+    faults: FaultsConfig | None = None
+    geo: GeoConfig | None = None
+    params_json: str = ""
+    params_digest: str = ""
+    minted_by: str = ""
 
     def validate(self) -> None:
         self.workloads.validate()
@@ -48,6 +71,30 @@ class Scenario:
             raise ValueError(
                 f"scenario {self.name!r}: unknown fault preset "
                 f"{self.fault_preset!r}; presets: {sorted(FAULT_PRESETS)}")
+        if self.faults is not None:
+            self.faults.validate()
+        if self.geo is not None:
+            self.geo.validate()
+        if bool(self.params_json) != bool(self.params_digest):
+            raise ValueError(
+                f"scenario {self.name!r}: minted provenance needs BOTH "
+                "params_json and params_digest (one without the other "
+                "is an unverifiable record)")
+        if self.params_json:
+            from ccka_tpu.search.params import params_digest
+
+            got = params_digest(self.params_json)
+            if got != self.params_digest:
+                raise ValueError(
+                    f"scenario {self.name!r}: params digest mismatch — "
+                    f"stored {self.params_digest[:12]}…, params hash to "
+                    f"{got[:12]}…. The stored parameters were modified "
+                    "after minting; refusing a tampered scenario.")
+
+    @property
+    def minted(self) -> bool:
+        """Whether this scenario carries search-mint provenance."""
+        return bool(self.params_digest)
 
     def family_mix(self) -> dict[str, float]:
         """Mean arrival rate per family (the `ccka scenarios` listing)."""
@@ -55,6 +102,64 @@ class Scenario:
         return {"inference": w.inference_rate_pods,
                 "batch": w.batch_rate_pods,
                 "background": w.background_rate_pods}
+
+    # -- mint codec (the `--mint-out` file format) --------------------
+
+    def to_doc(self) -> dict:
+        """JSON-serializable document — the snapshot-codec round trip
+        :func:`scenario_from_doc` inverts (and `validate` re-checks)."""
+        doc = {"name": self.name, "description": self.description,
+               "workloads": _asdict(self.workloads),
+               "fault_preset": self.fault_preset,
+               "params_json": self.params_json,
+               "params_digest": self.params_digest,
+               "minted_by": self.minted_by}
+        if self.faults is not None:
+            doc["faults"] = _asdict(self.faults)
+        if self.geo is not None:
+            doc["geo"] = _asdict(self.geo)
+        return doc
+
+
+def scenario_from_doc(doc: dict) -> Scenario:
+    """Rebuild (and VALIDATE — incl. the tamper digest check) a minted
+    scenario from its stored document."""
+    sc = Scenario(
+        name=str(doc["name"]), description=str(doc.get("description", "")),
+        workloads=_from_dict(WorkloadsConfig, doc["workloads"]),
+        fault_preset=str(doc.get("fault_preset", "")),
+        faults=(_from_dict(FaultsConfig, doc["faults"])
+                if doc.get("faults") is not None else None),
+        geo=(_from_dict(GeoConfig, doc["geo"])
+             if doc.get("geo") is not None else None),
+        params_json=str(doc.get("params_json", "")),
+        params_digest=str(doc.get("params_digest", "")),
+        minted_by=str(doc.get("minted_by", "")))
+    sc.validate()
+    return sc
+
+
+def load_minted_scenarios(path: str) -> dict[str, Scenario]:
+    """Minted scenarios from a ``--mint-out`` JSON file or a directory
+    of them — each validated (digest-checked) on load. Name collisions
+    with the hand-named library are rejected: a minted cell must not
+    silently shadow a published row."""
+    files = []
+    if os.path.isdir(path):
+        files = [os.path.join(path, f) for f in sorted(os.listdir(path))
+                 if f.endswith(".json")]
+    elif os.path.exists(path):
+        files = [path]
+    out: dict[str, Scenario] = {}
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        sc = scenario_from_doc(doc.get("scenario", doc))
+        if sc.name in WORKLOAD_SCENARIOS or sc.name in out:
+            raise ValueError(f"minted scenario {sc.name!r} ({f}) "
+                             "collides with an existing scenario name")
+        out[sc.name] = sc
+    return out
 
 
 WORKLOAD_SCENARIOS: dict[str, Scenario] = {
@@ -113,13 +218,20 @@ def resolve_scenarios(names) -> dict[str, Scenario]:
 
 def scenario_source(cfg, scenario: Scenario):
     """A SyntheticSignalSource generating this scenario's widened stream
-    (workload lanes, plus fault lanes when the scenario names a
-    preset). All scenarios driven from ONE key share bitwise-identical
-    exo rows — the cross-scenario pairing the scoreboard leans on."""
+    (workload lanes, plus fault lanes when the scenario names a preset
+    or carries an explicit minted section, plus region lanes for a
+    minted geo section). All scenarios driven from ONE key share
+    bitwise-identical exo rows — the cross-scenario pairing the
+    scoreboard leans on."""
     from ccka_tpu.signals.synthetic import SyntheticSignalSource
 
-    faults = (FAULT_PRESETS[scenario.fault_preset]
-              if scenario.fault_preset else None)
+    faults = scenario.faults
+    if faults is None and scenario.fault_preset:
+        faults = FAULT_PRESETS[scenario.fault_preset]
+    extra = ({"regions": scenario.geo}
+             if scenario.geo is not None and scenario.geo.enabled
+             else None)
     return SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
                                  cfg.signals, faults=faults,
-                                 workloads=scenario.workloads)
+                                 workloads=scenario.workloads,
+                                 extra_lanes=extra)

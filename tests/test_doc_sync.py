@@ -1193,3 +1193,108 @@ class TestFleetScaleClaims:
                        "skip-don't-fake-zeros",
                        "warmup ticks dropped"):
             assert phrase in flat, phrase
+
+
+class TestSearchClaims:
+    """Round 22's traced scenario axis + adversarial search (ISSUE 19
+    docs satellite): README's "Adversarial scenario search" claims are
+    PARSED against the BASELINE round22 record, not hand-synced."""
+
+    def test_round22_record_is_self_describing(self, baseline):
+        r22 = baseline["published"]["round22"]
+        sp = r22["speedup_evidence"]
+        # The acceptance criteria hold on the record itself.
+        assert sp["pass"] is True
+        assert sp["ratio"] >= 10.0
+        assert abs(sp["ratio"] - sp["traced_cells_per_sec"]
+                   / sp["loop_cells_per_sec"]) < 0.05 * sp["ratio"]
+        st = r22["search_stage"]
+        assert st["traced"]["recompiles_during_swaps"] == 0
+        assert st["traced"]["cells_per_sec"] == sp["traced_cells_per_sec"]
+        assert st["recompile_loop"]["cells_per_sec"] == \
+            sp["loop_cells_per_sec"]
+        par = r22["parity"]
+        assert par["s1_stream_bitwise"] is True
+        assert par["s1_summary_bitwise"] is True
+        assert par["ncell_allclose"] is True
+        assert par["ncell_values_traced"] == par["ncell_values_loop"]
+        se = r22["search"]
+        assert se["dominates"] is True
+        assert se["minted"]["value"] > se["hand_worst"]
+        assert se["hand_worst"] == max(se["hand_named"].values())
+        assert len(se["minted"]["params_digest"]) == 64
+        assert se["minted"]["name"].startswith("minted-rule-")
+        assert se["history"][-1]["best"] == se["minted"]["value"]
+        for gate, needle in (("parity_gate", "bitwise identical"),
+                             ("dominance_gate", "strictly exceeds")):
+            assert needle in r22[gate], gate
+
+    def test_readme_speedup_claim(self, readme, baseline):
+        sp = baseline["published"]["round22"]["speedup_evidence"]
+        tr = baseline["published"]["round22"]["search_stage"]["traced"]
+        m = re.search(
+            r"([\d.]+)\s+traced\s+scenario-cells/sec\s+against\s+the\s+"
+            r"per-config\s+recompile\s+loop's\s+([\d.]+)\s+—\s+a\s+"
+            r"([\d.]+)×\s+speedup\s+over\s+the\s+≥10×\s+gate\s+—\s+"
+            r"with\s+(\d+)\s+recompiles", " ".join(readme.split()))
+        assert m, ("README's scenario-search speedup claim no longer "
+                   "states the numbers in the pinned form — update "
+                   "the claim AND this regex together")
+        traced, loop, ratio, recompiles = m.groups()
+        assert abs(float(traced) - sp["traced_cells_per_sec"]) < 5e-3
+        assert abs(float(loop) - sp["loop_cells_per_sec"]) < 5e-4
+        assert abs(float(ratio) - sp["ratio"]) < 5e-2
+        assert float(ratio) >= 10.0
+        assert int(recompiles) == tr["recompiles_during_swaps"] == 0
+
+    def test_readme_parity_claim(self, readme, baseline):
+        par = baseline["published"]["round22"]["parity"]
+        flat = " ".join(readme.split())
+        assert ("the S=1 traced axis is bitwise the config-baked path "
+                "(stream AND kernel summary") in flat
+        m = re.search(r"max\s+\|Δ\|\s+([\d.e-]+)\s+on\s+the\s+N-cell\s+"
+                      r"allclose", flat)
+        assert m, "README's ulp-tolerance claim lost its pinned form"
+        assert abs(float(m.group(1)) - par["ncell_max_abs_delta"]) \
+            <= 1e-9
+
+    def test_readme_dominance_claim(self, readme, baseline):
+        se = baseline["published"]["round22"]["search"]
+        m = re.search(
+            r"degrades\s+the\s+rule\s+policy\s+to\s+([\d.]+)\s+"
+            r"\$/SLO-hr,\s+strictly\s+worse\s+than\s+its\s+worst\s+"
+            r"hand-named\s+scenario\s+cell\s+\(`(\S+)`,\s+([\d.]+)\)",
+            " ".join(readme.split()))
+        assert m, "README's minted-dominance claim lost its pinned form"
+        minted_v, hand_name, hand_v = m.groups()
+        assert abs(float(minted_v) - se["minted"]["value"]) < 5e-7
+        assert abs(float(hand_v) - se["hand_worst"]) < 5e-7
+        assert float(minted_v) > float(hand_v)
+        assert se["hand_named"][hand_name] == se["hand_worst"]
+
+    def test_readme_names_the_surfaces(self, readme):
+        flat = " ".join(readme.split())
+        for needle in ("ScenarioParams", "`from_config`/`to_config`",
+                       "generate_p", "ScenarioAxisSource", "set_params",
+                       "ccka scenario-search", "--intensity", "--bound",
+                       "--mint-out", "ccka scenarios --minted-dir",
+                       "replay_minted", "`ccka bench-diff`",
+                       "BENCH_r22.json", "common generation key"):
+            assert needle in flat, needle
+
+    def test_architecture_has_section_24(self):
+        arch = _read("ARCHITECTURE.md")
+        assert ("## 24. Traced scenario-parameter axis + adversarial "
+                "search") in arch
+        flat = " ".join(arch.split())
+        for phrase in ("ScenarioParams", "SEARCH_SPEC",
+                       "validate_bounds", "clip_to_bounds",
+                       "params_digest", "generate_p",
+                       "provide_lane_param_generator",
+                       "packed_fault_lanes_p", "PRICE_DEV_SIGMA",
+                       "ScenarioAxisSource", "summary_cells",
+                       "set_params", "ScenarioScorer", "search_iter",
+                       "search_mint", "replay_minted",
+                       "load_minted_scenarios", "_SEARCH_SPEEDUP_FLOOR",
+                       "tests/test_search.py"):
+            assert phrase in flat, phrase

@@ -1,0 +1,521 @@
+"""Traced scenario-parameter axis + adversarial search (ISSUE 19).
+
+Contract map:
+
+- **Config round trip**: `ScenarioParams.from_config` /
+  `to_config` invert each other EXACTLY — a searched cell written back
+  to config sections is the same point, and a config read into the axis
+  scores as itself.
+- **S=1 bitwise parity**: the traced axis at S=1 produces the SAME
+  packed stream as the config-baked generation path (same key, same
+  geometry) — and the kernel summaries on top are bitwise, for all four
+  packed modes, through the streaming pipeline, and through the 8-shard
+  mesh trace. Cross-width S>1 programs differ at ulp (XLA fusion
+  order), so the N-cell cross-check is allclose, never bitwise.
+- **Box discipline**: unknown knob names and inverted/out-of-box ranges
+  are rejected up front; `clip_to_bounds` is idempotent (int-kind knobs
+  round first).
+- **CEM determinism**: same seed, same scorer → identical proposals,
+  identical minted cell (digest and objective value).
+- **Mint provenance**: a minted scenario replays to EXACTLY its
+  recorded objective; a tampered params_json is refused; one-sided
+  provenance is refused; minted names cannot shadow the hand-named
+  library.
+- **bench-diff gates**: a doctored/partial `--search-only` record exits
+  1; the repo's real history stays clean.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccka_tpu.config import (FAULT_PRESETS, FaultsConfig, GeoConfig,
+                             WorkloadsConfig, default_config)
+from ccka_tpu.search.params import (PARAM_NAMES, SEARCH_BOUNDS,
+                                    ScenarioParams, params_digest,
+                                    validate_bounds)
+from ccka_tpu.signals.synthetic import SyntheticSignalSource
+from ccka_tpu.sim import SimParams
+
+# One shared CI geometry (matches the streaming suite's sizing).
+INNER, T, BLOCK_T, T_CHUNK, B_BLOCK = 8, 64, 32, 16, 8
+KERNEL_KW = dict(T=T, b_block=B_BLOCK, t_chunk=T_CHUNK, interpret=True,
+                 stochastic=False)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config()
+
+
+def _active_cell() -> ScenarioParams:
+    """One S=1 cell with EVERY searchable mechanism live (nonzero storm
+    hazard AND price coupling AND ICE AND delay AND outage AND both
+    workload windows AND the geo storm) — parity pinned here covers all
+    the traced twins' branches at once."""
+    rng = np.random.default_rng(9)
+    lo = np.asarray([SEARCH_BOUNDS[n][0] for n in PARAM_NAMES])
+    hi = np.asarray([SEARCH_BOUNDS[n][1] for n in PARAM_NAMES])
+    # Uniform inside the middle of the box: strictly > lo everywhere.
+    nat = lo + (0.2 + 0.6 * rng.uniform(size=(1, len(PARAM_NAMES)))) \
+        * (hi - lo)
+    return ScenarioParams.from_array(nat).clip_to_bounds()
+
+
+@pytest.fixture(scope="module")
+def cell():
+    return _active_cell()
+
+
+@pytest.fixture(scope="module")
+def sources(cfg, cell):
+    """(baked source, axis source, fa, wl, geo): the SAME cell through
+    the config-baked constructor and the traced axis."""
+    from ccka_tpu.search.axis import ScenarioAxisSource
+
+    fa, wl, geo = cell.to_config(0)
+    baked = SyntheticSignalSource(cfg.cluster, cfg.workload, cfg.sim,
+                                  cfg.signals, faults=fa, workloads=wl,
+                                  extra_lanes={"regions": geo})
+    axis = ScenarioAxisSource(cfg.cluster, cfg.workload, cfg.sim,
+                              cfg.signals, cell, faults=fa, workloads=wl,
+                              geo=geo)
+    return baked, axis, fa, wl, geo
+
+
+@pytest.fixture(scope="module")
+def net_params(cfg):
+    from ccka_tpu.models import ActorCritic, latent_dim
+    from ccka_tpu.sim.megakernel import _obs_dim
+
+    net = ActorCritic(act_dim=latent_dim(cfg.cluster))
+    return net.init(jax.random.key(5), jnp.zeros(
+        (_obs_dim(cfg.cluster.n_pools, cfg.cluster.n_zones),)))
+
+
+def _bitwise_fields(a, b):
+    return {f for f in a._fields
+            if not np.array_equal(np.asarray(getattr(a, f)),
+                                  np.asarray(getattr(b, f)))}
+
+
+class TestParamsCodec:
+    def test_from_config_to_config_round_trip_exact(self):
+        """Config sections → params → config sections is the identity
+        (EXACT, not approximate): dataclass equality on all three."""
+        fa = FAULT_PRESETS["severe"]
+        wl = WorkloadsConfig(enabled=True, inference_rate_pods=6.0,
+                             inference_flash_frac=0.06,
+                             inference_flash_mult=8.0,
+                             batch_rate_pods=5.0)
+        geo = GeoConfig(enabled=True)
+        p = ScenarioParams.from_config(faults=fa, workloads=wl, geo=geo)
+        fa2, wl2, geo2 = p.to_config(0, base_faults=fa,
+                                     base_workloads=wl, base_geo=geo)
+        assert fa2 == fa and wl2 == wl and geo2 == geo
+
+    def test_to_config_from_config_closes_the_loop(self, cell):
+        """Params → config → params lands on the SAME values (int-kind
+        knobs were already integral after clip)."""
+        fa, wl, geo = cell.to_config(0)
+        back = ScenarioParams.from_config(faults=fa, workloads=wl,
+                                          geo=geo)
+        assert np.array_equal(cell.to_array(), back.to_array())
+
+    def test_json_digest_canonical(self, cell):
+        p2 = ScenarioParams.from_json(cell.to_json())
+        assert p2.to_json() == cell.to_json()
+        assert p2.digest() == cell.digest()
+        assert cell.digest() == params_digest(cell.to_json())
+
+    def test_stack_and_row_invert(self, cell):
+        other = cell.clip_to_bounds({"inf_rate": (0.0, 1.0)})
+        batch = ScenarioParams.stack([cell, other])
+        assert batch.S == 2
+        assert np.array_equal(batch.row(1).to_array(), other.to_array())
+
+    def test_clip_is_idempotent_and_rounds_ints(self):
+        lo = np.asarray([SEARCH_BOUNDS[n][0] for n in PARAM_NAMES])
+        hi = np.asarray([SEARCH_BOUNDS[n][1] for n in PARAM_NAMES])
+        rng = np.random.default_rng(3)
+        # Deliberately OUTSIDE the box on both sides, fractional ints.
+        nat = lo - 5.0 + rng.uniform(size=(4, len(PARAM_NAMES))) \
+            * (hi - lo + 10.0)
+        once = ScenarioParams.from_array(nat).clip_to_bounds()
+        twice = once.clip_to_bounds()
+        assert np.array_equal(once.to_array(), twice.to_array())
+        for name in ("storm_mean_ticks", "ice_mean_ticks",
+                     "inf_flash_mean_ticks", "geo_storm_mean_ticks"):
+            v = once.values[name]
+            assert np.array_equal(v, np.round(v)), name
+
+    def test_unknown_and_inverted_bounds_rejected(self):
+        with pytest.raises(ValueError, match="unknown scenario params"):
+            validate_bounds({"bogus": (0.0, 1.0)})
+        with pytest.raises(ValueError, match="bounds"):
+            validate_bounds({"inf_rate": (2.0, 1.0)})
+        with pytest.raises(ValueError, match="bounds"):
+            validate_bounds({"inf_rate": (0.0, 1e9)})  # above the box
+
+
+class TestAxisParity:
+    @pytest.mark.slow  # ISSUE 14 lane-time rule (~24s): full raw-stream
+    # compare; the fast-lane S=1 bitwise pin is the rule-mode kernel
+    # summary below, which rides the same generation path.
+    def test_s1_stream_bitwise_vs_baked(self, sources):
+        """THE tentpole pin: the traced axis at S=1 IS the config-baked
+        generation path, bitwise, with every searchable mechanism live
+        (storm+coupling+ICE+delay+outage+flash+burst+geo storm)."""
+        baked, axis, *_ = sources
+        key = jax.random.key(11)
+        bs = baked.packed_trace_device(T, key, INNER, t_chunk=T_CHUNK)
+        xs = axis.packed_trace_device(T, key, INNER, t_chunk=T_CHUNK)
+        assert np.array_equal(np.asarray(bs), np.asarray(xs))
+
+    @pytest.mark.slow  # ISSUE 14 lane-time rule (~23s): the block-keyed
+    # variant of the fast-lane plain-stream pin — same fold chain, so a
+    # drift would also break the slow streaming-pipeline gate.
+    def test_s1_blocked_stream_bitwise_vs_baked(self, sources):
+        """Block-keyed generation (the streaming pipeline's path) stays
+        bitwise through the axis too — same BLOCK_KEY_TAG fold chain."""
+        baked, axis, *_ = sources
+        key = jax.random.key(12)
+        for j in range(T // BLOCK_T):
+            bs = baked.packed_block_trace_device(
+                BLOCK_T, key, INNER, j, t_chunk=T_CHUNK)
+            xs = axis.packed_block_trace_device(
+                BLOCK_T, key, INNER, j, t_chunk=T_CHUNK)
+            assert np.array_equal(np.asarray(bs), np.asarray(xs)), j
+
+    @pytest.mark.parametrize("mode", [
+        "rule",
+        # ISSUE 16 lane-time rule: the four modes ride the same stream;
+        # one fast-lane mode pins the contract, the rest ride slow.
+        pytest.param("carbon", marks=pytest.mark.slow),
+        pytest.param("neural", marks=pytest.mark.slow),
+        pytest.param("plan", marks=pytest.mark.slow)])
+    def test_s1_kernel_summary_bitwise_per_mode(self, cfg, sources,
+                                                net_params, mode):
+        from ccka_tpu.sim.megakernel import packed_mode_summary_fn
+
+        baked, axis, fa, wl, geo = sources
+        params = SimParams.from_config(
+            dataclasses.replace(cfg, faults=fa, workloads=wl, geo=geo))
+        key = jax.random.key(13)
+        bs = baked.packed_trace_device(T, key, INNER, t_chunk=T_CHUNK)
+        xs = axis.packed_trace_device(T, key, INNER, t_chunk=T_CHUNK)
+        fn = packed_mode_summary_fn(
+            params, cfg.cluster, mode,
+            net_params=net_params if mode == "neural" else None,
+            **KERNEL_KW)
+        assert not _bitwise_fields(fn(bs, 7), fn(xs, 7)), mode
+
+    @pytest.mark.slow  # ISSUE 14 lane-time rule (~29s): double-buffered
+    # drive over the axis source — heavy variant of the fast-lane pin.
+    def test_s1_streaming_pipeline_bitwise(self, cfg, sources):
+        """The double-buffered streaming drive consumes the axis source
+        through the SAME generic interface — summaries bitwise vs the
+        baked source's drive."""
+        from ccka_tpu.sim import streaming as streaming_mod
+
+        baked, axis, fa, wl, geo = sources
+        params = SimParams.from_config(
+            dataclasses.replace(cfg, faults=fa, workloads=wl, geo=geo))
+        key = jax.random.key(14)
+        kw = dict(T=T, block_T=BLOCK_T, t_chunk=T_CHUNK,
+                  b_block=B_BLOCK, interpret=True, stochastic=False)
+        s_baked, _ = streaming_mod.streaming_rollout_summary(
+            baked, params, cfg.cluster, "rule", key=key, batch=INNER,
+            seed=7, pipelined=True, **kw)
+        s_axis, _ = streaming_mod.streaming_rollout_summary(
+            axis, params, cfg.cluster, "rule", key=key, batch=INNER,
+            seed=7, pipelined=True, **kw)
+        assert not _bitwise_fields(s_baked, s_axis)
+
+    @pytest.mark.slow  # 8-device mesh compile — slow-lane per the rule.
+    def test_s1_8shard_trace_bitwise(self, sources):
+        from ccka_tpu.parallel import make_mesh, sharded_packed_trace
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        baked, axis, *_ = sources
+        mesh = make_mesh()
+        key = jax.random.key(15)
+        bs = sharded_packed_trace(mesh, baked, T, key, 16,
+                                  t_chunk=T_CHUNK)
+        xs = sharded_packed_trace(mesh, axis, T, key, 16,
+                                  t_chunk=T_CHUNK)
+        assert np.array_equal(np.asarray(bs), np.asarray(xs))
+
+    @pytest.mark.slow  # extra S=3 program compile — slow-lane.
+    def test_ncell_batch_allclose_vs_per_cell(self, cfg, cell):
+        """S=3 one-dispatch values match three S=1 dispatches to ulp
+        tolerance (cross-width programs are NOT bitwise — XLA fusion
+        order differs between widths; that caveat is the documented
+        contract, and this test pins the allclose side of it)."""
+        from ccka_tpu.search.adversarial import ScenarioScorer
+
+        scorer = ScenarioScorer(cfg, policy="rule", steps=T,
+                                inner_batch=INNER, t_chunk=T_CHUNK,
+                                seed=3)
+        a = cell
+        b = cell.clip_to_bounds({"inf_rate": (0.0, 2.0)})
+        c = cell.clip_to_bounds({"storm_hazard": (0.0, 0.5)})
+        batch = ScenarioParams.stack([a, b, c])
+        vals3 = scorer.score(batch)["usd_per_slo_hour"]
+        vals1 = [float(scorer.score(p)["usd_per_slo_hour"][0])
+                 for p in (a, b, c)]
+        np.testing.assert_allclose(np.asarray(vals3), vals1,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_batch_not_multiple_of_s_rejected(self, sources):
+        _, axis, *_ = sources
+        two = ScenarioParams.stack([_active_cell(), _active_cell()])
+        axis.set_params(two)
+        try:
+            with pytest.raises(ValueError, match="divisible"):
+                axis.packed_trace_device(T, jax.random.key(0), INNER + 1,
+                                         t_chunk=T_CHUNK)
+        finally:
+            axis.set_params(_active_cell())
+
+    def test_set_params_rejects_non_params(self, sources):
+        _, axis, *_ = sources
+        with pytest.raises(TypeError, match="ScenarioParams"):
+            axis.set_params({"inf_rate": np.zeros(1)})
+
+
+class TestAdversarialSearch:
+    @pytest.fixture(scope="class")
+    def scorer(self, cfg):
+        from ccka_tpu.search.adversarial import ScenarioScorer
+
+        return ScenarioScorer(cfg, policy="rule", steps=T,
+                              inner_batch=4, t_chunk=T_CHUNK, seed=5)
+
+    def test_rejections_happen_before_any_compile(self, cfg):
+        """Unknown policy/objective/intensity/bounds and degenerate
+        CEM sizes all raise BEFORE a scorer (and its compile) exists —
+        scorer=None never gets touched."""
+        from ccka_tpu.search.adversarial import (intensity_bounds,
+                                                 search_scenarios)
+
+        with pytest.raises(ValueError, match="policy"):
+            search_scenarios(cfg, policy="flagship")
+        with pytest.raises(ValueError, match="objective"):
+            search_scenarios(cfg, objective="profit")
+        with pytest.raises(ValueError, match="intensity"):
+            intensity_bounds("apocalyptic")
+        with pytest.raises(ValueError, match="unknown scenario params"):
+            search_scenarios(cfg, bounds={"bogus": (0, 1)})
+        with pytest.raises(ValueError, match="iters"):
+            search_scenarios(cfg, iters=0)
+
+    @pytest.mark.slow  # two CEM runs through compiled scoring — ~30s.
+    def test_cem_deterministic_under_fixed_seed(self, cfg, scorer):
+        from ccka_tpu.search.adversarial import search_scenarios
+
+        kw = dict(policy="rule", iters=2, pop=4, seed=23,
+                  intensity="moderate", scorer=scorer)
+        r1 = search_scenarios(cfg, **kw)
+        r2 = search_scenarios(cfg, **kw)
+        assert r1.scenario.params_digest == r2.scenario.params_digest
+        assert r1.best_value == r2.best_value
+        assert r1.history == r2.history
+
+    @pytest.mark.slow  # replay builds its own scorer (fresh compile).
+    def test_minted_replay_reproduces_recorded_objective(self, cfg,
+                                                         scorer):
+        """The reproducibility contract: the minted document alone is
+        enough to recompute the EXACT recorded objective (S=1 re-score
+        through the recorded eval geometry)."""
+        from ccka_tpu.search.adversarial import (replay_minted,
+                                                 search_scenarios)
+
+        res = search_scenarios(cfg, policy="rule", iters=1, pop=4,
+                               seed=29, intensity="moderate",
+                               scorer=scorer)
+        doc = json.loads(json.dumps(res.to_doc()))   # disk round trip
+        cells = replay_minted(cfg, doc)
+        assert cells[res.objective] == res.best_value
+        assert cells == res.best_cells
+
+    @pytest.mark.slow  # rides the class scorer's compiled programs.
+    def test_minted_scenario_validates_and_lists(self, cfg, scorer,
+                                                 tmp_path):
+        from ccka_tpu.search.adversarial import search_scenarios
+        from ccka_tpu.workloads.scenarios import load_minted_scenarios
+
+        res = search_scenarios(cfg, policy="rule", iters=1, pop=4,
+                               seed=31, intensity="mild", scorer=scorer)
+        assert res.scenario.minted
+        out = tmp_path / "mint.json"
+        out.write_text(json.dumps(res.to_doc()))
+        loaded = load_minted_scenarios(str(out))
+        assert set(loaded) == {res.scenario.name}
+        assert loaded[res.scenario.name].params_digest \
+            == res.scenario.params_digest
+
+
+class TestMintProvenance:
+    def _doc(self) -> dict:
+        p = _active_cell()
+        fa, wl, geo = p.to_config(0)
+        from ccka_tpu.workloads.scenarios import Scenario
+
+        sc = Scenario(name="minted-test-cell", description="t",
+                      workloads=wl, faults=fa, geo=geo,
+                      params_json=p.to_json(),
+                      params_digest=p.digest(), minted_by="test")
+        sc.validate()
+        return sc.to_doc()
+
+    def test_tampered_params_refused(self):
+        from ccka_tpu.workloads.scenarios import scenario_from_doc
+
+        doc = self._doc()
+        tampered = json.loads(doc["params_json"])
+        tampered["inf_rate"] = [0.0]
+        doc["params_json"] = json.dumps(tampered, sort_keys=True,
+                                        separators=(",", ":"))
+        with pytest.raises(ValueError, match="tampered"):
+            scenario_from_doc(doc)
+
+    def test_one_sided_provenance_refused(self):
+        from ccka_tpu.workloads.scenarios import scenario_from_doc
+
+        doc = self._doc()
+        doc["params_digest"] = ""
+        with pytest.raises(ValueError, match="BOTH"):
+            scenario_from_doc(doc)
+
+    def test_minted_name_cannot_shadow_library(self, tmp_path):
+        from ccka_tpu.workloads.scenarios import load_minted_scenarios
+
+        doc = self._doc()
+        doc["name"] = "mixed"                  # a hand-named entry
+        (tmp_path / "m.json").write_text(json.dumps({"scenario": doc}))
+        with pytest.raises(ValueError, match="collides"):
+            load_minted_scenarios(str(tmp_path))
+
+
+def _good_search_record() -> dict:
+    """A minimal healthy `--search-only` record (the gate surface only;
+    the real BENCH_r22.json carries much more)."""
+    return {
+        "stage": "--search-only",
+        "traced": {"cells": 6, "repeats": 3, "seconds": 0.05,
+                   "cells_per_sec": 360.0,
+                   "recompiles_during_swaps": 0},
+        "recompile_loop": {"cells": 3, "seconds": 47.0,
+                           "cells_per_sec": 0.064},
+        "speedup": {"ratio": 5625.0, "pass": True},
+        "parity": {"s1_stream_bitwise": True, "s1_summary_bitwise": True,
+                   "ncell_allclose": True, "ncell_max_abs_delta": 2e-8},
+        "search": {"policy": "rule", "objective": "usd_per_slo_hour",
+                   "minted": {"name": "minted-rule-ff",
+                              "params_digest": "ff", "value": 0.37},
+                   "hand_worst": 0.358, "dominates": True},
+    }
+
+
+class TestBenchDiffSearchGates:
+    """The bench-diff search invariants (ISSUE 19 satellite): doctored
+    or partial records exit 1, the real history stays clean."""
+
+    def _diff_of(self, tmp_path, rec):
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        (tmp_path / "BENCH_r95.json").write_text(json.dumps(rec))
+        return bench_diff(load_bench_history(str(tmp_path)))
+
+    def _search_regressions(self, diff):
+        return [r for r in diff["regressions"]
+                if r["kind"] == "search_invariant"]
+
+    def test_good_record_is_clean(self, tmp_path):
+        diff = self._diff_of(tmp_path, _good_search_record())
+        assert diff["ok"], diff["regressions"]
+
+    def test_speedup_below_floor_regresses_and_cli_exits_one(
+            self, tmp_path, capsys):
+        rec = _good_search_record()
+        rec["speedup"]["ratio"] = 9.0
+        diff = self._diff_of(tmp_path, rec)
+        assert any(r.get("threshold") == 10.0 and r.get("value") == 9.0
+                   for r in self._search_regressions(diff))
+        from ccka_tpu.cli import main
+
+        assert main(["bench-diff", "--root", str(tmp_path)]) == 1
+        assert "REGRESSION" in capsys.readouterr().err
+
+    def test_swap_window_recompile_regresses(self, tmp_path):
+        rec = _good_search_record()
+        rec["traced"]["recompiles_during_swaps"] = 2
+        diff = self._diff_of(tmp_path, rec)
+        assert any("recompiled" in r["detail"]
+                   for r in self._search_regressions(diff))
+
+    def test_false_or_missing_bitwise_flags_regress(self, tmp_path):
+        for key in ("s1_stream_bitwise", "s1_summary_bitwise",
+                    "ncell_allclose"):
+            rec = _good_search_record()
+            rec["parity"][key] = False
+            diff = self._diff_of(tmp_path, rec)
+            assert not diff["ok"], key
+            rec = _good_search_record()
+            del rec["parity"][key]
+            diff = self._diff_of(tmp_path, rec)
+            assert any("partial" in r["detail"]
+                       for r in self._search_regressions(diff)), key
+
+    def test_doctored_dominance_flag_regresses(self, tmp_path):
+        """A record whose flag claims dominance while its own numbers
+        say otherwise is doctored — both the contradiction and the
+        dominance gate fire."""
+        rec = _good_search_record()
+        rec["search"]["minted"]["value"] = 0.30     # below hand_worst
+        diff = self._diff_of(tmp_path, rec)
+        bad = self._search_regressions(diff)
+        assert any("contradicts" in r["detail"] for r in bad)
+        assert any("strictly" in r["detail"] for r in bad)
+
+    def test_partial_record_regresses(self, tmp_path):
+        for key in ("speedup", "traced", "search"):
+            rec = _good_search_record()
+            del rec[key]
+            diff = self._diff_of(tmp_path, rec)
+            assert any("partial" in r["detail"]
+                       for r in self._search_regressions(diff)), key
+
+    def test_real_history_is_clean_and_round22_extracted(self):
+        import os
+
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        history = load_bench_history(root)
+        rows = [r for r in history["records"]
+                if r.get("search_speedup") is not None]
+        assert rows, "BENCH_r22.json lost its search columns"
+        assert rows[-1]["search_speedup"] >= 10.0
+        assert rows[-1]["search_recompiles"] == 0
+        assert rows[-1]["search_s1_stream"] is True
+        assert rows[-1]["search_dominates"] is True
+        diff = bench_diff(history)
+        assert diff["ok"], diff["regressions"]
+
+
+class TestRunlogEvents:
+    def test_search_events_registered(self):
+        from ccka_tpu.obs.runlog import RUNLOG_EVENTS
+
+        assert {"search_iter", "search_mint"} <= RUNLOG_EVENTS

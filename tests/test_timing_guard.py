@@ -150,6 +150,14 @@ def test_guard_scans_a_nontrivial_tree():
     # clocks into violations.
     assert any(os.path.join("signals", "transport.py") in p
                for p in files)
+    # Round 22: the adversarial search's CEM loop and the scenario-axis
+    # source both sit one call away from compiled device programs — a
+    # bare clock timing a `scorer.score` dispatch would measure launch,
+    # not execution, so the search tree rides the same scan.
+    assert any(os.path.join("search", "adversarial.py") in p
+               for p in files)
+    assert any(os.path.join("search", "axis.py") in p for p in files)
+    assert any(os.path.join("search", "params.py") in p for p in files)
 
 
 def test_scrape_transport_is_device_free():
