@@ -4003,6 +4003,147 @@ def _mega_subprocess(mega_sizes, horizon: int, repeats: int,
     return out
 
 
+def bench_flywheel(*, generations: int = 2, n_tenants: int = 6,
+                   record_ticks: int = 16, shadow_ticks: int = 16,
+                   watch_ticks: int = 10, top_k: int = 3,
+                   steps: int = 40, iterations: int = 150,
+                   pairs_base: int = 3, pairs_max: int = 6,
+                   seed: int = 23) -> dict | None:
+    """Continual-learning flywheel stage (round 23,
+    `train/flywheel.py` + `harness/flywheel.py`): the seeded
+    end-to-end record the acceptance criterion pins. Gates (the `ccka
+    bench-diff` flywheel invariants):
+
+    - ``flywheel_gate_ok``: ≥ ``generations`` gate-passing promotions,
+      each strictly improving the pair-weighted $/SLO-hr ratio on its
+      mined weakness cells (mean ratio < 1) with no workload class
+      regressing beyond tolerance;
+    - ``provenance_ok``: every generation's checksummed provenance
+      record verifies after the run;
+    - ``rollback_ok``: a post-promotion divergence watch stamps ONE
+      edge-triggered policy_divergence incident, the demotion restores
+      the parent checkpoint, and the restored live params re-hash
+      BITWISE to the digest the promotion recorded;
+    - ``deterministic_ok``: generation 1 re-mined and re-distilled in
+      a fresh root under the same seed reproduces the same curriculum
+      digest AND the same challenger checkpoint digest.
+    """
+    import tempfile
+
+    from ccka_tpu.config import default_config
+    from ccka_tpu.harness.flywheel import FlywheelRunner
+    from ccka_tpu.train.checkpoint import load_params_npz, params_digest
+    from ccka_tpu.train.flywheel import Flywheel, load_provenance
+
+    cfg = default_config()
+    scratch = tempfile.mkdtemp(prefix="ccka-flywheel-bench-")
+
+    def build(tag: str):
+        fw = Flywheel(cfg, os.path.join(scratch, tag, "root"),
+                      steps=steps, block_T=steps, t_chunk=steps,
+                      pairs_base=pairs_base, pairs_max=pairs_max,
+                      iterations=iterations, seed=seed)
+        runner = FlywheelRunner(
+            cfg, fw, scratch=os.path.join(scratch, tag, "runs"),
+            n_tenants=n_tenants, record_ticks=record_ticks,
+            shadow_ticks=shadow_ticks, watch_ticks=watch_ticks,
+            top_k=top_k, seed=seed + 188)
+        return fw, runner
+
+    try:
+        fw, runner = build("a")
+        res = runner.run(generations=generations)
+        gens = res["generations"]
+        promoted = [g for g in gens if g["promoted"]]
+        gate_ok = bool(
+            len(promoted) >= generations
+            and all(g["decision"]["eligible"]
+                    and g["decision"]["gates"]["mean_ratio"] < 1.0
+                    and g["decision"]["gates"]["class_regression_ok"]
+                    for g in promoted))
+        prov_ok = True
+        for g in gens:
+            try:
+                load_provenance(os.path.join(
+                    fw.gen_dir(g["generation"]), "provenance.json"))
+            except ValueError:
+                prov_ok = False
+        rb = res.get("rollback", {})
+        rollback_ok = False
+        if rb.get("rolled_back"):
+            tree, _meta = load_params_npz(fw.live_npz)
+            restored = params_digest(tree)
+            want = promoted[-1]["parent"]["digest"]
+            rollback_ok = bool(restored == rb["restored"]["digest"]
+                               == want)
+        # Paired determinism rerun: generation 1 from scratch, fresh
+        # artifact root + fresh service scratch, same seeds.
+        _fw_b, runner_b = build("b")
+        g1b = runner_b.generation(1)
+        g1a = gens[0]
+        det_ok = bool(
+            g1b["curriculum_digest"] == g1a["curriculum_digest"]
+            and g1b["checkpoint_digest"] == g1a["checkpoint_digest"]
+            and g1b["mined_cells"] == g1a["mined_cells"])
+    finally:
+        import shutil
+
+        shutil.rmtree(scratch, ignore_errors=True)
+
+    out = {
+        "engine": "FlywheelRunner on the det-clock fleet service "
+                  "(record → mine → weakness-weighted distill → "
+                  "flywheel-challenger shadow lane → gate battery → "
+                  "atomic promote), then the armed divergence watch "
+                  "and bitwise parent restore; paired fresh-root "
+                  "gen-1 rerun for the determinism gate",
+        "generations_requested": generations,
+        "n_tenants": n_tenants,
+        "record_ticks": record_ticks,
+        "shadow_ticks": shadow_ticks,
+        "seed": seed,
+        "curriculum": {"steps": steps, "iterations": iterations,
+                       "pairs_base": pairs_base,
+                       "pairs_max": pairs_max, "top_k": top_k},
+        "generations": [{
+            "generation": g["generation"],
+            "incumbent": g["incumbent"],
+            "mined_cells": g["mined_cells"],
+            "curriculum_digest": g["curriculum_digest"],
+            "checkpoint_digest": g["checkpoint_digest"],
+            "parent": g["parent"],
+            "mean_ratio": g["decision"]["gates"]["mean_ratio"],
+            "worst_class_rel_delta":
+                g["decision"]["gates"]["worst_class_rel_delta"],
+            "shadow_outcome":
+                g["decision"]["gates"].get("shadow_outcome"),
+            "shadow_comparisons":
+                g["decision"]["gates"].get("shadow_comparisons"),
+            "gates": {k: v for k, v in g["decision"]["gates"].items()
+                      if isinstance(v, bool)},
+            "eligible": g["decision"]["eligible"],
+            "promoted": g["promoted"],
+        } for g in gens],
+        "promotions": len(promoted),
+        "rollback": {
+            "rolled_back": bool(rb.get("rolled_back")),
+            "incident": rb.get("incident"),
+            "demoted": rb.get("demoted"),
+            "restored": rb.get("restored"),
+            "watch_incidents": (rb.get("watch") or {}).get("incidents"),
+        },
+        "flywheel_gate_ok": gate_ok,
+        "provenance_ok": prov_ok,
+        "rollback_ok": rollback_ok,
+        "deterministic_ok": det_ok,
+    }
+    ratios = [g["mean_ratio"] for g in out["generations"]]
+    print(f"# flywheel: {len(promoted)}/{generations} promotions, "
+          f"paired $/SLO ratios {ratios}, rollback_ok={rollback_ok}, "
+          f"deterministic_ok={det_ok}", file=sys.stderr)
+    return out
+
+
 def _mesh_virtual_fallback() -> dict | None:
     """Single-device host: measure the sharded path on an 8-device
     CPU-virtual mesh in a child process (labeled as virtual — validates
@@ -4132,6 +4273,14 @@ def main(argv=None) -> int:
                          "S=1 bitwise parity, CEM minted-dominance) and "
                          "print its JSON — the BENCH_r22 record path; "
                          "interpret-mode CI-sized off-TPU")
+    ap.add_argument("--flywheel-only", action="store_true",
+                    help="run ONLY the continual-learning flywheel "
+                         "stage (two seeded generations of mine → "
+                         "weighted distill → shadow-gated promote, the "
+                         "forced-divergence rollback, and the paired "
+                         "determinism rerun) and print its JSON — the "
+                         "BENCH_r23 record path; interpret-mode "
+                         "CI-sized off-TPU")
     ap.add_argument("--mega-phase", choices=("gate", "time"),
                     help="child phases of the isolated megakernel stage "
                          "(see _mega_subprocess): 'gate' prints the "
@@ -4258,6 +4407,17 @@ def main(argv=None) -> int:
             se["compile_report"] = compile_report()
         print(json.dumps(se))
         return 0 if se is not None else 1
+
+    if args.flywheel_only:
+        with _TRACER.span("bench.flywheel_stage"):
+            fl = bench_flywheel()
+        if fl is not None:
+            # Record-path stamp (see --perf-only): a raw redirect into
+            # BENCH_rNN.json arms the bench-diff flywheel gates.
+            fl["stage"] = "--flywheel-only"
+            fl["provenance"] = bench_provenance()
+        print(json.dumps(fl))
+        return 0 if fl is not None else 1
 
     if args.geo_only:
         with _TRACER.span("bench.geo_stage"):

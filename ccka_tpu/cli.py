@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -419,6 +420,66 @@ def _build_parser() -> argparse.ArgumentParser:
                        help="append search_iter/search_mint events to "
                             "this RunLog JSONL path")
     ssrch.add_argument("--seed", type=int, default=0)
+
+    sfw = sub.add_parser(
+        "flywheel",
+        help="continual-learning flywheel (train/flywheel.py): mine "
+             "ledger-attributed weakness cells, distill a weakness-"
+             "weighted challenger with checksummed provenance, promote "
+             "it through the gate battery, inspect the generation "
+             "inventory — the round-23 closed loop over the decision/"
+             "tournament/incident observatories")
+    sfw.add_argument("action",
+                     choices=("mine", "distill", "promote", "status"),
+                     help="mine: rank weakness cells from recorded "
+                          "ledgers; distill: mine + produce generation "
+                          "N's challenger + paired evaluation + gate "
+                          "decision; promote: apply a generation's "
+                          "recorded gate decision (atomic live swap, "
+                          "refused without passing gates); status: "
+                          "live pointer + generation inventory with "
+                          "per-generation provenance verification")
+    sfw.add_argument("--root", default="data/flywheel",
+                     help="flywheel artifact root (generations/, "
+                          "live.npz, live.json)")
+    sfw.add_argument("--decisions", default="",
+                     help="decision-ledger JSONL (obs/decisions) to "
+                          "mine; '' skips the surface")
+    sfw.add_argument("--tournament", default="",
+                     help="tournament board JSONL (obs/tournament) to "
+                          "mine; '' skips")
+    sfw.add_argument("--incidents", default="",
+                     help="incident JSONL (obs/incidents) to mine; "
+                          "'' skips")
+    sfw.add_argument("--minted-dir", default="",
+                     help="minted adversarial scenarios (digest-"
+                          "validated on load) to fold into the "
+                          "candidate cell set")
+    sfw.add_argument("--intensities", default="off,moderate",
+                     help="comma list of 'off' + config.FAULT_PRESETS "
+                          "names for the mined cell grid (unknown "
+                          "names rejected up front)")
+    sfw.add_argument("--top-k", type=int, default=4,
+                     help="ranked weakness cells to keep (default 4)")
+    sfw.add_argument("--generation", type=int, default=1,
+                     help="generation number to distill/promote")
+    sfw.add_argument("--teacher", default="mpc",
+                     help="factory planner protocol: mpc|mpc-rh "
+                          "(unknown teachers rejected up front)")
+    sfw.add_argument("--pairs-base", type=int, default=8)
+    sfw.add_argument("--pairs-max", type=int, default=32)
+    sfw.add_argument("--steps", type=int, default=48,
+                     help="ticks per curriculum pair window")
+    sfw.add_argument("--iters", type=int, default=240,
+                     help="distillation Adam iterations")
+    sfw.add_argument("--decision", default="",
+                     help="promote: gate-decision JSON path (default: "
+                          "the generation dir's decision.json written "
+                          "by `flywheel distill`)")
+    sfw.add_argument("--runlog", default="",
+                     help="append flywheel_* events to this RunLog "
+                          "JSONL path")
+    sfw.add_argument("--seed", type=int, default=0)
 
     ssc = sub.add_parser(
         "scenario-eval", help="per-family workload scoreboard "
@@ -1502,6 +1563,97 @@ def _cmd_scenario_search(cfg: FrameworkConfig, args) -> int:
     return 0
 
 
+def _cmd_flywheel(cfg: FrameworkConfig, args) -> int:
+    """`ccka flywheel` — the continual-learning loop's operator
+    surface. Unknown intensity/teacher names are rejected BEFORE any
+    ledger read or compilation (the round-10 up-front-guard
+    discipline); promote applies only a recorded gate decision and
+    REFUSES without one."""
+    from ccka_tpu.config import FAULT_PRESETS
+    from ccka_tpu.obs.runlog import RunLog
+    from ccka_tpu.train.factory import FACTORY_TEACHERS
+    from ccka_tpu.train.flywheel import Flywheel, promotion_gates
+
+    intensities = tuple(s.strip() for s in args.intensities.split(",")
+                        if s.strip())
+    try:
+        bad = [i for i in intensities
+               if i != "off" and i not in FAULT_PRESETS]
+        if bad or not intensities:
+            raise ValueError(
+                f"unknown fault intensities {bad or '<empty>'}; have "
+                f"{sorted(set(FAULT_PRESETS) | {'off'})}")
+        if args.teacher not in FACTORY_TEACHERS:
+            raise ValueError(f"unknown teacher {args.teacher!r}; "
+                             f"teachers: {sorted(FACTORY_TEACHERS)}")
+        fw = Flywheel(cfg, args.root, teacher=args.teacher,
+                      steps=args.steps, pairs_base=args.pairs_base,
+                      pairs_max=args.pairs_max, iterations=args.iters,
+                      seed=args.seed, minted_dir=args.minted_dir)
+    except ValueError as e:
+        raise SystemExit(f"ccka: {e}")
+
+    if args.action == "status":
+        print(json.dumps(fw.status(), indent=2, default=str))
+        return 0
+
+    runlog = RunLog(args.runlog or None, kind="flywheel", echo=False,
+                    meta={"action": args.action, "root": args.root})
+    fw.runlog = runlog
+    try:
+        if args.action == "mine":
+            cells = fw.mine(decisions_path=args.decisions,
+                            tournament_path=args.tournament,
+                            incidents_path=args.incidents,
+                            intensities=intensities, top_k=args.top_k)
+            print(json.dumps([{
+                "scenario": c.scenario, "intensity": c.intensity,
+                "workload_class": c.workload_class,
+                "tenant_regime": c.tenant_regime, "score": c.score,
+                "evidence": c.evidence} for c in cells], indent=2))
+        elif args.action == "distill":
+            from ccka_tpu.train.checkpoint import load_params_npz
+            cells = fw.mine(decisions_path=args.decisions,
+                            tournament_path=args.tournament,
+                            incidents_path=args.incidents,
+                            intensities=intensities, top_k=args.top_k)
+            rep = fw.distill(cells, generation=args.generation)
+            params, _meta = load_params_npz(rep["checkpoint"])
+            eval_rows = fw.evaluate(params, rep["produced"])
+            decision = promotion_gates(
+                eval_rows, provenance=rep["provenance"])
+            dec_path = os.path.join(fw.gen_dir(args.generation),
+                                    "decision.json")
+            with open(dec_path, "w", encoding="utf-8") as fh:
+                json.dump({"decision": decision, "eval": eval_rows},
+                          fh, indent=1, sort_keys=True)
+            print(json.dumps({
+                "generation": args.generation,
+                "checkpoint": rep["checkpoint"],
+                "checkpoint_digest": rep["checkpoint_digest"],
+                "curriculum": rep["curriculum"],
+                "eval": eval_rows, "decision": decision,
+                "decision_path": dec_path}, indent=2))
+        elif args.action == "promote":
+            dec_path = args.decision or os.path.join(
+                fw.gen_dir(args.generation), "decision.json")
+            if not os.path.exists(dec_path):
+                raise ValueError(
+                    f"no gate decision at {dec_path!r} — run `ccka "
+                    "flywheel distill` (or the FlywheelRunner) first; "
+                    "a promotion without recorded gate evidence is "
+                    "refused")
+            with open(dec_path, encoding="utf-8") as fh:
+                decision = json.load(fh)["decision"]
+            live = fw.promote(args.generation, decision)
+            print(json.dumps(live, indent=2, default=str))
+    except ValueError as e:
+        runlog.close(status="error")
+        raise SystemExit(f"ccka: {e}")
+    runlog.close()
+    return 0
+
+
 def _cmd_perf(cfg: FrameworkConfig, args) -> int:
     """`ccka perf` — the device-time observatory's interactive probe:
     a small packed generate→rollout→summary pipeline per requested
@@ -2098,6 +2250,8 @@ def main(argv: list[str] | None = None) -> int:
             return 0
         if args.command == "scenario-search":
             return _cmd_scenario_search(cfg, args)
+        if args.command == "flywheel":
+            return _cmd_flywheel(cfg, args)
         if args.command == "scenario-eval":
             from ccka_tpu.workloads.scoreboard import workload_scoreboard
             try:

@@ -236,6 +236,12 @@ def _extract_metrics(doc: dict) -> dict:
           else doc.get("scenario_search"))
     if isinstance(se, dict):
         out.update(_extract_search(se))
+    # Round-23 continual-learning flywheel stage (stage record or
+    # nested "flywheel").
+    fl = (doc if doc.get("stage") == "--flywheel-only"
+          else doc.get("flywheel"))
+    if isinstance(fl, dict):
+        out.update(_extract_flywheel(fl))
     return out
 
 
@@ -825,9 +831,68 @@ def _extract_search(se: dict) -> dict:
     return out
 
 
+def _extract_flywheel(fl: dict) -> dict:
+    """The round-23 flywheel invariants a record states about itself
+    (ISSUE 20 satellite): every recorded promotion carries PASSING
+    gate evidence (a promoted generation with missing/failed gates is
+    how an ungated swap would look), the paired mean $/SLO-hr ratio on
+    the mined weakness cells is strictly < 1, no workload class
+    regressed beyond the class tolerance, the provenance/rollback/
+    determinism flags are PRESENT and true (absent is partial, not
+    green — the factory/search discipline)."""
+    out: dict = {"flywheel_partial": [], "flywheel_bad_promotions": [],
+                 "flywheel_class_regressions": []}
+    gens = fl.get("generations")
+    if not isinstance(gens, list) or not gens:
+        out["flywheel_partial"].append("no generation records")
+        gens = []
+    out["flywheel_promotions"] = int(fl.get("promotions") or 0)
+    for g in gens:
+        if not isinstance(g, dict):
+            out["flywheel_partial"].append("malformed generation row")
+            continue
+        tag = f"gen-{g.get('generation', '?')}"
+        if g.get("promoted"):
+            gates = g.get("gates")
+            ratio = g.get("mean_ratio")
+            if not g.get("eligible") or not isinstance(gates, dict) \
+                    or not gates or not all(gates.values()):
+                out["flywheel_bad_promotions"].append(
+                    f"{tag} promoted without passing gate evidence")
+            if not isinstance(ratio, (int, float)) or ratio >= 1.0:
+                out["flywheel_bad_promotions"].append(
+                    f"{tag} promoted without a strict paired $/SLO-hr "
+                    f"improvement on its mined cells (ratio {ratio})")
+        worst = g.get("worst_class_rel_delta")
+        if isinstance(worst, dict):
+            for cls, v in sorted(worst.items()):
+                if isinstance(v, (int, float)) \
+                        and v > _FLYWHEEL_CLASS_TOL:
+                    out["flywheel_class_regressions"].append(
+                        f"{tag} regressed workload class {cls} by "
+                        f"{v:+.4f} (tolerance {_FLYWHEEL_CLASS_TOL})")
+        elif g.get("promoted"):
+            out["flywheel_partial"].append(
+                f"{tag} promoted without per-class regression deltas")
+    for key, outk in (("provenance_ok", "flywheel_provenance_ok"),
+                      ("rollback_ok", "flywheel_rollback_ok"),
+                      ("deterministic_ok", "flywheel_deterministic_ok"),
+                      ("flywheel_gate_ok", "flywheel_gate_ok")):
+        if fl.get(key) is None:
+            out["flywheel_partial"].append(f"missing the {key} flag")
+        else:
+            out[outk] = bool(fl[key])
+    return out
+
+
 # Round-22 traced scenario-axis gate: the ISSUE 19 acceptance floor on
 # traced-axis scenario-cells/sec over the per-config recompile loop.
 _SEARCH_SPEEDUP_FLOOR = 10.0
+
+# Round-23 flywheel gate: per-workload-class relative regression
+# tolerance a promoted challenger must stay inside (stdlib mirror of
+# train/flywheel.CLASS_TOLERANCE — this module must run jax-free).
+_FLYWHEEL_CLASS_TOL = 0.05
 
 # A single-core virtual host cannot overlap generation with the kernel
 # (there is no second core to run it on): its pipelined drive is held
@@ -1297,6 +1362,45 @@ def bench_diff(history: dict, *,
                 "detail": "minted worst case no longer strictly "
                           "exceeds the policy's worst hand-named "
                           "scenario cell"})
+
+        # Round-23 continual-learning flywheel invariants: a promotion
+        # recorded without passing gate evidence, a missing/partial
+        # provenance record, a workload class regressed beyond
+        # tolerance, a broken rollback or a non-deterministic seeded
+        # rerun. Partial records are regressions.
+        for what in rec.get("flywheel_partial", []):
+            regressions.append({
+                "kind": "flywheel_invariant", "round": rnd,
+                "detail": f"partial flywheel record: {what}"})
+        for what in rec.get("flywheel_bad_promotions", []):
+            regressions.append({
+                "kind": "flywheel_invariant", "round": rnd,
+                "detail": what})
+        for what in rec.get("flywheel_class_regressions", []):
+            regressions.append({
+                "kind": "flywheel_invariant", "round": rnd,
+                "detail": what})
+        if rec.get("flywheel_gate_ok") is False:
+            regressions.append({
+                "kind": "flywheel_invariant", "round": rnd,
+                "detail": "the flywheel gate battery no longer passes "
+                          "on the recorded generations"})
+        if rec.get("flywheel_provenance_ok") is False:
+            regressions.append({
+                "kind": "flywheel_invariant", "round": rnd,
+                "detail": "a generation's checksummed provenance "
+                          "record failed verification"})
+        if rec.get("flywheel_rollback_ok") is False:
+            regressions.append({
+                "kind": "flywheel_invariant", "round": rnd,
+                "detail": "post-promotion divergence rollback did not "
+                          "restore the parent checkpoint bitwise"})
+        if rec.get("flywheel_deterministic_ok") is False:
+            regressions.append({
+                "kind": "flywheel_invariant", "round": rnd,
+                "detail": "the seeded flywheel rerun no longer "
+                          "reproduces the same curriculum and "
+                          "checkpoint digests"})
     return {"comparisons": comparisons, "regressions": regressions,
             "ok": not regressions}
 

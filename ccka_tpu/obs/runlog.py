@@ -65,6 +65,13 @@ RUNLOG_EVENTS = frozenset({
     # elite stats) and one per minted worst-case scenario (name,
     # params digest, objective value).
     "search_iter", "search_mint",
+    # Continual-learning flywheel (`train/flywheel.py`, round 23): one
+    # record per stage of a generation — mined weakness cells, the
+    # distilled challenger (curriculum + checkpoint digests), the gate
+    # decision, the atomic promotion swap, and the incident-triggered
+    # rollback to the parent digest.
+    "flywheel_mine", "flywheel_distill", "flywheel_gate",
+    "flywheel_promote", "flywheel_rollback",
 })
 
 

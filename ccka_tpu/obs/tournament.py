@@ -145,9 +145,20 @@ register_candidate("carbon-floor", _carbon(min_weight=0.2),
 register_candidate("carbon-greedy",
                    _carbon(sharpness=18.0, min_weight=0.01),
                    "carbon variant: sharp + near-zero floor")
+def _flywheel_challenger(cfg: FrameworkConfig):
+    from ccka_tpu.train.flywheel import challenger_backend
+    return challenger_backend(cfg)
+
+
 register_candidate("student", _student,
                    "distilled flagship student (round-17 factory; "
                    "needs the committed checkpoint)")
+register_candidate("flywheel-challenger", _flywheel_challenger,
+                   "the continual-learning flywheel's slotted "
+                   "challenger checkpoint (round 23; set via "
+                   "train.flywheel.set_challenger_checkpoint — the "
+                   "FlywheelRunner slots each generation before its "
+                   "shadow run)")
 
 
 class OverProvisionPolicy:

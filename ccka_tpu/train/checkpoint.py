@@ -9,12 +9,22 @@ with step-numbered directories and latest-resume.
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Any
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+# The meta key carrying the params content digest (round 23). Written by
+# save_params_npz on every new checkpoint and VERIFIED by load_params_npz:
+# the flywheel's promotion swap (`train/flywheel.py`) moves live policy
+# checkpoints around on disk, which turns a stale or hand-edited .npz
+# from a curiosity into a production hazard. Checkpoints saved before
+# this key existed (the committed flagship files) carry no digest and
+# load unchecked — absence is legacy, mismatch is refusal.
+PARAMS_DIGEST_KEY = "params_sha256"
 
 
 def save_state(path: str, state: Any, *, step: int | None = None) -> str:
@@ -44,6 +54,34 @@ def load_state(path: str, *, step: int | None = None,
     return restored
 
 
+def _flat_params(params: Any) -> dict:
+    """'/'-joined tree-path key -> host ndarray (the npz layout)."""
+    flat = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(_path_part(p) for p in kp)
+        flat[key] = np.asarray(jax.device_get(leaf))
+    return flat
+
+
+def params_digest(params: Any) -> str:
+    """Content sha256 of a params pytree: every leaf's tree path, dtype,
+    shape and C-order bytes, in sorted key order. Identical trees hash
+    identically whether the leaves are jax or numpy arrays, before or
+    after an npz round trip — the identity the flywheel's promotion/
+    rollback swap verifies bitwise. A nested dict and its '/'-joined
+    flat layout hash identically (both flatten to the same tree
+    paths), so the digest survives the npz round trip."""
+    flat = _flat_params(params)
+    h = hashlib.sha256()
+    for key in sorted(flat):
+        arr = np.ascontiguousarray(np.asarray(flat[key]))
+        h.update(key.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
 def save_params_npz(path: str, params: Any, *,
                     meta: dict | None = None) -> str:
     """Single-file pytree snapshot (np.savez) for params that ship in-repo.
@@ -52,17 +90,17 @@ def save_params_npz(path: str, params: Any, *,
     flagship policy checkpoint is committed to git and loaded by bench.py —
     one small reviewable file beats a directory tree there. Keys are
     '/'-joined tree paths; ``meta`` (JSON-serializable) rides along under
-    ``__meta__`` for provenance (training config, eval scores).
+    ``__meta__`` for provenance (training config, eval scores), and always
+    carries :data:`PARAMS_DIGEST_KEY` — the content digest
+    :func:`load_params_npz` re-verifies.
     """
     import json as _json
 
-    flat = {}
-    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
-        key = "/".join(_path_part(p) for p in kp)
-        flat[key] = np.asarray(jax.device_get(leaf))
-    if meta is not None:
-        flat["__meta__"] = np.frombuffer(
-            _json.dumps(meta).encode(), dtype=np.uint8)
+    flat = _flat_params(params)
+    meta = dict(meta or {})
+    meta[PARAMS_DIGEST_KEY] = params_digest(flat)
+    flat["__meta__"] = np.frombuffer(
+        _json.dumps(meta).encode(), dtype=np.uint8)
     path = os.path.abspath(path)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     np.savez(path, **flat)
@@ -70,21 +108,38 @@ def save_params_npz(path: str, params: Any, *,
 
 
 def load_params_npz(path: str) -> tuple[Any, dict]:
-    """Inverse of :func:`save_params_npz`: (nested-dict params, meta)."""
+    """Inverse of :func:`save_params_npz`: (nested-dict params, meta).
+
+    When the meta carries :data:`PARAMS_DIGEST_KEY` the loaded leaves are
+    re-hashed and a mismatch REFUSES the checkpoint (ValueError): a
+    tampered or bit-rotted file must not load as a policy. Digest-less
+    files (saved before round 23 — the committed flagship checkpoints)
+    load unchecked; absence is legacy, not tamper."""
     import json as _json
 
     with np.load(path) as z:
         meta = {}
+        flat: dict = {}
         tree: dict = {}
         for key in z.files:
             if key == "__meta__":
                 meta = _json.loads(bytes(z[key]).decode())
                 continue
+            flat[key] = z[key]
             node = tree
             parts = key.split("/")
             for p in parts[:-1]:
                 node = node.setdefault(p, {})
             node[parts[-1]] = z[key]
+    stored = meta.get(PARAMS_DIGEST_KEY)
+    if stored:
+        got = params_digest(flat)
+        if got != stored:
+            raise ValueError(
+                f"checkpoint {path!r}: params digest mismatch — meta "
+                f"says {stored[:12]}…, the stored arrays hash to "
+                f"{got[:12]}…. The file was modified after saving; "
+                "refusing a tampered checkpoint.")
     return tree, meta
 
 

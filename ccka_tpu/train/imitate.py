@@ -109,16 +109,24 @@ def collect_dataset(cfg: FrameworkConfig, teacher: PolicyBackend,
 def imitate(cfg: FrameworkConfig, teacher: PolicyBackend, source, *,
             iterations: int = 2000, minibatch: int = 4096,
             learning_rate: float = 1e-3, seed: int = 0,
-            dataset: ImitationBatch | None = None):
+            dataset: ImitationBatch | None = None,
+            init_params=None):
     """Distill ``teacher`` into a fresh ActorCritic. Returns params ready
     for PPOBackend / PPO fine-tuning (actor at the teacher, critic at the
-    teacher's value surface)."""
+    teacher's value surface).
+
+    ``init_params`` warm-starts from an existing checkpoint instead of a
+    fresh init — the flywheel's re-distillation path (round 23): a
+    challenger that starts at its parent and trains further on the
+    weakness-weighted curriculum inherits everything the parent already
+    knows about the cells the curriculum does NOT emphasize."""
     data = dataset if dataset is not None else collect_dataset(
         cfg, teacher, source, seed=seed)
     net = ActorCritic(act_dim=latent_dim(cfg.cluster),
                       init_log_std=cfg.train.init_log_std)
     key = jax.random.key(seed + 2)
-    params = net.init(key, data.obs[0])
+    params = (init_params if init_params is not None
+              else net.init(key, data.obs[0]))
     opt = optax.adam(learning_rate)
     opt_state = opt.init(params)
     n = data.obs.shape[0]

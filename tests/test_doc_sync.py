@@ -1298,3 +1298,84 @@ class TestSearchClaims:
                        "load_minted_scenarios", "_SEARCH_SPEEDUP_FLOOR",
                        "tests/test_search.py"):
             assert phrase in flat, phrase
+
+class TestFlywheelClaims:
+    """Round 23's continual-learning flywheel (ISSUE 20 docs
+    satellite): README's "Continual-learning flywheel" claims are
+    PARSED against the BASELINE round23 record, not hand-synced."""
+
+    def test_round23_record_is_self_describing(self, baseline):
+        r23 = baseline["published"]["round23"]
+        pe = r23["promotion_evidence"]
+        assert pe["pass"] is True
+        assert pe["promotions"] == 2
+        assert all(r < 1.0 for r in pe["mean_ratios"])
+        gens = r23["flywheel_stage"]["generations"]
+        assert [g["mean_ratio"] for g in gens] == pe["mean_ratios"]
+        assert gens[0]["incumbent"] == "rule"
+        assert gens[1]["incumbent"] == gens[0]["incumbent"] or \
+            gens[1]["incumbent"].startswith("gen-")
+        for g in gens:
+            assert g["promoted"] is True
+            assert all(v <= 0.05
+                       for v in g["worst_class_rel_delta"].values())
+        rb = r23["rollback_evidence"]
+        assert rb["bitwise"] is True
+        assert rb["trigger"] == "policy_divergence"
+        assert rb["restored"] == gens[0]["incumbent"] or \
+            rb["restored"].startswith("gen-")
+        assert len(rb["restored_digest"]) == 64
+        assert r23["provenance_evidence"]["pass"] is True
+        assert r23["determinism_evidence"]["pass"] is True
+
+    def test_readme_ratio_claims(self, readme, baseline):
+        pe = baseline["published"]["round23"]["promotion_evidence"]
+        m = re.search(
+            r"ratios\s+([\d.]+)\s+\(gen-1\s+vs\s+the\s+rule\s+"
+            r"incumbent\)\s+and\s+([\d.]+)\s+\(gen-2\s+vs\s+its\s+own\s+"
+            r"gen-1\s+parent\)", " ".join(readme.split()))
+        assert m, ("README's flywheel ratio claim no longer states the "
+                   "numbers in the pinned form — update the claim AND "
+                   "this regex together")
+        g1, g2 = (float(v) for v in m.groups())
+        assert abs(g1 - pe["mean_ratios"][0]) < 5e-7
+        assert abs(g2 - pe["mean_ratios"][1]) < 5e-7
+        assert g1 < 1.0 and g2 < 1.0
+        assert f"promotes {pe['promotions']}/2 gate-passing " \
+            "generations" in " ".join(readme.split())
+
+    def test_readme_rollback_claim(self, readme, baseline):
+        rb = baseline["published"]["round23"]["rollback_evidence"]
+        m = re.search(
+            r"demotes\s+gen-002\s+and\s+restores\s+gen-001\s+bitwise\s+"
+            r"\(digest\s+([0-9a-f]{12})…\)", " ".join(readme.split()))
+        assert m, "README's flywheel rollback claim lost its pinned form"
+        assert rb["restored_digest"].startswith(m.group(1))
+        assert rb["demoted"] == "gen-002"
+        assert rb["restored"] == "gen-001"
+
+    def test_readme_names_the_surfaces(self, readme):
+        flat = " ".join(readme.split())
+        for needle in ("mine_weakness_cells", "curriculum_from_cells",
+                       "curriculum_digest", "promotion_gates",
+                       "flywheel-challenger",
+                       "ccka flywheel mine|distill|promote| status",
+                       "BENCH_r23.json", "policy_divergence",
+                       "train/checkpoint.py"):
+            assert needle in flat, needle
+
+    def test_architecture_has_section_25(self):
+        arch = _read("ARCHITECTURE.md")
+        assert "## 25. Continual-learning flywheel" in arch
+        flat = " ".join(arch.split())
+        for phrase in ("mine_weakness_cells", "CLASS_SCENARIOS",
+                       "MINTED_SCORE_BONUS", "curriculum_from_cells",
+                       "curriculum_digest", "write_provenance",
+                       "load_provenance", "params_sha256",
+                       "promotion_gates", "cells_improved",
+                       "class_regression_ok", "CLASS_TOLERANCE",
+                       "shadow_ok", "set_challenger_checkpoint",
+                       "flywheel-challenger", "FlywheelRunner",
+                       "policy_divergence", "_FLYWHEEL_CLASS_TOL",
+                       "tests/test_flywheel.py"):
+            assert phrase in flat, phrase

@@ -1,0 +1,626 @@
+"""Continual-learning flywheel (ISSUE 20): ledger mining determinism,
+curriculum allocation, checksummed provenance + checkpoint tamper
+refusal, the promotion gate battery, the refusal paths the satellite
+names (gate failure leaves the incumbent untouched, tampered lineage
+refused, rollback restores the parent digest bitwise, seeded reruns
+reproduce the same digests), the `ccka flywheel` operator surface, and
+the bench-history flywheel invariant gates (an injected bad record
+exits 1, the committed history stays clean).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from ccka_tpu.config import default_config
+from ccka_tpu.train import flywheel as fw_mod
+from ccka_tpu.train.checkpoint import (PARAMS_DIGEST_KEY, load_params_npz,
+                                       params_digest, save_params_npz)
+from ccka_tpu.train.flywheel import (Flywheel, load_provenance,
+                                     promotion_gates, write_provenance)
+from ccka_tpu.train.mining import (WeaknessCell, curriculum_digest,
+                                   curriculum_from_cells,
+                                   mine_weakness_cells)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# One tiny shared distill geometry: pairs_base == pairs_max keeps every
+# curriculum cell on ONE compiled (pairs, steps) geometry so the module
+# compiles the factory kernel once.
+TINY = dict(steps=32, block_T=32, t_chunk=32, pairs_base=2, pairs_max=2,
+            iterations=40, seed=7)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return default_config()
+
+
+# -- synthetic ledgers for the mine stage ------------------------------------
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w", encoding="utf-8") as fh:
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def ledgers(tmp_path_factory):
+    """Hand-built decision/tournament/incident JSONLs with the exact
+    row shapes the live observatories write — inference pressure is
+    made dominant so the ranking is predictable."""
+    d = tmp_path_factory.mktemp("ledgers")
+    decisions = _write_jsonl(d / "decisions.jsonl", [
+        {"t": t,
+         "objective": {"total": 1.0,
+                       "shares": {"cost": 0.1, "carbon": 0.1,
+                                  "slo_pending": 0.3,
+                                  "slo_violation": 0.5,
+                                  "migration": 0.0},
+                       "by_class": {"class0": 0.8, "class1": 0.2}},
+         "shadow": {"diverged": t % 2 == 0,
+                    "objective": {"total": 0.8}},
+         "exo": {"is_peak": True}}
+        for t in range(8)])
+    tournament = _write_jsonl(d / "tournament.jsonl", [
+        {"kind": "board", "t": 7, "window_ticks": 8, "policy": "rule",
+         "board": {"carbon": {
+             "win_rate": 0.25, "wins": 2, "comparisons": 8,
+             "classes": {"inference": {"win_rate": 0.75,
+                                       "comparisons": 8},
+                         "batch": {"win_rate": 0.25,
+                                   "comparisons": 8},
+                         "background": {"win_rate": 0.0,
+                                        "comparisons": 8}}}}}])
+    incidents = _write_jsonl(d / "incidents.jsonl", [
+        {"kind": "incident", "id": 1, "t": 5, "trigger": "slo_burn"}])
+    return {"decisions": decisions, "tournament": tournament,
+            "incidents": incidents}
+
+
+class TestMining:
+    def test_empty_evidence_returns_library_floor(self):
+        cells = mine_weakness_cells(top_k=6)
+        assert cells, "a cold-start flywheel must still get a curriculum"
+        assert all(isinstance(c, WeaknessCell) for c in cells)
+        assert all(c.intensity in ("off", "moderate") for c in cells)
+        assert cells == mine_weakness_cells(top_k=6)
+
+    def test_mine_is_deterministic_over_files(self, ledgers):
+        kw = dict(decisions_path=ledgers["decisions"],
+                  tournament_path=ledgers["tournament"],
+                  incidents_path=ledgers["incidents"], top_k=8)
+        a, b = mine_weakness_cells(**kw), mine_weakness_cells(**kw)
+        assert a == b
+        assert [c.score for c in a] == sorted(
+            (c.score for c in a), reverse=True)
+
+    def test_evidence_shapes_the_ranking(self, ledgers):
+        """The synthetic ledgers put their pressure on inference (0.5
+        violation share + a 0.75 tournament loss rate), so inference
+        cells must top the board, stamped with the peak regime the
+        shadow regret recorded and the incident urgency multiplier."""
+        cells = mine_weakness_cells(
+            decisions_path=ledgers["decisions"],
+            tournament_path=ledgers["tournament"],
+            incidents_path=ledgers["incidents"], top_k=4)
+        assert cells[0].workload_class == "inference"
+        assert cells[0].tenant_regime == "peak"
+        assert cells[0].evidence["urgency"] > 1.0
+        assert cells[0].evidence["tournament_loss_rate"] == 0.75
+
+    def test_curriculum_allocation_monotone_and_bounded(self):
+        cells = [
+            WeaknessCell("flash-crowd", "off", "inference", "peak", 3.0),
+            WeaknessCell("mixed", "off", "background", "peak", 1.0),
+            WeaknessCell("flash-crowd", "off", "batch", "peak", 1.5),
+        ]
+        cur = curriculum_from_cells(cells, pairs_base=4, pairs_max=16)
+        by_sc = {r["scenario"]: r for r in cur}
+        assert by_sc["flash-crowd"]["score"] == 4.5  # merged duplicate
+        assert sorted(by_sc["flash-crowd"]["classes"]) == ["batch",
+                                                           "inference"]
+        assert by_sc["flash-crowd"]["pairs"] == 16   # top score → cap
+        assert 4 <= by_sc["mixed"]["pairs"] < by_sc["flash-crowd"]["pairs"]
+        with pytest.raises(ValueError, match="empty weakness-cell"):
+            curriculum_from_cells([])
+
+    def test_curriculum_digest_pins_content(self):
+        cur = curriculum_from_cells(
+            [WeaknessCell("mixed", "off", "background", "peak", 1.0)])
+        d1 = curriculum_digest(cur)
+        assert d1 == curriculum_digest(json.loads(json.dumps(cur)))
+        bumped = [dict(cur[0], pairs=cur[0]["pairs"] + 1)]
+        assert curriculum_digest(bumped) != d1
+
+
+class TestCheckpointDigest:
+    """The satellite fix: `load_params_npz` re-derives the params
+    digest and REFUSES a tampered checkpoint."""
+
+    def _params(self):
+        rng = np.random.default_rng(3)
+        return {"actor": {"w": rng.normal(size=(4, 3)).astype(np.float32),
+                          "b": np.zeros(3, np.float32)},
+                "critic": {"w": rng.normal(size=(4,)).astype(np.float32)}}
+
+    def test_round_trip_stamps_and_verifies(self, tmp_path):
+        path = str(tmp_path / "ck.npz")
+        save_params_npz(path, self._params(), meta={"tag": "t"})
+        tree, meta = load_params_npz(path)
+        assert meta[PARAMS_DIGEST_KEY] == params_digest(tree)
+        assert meta["tag"] == "t"
+
+    def test_tampered_params_refused(self, tmp_path):
+        path = str(tmp_path / "ck.npz")
+        save_params_npz(path, self._params(), meta={})
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k].copy() for k in z.files}
+        key = next(k for k in arrays if k != "__meta__")
+        arrays[key] = arrays[key] + 1.0  # the tamper
+        np.savez(path, **arrays)
+        with pytest.raises(ValueError, match="digest"):
+            load_params_npz(path)
+
+    def test_nested_and_flat_trees_hash_identically(self):
+        p = self._params()
+        flat = {"actor/w": p["actor"]["w"], "actor/b": p["actor"]["b"],
+                "critic/w": p["critic"]["w"]}
+        assert params_digest(p) == params_digest(flat)
+
+
+class TestProvenance:
+    def _record(self):
+        cur = curriculum_from_cells(
+            [WeaknessCell("mixed", "off", "background", "peak", 1.0)])
+        return {"generation": 1,
+                "parent": {"name": "rule", "digest": ""},
+                "curriculum": cur,
+                "curriculum_digest": curriculum_digest(cur),
+                "ledger_window": {"rows": 8},
+                "seeds": {"base": 7},
+                "checkpoint": "challenger.npz",
+                "checkpoint_digest": "ab" * 32}
+
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "prov.json")
+        write_provenance(path, self._record())
+        rec = load_provenance(path)
+        assert rec["generation"] == 1 and rec["record_digest"]
+
+    def test_tampered_record_refused(self, tmp_path):
+        path = str(tmp_path / "prov.json")
+        write_provenance(path, self._record())
+        doc = json.load(open(path))
+        doc["checkpoint_digest"] = "ff" * 32  # edit after signing
+        json.dump(doc, open(path, "w"))
+        with pytest.raises(ValueError, match="tampered|digest mismatch"):
+            load_provenance(path)
+
+    def test_missing_required_field_refused(self, tmp_path):
+        path = str(tmp_path / "prov.json")
+        rec = self._record()
+        del rec["seeds"]
+        write_provenance(path, rec)  # digest-valid but partial
+        with pytest.raises(ValueError, match="missing required"):
+            load_provenance(path)
+
+    def test_curriculum_digest_mismatch_refused(self, tmp_path):
+        path = str(tmp_path / "prov.json")
+        rec = self._record()
+        rec["curriculum_digest"] = "00" * 32
+        write_provenance(path, rec)  # signed over the WRONG pin
+        with pytest.raises(ValueError, match="curriculum digest"):
+            load_provenance(path)
+
+
+class TestPromotionGates:
+    """The gate battery as pure arithmetic — every refusal axis."""
+
+    def _rows(self, ratio=0.95, rel=0.0):
+        return [{"scenario": "mixed", "intensity": "off", "pairs": 4,
+                 "challenger_vs_incumbent_usd_per_slo_hour": ratio,
+                 "class_deltas": {
+                     "inference": {"rel_delta": rel},
+                     "batch": {"rel_delta": 0.0},
+                     "background": {"rel_delta": 0.0}}}]
+
+    def _prov(self):
+        return {"record_digest": "d" * 64}
+
+    def test_eligible_on_clean_evidence(self):
+        d = promotion_gates(self._rows(), provenance=self._prov())
+        assert d["eligible"], d
+        assert d["gates"]["mean_ratio"] == 0.95
+
+    def test_no_improvement_refused(self):
+        d = promotion_gates(self._rows(ratio=1.01),
+                            provenance=self._prov())
+        assert not d["eligible"] and not d["gates"]["cells_improved"]
+
+    def test_class_regression_beyond_tolerance_refused(self):
+        d = promotion_gates(self._rows(rel=0.08),
+                            provenance=self._prov())
+        assert not d["eligible"]
+        assert not d["gates"]["class_regression_ok"]
+        assert d["gates"]["worst_class_rel_delta"]["inference"] == 0.08
+
+    def test_empty_evidence_refused(self):
+        assert not promotion_gates([], provenance=self._prov())["eligible"]
+
+    def test_missing_provenance_refused(self):
+        assert not promotion_gates(self._rows())["eligible"]
+
+    def test_history_regressions_refuse(self):
+        bad = [{"kind": "recovery_invariant", "round": 9}]
+        d = promotion_gates(self._rows(), provenance=self._prov(),
+                            history_regressions=bad)
+        assert not d["eligible"] and not d["gates"]["history_ok"]
+        clean = [{"kind": "headline", "round": 9}]  # trend, not a gate
+        assert promotion_gates(self._rows(), provenance=self._prov(),
+                               history_regressions=clean)["eligible"]
+
+    def _board(self, usd=0.0, slo=0.0, rate=0.0, comps=16):
+        return {"win_rate": rate, "comparisons": comps,
+                "classes": {"inference": {"comparisons": comps,
+                                          "usd_delta": usd,
+                                          "slo_delta": slo}}}
+
+    def test_shadow_outcomes(self):
+        cases = [
+            (self._board(comps=0), "no_comparisons", False),
+            (self._board(usd=-0.5), "class_harm", False),
+            (self._board(usd=1e-7), "non_inferior", True),
+            (self._board(usd=0.5, rate=0.8), "win", True),
+            (self._board(usd=0.5, rate=0.2), "material_loss", False),
+        ]
+        for board, outcome, ok in cases:
+            d = promotion_gates(self._rows(), provenance=self._prov(),
+                                shadow_board=board)
+            assert d["gates"]["shadow_outcome"] == outcome
+            assert d["gates"]["shadow_ok"] is ok
+            assert d["eligible"] is ok
+
+
+# -- the artifact loop (one tiny real distill, shared) -----------------------
+
+
+@pytest.fixture(scope="module")
+def arc(cfg, tmp_path_factory):
+    """Generation 1 mined + distilled once at the TINY geometry; the
+    mutation tests below each copy this root before touching it."""
+    root = str(tmp_path_factory.mktemp("fw"))
+    fw = Flywheel(cfg, root, **TINY)
+    cells = fw.mine(top_k=2)
+    rep = fw.distill(cells, generation=1,
+                     ledger_window={"rows": 0, "seed": TINY["seed"]})
+    params, _meta = load_params_npz(rep["checkpoint"])
+    eval_rows = fw.evaluate(params, rep["produced"])
+    decision = promotion_gates(eval_rows, provenance=rep["provenance"])
+    return {"root": root, "cells": cells, "rep": rep,
+            "eval": eval_rows, "decision": decision}
+
+
+def _copy_root(arc, tmp_path, cfg):
+    root = str(tmp_path / "fw")
+    shutil.copytree(arc["root"], root)
+    return Flywheel(cfg, root, **TINY)
+
+
+class TestFlywheelArtifacts:
+    def test_distill_writes_verified_provenance(self, arc, cfg):
+        fw = Flywheel(cfg, arc["root"], **TINY)
+        st = fw.status()
+        assert st["incumbent"] == "rule"
+        assert st["generations"][0]["provenance"] == "verified"
+        prov = arc["rep"]["provenance"]
+        assert prov["checkpoint_digest"] == arc["rep"]["checkpoint_digest"]
+        assert prov["curriculum_digest"] == curriculum_digest(
+            arc["rep"]["curriculum"])
+
+    def test_challenger_beats_rule_on_its_cells(self, arc):
+        """The superiority evidence the gate battery rides: even TINY
+        distillation beats the hand rule on the mined cells."""
+        assert arc["decision"]["eligible"], arc["decision"]
+        assert arc["decision"]["gates"]["mean_ratio"] < 1.0
+
+    def test_gate_failure_leaves_incumbent_untouched(self, arc, cfg,
+                                                     tmp_path):
+        fw = _copy_root(arc, tmp_path, cfg)
+        bad = {"eligible": False,
+               "gates": {"cells_improved": False}}
+        with pytest.raises(ValueError, match="promotion refused"):
+            fw.promote(1, bad)
+        assert fw.incumbent() == ("rule", None)
+        assert not os.path.exists(fw.live_npz)
+        assert not os.path.exists(fw.live_json)
+
+    def test_tampered_provenance_refuses_promotion(self, arc, cfg,
+                                                   tmp_path):
+        fw = _copy_root(arc, tmp_path, cfg)
+        prov_path = os.path.join(fw.gen_dir(1), "provenance.json")
+        doc = json.load(open(prov_path))
+        doc["checkpoint_digest"] = "00" * 32
+        json.dump(doc, open(prov_path, "w"))
+        with pytest.raises(ValueError, match="tampered|digest mismatch"):
+            fw.promote(1, arc["decision"])
+        assert fw.incumbent() == ("rule", None)
+
+    def test_tampered_checkpoint_refuses_promotion(self, arc, cfg,
+                                                   tmp_path):
+        fw = _copy_root(arc, tmp_path, cfg)
+        ckpt = os.path.join(fw.gen_dir(1), "challenger.npz")
+        with np.load(ckpt, allow_pickle=False) as z:
+            arrays = {k: z[k].copy() for k in z.files}
+        key = next(k for k in sorted(arrays) if k != "__meta__")
+        arrays[key] = arrays[key] + 0.5
+        np.savez(ckpt, **arrays)
+        with pytest.raises(ValueError, match="digest"):
+            fw.promote(1, arc["decision"])
+        assert fw.incumbent() == ("rule", None)
+
+    def test_promote_swaps_live_and_rollback_restores(self, arc, cfg,
+                                                      tmp_path):
+        fw = _copy_root(arc, tmp_path, cfg)
+        live = fw.promote(1, arc["decision"])
+        assert live["name"] == "gen-001"
+        name, params = fw.incumbent()
+        assert name == "gen-001"
+        assert params_digest(params) == arc["rep"]["checkpoint_digest"]
+        # A swapped-in stray live file is refused, not adopted.
+        doc = json.load(open(fw.live_json))
+        doc["digest"] = "11" * 32
+        json.dump(doc, open(fw.live_json, "w"))
+        with pytest.raises(ValueError, match="swapped outside"):
+            fw.incumbent()
+        json.dump(live, open(fw.live_json, "w"))
+        # Rollback: gen-001's parent is the rule profile → demotion
+        # clears the live checkpoint entirely.
+        new_live = fw.rollback(incident={"id": 1, "t": 3})
+        assert new_live["name"] == "rule"
+        assert new_live["rolled_back_from"]["name"] == "gen-001"
+        assert fw.incumbent() == ("rule", None)
+        with pytest.raises(ValueError, match="nothing is promoted"):
+            fw.rollback()
+
+    @pytest.mark.slow  # ISSUE 16 lane-time rule: a second full distill
+    # on top of the module fixture's; the bitwise-restore contract is
+    # re-proven by the slow runner e2e and the record's rollback_ok
+    # bench-diff gate, and the fast lane keeps the rule-parent rollback.
+    def test_second_generation_rollback_is_bitwise(self, arc, cfg,
+                                                   tmp_path):
+        """The satellite's rollback contract at full strength: promote
+        gen-1, distill + promote gen-2 warm-started ON gen-1, then roll
+        back — the restored live params must hash to EXACTLY the parent
+        digest the gen-2 promotion recorded."""
+        fw = _copy_root(arc, tmp_path, cfg)
+        fw.promote(1, arc["decision"])
+        rep2 = fw.distill(arc["cells"], generation=2,
+                          ledger_window={"rows": 0})
+        assert rep2["parent"]["name"] == "gen-001"
+        assert rep2["parent"]["digest"] == arc["rep"]["checkpoint_digest"]
+        p2, _ = load_params_npz(rep2["checkpoint"])
+        rows2 = fw.evaluate(p2, rep2["produced"])
+        d2 = promotion_gates(rows2, provenance=rep2["provenance"])
+        live2 = fw.promote(2, dict(d2, eligible=True))
+        assert live2["parent"]["digest"] == arc["rep"]["checkpoint_digest"]
+        restored = fw.rollback(incident={"id": 2, "t": 9})
+        assert restored["name"] == "gen-001"
+        name, params = fw.incumbent()
+        assert name == "gen-001"
+        assert params_digest(params) == arc["rep"]["checkpoint_digest"]
+
+    def test_seeded_rerun_reproduces_digests(self, arc, cfg,
+                                             tmp_path):
+        """The determinism contract: a fresh-root rerun with the same
+        seed mines the same cells and distills a challenger with the
+        same curriculum AND checkpoint digests."""
+        fw = Flywheel(cfg, str(tmp_path / "fw-b"), **TINY)
+        cells = fw.mine(top_k=2)
+        assert cells == arc["cells"]
+        rep = fw.distill(cells, generation=1,
+                         ledger_window={"rows": 0,
+                                        "seed": TINY["seed"]})
+        assert rep["curriculum_digest"] == arc["rep"]["curriculum_digest"]
+        assert rep["checkpoint_digest"] == arc["rep"]["checkpoint_digest"]
+
+    def test_challenger_slot_guards(self, arc, cfg):
+        with pytest.raises(ValueError, match="does not exist"):
+            fw_mod.set_challenger_checkpoint("/no/such/file.npz")
+        fw_mod.set_challenger_checkpoint("")
+        with pytest.raises(ValueError, match="no challenger checkpoint"):
+            fw_mod.challenger_backend(cfg)
+        fw_mod.set_challenger_checkpoint(arc["rep"]["checkpoint"])
+        try:
+            backend = fw_mod.challenger_backend(cfg)
+            assert backend is not None
+        finally:
+            fw_mod.set_challenger_checkpoint("")
+
+    def test_challenger_candidate_registered(self):
+        from ccka_tpu.obs.tournament import CANDIDATE_BUILDERS
+
+        assert "flywheel-challenger" in CANDIDATE_BUILDERS
+
+
+@pytest.mark.slow  # ISSUE 16 lane-time rule: the full service-driven
+# two-generation arc (record → mine → distill → shadow lane → gate →
+# promote → divergence rollback) re-proves what the fast-lane artifact
+# tests and bench.py --flywheel-only's recorded gate battery already
+# cover; the fleet-service runs compile several programs.
+class TestFlywheelRunnerE2E:
+    def test_two_generations_promote_and_roll_back(self, cfg, tmp_path):
+        from ccka_tpu.harness.flywheel import FlywheelRunner
+
+        fw = Flywheel(cfg, str(tmp_path / "fw"), **dict(
+            TINY, pairs_base=2, pairs_max=3))
+        runner = FlywheelRunner(cfg, fw,
+                                scratch=str(tmp_path / "scratch"),
+                                n_tenants=4, record_ticks=8,
+                                shadow_ticks=10, watch_ticks=8,
+                                top_k=2, seed=211)
+        out = runner.run(2)
+        assert out["promotions"] >= 1
+        for g in out["generations"]:
+            if g["promoted"]:
+                assert g["decision"]["eligible"]
+                assert g["decision"]["gates"]["mean_ratio"] < 1.0
+        if out["generations"][-1]["promoted"]:
+            rb = out["rollback"]
+            assert rb["rolled_back"]
+            assert (rb["restored"]["digest"]
+                    == out["generations"][-1]["parent"]["digest"])
+
+
+class TestCLI:
+    def test_status_on_empty_root(self, tmp_path, capsys):
+        from ccka_tpu.cli import main
+
+        assert main(["flywheel", "status",
+                     "--root", str(tmp_path / "none")]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["incumbent"] == "rule" and doc["live"] is None
+
+    def test_unknown_names_rejected_up_front(self, tmp_path):
+        from ccka_tpu.cli import main
+
+        root = str(tmp_path / "fw")
+        with pytest.raises(SystemExit, match="unknown fault intensities"):
+            main(["flywheel", "mine", "--root", root,
+                  "--intensities", "off,catastrophic"])
+        with pytest.raises(SystemExit, match="unknown teacher"):
+            main(["flywheel", "distill", "--root", root,
+                  "--teacher", "oracle"])
+
+    def test_promote_without_recorded_decision_refused(self, tmp_path):
+        from ccka_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="refused"):
+            main(["flywheel", "promote",
+                  "--root", str(tmp_path / "fw"), "--generation", "1"])
+
+    def test_mine_prints_ranked_cells(self, tmp_path, capsys, ledgers):
+        from ccka_tpu.cli import main
+
+        assert main(["flywheel", "mine", "--root", str(tmp_path / "fw"),
+                     "--decisions", ledgers["decisions"],
+                     "--tournament", ledgers["tournament"],
+                     "--incidents", ledgers["incidents"],
+                     "--top-k", "3"]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert len(rows) == 3
+        assert rows[0]["workload_class"] == "inference"
+
+
+# -- bench-history flywheel invariant gates ----------------------------------
+
+
+def _good_flywheel_record():
+    """The shape `bench.py --flywheel-only` emits (BENCH_r23.json)."""
+    gen = {
+        "generation": 1, "incumbent": "rule", "promoted": True,
+        "eligible": True, "mean_ratio": 0.97,
+        "gates": {"cells_improved": True, "class_regression_ok": True,
+                  "shadow_ok": True, "provenance_ok": True,
+                  "history_ok": True},
+        "worst_class_rel_delta": {"inference": 0.0, "batch": 0.01,
+                                  "background": 0.0},
+        "shadow_outcome": "non_inferior",
+    }
+    return {
+        "stage": "--flywheel-only",
+        "provenance": {"platform": "cpu"},
+        "generations": [gen,
+                        dict(gen, generation=2, incumbent="gen-001",
+                             mean_ratio=0.99)],
+        "promotions": 2,
+        "flywheel_gate_ok": True, "provenance_ok": True,
+        "rollback_ok": True, "deterministic_ok": True,
+    }
+
+
+class TestBenchDiffFlywheelGates:
+    def _diff_of(self, tmp_path, rec):
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        (tmp_path / "BENCH_r96.json").write_text(json.dumps(rec))
+        return bench_diff(load_bench_history(str(tmp_path)))
+
+    def _fw_regressions(self, diff):
+        return [r for r in diff["regressions"]
+                if r["kind"] == "flywheel_invariant"]
+
+    def test_good_record_is_clean(self, tmp_path):
+        diff = self._diff_of(tmp_path, _good_flywheel_record())
+        assert diff["ok"], diff["regressions"]
+
+    def test_promotion_without_gate_evidence_regresses_and_cli_exits_one(
+            self, tmp_path, capsys):
+        rec = _good_flywheel_record()
+        rec["generations"][0]["gates"]["shadow_ok"] = False
+        diff = self._diff_of(tmp_path, rec)
+        assert any("without passing gate evidence" in r["detail"]
+                   for r in self._fw_regressions(diff))
+        from ccka_tpu.cli import main
+
+        assert main(["bench-diff", "--root", str(tmp_path)]) == 1
+        assert "REGRESSION" in capsys.readouterr().err
+
+    def test_promotion_without_strict_improvement_regresses(
+            self, tmp_path):
+        rec = _good_flywheel_record()
+        rec["generations"][1]["mean_ratio"] = 1.0
+        diff = self._diff_of(tmp_path, rec)
+        assert any("strict paired" in r["detail"]
+                   for r in self._fw_regressions(diff))
+
+    def test_class_regression_beyond_tolerance_regresses(self, tmp_path):
+        rec = _good_flywheel_record()
+        rec["generations"][0]["worst_class_rel_delta"]["batch"] = 0.12
+        diff = self._diff_of(tmp_path, rec)
+        assert any("regressed workload class batch" in r["detail"]
+                   for r in self._fw_regressions(diff))
+
+    def test_false_or_missing_flags_regress(self, tmp_path):
+        for key in ("flywheel_gate_ok", "provenance_ok",
+                    "rollback_ok", "deterministic_ok"):
+            rec = _good_flywheel_record()
+            rec[key] = False
+            assert not self._diff_of(tmp_path, rec)["ok"], key
+            rec = _good_flywheel_record()
+            del rec[key]
+            diff = self._diff_of(tmp_path, rec)
+            assert any("partial" in r["detail"]
+                       for r in self._fw_regressions(diff)), key
+
+    def test_real_history_is_clean_and_round23_extracted(self):
+        from ccka_tpu.obs.bench_history import (bench_diff,
+                                                load_bench_history)
+
+        history = load_bench_history(ROOT)
+        rows = [r for r in history["records"]
+                if r.get("flywheel_promotions") is not None]
+        assert rows, "BENCH_r23.json lost its flywheel columns"
+        assert rows[-1]["flywheel_promotions"] >= 2
+        assert rows[-1]["flywheel_gate_ok"] is True
+        assert rows[-1]["flywheel_rollback_ok"] is True
+        assert rows[-1]["flywheel_deterministic_ok"] is True
+        diff = bench_diff(history)
+        assert diff["ok"], diff["regressions"]
+
+
+class TestRunlogEvents:
+    def test_flywheel_events_registered(self):
+        from ccka_tpu.obs.runlog import RUNLOG_EVENTS
+
+        assert {"flywheel_mine", "flywheel_distill", "flywheel_gate",
+                "flywheel_promote",
+                "flywheel_rollback"} <= RUNLOG_EVENTS

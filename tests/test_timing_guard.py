@@ -157,6 +157,15 @@ def test_guard_scans_a_nontrivial_tree():
     assert any(os.path.join("search", "adversarial.py") in p
                for p in files)
     assert any(os.path.join("search", "axis.py") in p for p in files)
+    # Round 23: the continual-learning flywheel distills and evaluates
+    # compiled programs (factory cells, the paired neural replays) and
+    # its runner drives full fleet-service windows — training-loop
+    # timing next to device dispatch is the classic place a bare clock
+    # would measure launch latency and call it learning progress.
+    assert any(os.path.join("train", "flywheel.py") in p for p in files)
+    assert any(os.path.join("train", "mining.py") in p for p in files)
+    assert any(os.path.join("harness", "flywheel.py") in p
+               for p in files)
     assert any(os.path.join("search", "params.py") in p for p in files)
 
 

@@ -170,7 +170,10 @@ def test_params_npz_round_trip_drives_policy(tmp_path, cfg, source):
 
     from ccka_tpu.sim import initial_state
     from ccka_tpu.sim.rollout import exo_steps
-    from ccka_tpu.train.checkpoint import load_params_npz, save_params_npz
+    from ccka_tpu.train.checkpoint import (PARAMS_DIGEST_KEY,
+                                           load_params_npz,
+                                           params_digest,
+                                           save_params_npz)
     from ccka_tpu.train.ppo import PPOBackend
 
     trainer = PPOTrainer(cfg)
@@ -178,6 +181,9 @@ def test_params_npz_round_trip_drives_policy(tmp_path, cfg, source):
     meta = {"iterations_total": 7, "wins_both": False}
     path = save_params_npz(str(tmp_path / "flag.npz"), ts.params, meta=meta)
     params, got_meta = load_params_npz(path)
+    # Round 23: every save stamps the params digest next to the caller's
+    # meta, and the load path re-derives + verifies it (tamper refusal).
+    assert got_meta.pop(PARAMS_DIGEST_KEY) == params_digest(params)
     assert got_meta == meta
     # Same decide() output from original and restored params.
     exo = jax.tree.map(lambda x: x[0], exo_steps(source.trace(1)))
